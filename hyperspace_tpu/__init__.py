@@ -1,0 +1,39 @@
+"""hyperspace-tpu: a TPU-native indexing and query-acceleration framework.
+
+Capabilities of Microsoft Hyperspace (the Spark indexing subsystem), rebuilt
+TPU-first: bucketed sorted covering indexes over columnar datasets, a
+filesystem-backed operation log with optimistic concurrency and a full
+lifecycle state machine, and transparent query rewriting — filters become
+index scans, equi-joins become shuffle-free bucket-aligned sort-merge joins.
+The data plane is JAX/XLA (all_to_all bucketize under shard_map, per-shard
+sort, gather/filter and merge-join kernels); the host plane is pure Python.
+"""
+
+from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.plan.expr import col, lit
+from hyperspace_tpu.schema import Field, Schema
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "HyperspaceError",
+    "IndexConfig",
+    "col",
+    "lit",
+    "Field",
+    "Schema",
+]
+
+
+def __getattr__(name):
+    # Lazy imports so the metadata plane is importable without jax.
+    if name in ("Hyperspace", "HyperspaceSession"):
+        from hyperspace_tpu import hyperspace as _h
+
+        return getattr(_h, name)
+    if name == "Dataset":
+        from hyperspace_tpu.dataset import Dataset
+
+        return Dataset
+    raise AttributeError(name)

@@ -1,0 +1,22 @@
+from hyperspace_tpu.actions import states
+from hyperspace_tpu.actions.base import Action
+from hyperspace_tpu.actions.create import CreateAction, IndexWriter
+from hyperspace_tpu.actions.refresh import RefreshAction
+from hyperspace_tpu.actions.delete import DeleteAction
+from hyperspace_tpu.actions.restore import RestoreAction
+from hyperspace_tpu.actions.vacuum import VacuumAction
+from hyperspace_tpu.actions.cancel import CancelAction
+from hyperspace_tpu.actions.optimize import OptimizeAction
+
+__all__ = [
+    "states",
+    "Action",
+    "CreateAction",
+    "IndexWriter",
+    "RefreshAction",
+    "DeleteAction",
+    "RestoreAction",
+    "VacuumAction",
+    "CancelAction",
+    "OptimizeAction",
+]

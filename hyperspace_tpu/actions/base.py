@@ -1,0 +1,80 @@
+"""The Action protocol: a two-phase commit over the operation log.
+
+Reference parity: actions/Action.scala:33-96. Every lifecycle operation runs
+
+    run() = validate(); begin(); op(); end()
+
+where `begin` CAS-writes log id `base_id + 1` in the transient state and
+`end` CAS-writes `base_id + 2` in the final state, then swaps the
+`latestStable` pointer (Action.scala:47-73). If either CAS write loses to a
+concurrent writer, the action aborts with "Could not acquire proper state"
+(Action.scala:75-80) — single-writer optimistic concurrency.
+
+An action that dies between begin and end leaves the index in the transient
+state; `cancel` rolls it forward to the last stable state (see cancel.py).
+"""
+
+from __future__ import annotations
+
+from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu.metadata.log_entry import IndexLogEntry
+from hyperspace_tpu.metadata.log_manager import IndexLogManager
+
+
+class Action:
+    transient_state: str
+    final_state: str
+
+    def __init__(self, log_manager: IndexLogManager):
+        self.log_manager = log_manager
+        self._base_id: int | None = None
+        self._log_entry: IndexLogEntry | None = None
+
+    # -- extension points -------------------------------------------------
+    def validate(self) -> None:
+        """Raise HyperspaceError if this action is not permitted now."""
+
+    def op(self) -> None:
+        """Do the work (data plane). Default: metadata-only transition."""
+
+    def build_log_entry(self) -> IndexLogEntry:
+        """Construct the entry this action commits (lazily, once)."""
+        raise NotImplementedError
+
+    # -- protocol ---------------------------------------------------------
+    @property
+    def base_id(self) -> int:
+        if self._base_id is None:
+            latest = self.log_manager.get_latest_id()
+            self._base_id = -1 if latest is None else latest
+        return self._base_id
+
+    @property
+    def log_entry(self) -> IndexLogEntry:
+        if self._log_entry is None:
+            self._log_entry = self.build_log_entry()
+        return self._log_entry
+
+    def _save_entry(self, id: int, entry: IndexLogEntry) -> None:
+        if not self.log_manager.write_log(id, entry):
+            raise HyperspaceError(
+                "Could not acquire proper state: concurrent writer committed "
+                f"log id {id} first"
+            )
+
+    def begin(self) -> None:
+        entry = self.log_entry.with_state(self.transient_state)
+        self._save_entry(self.base_id + 1, entry)
+
+    def end(self) -> None:
+        entry = self.log_entry.with_state(self.final_state)
+        final_id = self.base_id + 2
+        self._save_entry(final_id, entry)
+        self.log_manager.delete_latest_stable_log()
+        self.log_manager.create_latest_stable_log(final_id)
+
+    def run(self) -> None:
+        self.validate()
+        self.begin()
+        self.op()
+        self.end()

@@ -1,0 +1,72 @@
+"""OptimizeAction: compact index data files (OPTIMIZING → ACTIVE).
+
+The v0.2 reference does not yet ship optimizeIndex (it arrives in later
+Hyperspace releases), but the BASELINE configs require an
+incremental-refresh + compaction loop (NYC-Taxi), so it is first-class here:
+valid from ACTIVE, op merges the per-bucket delta files produced by
+incremental refreshes into one sorted file per bucket, written to the next
+`v__=` version; the log swap makes the compacted version live.
+
+The compaction itself is injected via the same writer seam as create
+(actions/create.py) — an `IndexCompactor` with a `compact` method.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Protocol
+
+from hyperspace_tpu.actions import states
+from hyperspace_tpu.actions.base import Action
+from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu.metadata.data_manager import IndexDataManager
+from hyperspace_tpu.metadata.log_entry import IndexLogEntry
+from hyperspace_tpu.metadata.log_manager import IndexLogManager
+
+
+class IndexCompactor(Protocol):
+    def compact(self, entry: IndexLogEntry, src_path: Path, dest_path: Path) -> None: ...
+
+
+class OptimizeAction(Action):
+    transient_state = states.OPTIMIZING
+    final_state = states.ACTIVE
+
+    def __init__(
+        self,
+        log_manager: IndexLogManager,
+        data_manager: IndexDataManager,
+        compactor: IndexCompactor,
+    ):
+        super().__init__(log_manager)
+        self.data_manager = data_manager
+        self.compactor = compactor
+        self.previous_entry = log_manager.get_latest_log()
+        if self.previous_entry is None:
+            raise HyperspaceError("no index to optimize")
+
+    def validate(self) -> None:
+        if self.previous_entry.state != states.ACTIVE:
+            raise HyperspaceError(
+                f"optimize is only supported in {states.ACTIVE} state "
+                f"(found {self.previous_entry.state})"
+            )
+
+    @property
+    def _version_id(self) -> int:
+        latest = self.data_manager.get_latest_version_id()
+        return 0 if latest is None else latest + 1
+
+    def build_log_entry(self) -> IndexLogEntry:
+        entry = dataclasses.replace(self.previous_entry)
+        entry.content = dataclasses.replace(entry.content, directories=[f"v__={self._version_id}"])
+        return entry
+
+    def op(self) -> None:
+        prev_version = self.data_manager.get_latest_version_id()
+        if prev_version is None:
+            raise HyperspaceError("index has no data to optimize")
+        src = self.data_manager.get_path(prev_version)
+        dest = self.data_manager.get_path(self._version_id)
+        self.compactor.compact(self.previous_entry, src, dest)

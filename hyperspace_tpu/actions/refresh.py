@@ -1,0 +1,57 @@
+"""RefreshAction: full rebuild from logged lineage (REFRESHING → ACTIVE).
+
+Reference parity: actions/RefreshAction.scala:30-78 — deserialize the stored
+source plan (picking up new source files because the scan re-lists the live
+filesystem), re-derive the IndexConfig from the previous entry
+(RefreshAction.scala:52-55), re-run the build into the next `v__=` version.
+Valid only from ACTIVE (RefreshAction.scala:64-70).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from hyperspace_tpu.actions import states
+from hyperspace_tpu.actions.create import CreateActionBase, IndexWriter
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.metadata.data_manager import IndexDataManager
+from hyperspace_tpu.metadata.log_manager import IndexLogManager
+from hyperspace_tpu.plan.nodes import plan_from_json
+
+
+class RefreshAction(CreateActionBase):
+    transient_state = states.REFRESHING
+    final_state = states.ACTIVE
+
+    def __init__(
+        self,
+        log_manager: IndexLogManager,
+        data_manager: IndexDataManager,
+        index_path: Path,
+        conf: HyperspaceConf,
+        writer: IndexWriter,
+    ):
+        prev = log_manager.get_latest_log()
+        if prev is None:
+            raise HyperspaceError("no index to refresh")
+        self.previous_entry = prev
+        plan = plan_from_json(prev.source.plan)
+        cfg = IndexConfig(
+            prev.name,
+            prev.derived_dataset.indexed_columns,
+            prev.derived_dataset.included_columns,
+        )
+        super().__init__(plan, cfg, log_manager, data_manager, index_path, conf, writer)
+
+    def _num_buckets(self) -> int:
+        # Keep the previous bucket count stable across refreshes.
+        return self.previous_entry.derived_dataset.num_buckets
+
+    def validate(self) -> None:
+        if self.previous_entry.state != states.ACTIVE:
+            raise HyperspaceError(
+                f"refresh is only supported in {states.ACTIVE} state "
+                f"(found {self.previous_entry.state})"
+            )

@@ -1,0 +1,62 @@
+"""Framework configuration.
+
+Reference parity: index/IndexConstants.scala:21-49 — all tunables live under
+string keys with defaults, resolved at use-sites. Here they are a typed
+dataclass attached to the session (there is no SparkSession / SQLConf to
+piggyback on), plus the same string-keyed override map so tests and callers
+can set individual knobs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any
+
+# String keys (kept spiritually compatible with spark.hyperspace.* keys,
+# reference index/IndexConstants.scala:21-49).
+INDEX_SYSTEM_PATH = "hyperspace.system.path"
+INDEX_NUM_BUCKETS = "hyperspace.index.num.buckets"
+INDEX_CACHE_EXPIRY_SECONDS = "hyperspace.index.cache.expiryDurationInSeconds"
+
+# Directory-layout constants (reference index/IndexConstants.scala:38-39).
+HYPERSPACE_LOG_DIR = "_hyperspace_log"
+DATA_VERSION_PREFIX = "v__="
+LATEST_STABLE_LOG_NAME = "latestStable"
+
+DEFAULT_NUM_BUCKETS = 8
+DEFAULT_CACHE_EXPIRY_SECONDS = 300.0
+
+
+@dataclasses.dataclass
+class HyperspaceConf:
+    """Per-session configuration with string-key overrides."""
+
+    system_path: str = ""
+    num_buckets: int = DEFAULT_NUM_BUCKETS
+    cache_expiry_seconds: float = DEFAULT_CACHE_EXPIRY_SECONDS
+    overrides: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        if not self.system_path:
+            self.system_path = os.path.join(os.getcwd(), "spark-warehouse", "indexes")
+
+    def set(self, key: str, value: Any) -> None:
+        self.overrides[key] = value
+        if key == INDEX_SYSTEM_PATH:
+            self.system_path = str(value)
+        elif key == INDEX_NUM_BUCKETS:
+            self.num_buckets = int(value)
+        elif key == INDEX_CACHE_EXPIRY_SECONDS:
+            self.cache_expiry_seconds = float(value)
+
+    def get(self, key: str, default: Any = None) -> Any:
+        if key in self.overrides:
+            return self.overrides[key]
+        if key == INDEX_SYSTEM_PATH:
+            return self.system_path
+        if key == INDEX_NUM_BUCKETS:
+            return self.num_buckets
+        if key == INDEX_CACHE_EXPIRY_SECONDS:
+            return self.cache_expiry_seconds
+        return default

@@ -1,0 +1,57 @@
+"""Dataset registration: the analog of a Spark relation over data-lake files.
+
+A Dataset is a parquet directory with a derived Schema; `scan()` yields the
+plan leaf. File enumeration returns (path, size, mtime) triples — the
+identity the signature provider fingerprints (reference collects
+`PartitioningAwareFileIndex.allFiles` at actions/CreateActionBase.scala:89-97).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+
+from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu.metadata.log_entry import FileInfo
+from hyperspace_tpu.plan.nodes import Scan
+from hyperspace_tpu.schema import Schema
+
+
+def list_data_files(root: str | Path, suffix: str = ".parquet") -> list[FileInfo]:
+    """Recursively list data files under `root`, sorted by path."""
+    root = Path(root)
+    if root.is_file():
+        st = root.stat()
+        return [FileInfo(str(root), st.st_size, st.st_mtime_ns)]
+    out = []
+    for p in sorted(root.rglob(f"*{suffix}")):
+        if p.name.startswith((".", "_")):
+            continue
+        st = p.stat()
+        out.append(FileInfo(str(p), st.st_size, st.st_mtime_ns))
+    return out
+
+
+@dataclasses.dataclass
+class Dataset:
+    root: str
+    format: str
+    schema: Schema
+
+    @staticmethod
+    def parquet(root: str | Path) -> "Dataset":
+        """Register a parquet dataset, deriving the schema from the first
+        footer (all files must share it)."""
+        import pyarrow.parquet as pq
+
+        files = list_data_files(root)
+        if not files:
+            raise HyperspaceError(f"no parquet files found under {root}")
+        arrow_schema = pq.read_schema(files[0].path)
+        return Dataset(str(root), "parquet", Schema.from_arrow(arrow_schema))
+
+    def files(self) -> list[FileInfo]:
+        return list_data_files(self.root)
+
+    def scan(self) -> Scan:
+        return Scan(self.root, self.format, self.schema)

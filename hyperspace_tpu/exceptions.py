@@ -1,0 +1,13 @@
+"""Framework exception type.
+
+Reference parity: com/microsoft/hyperspace/HyperspaceException.scala:17-19 —
+a single exception class carrying a message.
+"""
+
+
+class HyperspaceError(Exception):
+    """Raised for any user-facing framework error."""
+
+    def __init__(self, msg: str):
+        super().__init__(msg)
+        self.msg = msg

@@ -1,0 +1,3 @@
+from hyperspace_tpu.index.index_config import IndexConfig
+
+__all__ = ["IndexConfig"]

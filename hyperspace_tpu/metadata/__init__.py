@@ -1,0 +1,28 @@
+from hyperspace_tpu.metadata.log_entry import (
+    Content,
+    CoveringIndex,
+    FileInfo,
+    IndexLogEntry,
+    LogEntry,
+    Fingerprint,
+    Source,
+)
+from hyperspace_tpu.metadata.log_manager import IndexLogManager
+from hyperspace_tpu.metadata.data_manager import IndexDataManager
+from hyperspace_tpu.metadata.path_resolver import PathResolver
+from hyperspace_tpu.metadata.cache import Cache, CreationTimeBasedCache
+
+__all__ = [
+    "Content",
+    "CoveringIndex",
+    "FileInfo",
+    "IndexLogEntry",
+    "LogEntry",
+    "Fingerprint",
+    "Source",
+    "IndexLogManager",
+    "IndexDataManager",
+    "PathResolver",
+    "Cache",
+    "CreationTimeBasedCache",
+]

@@ -1,0 +1,48 @@
+"""Metadata cache SPI + TTL implementation.
+
+Reference parity: index/Cache.scala:23-41 (get/set/clear SPI) and
+index/CachingIndexCollectionManager.scala:117-160
+(CreationTimeBasedIndexCache: entries expire `expiry_seconds` after they
+were set; every mutating API clears the cache).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Generic, TypeVar
+
+T = TypeVar("T")
+
+
+class Cache(Generic[T]):
+    def get(self) -> T | None:
+        raise NotImplementedError
+
+    def set(self, entry: T) -> None:
+        raise NotImplementedError
+
+    def clear(self) -> None:
+        raise NotImplementedError
+
+
+class CreationTimeBasedCache(Cache[T]):
+    def __init__(self, expiry_seconds: float):
+        self.expiry_seconds = expiry_seconds
+        self._entry: T | None = None
+        self._set_at: float = 0.0
+
+    def get(self) -> T | None:
+        if self._entry is None:
+            return None
+        if time.time() - self._set_at > self.expiry_seconds:
+            self.clear()
+            return None
+        return self._entry
+
+    def set(self, entry: T) -> None:
+        self._entry = entry
+        self._set_at = time.time()
+
+    def clear(self) -> None:
+        self._entry = None
+        self._set_at = 0.0

@@ -1,0 +1,39 @@
+from hyperspace_tpu.plan.expr import (
+    And,
+    BinOp,
+    Col,
+    Expr,
+    Lit,
+    Not,
+    Or,
+    col,
+    expr_from_json,
+    lit,
+)
+from hyperspace_tpu.plan.nodes import (
+    Filter,
+    Join,
+    LogicalPlan,
+    Project,
+    Scan,
+    plan_from_json,
+)
+
+__all__ = [
+    "And",
+    "BinOp",
+    "Col",
+    "Expr",
+    "Lit",
+    "Not",
+    "Or",
+    "col",
+    "lit",
+    "expr_from_json",
+    "Filter",
+    "Join",
+    "LogicalPlan",
+    "Project",
+    "Scan",
+    "plan_from_json",
+]

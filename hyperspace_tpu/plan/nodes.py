@@ -1,0 +1,206 @@
+"""Logical plan IR: declarative, JSON-native relational algebra.
+
+Design stance (SURVEY.md §7): the reference's most fragile subsystem is its
+Kryo plan serde (index/serde/LogicalPlanSerDeUtils.scala:37-246 + 12 wrapper
+classes) which exists only because Catalyst plans aren't serializable. Our
+plans are plain dataclasses that round-trip through JSON trivially, while
+keeping the same capability: the log entry stores the plan as lineage and
+`refresh` re-executes it (actions/RefreshAction.scala:45-50).
+
+A `Scan` stores the dataset root + format + schema — NOT a pinned file list.
+On (re-)execution the file list is derived from the live filesystem, which is
+exactly how the reference's deserialize rebuilds `InMemoryFileIndex` against
+the live session to pick up new source files
+(index/serde/LogicalPlanSerDeUtils.scala:156-223).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from hyperspace_tpu.plan.expr import Expr, expr_from_json
+from hyperspace_tpu.schema import Schema
+
+
+class LogicalPlan:
+    """Base plan node. Offers the fluent builder users treat as a DataFrame."""
+
+    def filter(self, predicate: Expr) -> "Filter":
+        return Filter(self, predicate)
+
+    def select(self, *columns: str) -> "Project":
+        return Project(self, list(columns))
+
+    def join(self, other: "LogicalPlan", left_on: list[str], right_on: list[str] | None = None) -> "Join":
+        return Join(self, other, list(left_on), list(right_on or left_on))
+
+    # -- interface --------------------------------------------------------
+    @property
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    def children(self) -> list["LogicalPlan"]:
+        raise NotImplementedError
+
+    def to_json(self) -> dict[str, Any]:
+        raise NotImplementedError
+
+    def leaves(self) -> list["Scan"]:
+        if isinstance(self, Scan):
+            return [self]
+        out: list[Scan] = []
+        for c in self.children():
+            out.extend(c.leaves())
+        return out
+
+    def is_linear(self) -> bool:
+        """True iff no node has more than one child (reference requires
+        linear sub-plans for join sides, JoinIndexRule.scala:210-211)."""
+        cs = self.children()
+        return len(cs) <= 1 and all(c.is_linear() for c in cs)
+
+
+@dataclasses.dataclass
+class Scan(LogicalPlan):
+    """Leaf: scan a registered columnar dataset (analog of
+    LogicalRelation(HadoopFsRelation) in the reference)."""
+
+    root: str
+    format: str
+    scan_schema: Schema
+    # Optional pinned file subset (used for index scans / hybrid scan);
+    # None ⇒ list the live filesystem at execution time.
+    files: list[str] | None = None
+    # Bucket spec when scanning bucketed index data (num_buckets, bucket_cols)
+    bucket_spec: tuple[int, list[str]] | None = None
+
+    @property
+    def schema(self) -> Schema:
+        return self.scan_schema
+
+    def children(self) -> list[LogicalPlan]:
+        return []
+
+    def to_json(self) -> dict[str, Any]:
+        d: dict[str, Any] = {
+            "type": "scan",
+            "root": self.root,
+            "format": self.format,
+            "schema": self.scan_schema.to_json(),
+        }
+        if self.files is not None:
+            d["files"] = self.files
+        if self.bucket_spec is not None:
+            d["bucketSpec"] = {"numBuckets": self.bucket_spec[0], "bucketColumns": self.bucket_spec[1]}
+        return d
+
+
+@dataclasses.dataclass
+class Filter(LogicalPlan):
+    child: LogicalPlan
+    predicate: Expr
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+    def children(self) -> list[LogicalPlan]:
+        return [self.child]
+
+    def to_json(self) -> dict[str, Any]:
+        return {"type": "filter", "child": self.child.to_json(), "predicate": self.predicate.to_json()}
+
+
+@dataclasses.dataclass
+class Project(LogicalPlan):
+    child: LogicalPlan
+    columns: list[str]
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema.select(self.columns)
+
+    def children(self) -> list[LogicalPlan]:
+        return [self.child]
+
+    def to_json(self) -> dict[str, Any]:
+        return {"type": "project", "child": self.child.to_json(), "columns": self.columns}
+
+
+@dataclasses.dataclass
+class Join(LogicalPlan):
+    """Inner equi-join on key column lists (reference matches CNF of EqualTo,
+    JoinIndexRule.scala:179-185; we make the equi-join structural)."""
+
+    left: LogicalPlan
+    right: LogicalPlan
+    left_on: list[str]
+    right_on: list[str]
+    how: str = "inner"
+
+    def __post_init__(self):
+        if len(self.left_on) != len(self.right_on):
+            raise ValueError("join key lists must have equal length")
+        if self.how != "inner":
+            raise ValueError("only inner equi-joins are supported")
+
+    @property
+    def schema(self) -> Schema:
+        """Join key columns appear once (values are equal by definition);
+        a non-key name collision is ambiguous and rejected."""
+        lf = self.left.schema.fields
+        left_names = {f.name.lower() for f in lf}
+        keys = {k.lower() for k in self.right_on}
+        rf = []
+        for f in self.right.schema.fields:
+            low = f.name.lower()
+            if low in keys:
+                continue  # merged into the left key column
+            if low in left_names:
+                raise ValueError(
+                    f"ambiguous non-key column {f.name!r} appears on both join sides"
+                )
+            rf.append(f)
+        return Schema(lf + tuple(rf))
+
+    def children(self) -> list[LogicalPlan]:
+        return [self.left, self.right]
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "type": "join",
+            "left": self.left.to_json(),
+            "right": self.right.to_json(),
+            "leftOn": self.left_on,
+            "rightOn": self.right_on,
+            "how": self.how,
+        }
+
+
+def plan_from_json(d: dict[str, Any]) -> LogicalPlan:
+    t = d["type"]
+    if t == "scan":
+        bs = None
+        if "bucketSpec" in d:
+            bs = (int(d["bucketSpec"]["numBuckets"]), list(d["bucketSpec"]["bucketColumns"]))
+        return Scan(
+            d["root"],
+            d["format"],
+            Schema.from_json(d["schema"]),
+            files=d.get("files"),
+            bucket_spec=bs,
+        )
+    if t == "filter":
+        return Filter(plan_from_json(d["child"]), expr_from_json(d["predicate"]))
+    if t == "project":
+        return Project(plan_from_json(d["child"]), list(d["columns"]))
+    if t == "join":
+        return Join(
+            plan_from_json(d["left"]),
+            plan_from_json(d["right"]),
+            list(d["leftOn"]),
+            list(d["rightOn"]),
+            d.get("how", "inner"),
+        )
+    raise ValueError(f"unknown plan node type {t!r}")

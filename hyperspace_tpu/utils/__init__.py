@@ -1,0 +1,17 @@
+from hyperspace_tpu.utils.file_utils import (
+    atomic_write,
+    delete_recursively,
+    read_json,
+    write_json,
+)
+from hyperspace_tpu.utils.hashing_utils import md5_hex
+from hyperspace_tpu.utils.name_utils import normalize_index_name
+
+__all__ = [
+    "atomic_write",
+    "delete_recursively",
+    "read_json",
+    "write_json",
+    "md5_hex",
+    "normalize_index_name",
+]

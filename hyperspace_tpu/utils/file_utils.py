@@ -1,0 +1,86 @@
+"""Filesystem helpers for the metadata plane.
+
+Reference parity: util/FileUtils.scala:28-117 (create/read/delete/byte IO).
+The load-bearing primitive here is `atomic_write`: the operation log's
+optimistic concurrency is "write temp file, atomically link to final name;
+loser of the race gets False" (reference: index/IndexLogManager.scala:138-154,
+which uses Hadoop's atomic rename). On POSIX we get compare-and-swap via
+`os.link` (fails with EEXIST if the target already exists) which, unlike
+`os.rename`, does not clobber.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+from pathlib import Path
+from typing import Any
+
+
+def ensure_dir(path: str | os.PathLike) -> None:
+    Path(path).mkdir(parents=True, exist_ok=True)
+
+
+def atomic_write(path: str | os.PathLike, data: bytes) -> bool:
+    """Atomically create `path` with `data`.
+
+    Returns True on success, False if `path` already exists (i.e. a
+    concurrent writer won the race). Never overwrites an existing file.
+    """
+    path = Path(path)
+    ensure_dir(path.parent)
+    fd, tmp = tempfile.mkstemp(dir=path.parent, prefix=".tmp-", suffix=path.name)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        try:
+            os.link(tmp, path)  # CAS: fails iff path exists
+            return True
+        except FileExistsError:
+            return False
+        except OSError:
+            # Filesystem without hard links (FUSE/SMB/some overlays):
+            # fall back to O_EXCL exclusive create.
+            try:
+                with open(path, "xb") as f:
+                    f.write(data)
+                    f.flush()
+                    os.fsync(f.fileno())
+                return True
+            except FileExistsError:
+                return False
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def write_json(path: str | os.PathLike, obj: Any, *, overwrite: bool = True) -> bool:
+    data = json.dumps(obj, indent=2, sort_keys=False).encode()
+    if overwrite:
+        path = Path(path)
+        ensure_dir(path.parent)
+        fd, tmp = tempfile.mkstemp(dir=path.parent, prefix=".tmp-")
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+        return True
+    return atomic_write(path, data)
+
+
+def read_json(path: str | os.PathLike) -> Any:
+    with open(path, "rb") as f:
+        return json.loads(f.read())
+
+
+def delete_recursively(path: str | os.PathLike) -> None:
+    p = Path(path)
+    if p.is_dir():
+        shutil.rmtree(p, ignore_errors=True)
+    elif p.exists():
+        p.unlink(missing_ok=True)

@@ -1,0 +1,15 @@
+"""Host-side hashing helpers.
+
+Reference parity: util/HashingUtils.scala:186-197 (MD5 hex of a string).
+Used by the file-based signature provider to fingerprint source data.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+
+def md5_hex(s: str | bytes) -> str:
+    if isinstance(s, str):
+        s = s.encode("utf-8")
+    return hashlib.md5(s).hexdigest()

@@ -1,0 +1,50 @@
+"""Test harness configuration.
+
+The analog of the reference's `local[4]` SparkSession
+(SparkInvolvedSuite.scala:99-119): multi-device is simulated with 8 virtual
+CPU devices via XLA_FLAGS, set before jax is first imported. Tests must not
+assume real TPU hardware.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def tmp_system_path(tmp_path):
+    """Per-test index system path isolation (analog of HyperspaceSuite's
+    systemPath handling, HyperspaceSuite.scala:25-75)."""
+    p = tmp_path / "indexes"
+    p.mkdir(parents=True, exist_ok=True)
+    return str(p)
+
+
+@pytest.fixture
+def sample_parquet(tmp_path):
+    """Small deterministic sample dataset (analog of SampleData.scala:141-153)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(42)
+    n = 1000
+    table = pa.table(
+        {
+            "id": pa.array(np.arange(n, dtype=np.int64)),
+            "key": pa.array(rng.integers(0, 100, size=n, dtype=np.int64)),
+            "value": pa.array(rng.standard_normal(n).astype(np.float64)),
+            "name": pa.array([f"name_{i % 37}" for i in range(n)]),
+        }
+    )
+    root = tmp_path / "sample_data"
+    root.mkdir()
+    # Two files so signatures cover multi-file listing.
+    pq.write_table(table.slice(0, n // 2), root / "part-0.parquet")
+    pq.write_table(table.slice(n // 2), root / "part-1.parquet")
+    return str(root)

@@ -1,0 +1,194 @@
+"""Action state-machine protocol tests with a fake writer.
+
+Analog of actions/ActionTest.scala:139-166 (exact writeLog(0, CREATING) →
+writeLog(1, ACTIVE) → latestStable swap sequence), the per-action validate()
+matrices (CreateActionTest etc.), and VacuumActionTest's per-version delete
+fan-out.
+"""
+
+from pathlib import Path
+
+import pytest
+
+from hyperspace_tpu import states
+from hyperspace_tpu.actions import (
+    CancelAction,
+    CreateAction,
+    DeleteAction,
+    RefreshAction,
+    RestoreAction,
+    VacuumAction,
+)
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.dataset import Dataset
+from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.metadata.data_manager import IndexDataManager
+from hyperspace_tpu.metadata.log_manager import IndexLogManager
+
+
+class FakeWriter:
+    """Records build requests and fabricates bucket files."""
+
+    def __init__(self):
+        self.calls = []
+
+    def write(self, plan, columns, indexed_columns, num_buckets, dest_path):
+        self.calls.append(
+            {
+                "columns": list(columns),
+                "indexed": list(indexed_columns),
+                "num_buckets": num_buckets,
+                "dest": str(dest_path),
+            }
+        )
+        Path(dest_path).mkdir(parents=True, exist_ok=True)
+        for b in range(num_buckets):
+            (Path(dest_path) / f"bucket-{b:05d}.parquet").write_bytes(b"fake")
+
+
+@pytest.fixture
+def ctx(tmp_system_path, sample_parquet):
+    conf = HyperspaceConf(system_path=tmp_system_path, num_buckets=4)
+    ds = Dataset.parquet(sample_parquet)
+    index_path = Path(tmp_system_path) / "idx1"
+    lm = IndexLogManager(index_path)
+    dm = IndexDataManager(index_path)
+    writer = FakeWriter()
+    cfg = IndexConfig("idx1", ["key"], ["value"])
+    return dict(conf=conf, ds=ds, index_path=index_path, lm=lm, dm=dm, writer=writer, cfg=cfg)
+
+
+def run_create(ctx):
+    action = CreateAction(
+        ctx["ds"].scan(), ctx["cfg"], ctx["lm"], ctx["dm"], ctx["index_path"], ctx["conf"], ctx["writer"]
+    )
+    action.run()
+    return action
+
+
+def test_create_protocol_sequence(ctx):
+    run_create(ctx)
+    lm = ctx["lm"]
+    # Exact write sequence: id 0 CREATING, id 1 ACTIVE, latestStable → 1.
+    assert lm.get_log(0).state == states.CREATING
+    assert lm.get_log(1).state == states.ACTIVE
+    assert lm.get_latest_id() == 1
+    stable = lm.get_latest_stable_log()
+    assert stable.id == 1 and stable.state == states.ACTIVE
+    # Entry contents.
+    entry = lm.get_latest_log()
+    assert entry.name == "idx1"
+    assert entry.indexed_columns == ["key"]
+    assert entry.included_columns == ["value"]
+    assert entry.num_buckets == 4
+    assert entry.signature.kind == "fileBased" and entry.signature.value
+    assert len(entry.source.files) == 2
+    assert entry.content.directories == ["v__=0"]
+    # Writer was invoked once with the right spec.
+    assert ctx["writer"].calls == [
+        {
+            "columns": ["key", "value"],
+            "indexed": ["key"],
+            "num_buckets": 4,
+            "dest": str(ctx["index_path"] / "v__=0"),
+        }
+    ]
+
+
+def test_create_validates_schema_and_collision(ctx):
+    bad_cfg = IndexConfig("idx1", ["nope"])
+    with pytest.raises(HyperspaceError, match="not found"):
+        CreateAction(
+            ctx["ds"].scan(), bad_cfg, ctx["lm"], ctx["dm"], ctx["index_path"], ctx["conf"], ctx["writer"]
+        ).run()
+    run_create(ctx)
+    with pytest.raises(HyperspaceError, match="already exists"):
+        run_create(ctx)
+
+
+def test_delete_restore_vacuum_lifecycle(ctx):
+    run_create(ctx)
+    lm, dm = ctx["lm"], ctx["dm"]
+
+    # Delete: valid only from ACTIVE.
+    DeleteAction(lm).run()
+    assert lm.get_latest_log().state == states.DELETED
+    with pytest.raises(HyperspaceError):
+        DeleteAction(lm).run()
+
+    # Restore: back to ACTIVE; data untouched.
+    RestoreAction(lm).run()
+    assert lm.get_latest_log().state == states.ACTIVE
+    assert dm.get_version_ids() == [0]
+    with pytest.raises(HyperspaceError):
+        RestoreAction(lm).run()  # not DELETED
+
+    # Vacuum: only from DELETED; deletes all versions.
+    with pytest.raises(HyperspaceError):
+        VacuumAction(lm, dm).run()
+    DeleteAction(lm).run()
+    VacuumAction(lm, dm).run()
+    assert lm.get_latest_log().state == states.DOESNOTEXIST
+    assert dm.get_version_ids() == []
+
+
+def test_refresh_builds_next_version(ctx, sample_parquet):
+    run_create(ctx)
+    # Append a new source file; refresh must pick it up via live listing.
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    pq.write_table(
+        pa.table(
+            {
+                "id": pa.array(np.arange(5, dtype=np.int64)),
+                "key": pa.array(np.arange(5, dtype=np.int64)),
+                "value": pa.array(np.zeros(5)),
+                "name": pa.array(["x"] * 5),
+            }
+        ),
+        Path(sample_parquet) / "part-2.parquet",
+    )
+    old_sig = ctx["lm"].get_latest_log().signature.value
+    RefreshAction(ctx["lm"], ctx["dm"], ctx["index_path"], ctx["conf"], ctx["writer"]).run()
+    entry = ctx["lm"].get_latest_log()
+    assert entry.state == states.ACTIVE
+    assert entry.content.directories == ["v__=1"]
+    assert len(entry.source.files) == 3
+    assert entry.signature.value != old_sig
+    assert ctx["dm"].get_version_ids() == [0, 1]
+    # Refresh is rejected in non-ACTIVE states.
+    DeleteAction(ctx["lm"]).run()
+    with pytest.raises(HyperspaceError):
+        RefreshAction(ctx["lm"], ctx["dm"], ctx["index_path"], ctx["conf"], ctx["writer"]).run()
+
+
+def test_cancel_rolls_forward_to_stable(ctx):
+    run_create(ctx)
+    lm = ctx["lm"]
+    # Simulate a refresh that died after begin: transient REFRESHING at id 2.
+    dead = lm.get_latest_log().with_state(states.REFRESHING)
+    assert lm.write_log(2, dead)
+    # Cancel in a stable state is rejected only when latest IS stable;
+    # here latest is transient, so cancel rolls forward to ACTIVE.
+    CancelAction(lm).run()
+    latest = lm.get_latest_log()
+    assert latest.state == states.ACTIVE
+    assert latest.id == 3
+    # Now latest is stable: cancel is rejected.
+    with pytest.raises(HyperspaceError):
+        CancelAction(lm).run()
+
+
+def test_cancel_without_stable_goes_doesnotexist(ctx):
+    # A create that died after begin: only CREATING at id 0.
+    action = CreateAction(
+        ctx["ds"].scan(), ctx["cfg"], ctx["lm"], ctx["dm"], ctx["index_path"], ctx["conf"], ctx["writer"]
+    )
+    action.validate()
+    action.begin()
+    assert ctx["lm"].get_latest_log().state == states.CREATING
+    CancelAction(ctx["lm"]).run()
+    assert ctx["lm"].get_latest_log().state == states.DOESNOTEXIST

@@ -1,0 +1,26 @@
+"""Versioned data directory discovery (analog of IndexDataManager tests)."""
+
+from hyperspace_tpu.metadata.data_manager import IndexDataManager
+
+
+def test_version_discovery(tmp_path):
+    dm = IndexDataManager(tmp_path / "idx1")
+    assert dm.get_latest_version_id() is None
+    for v in (0, 1, 3):
+        dm.get_path(v).mkdir(parents=True)
+    # Non-version dirs/files are ignored.
+    (tmp_path / "idx1" / "_hyperspace_log").mkdir()
+    (tmp_path / "idx1" / "v__=bad").mkdir()
+    assert dm.get_version_ids() == [0, 1, 3]
+    assert dm.get_latest_version_id() == 3
+    assert dm.get_path(3).name == "v__=3"
+
+
+def test_delete(tmp_path):
+    dm = IndexDataManager(tmp_path / "idx1")
+    p = dm.get_path(0)
+    p.mkdir(parents=True)
+    (p / "bucket-0.parquet").write_bytes(b"x")
+    dm.delete(0)
+    assert not p.exists()
+    assert dm.get_version_ids() == []

@@ -1,0 +1,99 @@
+"""Golden-spec tests for the log entry JSON model.
+
+Analog of index/IndexLogEntryTest.scala:25-120 which pins the exact on-disk
+JSON layout.
+"""
+
+import json
+
+from hyperspace_tpu.metadata.log_entry import (
+    Content,
+    CoveringIndex,
+    FileInfo,
+    Fingerprint,
+    IndexLogEntry,
+    Source,
+    entry_from_json,
+)
+
+
+def make_entry() -> IndexLogEntry:
+    return IndexLogEntry(
+        id=0,
+        state="ACTIVE",
+        timestamp=1234.5,
+        enabled=True,
+        name="idx1",
+        derived_dataset=CoveringIndex(
+            indexed_columns=["key"],
+            included_columns=["value"],
+            schema=[
+                {"name": "key", "dtype": "int64", "nullable": False},
+                {"name": "value", "dtype": "float64", "nullable": False},
+            ],
+            num_buckets=8,
+        ),
+        content=Content(root="/idx/idx1", directories=["v__=0"]),
+        source=Source(
+            plan={"type": "scan", "root": "/data", "format": "parquet", "schema": []},
+            fingerprint=Fingerprint("fileBased", "abc123"),
+            files=[FileInfo("/data/p0.parquet", 100, 999)],
+        ),
+        extra={},
+    )
+
+
+GOLDEN = {
+    "version": "0.1",
+    "id": 0,
+    "state": "ACTIVE",
+    "timestamp": 1234.5,
+    "enabled": True,
+    "name": "idx1",
+    "derivedDataset": {
+        "kind": "CoveringIndex",
+        "properties": {
+            "indexedColumns": ["key"],
+            "includedColumns": ["value"],
+            "schema": [
+                {"name": "key", "dtype": "int64", "nullable": False},
+                {"name": "value", "dtype": "float64", "nullable": False},
+            ],
+            "numBuckets": 8,
+        },
+    },
+    "content": {"root": "/idx/idx1", "directories": ["v__=0"]},
+    "source": {
+        "plan": {"type": "scan", "root": "/data", "format": "parquet", "schema": []},
+        "fingerprint": {"kind": "fileBased", "value": "abc123"},
+        "files": [{"path": "/data/p0.parquet", "size": 100, "mtimeNs": 999}],
+    },
+    "extra": {},
+}
+
+
+def test_to_json_matches_golden():
+    assert make_entry().to_json() == GOLDEN
+
+
+def test_round_trip():
+    entry = make_entry()
+    back = entry_from_json(json.loads(json.dumps(entry.to_json())))
+    assert back == entry
+
+
+def test_unknown_version_rejected():
+    bad = dict(GOLDEN, version="9.9")
+    try:
+        entry_from_json(bad)
+        assert False, "expected ValueError"
+    except ValueError as e:
+        assert "version" in str(e)
+
+
+def test_with_state_bumps_timestamp():
+    entry = make_entry()
+    new = entry.with_state("DELETING")
+    assert new.state == "DELETING"
+    assert new.timestamp > entry.timestamp
+    assert entry.state == "ACTIVE"  # original untouched

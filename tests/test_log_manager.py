@@ -1,0 +1,67 @@
+"""Operation log tests, incl. the CAS conflict contract.
+
+Analog of index/IndexLogManagerImplTest.scala:94-150 ("writeLog pass if no
+other file exists with same name").
+"""
+
+from hyperspace_tpu import states
+from hyperspace_tpu.metadata.log_manager import IndexLogManager
+
+from tests.test_log_entry import make_entry
+
+
+def test_write_and_read(tmp_path):
+    lm = IndexLogManager(tmp_path / "idx1")
+    entry = make_entry()
+    assert lm.write_log(0, entry)
+    got = lm.get_log(0)
+    assert got is not None and got.name == "idx1" and got.id == 0
+    assert lm.get_log(5) is None
+
+
+def test_write_log_cas_conflict(tmp_path):
+    lm = IndexLogManager(tmp_path / "idx1")
+    assert lm.write_log(0, make_entry()) is True
+    # Second write to the same id loses the race.
+    assert lm.write_log(0, make_entry()) is False
+
+
+def test_latest_id_and_log(tmp_path):
+    lm = IndexLogManager(tmp_path / "idx1")
+    assert lm.get_latest_id() is None
+    assert lm.get_latest_log() is None
+    for i in range(3):
+        e = make_entry()
+        e.state = states.CREATING if i < 2 else states.ACTIVE
+        assert lm.write_log(i, e)
+    assert lm.get_latest_id() == 2
+    assert lm.get_latest_log().state == states.ACTIVE
+
+
+def test_latest_stable_pointer_and_fallback(tmp_path):
+    lm = IndexLogManager(tmp_path / "idx1")
+    e0 = make_entry()
+    e0.state = states.CREATING
+    lm.write_log(0, e0)
+    e1 = make_entry()
+    e1.state = states.ACTIVE
+    lm.write_log(1, e1)
+
+    # No pointer yet: backward-scan fallback finds id 1.
+    got = lm.get_latest_stable_log()
+    assert got is not None and got.id == 1 and got.state == states.ACTIVE
+
+    # Create the pointer; it should now be preferred.
+    assert lm.create_latest_stable_log(1)
+    e2 = make_entry()
+    e2.state = states.DELETING
+    lm.write_log(2, e2)
+    got = lm.get_latest_stable_log()
+    assert got.id == 1 and got.state == states.ACTIVE
+
+    # Pointer to a non-stable entry is refused.
+    assert not lm.create_latest_stable_log(2)
+
+    assert lm.delete_latest_stable_log()
+    # Fallback still works after pointer deletion.
+    assert lm.get_latest_stable_log().id == 1
