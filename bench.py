@@ -1,0 +1,124 @@
+"""Benchmark: TPC-H-style lineitem point-lookup, indexed vs un-indexed.
+
+The BASELINE.json config 1 analog ("TPC-H SF1 lineitem single-column
+CoveringIndex + FilterIndexRule point-lookup"): generate a lineitem-like
+table, build a covering index on the lookup key, then time point-lookup
+queries with hyperspace enabled (bucket-pruned sorted index scan) vs
+disabled (full scan + device filter). Prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+vs_baseline normalizes against the driver's ≥5× query-speedup target
+(BASELINE.md). Auxiliary numbers (build GB/s/chip) go to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+import numpy as np
+
+
+def log(*args):
+    print(*args, file=sys.stderr, flush=True)
+
+
+def main():
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    import jax
+
+    from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col
+
+    devices = jax.devices()
+    log(f"devices: {devices}")
+
+    tmp = Path(tempfile.mkdtemp(prefix="hs_bench_"))
+    try:
+        # ---- data: lineitem-ish, ~2M rows ------------------------------
+        n = 2_000_000
+        rng = np.random.default_rng(42)
+        orderkey = rng.integers(0, n // 4, n).astype(np.int64)
+        table = pa.table(
+            {
+                "l_orderkey": orderkey,
+                "l_partkey": rng.integers(0, 200_000, n).astype(np.int64),
+                "l_quantity": rng.integers(1, 51, n).astype(np.int64),
+                "l_extendedprice": (rng.random(n) * 100_000).astype(np.float64),
+                "l_discount": (rng.random(n) * 0.1).astype(np.float64),
+            }
+        )
+        data_root = tmp / "lineitem"
+        data_root.mkdir()
+        pq.write_table(table, data_root / "part-0.parquet")
+        input_bytes = table.nbytes
+        log(f"rows={n} input={input_bytes/1e9:.3f} GB")
+
+        session = HyperspaceSession(system_path=str(tmp / "indexes"), num_buckets=64)
+        hs = Hyperspace(session)
+        df = session.parquet(data_root)
+
+        # ---- index build (report GB/s/chip to stderr) ------------------
+        t0 = time.perf_counter()
+        hs.create_index(
+            df,
+            IndexConfig(
+                "lineitem_orderkey",
+                ["l_orderkey"],
+                ["l_partkey", "l_quantity", "l_extendedprice", "l_discount"],
+            ),
+        )
+        build_s = time.perf_counter() - t0
+        gbps = input_bytes / 1e9 / build_s
+        log(f"index build: {build_s:.2f}s -> {gbps:.3f} GB/s/chip")
+
+        # ---- point lookups ---------------------------------------------
+        keys = rng.integers(0, n // 4, 12).astype(np.int64)
+
+        def run_lookups():
+            total = 0
+            for k in keys:
+                q = df.filter(col("l_orderkey") == int(k)).select(
+                    "l_orderkey", "l_partkey", "l_extendedprice"
+                )
+                total += len(session.run(q).columns["l_orderkey"])
+            return total
+
+        session.enable_hyperspace()
+        run_lookups()  # warmup (compile)
+        t0 = time.perf_counter()
+        rows_idx = run_lookups()
+        t_indexed = time.perf_counter() - t0
+
+        session.disable_hyperspace()
+        run_lookups()  # warmup
+        t0 = time.perf_counter()
+        rows_no = run_lookups()
+        t_noindex = time.perf_counter() - t0
+
+        assert rows_idx == rows_no, f"result mismatch: {rows_idx} vs {rows_no}"
+        speedup = t_noindex / t_indexed
+        log(f"indexed: {t_indexed:.3f}s  no-index: {t_noindex:.3f}s  speedup: {speedup:.2f}x")
+
+        print(
+            json.dumps(
+                {
+                    "metric": "tpch_sf1_point_lookup_speedup",
+                    "value": round(speedup, 3),
+                    "unit": "x",
+                    "vs_baseline": round(speedup / 5.0, 3),
+                }
+            )
+        )
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

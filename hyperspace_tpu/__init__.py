@@ -23,6 +23,8 @@ __all__ = [
     "lit",
     "Field",
     "Schema",
+    "Hyperspace",
+    "HyperspaceSession",
 ]
 
 

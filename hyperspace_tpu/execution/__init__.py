@@ -1,0 +1,3 @@
+from hyperspace_tpu.execution.table import ColumnTable
+
+__all__ = ["ColumnTable"]

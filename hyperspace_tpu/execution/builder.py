@@ -1,0 +1,217 @@
+"""The device build pipeline: scan → hash-bucketize → per-shard sort → persist.
+
+This is the framework's write hot path — the TPU-native re-design of the
+reference's `df.repartition(numBuckets, indexedCols)` + bucketed sorted
+Parquet write (actions/CreateActionBase.scala:99-120 and
+index/DataFrameWriterExtensions.scala:49-78):
+
+  host:   parquet → ColumnTable (strings dict-encoded) → row hashes (the
+          same uint32 function the query plane uses for bucket pruning)
+  device: all_to_all bucketize over the mesh (ops/bucketize.py — the
+          Spark-shuffle analog, riding ICI) then ONE fused lexicographic
+          lax.sort by (bucket, indexed columns) per shard
+  host:   carve the bucket-grouped, key-sorted shards into one parquet
+          file per bucket + a manifest of per-bucket row counts
+
+`DeviceIndexBuilder` implements the `IndexWriter` seam consumed by
+CreateAction/RefreshAction, and `compact` implements OptimizeAction's
+compactor seam.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from pathlib import Path
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from hyperspace_tpu.dataset import list_data_files
+from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu.execution import io as hio
+from hyperspace_tpu.execution.table import ColumnTable
+from hyperspace_tpu.ops.bucketize import AXIS, bucketize
+from hyperspace_tpu.ops.hashing import bucket_ids, combine_hashes, hash_int_column, string_dict_hashes
+from hyperspace_tpu.parallel.mesh import ensure_x64, make_mesh
+from hyperspace_tpu.plan.nodes import LogicalPlan, Scan
+
+
+def compute_row_hashes(table: ColumnTable, key_columns: list[str]) -> np.ndarray:
+    """Host-side uint32 row hash over the key columns. Deterministic and
+    dictionary-independent (ops/hashing.py), so the query plane can prune
+    buckets by recomputing the same hash on a literal."""
+    hashes = []
+    for name in key_columns:
+        f = table.schema.field(name)
+        arr = table.columns[f.name]
+        if f.is_string:
+            dh = string_dict_hashes(table.dictionaries[f.name])
+            hashes.append(dh[arr])
+        else:
+            hashes.append(hash_int_column(arr, np))
+    return combine_hashes(hashes, np)
+
+
+def hash_scalar_key(values: list, fields) -> np.ndarray:
+    """Hash one key tuple (for bucket pruning at query time)."""
+    hs = []
+    for v, f in zip(values, fields):
+        if f.is_string:
+            hs.append(string_dict_hashes(np.array([v], dtype=object)))
+        else:
+            hs.append(hash_int_column(np.array([v], dtype=f.device_dtype), np))
+    return combine_hashes(hs, np)
+
+
+@functools.lru_cache(maxsize=64)
+def _make_local_sort(mesh: Mesh, num_keys: int, num_payloads: int):
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(AXIS),) * (1 + num_keys + num_payloads),
+        out_specs=(P(AXIS),) * (1 + num_keys + num_payloads),
+    )
+    def fn(*arrays):
+        # arrays = (bucket, keys..., payloads...); invalid rows carry the
+        # sentinel bucket so they sink to the end of the shard.
+        return lax.sort(arrays, num_keys=1 + num_keys, is_stable=True)
+
+    return jax.jit(fn)
+
+
+def _pad_to(arr: np.ndarray, n: int, fill=0) -> np.ndarray:
+    if len(arr) == n:
+        return arr
+    pad = np.full((n - len(arr),) + arr.shape[1:], fill, dtype=arr.dtype)
+    return np.concatenate([arr, pad])
+
+
+class DeviceIndexBuilder:
+    """IndexWriter over a device mesh (defaults to all local devices)."""
+
+    def __init__(self, mesh: Mesh | None = None, capacity_factor: float = 2.0):
+        self._mesh = mesh
+        self.capacity_factor = capacity_factor
+
+    def _mesh_for(self, num_buckets: int) -> Mesh:
+        mesh = self._mesh if self._mesh is not None else make_mesh()
+        d = mesh.shape[AXIS]
+        if num_buckets % d == 0:
+            return mesh
+        # Shrink to the largest device count dividing num_buckets.
+        while num_buckets % d != 0:
+            d -= 1
+        return make_mesh(list(mesh.devices.flat), n=d)
+
+    # -- IndexWriter -----------------------------------------------------
+    def write(
+        self,
+        plan: LogicalPlan,
+        columns: list[str],
+        indexed_columns: list[str],
+        num_buckets: int,
+        dest_path: Path,
+    ) -> None:
+        table = self._materialize(plan, columns)
+        self.write_table(table, indexed_columns, num_buckets, dest_path)
+
+    def write_table(
+        self,
+        table: ColumnTable,
+        indexed_columns: list[str],
+        num_buckets: int,
+        dest_path: Path,
+    ) -> None:
+        ensure_x64()
+        mesh = self._mesh_for(num_buckets)
+        d = mesh.shape[AXIS]
+        n = table.num_rows
+
+        # Host: bucket assignment from the canonical row hash.
+        row_hash = compute_row_hashes(table, indexed_columns)
+        bucket = bucket_ids(row_hash, num_buckets, np)
+
+        # Pad rows to a multiple of the mesh size.
+        n_pad = max(d, math.ceil(max(n, 1) / d) * d)
+        valid = _pad_to(np.ones(n, np.int32), n_pad)
+        bucket = _pad_to(bucket, n_pad)
+
+        field_names = [f.name for f in table.schema.fields]
+        key_names = [table.schema.field(c).name for c in indexed_columns]
+        payload_names = [c for c in field_names if c not in key_names]
+        ordered = key_names + payload_names
+
+        cols = [_pad_to(self._device_repr(table, c), n_pad) for c in ordered]
+
+        # Device: the exchange (Spark-shuffle analog, single all_to_all).
+        out_cols, out_bucket, out_valid = bucketize(
+            mesh, [jnp.asarray(c) for c in cols], jnp.asarray(bucket), jnp.asarray(valid),
+            num_buckets, self.capacity_factor,
+        )
+
+        # Device: fused lex sort by (bucket, indexed cols) per shard.
+        sort_fn = _make_local_sort(mesh, len(key_names), len(payload_names))
+        sorted_arrays = sort_fn(out_bucket, *out_cols)
+        out_bucket = np.asarray(jax.device_get(sorted_arrays[0]))
+        host_cols = [np.asarray(jax.device_get(a)) for a in sorted_arrays[1:]]
+        out_valid_host = out_bucket < num_buckets  # sentinel marks invalid
+
+        # Host: compact and carve into per-bucket files.
+        compact_bucket = out_bucket[out_valid_host]
+        compact_cols = {name: arr[out_valid_host] for name, arr in zip(ordered, host_cols)}
+        if len(compact_bucket) != n:
+            raise HyperspaceError(
+                f"row count changed through exchange: {n} → {len(compact_bucket)}"
+            )
+        # Devices own contiguous bucket ranges in mesh order and each shard
+        # is bucket-sorted, so the compacted global bucket array is sorted.
+        result = ColumnTable(
+            table.schema.select(ordered),
+            {k: self._logical_repr(table, k, v) for k, v in compact_cols.items()},
+            dict(table.dictionaries),
+        )
+        bucket_rows = []
+        starts = np.searchsorted(compact_bucket, np.arange(num_buckets + 1))
+        dest = Path(dest_path)
+        for b in range(num_buckets):
+            lo, hi = int(starts[b]), int(starts[b + 1])
+            hio.write_bucket(dest, b, result.take(np.arange(lo, hi)))
+            bucket_rows.append(hi - lo)
+        hio.write_manifest(dest, num_buckets, indexed_columns, bucket_rows)
+
+    # -- OptimizeAction's compactor seam ---------------------------------
+    def compact(self, entry, src_path: Path, dest_path: Path) -> None:
+        """Merge all files of each bucket (base + deltas) into one sorted
+        file per bucket in the new version dir."""
+        num_buckets = entry.derived_dataset.num_buckets
+        indexed = entry.derived_dataset.indexed_columns
+        files = [fi.path for fi in list_data_files(src_path)]
+        table = hio.read_parquet(files)
+        self.write_table(table, indexed, num_buckets, dest_path)
+
+    # -- helpers ---------------------------------------------------------
+    def _materialize(self, plan: LogicalPlan, columns: list[str]) -> ColumnTable:
+        if not isinstance(plan, Scan):
+            raise HyperspaceError("index builds materialize scan-only plans")
+        files = plan.files if plan.files is not None else [fi.path for fi in list_data_files(plan.root)]
+        return hio.read_parquet(files, columns=columns, schema=plan.schema)
+
+    @staticmethod
+    def _device_repr(table: ColumnTable, name: str) -> np.ndarray:
+        arr = table.columns[name]
+        if arr.dtype == np.bool_:
+            return arr.astype(np.int32)
+        return arr
+
+    @staticmethod
+    def _logical_repr(table: ColumnTable, name: str, arr: np.ndarray) -> np.ndarray:
+        orig = table.columns[name]
+        if orig.dtype == np.bool_:
+            return arr.astype(np.bool_)
+        return arr.astype(orig.dtype, copy=False)

@@ -1,0 +1,262 @@
+"""Plan executor: runs logical plans against the device plane.
+
+The analog of Spark's physical planning + execution for the four node types
+our IR has (SURVEY.md §7 design stance). What matters for TPU performance:
+
+- **bucket pruning** (Filter over an index scan with equality literals on
+  every bucket column): recompute the canonical row hash on the literal
+  tuple and read ONLY that bucket's file — the reference cannot do this
+  (its FilterIndexRule keeps a full scan, FilterIndexRule.scala:114-120);
+  for a point lookup this divides IO by numBuckets;
+- **zero-exchange join** (Join over two index scans bucketed on the join
+  keys with equal bucket counts): per-bucket sort-merge join, all buckets
+  in one vmapped device kernel (ops/join.py) — the analog of the
+  reference's shuffle-free SortMergeJoin;
+- predicates evaluate as one fused XLA computation (ops/filter.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+
+import numpy as np
+
+from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu.execution import io as hio
+from hyperspace_tpu.execution.builder import hash_scalar_key
+from hyperspace_tpu.execution.table import ColumnTable
+from hyperspace_tpu.dataset import list_data_files
+from hyperspace_tpu.ops.filter import apply_filter
+from hyperspace_tpu.ops.hashing import bucket_ids
+from hyperspace_tpu.ops import join as join_ops
+from hyperspace_tpu.plan.expr import BinOp, Col, Expr, Lit, split_conjuncts
+from hyperspace_tpu.plan.nodes import Filter, Join, LogicalPlan, Project, Scan
+
+
+@dataclasses.dataclass
+class AlignedSide:
+    scan: Scan
+    project: list[str] | None  # columns to keep after the join gather
+
+
+class Executor:
+    def execute(self, plan: LogicalPlan) -> ColumnTable:
+        if isinstance(plan, Scan):
+            return self._scan(plan)
+        if isinstance(plan, Filter):
+            return self._filter(plan)
+        if isinstance(plan, Project):
+            return self.execute(plan.child).select(plan.columns)
+        if isinstance(plan, Join):
+            return self._join(plan)
+        raise HyperspaceError(f"cannot execute plan node {type(plan).__name__}")
+
+    # -- scan ------------------------------------------------------------
+    def _scan_files(self, scan: Scan) -> list[str]:
+        if scan.files is not None:
+            return list(scan.files)
+        return [fi.path for fi in list_data_files(scan.root)]
+
+    def _scan(self, scan: Scan, columns: list[str] | None = None) -> ColumnTable:
+        files = self._scan_files(scan)
+        cols = columns if columns is not None else scan.scan_schema.names
+        return hio.read_parquet(files, columns=cols, schema=scan.scan_schema)
+
+    # -- filter (with index bucket pruning) ------------------------------
+    def _filter(self, plan: Filter) -> ColumnTable:
+        child = plan.child
+        if isinstance(child, Scan) and child.bucket_spec is not None:
+            pruned = self._prune_bucket_files(child, plan.predicate)
+            if pruned is not None:
+                table = hio.read_parquet(pruned, columns=child.scan_schema.names, schema=child.scan_schema)
+                return apply_filter(table, plan.predicate)
+        return apply_filter(self.execute(child), plan.predicate)
+
+    def _prune_bucket_files(self, scan: Scan, predicate: Expr) -> list[str] | None:
+        """If the predicate pins every bucket column with an equality
+        literal, return only the owning bucket's file."""
+        num_buckets, bucket_cols = scan.bucket_spec
+        eq_lits: dict[str, object] = {}
+        for conj in split_conjuncts(predicate):
+            if isinstance(conj, BinOp) and conj.op == "eq":
+                if isinstance(conj.left, Col) and isinstance(conj.right, Lit):
+                    eq_lits[conj.left.name.lower()] = conj.right.value
+                elif isinstance(conj.right, Col) and isinstance(conj.left, Lit):
+                    eq_lits[conj.right.name.lower()] = conj.left.value
+        try:
+            values = [eq_lits[c.lower()] for c in bucket_cols]
+        except KeyError:
+            return None
+        fields = [scan.scan_schema.field(c) for c in bucket_cols]
+        h = hash_scalar_key(values, fields)
+        b = int(bucket_ids(h, num_buckets, np)[0])
+        files = self._scan_files(scan)
+        name = hio.bucket_file_name(b)
+        matches = [f for f in files if Path(f).name == name]
+        return matches if matches else None
+
+    # -- join ------------------------------------------------------------
+    def _join(self, plan: Join) -> ColumnTable:
+        left_side = self._aligned_side(plan.left)
+        right_side = self._aligned_side(plan.right)
+        if (
+            left_side is not None
+            and right_side is not None
+            and left_side.scan.bucket_spec is not None
+            and right_side.scan.bucket_spec is not None
+            and left_side.scan.bucket_spec[0] == right_side.scan.bucket_spec[0]
+            and [c.lower() for c in left_side.scan.bucket_spec[1]] == [c.lower() for c in plan.left_on]
+            and [c.lower() for c in right_side.scan.bucket_spec[1]] == [c.lower() for c in plan.right_on]
+        ):
+            return self._aligned_join(plan, left_side, right_side)
+        # General path: single partition (bucket count 1).
+        lt = self.execute(plan.left)
+        rt = self.execute(plan.right)
+        return self._partition_join(plan, [lt], [rt], presorted=False)
+
+    def _aligned_side(self, plan: LogicalPlan) -> AlignedSide | None:
+        if isinstance(plan, Scan):
+            return AlignedSide(plan, None)
+        if isinstance(plan, Project) and isinstance(plan.child, Scan):
+            return AlignedSide(plan.child, plan.columns)
+        return None
+
+    def _aligned_join(self, plan: Join, left: AlignedSide, right: AlignedSide) -> ColumnTable:
+        """Per-bucket zero-exchange SMJ: read bucket b of each side, join
+        bucket-locally in one vmapped kernel."""
+        num_buckets = left.scan.bucket_spec[0]
+        lfiles = self._bucket_files_in_order(left.scan, num_buckets)
+        rfiles = self._bucket_files_in_order(right.scan, num_buckets)
+        ltables = [
+            hio.read_parquet([f], columns=left.scan.scan_schema.names, schema=left.scan.scan_schema)
+            for f in lfiles
+        ]
+        rtables = [
+            hio.read_parquet([f], columns=right.scan.scan_schema.names, schema=right.scan.scan_schema)
+            for f in rfiles
+        ]
+        out = self._partition_join(plan, ltables, rtables, presorted=True)
+        cols = None
+        if left.project is not None or right.project is not None:
+            keep = list(left.project if left.project is not None else left.scan.scan_schema.names)
+            rkeys = {k.lower() for k in plan.right_on}
+            for c in right.project if right.project is not None else right.scan.scan_schema.names:
+                if c.lower() not in rkeys and c.lower() not in {k.lower() for k in keep}:
+                    keep.append(c)
+            cols = keep
+        return out.select(cols) if cols is not None else out
+
+    def _bucket_files_in_order(self, scan: Scan, num_buckets: int) -> list[str]:
+        files = self._scan_files(scan)
+        by_name = {Path(f).name: f for f in files}
+        out = []
+        for b in range(num_buckets):
+            name = hio.bucket_file_name(b)
+            if name not in by_name:
+                raise HyperspaceError(f"missing bucket file {name} in {scan.root}")
+            out.append(by_name[name])
+        return out
+
+    def _partition_join(
+        self,
+        plan: Join,
+        ltables: list[ColumnTable],
+        rtables: list[ColumnTable],
+        presorted: bool,
+    ) -> ColumnTable:
+        """Join partition i of left with partition i of right, concat."""
+        lkeys = [ltables[0].schema.field(c).name for c in plan.left_on]
+        rkeys = [rtables[0].schema.field(c).name for c in plan.right_on]
+
+        # Shared order-preserving factorization of the key tuples.
+        lcodes, rcodes = _factorize_keys(ltables, rtables, lkeys, rkeys)
+
+        b = len(ltables)
+        lmax = max((len(c) for c in lcodes), default=1) or 1
+        rmax = max((len(c) for c in rcodes), default=1) or 1
+        lk = np.full((b, lmax), join_ops.SENTINEL, dtype=np.int64)
+        rk = np.full((b, rmax), join_ops.SENTINEL, dtype=np.int64)
+        lorder = []
+        rorder = []
+        for i in range(b):
+            lo = np.argsort(lcodes[i], kind="stable") if not presorted else np.arange(len(lcodes[i]))
+            ro = np.argsort(rcodes[i], kind="stable") if not presorted else np.arange(len(rcodes[i]))
+            # Even "presorted" index buckets are verified cheaply.
+            lc = lcodes[i][lo]
+            rc = rcodes[i][ro]
+            if presorted and (np.any(np.diff(lc) < 0) or np.any(np.diff(rc) < 0)):
+                lo = np.argsort(lcodes[i], kind="stable")
+                ro = np.argsort(rcodes[i], kind="stable")
+                lc = lcodes[i][lo]
+                rc = rcodes[i][ro]
+            lk[i, : len(lc)] = lc
+            rk[i, : len(rc)] = rc
+            lorder.append(lo)
+            rorder.append(ro)
+
+        li, ri, valid = join_ops.merge_join(lk, rk)
+
+        # Gather output rows per partition on host.
+        rkeys_low = {k.lower() for k in rkeys}
+        out_parts: list[ColumnTable] = []
+        out_schema = plan.schema
+        for i in range(b):
+            v = valid[i]
+            lidx = lorder[i][li[i][v]]
+            ridx = rorder[i][ri[i][v]]
+            lt, rt = ltables[i], rtables[i]
+            cols: dict[str, np.ndarray] = {}
+            dicts: dict[str, np.ndarray] = {}
+            for f in lt.schema.fields:
+                cols[f.name] = lt.columns[f.name][lidx]
+                if f.name in lt.dictionaries:
+                    dicts[f.name] = lt.dictionaries[f.name]
+            for f in rt.schema.fields:
+                if f.name.lower() in rkeys_low:
+                    continue
+                cols[f.name] = rt.columns[f.name][ridx]
+                if f.name in rt.dictionaries:
+                    dicts[f.name] = rt.dictionaries[f.name]
+            out_parts.append(ColumnTable(out_schema, cols, dicts))
+        return ColumnTable.concat(out_parts)
+
+
+def _factorize_keys(ltables, rtables, lkeys, rkeys):
+    """Map each partition's key tuples to a shared int64 code space whose
+    order matches the lexicographic order of the raw key tuples."""
+    per_col_codes_l: list[list[np.ndarray]] = [[] for _ in ltables]
+    per_col_codes_r: list[list[np.ndarray]] = [[] for _ in rtables]
+    cards: list[int] = []
+    for lname, rname in zip(lkeys, rkeys):
+        lvals = [_logical_key(t, lname) for t in ltables]
+        rvals = [_logical_key(t, rname) for t in rtables]
+        allv = np.concatenate(lvals + rvals) if (lvals or rvals) else np.array([])
+        uniq, inv = np.unique(allv, return_inverse=True)
+        cards.append(max(len(uniq), 1))
+        pos = 0
+        for i, v in enumerate(lvals):
+            per_col_codes_l[i].append(inv[pos : pos + len(v)])
+            pos += len(v)
+        for i, v in enumerate(rvals):
+            per_col_codes_r[i].append(inv[pos : pos + len(v)])
+            pos += len(v)
+
+    def combine(per_part):
+        out = []
+        for codes in per_part:
+            acc = np.zeros(len(codes[0]) if codes else 0, dtype=np.int64)
+            for c, k in zip(codes, cards):
+                acc = acc * np.int64(k) + c.astype(np.int64)
+            out.append(acc)
+        return out
+
+    return combine(per_col_codes_l), combine(per_col_codes_r)
+
+
+def _logical_key(table: ColumnTable, name: str) -> np.ndarray:
+    f = table.schema.field(name)
+    arr = table.columns[f.name]
+    if f.is_string:
+        return table.dictionaries[f.name][arr]
+    return arr

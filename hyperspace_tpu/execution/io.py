@@ -1,0 +1,60 @@
+"""Parquet IO: host staging between disk and the device plane.
+
+The analog of Spark's FileSourceScanExec + vectorized Parquet read
+(SURVEY.md §2.2). Reads go through pyarrow into ColumnTable (strings
+dictionary-encoded); writes emit one sorted parquet file per bucket plus a
+`_index_manifest.json` with per-bucket row counts — the manifest is what
+enables query-time bucket pruning and hybrid-scan planning without opening
+every footer.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu.execution.table import ColumnTable
+from hyperspace_tpu.schema import Schema
+
+MANIFEST_NAME = "_index_manifest.json"
+
+
+def read_parquet(files: list[str], columns: list[str] | None = None, schema: Schema | None = None) -> ColumnTable:
+    if not files:
+        raise HyperspaceError("no files to read")
+    tables = [pq.read_table(f, columns=columns) for f in files]
+    table = pa.concat_tables(tables, promote_options="default") if len(tables) > 1 else tables[0]
+    if schema is not None and columns is not None:
+        schema = schema.select(columns)
+    return ColumnTable.from_arrow(table, schema)
+
+
+def bucket_file_name(bucket: int) -> str:
+    return f"bucket-{bucket:05d}.parquet"
+
+
+def write_bucket(dest_dir: Path, bucket: int, table: ColumnTable) -> None:
+    dest_dir.mkdir(parents=True, exist_ok=True)
+    pq.write_table(table.to_arrow(), dest_dir / bucket_file_name(bucket))
+
+
+def write_manifest(dest_dir: Path, num_buckets: int, indexed_columns: list[str], bucket_rows: list[int]) -> None:
+    dest_dir.mkdir(parents=True, exist_ok=True)
+    manifest = {
+        "numBuckets": num_buckets,
+        "indexedColumns": indexed_columns,
+        "bucketRows": bucket_rows,
+    }
+    (dest_dir / MANIFEST_NAME).write_text(json.dumps(manifest, indent=2))
+
+
+def read_manifest(version_dir: Path) -> dict | None:
+    p = Path(version_dir) / MANIFEST_NAME
+    if not p.exists():
+        return None
+    return json.loads(p.read_text())

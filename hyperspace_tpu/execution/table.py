@@ -1,0 +1,175 @@
+"""ColumnTable: the host-side columnar container feeding the device plane.
+
+Struct-of-arrays over numpy, with TPU-compatible physical types only:
+
+- numerics/bools/dates map directly;
+- strings are dictionary-encoded with a SORTED dictionary, so int32 codes
+  preserve the string sort order — equality AND range predicates evaluate
+  correctly on codes once literals are translated (schema.py describes the
+  logical types).
+
+This is the analog of the reference's reliance on Spark's columnar batches
+(FileSourceScanExec / vectorized Parquet read, SURVEY.md §2.2) — but as an
+explicit host staging structure that uploads to `jax.Array`s.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterable
+
+import numpy as np
+
+from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu.schema import Schema
+
+
+@dataclasses.dataclass
+class ColumnTable:
+    schema: Schema
+    columns: dict[str, np.ndarray]  # physical arrays (codes for strings)
+    dictionaries: dict[str, np.ndarray]  # string name -> sorted object array
+
+    def __post_init__(self):
+        lens = {len(v) for v in self.columns.values()}
+        if len(lens) > 1:
+            raise HyperspaceError(f"ragged columns: {lens}")
+
+    @property
+    def num_rows(self) -> int:
+        if not self.columns:
+            return 0
+        return len(next(iter(self.columns.values())))
+
+    def column(self, name: str) -> np.ndarray:
+        f = self.schema.field(name)
+        return self.columns[f.name]
+
+    def dictionary(self, name: str) -> np.ndarray | None:
+        f = self.schema.field(name)
+        return self.dictionaries.get(f.name)
+
+    # -- construction ----------------------------------------------------
+    @staticmethod
+    def from_arrow(table, schema: Schema | None = None) -> "ColumnTable":
+        """Build from a pyarrow Table, dictionary-encoding string columns."""
+        if schema is None:
+            schema = Schema.from_arrow(table.schema)
+        columns: dict[str, np.ndarray] = {}
+        dictionaries: dict[str, np.ndarray] = {}
+        for f in schema.fields:
+            arr = table.column(f.name)
+            if arr.null_count:
+                # Nulls would silently corrupt: arrow→numpy turns int nulls
+                # into NaN→INT_MIN and string nulls into the value "nan".
+                raise HyperspaceError(
+                    f"column {f.name!r} contains {arr.null_count} null values; "
+                    "null handling is not supported — drop or fill nulls first"
+                )
+            if f.is_string:
+                values = arr.to_pandas().to_numpy(dtype=object)
+                # np.unique gives a sorted dictionary + inverse codes, so
+                # codes are order-preserving.
+                dictionary, codes = np.unique(values.astype(str), return_inverse=True)
+                columns[f.name] = codes.astype(np.int32)
+                dictionaries[f.name] = dictionary
+            else:
+                import pyarrow as pa
+
+                if f.dtype == "date":
+                    arr = arr.cast(pa.int32())
+                elif f.dtype == "timestamp":
+                    arr = arr.cast(pa.int64())
+                np_arr = arr.to_numpy(zero_copy_only=False)
+                columns[f.name] = np.ascontiguousarray(np_arr).astype(f.device_dtype, copy=False)
+        return ColumnTable(schema, columns, dictionaries)
+
+    @staticmethod
+    def from_numpy(schema: Schema, columns: dict[str, np.ndarray], dictionaries=None) -> "ColumnTable":
+        return ColumnTable(schema, dict(columns), dict(dictionaries or {}))
+
+    # -- transforms ------------------------------------------------------
+    def select(self, names: Iterable[str]) -> "ColumnTable":
+        names = list(names)
+        sub = self.schema.select(names)
+        cols = {f.name: self.columns[f.name] for f in sub.fields}
+        dicts = {f.name: self.dictionaries[f.name] for f in sub.fields if f.name in self.dictionaries}
+        return ColumnTable(sub, cols, dicts)
+
+    def take(self, indices: np.ndarray) -> "ColumnTable":
+        cols = {k: v[indices] for k, v in self.columns.items()}
+        return ColumnTable(self.schema, cols, dict(self.dictionaries))
+
+    def filter_mask(self, mask: np.ndarray) -> "ColumnTable":
+        cols = {k: v[mask] for k, v in self.columns.items()}
+        return ColumnTable(self.schema, cols, dict(self.dictionaries))
+
+    def translate_literal(self, column: str, value: Any, op: str) -> Any:
+        """Map a literal to the physical domain of `column`.
+
+        For string columns, translate a string literal to the dictionary
+        code domain such that comparisons on codes are equivalent:
+        - present in dict: its code works for all comparison ops;
+        - absent: use the insertion point; eq ⇒ impossible (-1 with ne
+          semantics handled by caller via code space), lt/ge boundaries
+          still correct because the dictionary is sorted.
+        """
+        f = self.schema.field(column)
+        if not f.is_string:
+            return value
+        d = self.dictionaries.get(f.name)
+        if d is None:
+            raise HyperspaceError(f"no dictionary for string column {column!r}")
+        pos = int(np.searchsorted(d, value))
+        present = pos < len(d) and d[pos] == value
+        if present:
+            return pos
+        # Absent literal: map so code-domain comparison stays correct.
+        if op in ("eq",):
+            return -1  # no code is -1 ⇒ always false
+        if op in ("ne",):
+            return -1  # all codes != -1 ⇒ always true
+        if op in ("lt", "ge"):
+            return pos  # codes < pos are strictly smaller strings
+        if op in ("le",):
+            return pos - 1 if pos > 0 else -1
+        if op in ("gt",):
+            return pos - 1 if pos > 0 else -1
+        return pos
+
+    def decode(self) -> dict[str, np.ndarray]:
+        """Materialize logical values (strings decoded) for result checks."""
+        out = {}
+        for f in self.schema.fields:
+            arr = self.columns[f.name]
+            if f.is_string:
+                out[f.name] = self.dictionaries[f.name][arr]
+            else:
+                out[f.name] = arr
+        return out
+
+    def to_arrow(self):
+        import pyarrow as pa
+
+        return pa.table({k: v for k, v in self.decode().items()})
+
+    @staticmethod
+    def concat(tables: list["ColumnTable"]) -> "ColumnTable":
+        """Concatenate tables with the same schema, re-encoding string
+        columns onto a merged dictionary."""
+        if not tables:
+            raise HyperspaceError("cannot concat zero tables")
+        if len(tables) == 1:
+            return tables[0]
+        schema = tables[0].schema
+        cols: dict[str, np.ndarray] = {}
+        dicts: dict[str, np.ndarray] = {}
+        for f in schema.fields:
+            if f.is_string:
+                decoded = np.concatenate([t.dictionaries[f.name][t.columns[f.name]] for t in tables])
+                dictionary, codes = np.unique(decoded.astype(str), return_inverse=True)
+                cols[f.name] = codes.astype(np.int32)
+                dicts[f.name] = dictionary
+            else:
+                cols[f.name] = np.concatenate([t.columns[f.name] for t in tables])
+        return ColumnTable(schema, cols, dicts)

@@ -1,0 +1,3 @@
+from hyperspace_tpu.explain.plan_analyzer import explain_string, pretty_plan
+
+__all__ = ["explain_string", "pretty_plan"]

@@ -1,0 +1,121 @@
+"""User facade and session integration.
+
+Reference parity: com/microsoft/hyperspace/Hyperspace.scala:24-133 (the 8
+user APIs delegating to the collection manager, with a context holding the
+session + caching manager) and package.scala:34-77 (enable/disable toggling
+the optimizer rule batch). There is no SparkSession here; `HyperspaceSession`
+owns the configuration, the device mesh, the executor, and the
+enable/disable switch, and `session.run(plan)` is the query entry point
+that applies the rewrite rules when enabled.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.dataset import Dataset
+from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu.index.collection_manager import CachingIndexCollectionManager
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.plan.nodes import LogicalPlan, Scan
+from hyperspace_tpu.rules.base import apply_rules
+
+
+class HyperspaceSession:
+    """The engine session: configuration + mesh + executor + rule toggle."""
+
+    def __init__(self, system_path: str | None = None, num_buckets: int | None = None, mesh=None):
+        kwargs = {}
+        if system_path is not None:
+            kwargs["system_path"] = str(system_path)
+        if num_buckets is not None:
+            kwargs["num_buckets"] = int(num_buckets)
+        self.conf = HyperspaceConf(**kwargs)
+        self.mesh = mesh
+        self._enabled = False
+        self._manager: CachingIndexCollectionManager | None = None
+
+    # -- rule toggle (package.scala:46-70) --------------------------------
+    def enable_hyperspace(self) -> "HyperspaceSession":
+        self._enabled = True
+        return self
+
+    def disable_hyperspace(self) -> "HyperspaceSession":
+        self._enabled = False
+        return self
+
+    def is_hyperspace_enabled(self) -> bool:
+        return self._enabled
+
+    # -- wiring -----------------------------------------------------------
+    @property
+    def manager(self) -> CachingIndexCollectionManager:
+        if self._manager is None:
+            def writer_factory():
+                from hyperspace_tpu.execution.builder import DeviceIndexBuilder
+
+                return DeviceIndexBuilder(mesh=self.mesh)
+
+            self._manager = CachingIndexCollectionManager(self.conf, writer_factory)
+        return self._manager
+
+    # -- data access ------------------------------------------------------
+    def parquet(self, root: str | Path) -> Scan:
+        """Register a parquet dataset and return its scan plan (the
+        DataFrame-equivalent; LogicalPlan carries the fluent API)."""
+        return Dataset.parquet(root).scan()
+
+    def optimized_plan(self, plan: LogicalPlan) -> LogicalPlan:
+        if not self._enabled:
+            return plan
+        indexes = self.manager.get_indexes()
+        return apply_rules(plan, indexes)
+
+    def run(self, plan: LogicalPlan):
+        """Execute a plan (rewriting through indexes when enabled);
+        returns a ColumnTable."""
+        from hyperspace_tpu.execution.executor import Executor
+
+        return Executor().execute(self.optimized_plan(plan))
+
+    def to_pandas(self, plan: LogicalPlan):
+        import pandas as pd
+
+        return pd.DataFrame(self.run(plan).decode())
+
+
+class Hyperspace:
+    """The 8-method user API (Hyperspace.scala:32-104)."""
+
+    def __init__(self, session: HyperspaceSession):
+        self.session = session
+
+    def create_index(self, plan: LogicalPlan, index_config: IndexConfig) -> None:
+        self.session.manager.create(plan, index_config)
+
+    def delete_index(self, name: str) -> None:
+        self.session.manager.delete(name)
+
+    def restore_index(self, name: str) -> None:
+        self.session.manager.restore(name)
+
+    def vacuum_index(self, name: str) -> None:
+        self.session.manager.vacuum(name)
+
+    def refresh_index(self, name: str) -> None:
+        self.session.manager.refresh(name)
+
+    def optimize_index(self, name: str) -> None:
+        self.session.manager.optimize(name)
+
+    def cancel(self, name: str) -> None:
+        self.session.manager.cancel(name)
+
+    def indexes(self):
+        return self.session.manager.indexes()
+
+    def explain(self, plan: LogicalPlan, verbose: bool = False) -> str:
+        from hyperspace_tpu.explain.plan_analyzer import explain_string
+
+        return explain_string(plan, self.session, verbose=verbose)

@@ -1,0 +1,167 @@
+"""Index collection management: wiring actions to per-index managers.
+
+Reference parity: index/IndexManager.scala:24-81 (the 7-method interface),
+index/IndexCollectionManager.scala:26-137 (wiring + getIndexes enumerating
+every index dir under the system path), and
+index/CachingIndexCollectionManager.scala:37-160 (read-path TTL cache,
+cleared by every mutating API).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from hyperspace_tpu.actions import (
+    CancelAction,
+    CreateAction,
+    DeleteAction,
+    OptimizeAction,
+    RefreshAction,
+    RestoreAction,
+    VacuumAction,
+)
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.metadata.cache import CreationTimeBasedCache
+from hyperspace_tpu.metadata.data_manager import IndexDataManager
+from hyperspace_tpu.metadata.log_entry import IndexLogEntry
+from hyperspace_tpu.metadata.log_manager import IndexLogManager
+from hyperspace_tpu.metadata.path_resolver import PathResolver
+from hyperspace_tpu.plan.nodes import LogicalPlan
+from hyperspace_tpu import states
+
+
+class IndexCollectionManager:
+    """Concrete manager: one log/data manager pair per index directory."""
+
+    def __init__(self, conf: HyperspaceConf, writer_factory=None):
+        self.conf = conf
+        self.path_resolver = PathResolver(conf)
+        # The writer seam (DI for tests; analog of index/factories.scala).
+        if writer_factory is None:
+            def writer_factory():
+                from hyperspace_tpu.execution.builder import DeviceIndexBuilder
+
+                return DeviceIndexBuilder()
+
+        self.writer_factory = writer_factory
+
+    # -- manager wiring --------------------------------------------------
+    def _managers(self, name: str) -> tuple[IndexLogManager, IndexDataManager, Path]:
+        index_path = self.path_resolver.get_index_path(name)
+        return IndexLogManager(index_path), IndexDataManager(index_path), index_path
+
+    # -- IndexManager interface ------------------------------------------
+    def create(self, plan: LogicalPlan, config: IndexConfig) -> None:
+        lm, dm, path = self._managers(config.index_name)
+        CreateAction(plan, config, lm, dm, path, self.conf, self.writer_factory()).run()
+
+    def delete(self, name: str) -> None:
+        lm, _, _ = self._managers(name)
+        DeleteAction(lm).run()
+
+    def restore(self, name: str) -> None:
+        lm, _, _ = self._managers(name)
+        RestoreAction(lm).run()
+
+    def vacuum(self, name: str) -> None:
+        lm, dm, _ = self._managers(name)
+        VacuumAction(lm, dm).run()
+
+    def refresh(self, name: str) -> None:
+        lm, dm, path = self._managers(name)
+        RefreshAction(lm, dm, path, self.conf, self.writer_factory()).run()
+
+    def optimize(self, name: str) -> None:
+        lm, dm, _ = self._managers(name)
+        OptimizeAction(lm, dm, self.writer_factory()).run()
+
+    def cancel(self, name: str) -> None:
+        lm, _, _ = self._managers(name)
+        if lm.get_latest_log() is None:
+            raise HyperspaceError(f"index {name!r} does not exist")
+        CancelAction(lm).run()
+
+    def get_indexes(self, states_filter=(states.ACTIVE,)) -> list[IndexLogEntry]:
+        """Enumerate every index dir under the system path and read each
+        latest log (IndexCollectionManager.scala:87-105)."""
+        out = []
+        for d in self.path_resolver.list_index_paths():
+            entry = IndexLogManager(d).get_latest_log()
+            if entry is not None and entry.state in states_filter:
+                out.append(entry)
+        return out
+
+    def indexes(self):
+        """Project all indexes to a summary DataFrame
+        (IndexCollectionManager.scala:79-85, IndexSummary :151-173)."""
+        import pandas as pd
+
+        rows = []
+        for entry in self.get_indexes(states_filter=tuple(states.ALL_STATES)):
+            rows.append(
+                {
+                    "name": entry.name,
+                    "indexedColumns": list(entry.indexed_columns),
+                    "includedColumns": list(entry.included_columns),
+                    "numBuckets": entry.num_buckets,
+                    "schema": [f["name"] for f in entry.derived_dataset.schema],
+                    "indexLocation": str(Path(entry.content.root) / entry.content.directories[-1]),
+                    "state": entry.state,
+                }
+            )
+        return pd.DataFrame(rows, columns=[
+            "name", "indexedColumns", "includedColumns", "numBuckets", "schema", "indexLocation", "state",
+        ])
+
+
+class CachingIndexCollectionManager(IndexCollectionManager):
+    """Read-path cache of the ACTIVE index entries with TTL expiry;
+    every mutating API clears the cache first
+    (CachingIndexCollectionManager.scala:60-98)."""
+
+    def __init__(self, conf: HyperspaceConf, writer_factory=None):
+        super().__init__(conf, writer_factory)
+        self._cache = CreationTimeBasedCache(conf.cache_expiry_seconds)
+
+    def clear_cache(self) -> None:
+        self._cache.clear()
+
+    def get_indexes(self, states_filter=(states.ACTIVE,)) -> list[IndexLogEntry]:
+        if tuple(states_filter) == (states.ACTIVE,):
+            cached = self._cache.get()
+            if cached is not None:
+                return cached
+            entries = super().get_indexes(states_filter)
+            self._cache.set(entries)
+            return entries
+        return super().get_indexes(states_filter)
+
+    def create(self, plan, config):
+        self.clear_cache()
+        super().create(plan, config)
+
+    def delete(self, name):
+        self.clear_cache()
+        super().delete(name)
+
+    def restore(self, name):
+        self.clear_cache()
+        super().restore(name)
+
+    def vacuum(self, name):
+        self.clear_cache()
+        super().vacuum(name)
+
+    def refresh(self, name):
+        self.clear_cache()
+        super().refresh(name)
+
+    def optimize(self, name):
+        self.clear_cache()
+        super().optimize(name)
+
+    def cancel(self, name):
+        self.clear_cache()
+        super().cancel(name)

@@ -50,10 +50,14 @@ class IndexLogManager:
 
     def get_latest_stable_log(self) -> IndexLogEntry | None:
         pointer = self.log_dir / LATEST_STABLE_LOG_NAME
-        if pointer.exists():
+        try:
             entry = entry_from_json(read_json(pointer))
             if entry.state in STABLE_STATES:
                 return entry
+        except (FileNotFoundError, ValueError):
+            # Pointer absent, or caught mid delete/recreate by a concurrent
+            # Action.end(): fall back to the backward scan.
+            pass
         # Backward scan fallback (IndexLogManager.scala:113-122).
         latest = self.get_latest_id()
         if latest is None:

@@ -1,0 +1,3 @@
+from hyperspace_tpu.ops.hashing import bucket_ids, combine_hashes, hash_int_column, string_dict_hashes
+
+__all__ = ["bucket_ids", "combine_hashes", "hash_int_column", "string_dict_hashes"]

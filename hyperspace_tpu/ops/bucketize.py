@@ -1,0 +1,147 @@
+"""Distributed hash-bucketize: the build-time shuffle, TPU-native.
+
+This is the framework's equivalent of Spark's ShuffleExchangeExec + Netty
+block transfer (reference hot path: `repartition(numBuckets, indexedCols)`
+at actions/CreateActionBase.scala:110-112). Design per SURVEY.md §2.3:
+
+- the mesh axis ("x") spans the devices; device d owns the contiguous
+  bucket range [d*B/D, (d+1)*B/D) for B buckets over D devices;
+- each device sorts its local rows by destination device, scatters them
+  into a padded [D, C] send buffer, and ONE `lax.all_to_all` over ICI moves
+  every row to its owner — no Netty, no host round-trip;
+- a per-(src,dst) capacity C bounds the padded transfer; overflow is
+  detected on device and reported back so the host can retry with a larger
+  capacity factor (skew mitigation, SURVEY.md §7 step 3);
+- after the exchange each device lex-sorts its received rows by
+  (bucket, key columns) — giving bucket-grouped, key-sorted shards ready
+  for per-bucket persistence.
+
+Rows are carried as a stack of int32/uint32/float32-compatible columns; the
+caller is responsible for representing every column as a jax-compatible
+array (ColumnTable guarantees this).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+AXIS = "x"
+
+
+def _exchange_one_device(
+    cols: list,
+    bucket: jnp.ndarray,
+    valid: jnp.ndarray,
+    num_devices: int,
+    buckets_per_device: int,
+    capacity: int,
+):
+    """Per-device body run under shard_map. `cols` are the local columns
+    [R, ...]; `bucket` the per-row bucket id; `valid` marks real rows.
+    Returns (recv_cols, recv_bucket, recv_valid, overflowed)."""
+    r = bucket.shape[0]
+    dest = jnp.where(valid, bucket // buckets_per_device, num_devices)  # invalid → sentinel D
+
+    # Stable sort rows by dest so each destination's rows are contiguous.
+    order = lax.sort((dest.astype(jnp.int32), jnp.arange(r, dtype=jnp.int32)), num_keys=1, is_stable=True)[1]
+    dest_sorted = dest[order]
+    bucket_sorted = bucket[order]
+
+    # Rank of each row within its destination group.
+    counts = jnp.bincount(dest_sorted, length=num_devices + 1)
+    offsets = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(counts)[:-1].astype(jnp.int32)])
+    within = jnp.arange(r, dtype=jnp.int32) - offsets[dest_sorted]
+
+    overflowed = jnp.max(counts[:num_devices]) > capacity
+
+    # Scatter into the [D, C] send buffer (invalid/overflow rows dropped).
+    slot_ok = (within < capacity) & (dest_sorted < num_devices)
+    flat_idx = jnp.where(slot_ok, dest_sorted * capacity + within, num_devices * capacity)
+
+    def scatter(col_sorted, fill):
+        buf = jnp.full((num_devices * capacity + 1,), fill, dtype=col_sorted.dtype)
+        buf = buf.at[flat_idx].set(col_sorted, mode="drop")
+        return buf[:-1].reshape(num_devices, capacity)
+
+    send_valid = scatter(slot_ok.astype(jnp.int32), 0)
+    send_bucket = scatter(jnp.where(slot_ok, bucket_sorted, -1), -1)
+    send_cols = [scatter(c[order], 0) for c in cols]
+
+    # THE exchange: one all_to_all over the mesh axis (ICI).
+    recv_valid = lax.all_to_all(send_valid, AXIS, 0, 0, tiled=True)
+    recv_bucket = lax.all_to_all(send_bucket, AXIS, 0, 0, tiled=True)
+    recv_cols = [lax.all_to_all(c, AXIS, 0, 0, tiled=True) for c in send_cols]
+
+    # Flatten [D, C] → [D*C] and lex-sort by (validity, bucket) so real rows
+    # come first, grouped by bucket. Key sort happens later with the real
+    # key columns (builder adds them as leading sort keys).
+    rv = recv_valid.reshape(-1)
+    rb = jnp.where(rv > 0, recv_bucket.reshape(-1), jnp.int32(2**30))
+    rc = [c.reshape(-1) for c in recv_cols]
+    return rc, rb, rv, overflowed
+
+
+@functools.lru_cache(maxsize=64)
+def make_bucketize_fn(
+    mesh: Mesh,
+    num_cols: int,
+    num_buckets: int,
+    capacity: int,
+):
+    """Build the jitted shard_map'd exchange for a fixed column layout."""
+    num_devices = mesh.shape[AXIS]
+    if num_buckets % num_devices != 0:
+        raise ValueError(f"num_buckets {num_buckets} must be a multiple of mesh size {num_devices}")
+    buckets_per_device = num_buckets // num_devices
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(tuple(P(AXIS) for _ in range(num_cols)), P(AXIS), P(AXIS)),
+        out_specs=(tuple(P(AXIS) for _ in range(num_cols)), P(AXIS), P(AXIS), P()),
+        check_vma=False,
+    )
+    def fn(cols, bucket, valid):
+        rc, rb, rv, overflow = _exchange_one_device(
+            list(cols), bucket, valid, num_devices, buckets_per_device, capacity
+        )
+        # overflow is a per-device scalar; reduce with OR (max) across mesh.
+        overflow = lax.pmax(overflow.astype(jnp.int32), AXIS)
+        return tuple(rc), rb, rv, overflow[None] if overflow.ndim == 0 else overflow
+
+    return jax.jit(fn)
+
+
+def bucketize(
+    mesh: Mesh,
+    cols: list,
+    bucket: jnp.ndarray,
+    valid: jnp.ndarray,
+    num_buckets: int,
+    capacity_factor: float = 2.0,
+):
+    """Host wrapper with overflow retry (doubling the capacity factor).
+
+    Inputs are global arrays whose leading dim is a multiple of the mesh
+    size (caller pads). Returns (cols, bucket, valid) where rows live on
+    their owning device, ordered valid-first by bucket."""
+    num_devices = mesh.shape[AXIS]
+    n = bucket.shape[0]
+    per_dev = n // num_devices
+    while True:
+        capacity = max(1, math.ceil(per_dev / num_devices * capacity_factor))
+        capacity = min(capacity, per_dev)  # no point exceeding local rows
+        fn = make_bucketize_fn(mesh, len(cols), num_buckets, capacity)
+        out_cols, out_bucket, out_valid, overflow = fn(tuple(cols), bucket, valid)
+        if not bool(jax.device_get(overflow).max()):
+            return list(out_cols), out_bucket, out_valid
+        if capacity >= per_dev:
+            raise AssertionError("bucketize overflow with full capacity — impossible")
+        capacity_factor *= 2.0

@@ -1,0 +1,63 @@
+"""Device-side predicate evaluation.
+
+The analog of Spark's WholeStageCodegen'd filter/project over the index scan
+(SURVEY.md §2.2): the whole predicate tree evaluates as ONE jitted XLA
+computation over the columns — XLA fuses the comparisons/boolean algebra
+into a single pass over HBM, which is the TPU equivalent of the JVM's fused
+codegen operator.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from hyperspace_tpu.execution.table import ColumnTable
+from hyperspace_tpu.plan.expr import And, BinOp, Col, Expr, Lit, Not, Or, evaluate
+
+
+def translate_predicate(table: ColumnTable, e: Expr) -> Expr:
+    """Rewrite string-column comparisons against literals into the code
+    domain of `table`'s dictionaries (order-preserving). Pure — returns a
+    new tree, never mutates the plan's predicate."""
+    if isinstance(e, BinOp) and e.is_comparison:
+        l, r = e.left, e.right
+        if isinstance(l, Col) and isinstance(r, Lit) and table.schema.field(l.name).is_string:
+            return BinOp(e.op, l, Lit(table.translate_literal(l.name, r.value, e.op)))
+        if isinstance(r, Col) and isinstance(l, Lit) and table.schema.field(r.name).is_string:
+            flip = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le", "eq": "eq", "ne": "ne"}
+            return translate_predicate(table, BinOp(flip[e.op], r, l))
+        return e
+    if isinstance(e, And):
+        return And(translate_predicate(table, e.left), translate_predicate(table, e.right))
+    if isinstance(e, Or):
+        return Or(translate_predicate(table, e.left), translate_predicate(table, e.right))
+    if isinstance(e, Not):
+        return Not(translate_predicate(table, e.child))
+    return e
+
+
+def eval_predicate_mask(table: ColumnTable, predicate: Expr) -> np.ndarray:
+    """Evaluate the predicate on device; returns a host bool mask."""
+    predicate = translate_predicate(table, predicate)
+    names = sorted(predicate.references())
+    resolved = {}
+    for n in names:
+        f = table.schema.field(n)
+        arr = table.columns[f.name]
+        resolved[n.lower()] = jnp.asarray(arr)
+
+    def fn(cols):
+        return evaluate(predicate, lambda name: cols[name.lower()], jnp)
+
+    mask = jax.jit(fn)(resolved)
+    return np.asarray(jax.device_get(mask)).astype(bool)
+
+
+def apply_filter(table: ColumnTable, predicate: Expr) -> ColumnTable:
+    if table.num_rows == 0:
+        return table
+    mask = eval_predicate_mask(table, predicate)
+    return table.filter_mask(mask)

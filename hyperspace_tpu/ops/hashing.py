@@ -1,0 +1,80 @@
+"""Deterministic vectorized hashing for bucket assignment.
+
+The analog of Spark's HashPartitioning under `repartition(numBuckets, cols)`
+(reference hot path actions/CreateActionBase.scala:108-112): every row is
+assigned `bucket = hash(key columns) % num_buckets`. The hash must be
+
+- identical on host (numpy) and device (jax.numpy), so build-time bucketing
+  (device) and query-time bucket pruning (host) agree;
+- dictionary-independent for strings: the hash is a function of the string
+  BYTES (per-dictionary hashes gathered through codes), never of the codes,
+  so two tables bucket identically regardless of their dictionaries;
+- 32-bit only: TPUs strongly prefer 32-bit lanes; int64 inputs are split
+  into hi/lo words and mixed (murmur3 finalizer).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+_U32 = np.uint32
+
+
+def _mix32(x, xp):
+    """murmur3 fmix32 — avalanche a uint32 lane."""
+    x = x.astype(xp.uint32) if hasattr(x, "astype") else xp.uint32(x)
+    x = x ^ (x >> xp.uint32(16))
+    x = x * xp.uint32(0x85EBCA6B)
+    x = x ^ (x >> xp.uint32(13))
+    x = x * xp.uint32(0xC2B2AE35)
+    x = x ^ (x >> xp.uint32(16))
+    return x
+
+
+def hash_int_column(arr, xp):
+    """Hash an integer/bool/float column to uint32.
+
+    int64/float64 are viewed as two 32-bit words and both words mixed;
+    32-bit types mix directly. Works with numpy or jax.numpy via `xp`.
+    """
+    dtype = arr.dtype
+    if dtype in (np.dtype(np.float32),):
+        arr = arr.view(np.int32) if xp is np else arr.view(xp.int32)
+        dtype = arr.dtype
+    if dtype in (np.dtype(np.float64),):
+        arr = arr.view(np.int64) if xp is np else arr.view(xp.int64)
+        dtype = arr.dtype
+    if dtype in (np.dtype(np.bool_),):
+        arr = arr.astype(np.int32 if xp is np else xp.int32)
+        dtype = arr.dtype
+    if dtype in (np.dtype(np.int64), np.dtype(np.uint64)):
+        lo = (arr & 0xFFFFFFFF).astype(xp.uint32)
+        hi = ((arr >> 32) & 0xFFFFFFFF).astype(xp.uint32)
+        return _mix32(lo ^ (_mix32(hi, xp) * xp.uint32(0x9E3779B1)), xp)
+    # 32-bit lane
+    return _mix32(arr.astype(xp.uint32), xp)
+
+
+def string_dict_hashes(dictionary: np.ndarray) -> np.ndarray:
+    """uint32 hash per dictionary entry, a pure function of the bytes
+    (md5 prefix) — stable across processes and dictionaries."""
+    out = np.empty(len(dictionary), dtype=np.uint32)
+    for i, s in enumerate(dictionary):
+        h = hashlib.md5(str(s).encode("utf-8")).digest()
+        out[i] = int.from_bytes(h[:4], "little")
+    return out
+
+
+def combine_hashes(hashes: list, xp):
+    """Order-dependent combine of per-column uint32 hashes."""
+    acc = hashes[0]
+    for h in hashes[1:]:
+        acc = _mix32(acc * xp.uint32(31) + h, xp)
+    return acc
+
+
+def bucket_ids(hashes, num_buckets: int, xp):
+    """Map uint32 hashes to bucket ids [0, num_buckets) as int32."""
+    return (hashes % xp.uint32(num_buckets)).astype(xp.int32)

@@ -1,0 +1,93 @@
+"""Bucket-aligned sort-merge equi-join on device.
+
+The read-side hot path: the analog of Spark's SortMergeJoinExec running
+WITHOUT a ShuffleExchange on bucketed relations — the entire value
+proposition of the reference's JoinIndexRule
+(index/rules/JoinIndexRule.scala:38-52,124-153). Design:
+
+- both sides arrive as [B, L] bucket-major padded arrays whose key lanes
+  are int64 codes from a shared, order-preserving factorization (the
+  executor guarantees this); pads carry the int64 max sentinel;
+- per bucket, the join is the classic sorted expansion: for each left row,
+  `searchsorted(right, key, left/right)` bounds its match run — XLA compiles
+  this to a fused vectorized binary search, the TPU-friendly formulation of
+  the data-dependent merge advance (SURVEY.md §7 "hardest parts" #1);
+- match-count phase and expansion phase are separate jits: the host reads
+  the total, rounds the output capacity up to a power of two (bounding
+  recompiles), and the expansion emits (left row, right row) index pairs;
+- `vmap` runs every bucket in parallel in ONE compiled kernel; because
+  bucket(key) is a pure function of the key, per-bucket joins concatenated
+  are exactly the global join — zero collectives, matching the reference's
+  zero-exchange SMJ.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+SENTINEL = np.iinfo(np.int64).max
+
+
+def _sort_bucket(keys: jnp.ndarray) -> jnp.ndarray:
+    return jnp.sort(keys)
+
+
+@jax.jit
+def join_counts(lkeys: jnp.ndarray, rkeys: jnp.ndarray):
+    """Per-bucket match counts. lkeys/rkeys: [B, L]/[B, R] sorted int64
+    with SENTINEL pads. Returns (start [B,L], cum [B,L], totals [B])."""
+
+    def one(lk, rk):
+        start = jnp.searchsorted(rk, lk, side="left").astype(jnp.int32)
+        end = jnp.searchsorted(rk, lk, side="right").astype(jnp.int32)
+        real = lk < SENTINEL
+        cnt = jnp.where(real, end - start, 0)
+        cum = jnp.cumsum(cnt).astype(jnp.int32)
+        return start, cum, cum[-1] if cum.shape[0] else jnp.int32(0)
+
+    return jax.vmap(one)(lkeys, rkeys)
+
+
+@functools.partial(jax.jit, static_argnames=("cap",))
+def join_expand(start: jnp.ndarray, cum: jnp.ndarray, totals: jnp.ndarray, cap: int):
+    """Emit (li, ri, valid) of shape [B, cap] from the count phase."""
+
+    def one(st, cm, total):
+        t = jnp.arange(cap, dtype=jnp.int32)
+        li = jnp.searchsorted(cm, t, side="right").astype(jnp.int32)
+        li_c = jnp.minimum(li, cm.shape[0] - 1)
+        prev = jnp.where(li_c > 0, cm[jnp.maximum(li_c - 1, 0)], 0)
+        within = t - prev
+        ri = st[li_c] + within
+        valid = t < total
+        return li_c, ri, valid
+
+    return jax.vmap(one)(start, cum, totals)
+
+
+def next_pow2(n: int) -> int:
+    if n <= 1:
+        return 1
+    return 1 << (int(n - 1).bit_length())
+
+
+def merge_join(lkeys_np: np.ndarray, rkeys_np: np.ndarray):
+    """Host wrapper. lkeys_np/rkeys_np: [B, L]/[B, R] sorted int64 code
+    arrays with SENTINEL pads. Returns (li, ri, valid) numpy arrays of
+    shape [B, cap]."""
+    lk = jnp.asarray(lkeys_np)
+    rk = jnp.asarray(rkeys_np)
+    start, cum, totals = join_counts(lk, rk)
+    totals_h = np.asarray(jax.device_get(totals))
+    cap = next_pow2(int(totals_h.max()) if totals_h.size else 1)
+    li, ri, valid = join_expand(start, cum, totals, cap)
+    return (
+        np.asarray(jax.device_get(li)),
+        np.asarray(jax.device_get(ri)),
+        np.asarray(jax.device_get(valid)),
+    )

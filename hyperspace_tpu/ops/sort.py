@@ -1,0 +1,31 @@
+"""Multi-key lexicographic sort on device.
+
+The analog of the per-bucket sort in the reference's bucketed write
+(index/DataFrameWriterExtensions.scala:49-66, bucketBy == sortBy). XLA's
+`lax.sort` with `num_keys` performs a fused lexicographic sort of all
+operands in one compiled op — this is exactly the "let XLA do it" path; no
+hand-written kernel needed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def lex_sort_tables(key_arrays: list, payload_arrays: list) -> tuple[list, list]:
+    """Sort rows by the key columns (lexicographic), carrying payloads.
+
+    Returns (sorted_keys, sorted_payloads)."""
+    operands = tuple(key_arrays) + tuple(payload_arrays)
+    out = lax.sort(operands, num_keys=len(key_arrays), is_stable=True)
+    return list(out[: len(key_arrays)]), list(out[len(key_arrays) :])
+
+
+def sort_indices_by_keys(key_arrays: list) -> jnp.ndarray:
+    """Permutation that sorts by the key columns (stable)."""
+    n = key_arrays[0].shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    out = lax.sort(tuple(key_arrays) + (iota,), num_keys=len(key_arrays), is_stable=True)
+    return out[-1]

@@ -1,0 +1,3 @@
+from hyperspace_tpu.parallel.mesh import default_mesh, make_mesh
+
+__all__ = ["default_mesh", "make_mesh"]

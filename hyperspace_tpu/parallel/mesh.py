@@ -1,0 +1,40 @@
+"""Device mesh/topology layer.
+
+SURVEY.md §2.3 names this a first-class component for the TPU build: the
+analog of "N shuffle partitions over a Spark cluster" is "N buckets sharded
+over a device mesh". One 1-D mesh axis ("x") spans all chips; build-time
+bucketize rides ICI via all_to_all over this axis, query-time bucket-aligned
+ops need no collective at all. Multi-slice (DCN) meshes slot in here later
+by adding an outer axis.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+AXIS = "x"
+
+_x64_enabled = False
+
+
+def ensure_x64() -> None:
+    """int64 key columns require x64 (jax defaults to 32-bit). TPU lowers
+    s64 to a pair of 32-bit lanes; the builder narrows where values fit."""
+    global _x64_enabled
+    if not _x64_enabled:
+        jax.config.update("jax_enable_x64", True)
+        _x64_enabled = True
+
+
+def make_mesh(devices=None, n: int | None = None) -> Mesh:
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if n is not None:
+        devices = devices[:n]
+    return Mesh(np.array(devices), (AXIS,))
+
+
+def default_mesh() -> Mesh:
+    return make_mesh()

@@ -1,0 +1,79 @@
+"""Rule infrastructure for transparent plan rewriting.
+
+The analog of the reference's Catalyst rule batch
+(`JoinIndexRule :: FilterIndexRule` registered at package.scala:34). The
+ordering is load-bearing and preserved: join first, then filter, because a
+source already rewritten to an index scan cannot be rewritten again
+(package.scala:23-33). Rules never throw: any failure downgrades to a no-op
+(reference behavior at FilterIndexRule.scala:76-80).
+"""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+
+from hyperspace_tpu.dataset import list_data_files
+from hyperspace_tpu.execution import io as hio
+from hyperspace_tpu.metadata.log_entry import IndexLogEntry
+from hyperspace_tpu.plan.nodes import LogicalPlan, Scan
+from hyperspace_tpu.schema import Schema
+from hyperspace_tpu.signature import create_signature_provider
+
+logger = logging.getLogger("hyperspace_tpu")
+
+
+class Rule:
+    name: str = "rule"
+
+    def apply(self, plan: LogicalPlan, indexes: list[IndexLogEntry]) -> LogicalPlan:
+        raise NotImplementedError
+
+
+def apply_rules(plan: LogicalPlan, indexes: list[IndexLogEntry], rules=None) -> LogicalPlan:
+    if rules is None:
+        from hyperspace_tpu.rules.filter_index_rule import FilterIndexRule
+        from hyperspace_tpu.rules.join_index_rule import JoinIndexRule
+
+        rules = [JoinIndexRule(), FilterIndexRule()]
+    for rule in rules:
+        try:
+            plan = rule.apply(plan, indexes)
+        except Exception as e:  # noqa: BLE001 — rules must never break a query
+            logger.warning("rule %s failed, skipping: %s", rule.name, e)
+    return plan
+
+
+def index_scan_for(entry: IndexLogEntry) -> Scan:
+    """Build the bucketed index Scan replacing a source relation — the
+    analog of constructing the index-backed HadoopFsRelation with a
+    BucketSpec (JoinIndexRule.scala:124-153)."""
+    version_dir = Path(entry.content.root) / entry.content.directories[-1]
+    schema = Schema.from_json(entry.derived_dataset.schema)
+    files = [fi.path for fi in list_data_files(version_dir)]
+    manifest = hio.read_manifest(version_dir)
+    num_buckets = manifest["numBuckets"] if manifest else entry.derived_dataset.num_buckets
+    return Scan(
+        str(version_dir),
+        "parquet",
+        schema,
+        files=sorted(files),
+        bucket_spec=(num_buckets, list(entry.derived_dataset.indexed_columns)),
+    )
+
+
+class SignatureMatcher:
+    """Memoized plan-fingerprint matching (the reference memoizes per
+    provider within one optimizer invocation, JoinIndexRule.scala:328-353)."""
+
+    def __init__(self):
+        self._provider = create_signature_provider()
+        self._cache: dict[int, str | None] = {}
+
+    def matches(self, entry: IndexLogEntry, source: LogicalPlan) -> bool:
+        key = id(source)
+        if key not in self._cache:
+            fp = self._provider.signature(source)
+            self._cache[key] = None if fp is None else fp.value
+        value = self._cache[key]
+        return value is not None and value == entry.signature.value

@@ -1,0 +1,142 @@
+"""JoinIndexRule: rewrite equi-joins to bucket-aligned index scans.
+
+Reference parity: index/rules/JoinIndexRule.scala:54-595 (the reference's
+largest component). Our plan IR makes several of its checks structural:
+the equi-join CNF and base-table attribute requirements
+(JoinIndexRule.scala:179-185, 278-317) are guaranteed by the `Join` node
+shape. What remains:
+
+- sides must be linear sub-plans over a single source relation
+  (JoinIndexRule.scala:210-211): here Scan / Project(Scan) / Filter(Scan);
+- the key mapping must be 1:1 (no column repeated on either side);
+- a side's candidate indexes are those whose signature matches the side's
+  relation (JoinIndexRule.scala:328-353); usable iff indexed columns are
+  set-equal to the side's join columns AND the index covers the side's
+  required output columns (JoinIndexRule.scala:515-524);
+- a compatible pair lists indexed columns in the same mapped order
+  (JoinIndexRule.scala:547-594);
+- the best pair is chosen by JoinIndexRanker (equal bucket counts first —
+  zero-exchange, then more buckets);
+- the rewrite swaps both sides' relations for bucketed index scans so the
+  executor's per-bucket SMJ needs no exchange (JoinIndexRule.scala:124-153).
+"""
+
+from __future__ import annotations
+
+from hyperspace_tpu.metadata.log_entry import IndexLogEntry
+from hyperspace_tpu.plan.nodes import Filter, Join, LogicalPlan, Project, Scan
+from hyperspace_tpu.rules.base import Rule, SignatureMatcher, index_scan_for
+from hyperspace_tpu.rules.ranker import JoinIndexRanker
+
+
+def _side_scan(plan: LogicalPlan) -> Scan | None:
+    """The single source relation of a linear side, if any."""
+    node = plan
+    while True:
+        if isinstance(node, Scan):
+            return node if node.bucket_spec is None else None
+        if isinstance(node, (Project, Filter)):
+            node = node.child
+            continue
+        return None
+
+
+def _side_required_columns(plan: LogicalPlan, join_cols: list[str]) -> set[str]:
+    """Columns the side must produce: its output + its own predicates +
+    the join keys (analog of JoinIndexRule.scala:399-457)."""
+    required = {c.lower() for c in join_cols}
+    node = plan
+    required |= {c.lower() for c in plan.schema.names}
+    while not isinstance(node, Scan):
+        if isinstance(node, Filter):
+            required |= {c.lower() for c in node.predicate.references()}
+        node = node.child
+    return required
+
+
+def _replace_scan(plan: LogicalPlan, new_scan: Scan) -> LogicalPlan:
+    if isinstance(plan, Scan):
+        return new_scan
+    if isinstance(plan, Project):
+        return Project(_replace_scan(plan.child, new_scan), plan.columns)
+    if isinstance(plan, Filter):
+        return Filter(_replace_scan(plan.child, new_scan), plan.predicate)
+    raise AssertionError("non-linear side")
+
+
+class JoinIndexRule(Rule):
+    name = "JoinIndexRule"
+
+    def apply(self, plan: LogicalPlan, indexes: list[IndexLogEntry]) -> LogicalPlan:
+        matcher = SignatureMatcher()
+        return self._rewrite(plan, indexes, matcher)
+
+    def _rewrite(self, plan: LogicalPlan, indexes, matcher) -> LogicalPlan:
+        if isinstance(plan, Join):
+            rewritten = self._try_rewrite_join(plan, indexes, matcher)
+            if rewritten is not None:
+                return rewritten
+            new = Join(
+                self._rewrite(plan.left, indexes, matcher),
+                self._rewrite(plan.right, indexes, matcher),
+                plan.left_on,
+                plan.right_on,
+                plan.how,
+            )
+            return new
+        if isinstance(plan, Project):
+            return Project(self._rewrite(plan.child, indexes, matcher), plan.columns)
+        if isinstance(plan, Filter):
+            return Filter(self._rewrite(plan.child, indexes, matcher), plan.predicate)
+        return plan
+
+    def _try_rewrite_join(self, plan: Join, indexes, matcher) -> LogicalPlan | None:
+        # 1:1 mapping: no repeated columns on either side.
+        if len({c.lower() for c in plan.left_on}) != len(plan.left_on):
+            return None
+        if len({c.lower() for c in plan.right_on}) != len(plan.right_on):
+            return None
+
+        lscan = _side_scan(plan.left)
+        rscan = _side_scan(plan.right)
+        if lscan is None or rscan is None or lscan is rscan:
+            return None
+
+        lreq = _side_required_columns(plan.left, plan.left_on)
+        rreq = _side_required_columns(plan.right, plan.right_on)
+
+        lcands = self._usable(indexes, lscan, plan.left_on, lreq, matcher)
+        rcands = self._usable(indexes, rscan, plan.right_on, rreq, matcher)
+        if not lcands or not rcands:
+            return None
+
+        pairs = self._compatible_pairs(lcands, rcands, plan.left_on, plan.right_on)
+        if not pairs:
+            return None
+        best_l, best_r = JoinIndexRanker.rank(pairs)[0]
+
+        new_left = _replace_scan(plan.left, index_scan_for(best_l))
+        new_right = _replace_scan(plan.right, index_scan_for(best_r))
+        return Join(new_left, new_right, plan.left_on, plan.right_on, plan.how)
+
+    def _usable(self, indexes, scan: Scan, join_cols, required: set[str], matcher) -> list[IndexLogEntry]:
+        out = []
+        jset = {c.lower() for c in join_cols}
+        for entry in indexes:
+            iset = {c.lower() for c in entry.indexed_columns}
+            cover = {c.lower() for c in entry.derived_dataset.all_columns}
+            if iset == jset and required <= cover and matcher.matches(entry, scan):
+                out.append(entry)
+        return out
+
+    def _compatible_pairs(self, lcands, rcands, left_on, right_on):
+        """Pairs whose indexed column order respects the key mapping
+        (JoinIndexRule.scala:547-594)."""
+        l2r = {l.lower(): r.lower() for l, r in zip(left_on, right_on)}
+        pairs = []
+        for le in lcands:
+            expected_r = [l2r[c.lower()] for c in le.indexed_columns]
+            for re in rcands:
+                if [c.lower() for c in re.indexed_columns] == expected_r:
+                    pairs.append((le, re))
+        return pairs

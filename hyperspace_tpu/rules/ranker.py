@@ -1,0 +1,21 @@
+"""Ranking of candidate index pairs for the join rewrite.
+
+Reference parity: index/rankers/JoinIndexRanker.scala:24-56 — prefer pairs
+with EQUAL bucket counts (zero-exchange join), then larger bucket counts
+(more parallelism).
+"""
+
+from __future__ import annotations
+
+from hyperspace_tpu.metadata.log_entry import IndexLogEntry
+
+
+class JoinIndexRanker:
+    @staticmethod
+    def rank(pairs: list[tuple[IndexLogEntry, IndexLogEntry]]) -> list[tuple[IndexLogEntry, IndexLogEntry]]:
+        def score(pair):
+            l, r = pair
+            equal = l.num_buckets == r.num_buckets
+            return (0 if equal else 1, -(l.num_buckets + r.num_buckets))
+
+        return sorted(pairs, key=score)

@@ -43,15 +43,17 @@ def atomic_write(path: str | os.PathLike, data: bytes) -> bool:
         except FileExistsError:
             return False
         except OSError:
-            # Filesystem without hard links (FUSE/SMB/some overlays):
-            # fall back to O_EXCL exclusive create.
+            # Filesystem without hard links (FUSE/SMB/some overlays). The
+            # tmp file already holds the full fsynced payload; make it
+            # visible with rename guarded by an existence check. The
+            # check→rename window is a narrow race on this degraded path,
+            # but content is never torn (rename is atomic).
+            if path.exists():
+                return False
             try:
-                with open(path, "xb") as f:
-                    f.write(data)
-                    f.flush()
-                    os.fsync(f.fileno())
+                os.rename(tmp, path)
                 return True
-            except FileExistsError:
+            except OSError:
                 return False
     finally:
         try:

@@ -1,0 +1,213 @@
+"""End-to-end contract tests.
+
+Analog of index/E2EHyperspaceRulesTests.scala: write sample parquet, create
+indexes, then for each query shape assert (a) the optimized plan scans the
+index location and (b) results with hyperspace enabled are row-identical to
+disabled (verifyIndexUsage, E2EHyperspaceRulesTests.scala:324-340).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col
+from hyperspace_tpu.plan.nodes import Scan
+
+
+@pytest.fixture
+def session(tmp_system_path):
+    return HyperspaceSession(system_path=tmp_system_path, num_buckets=8)
+
+
+@pytest.fixture
+def hs(session):
+    return Hyperspace(session)
+
+
+def frames_equal(a: pd.DataFrame, b: pd.DataFrame):
+    """Row-identical regardless of order."""
+    assert sorted(a.columns) == sorted(b.columns)
+    cols = sorted(a.columns)
+    a2 = a[cols].sort_values(cols).reset_index(drop=True)
+    b2 = b[cols].sort_values(cols).reset_index(drop=True)
+    pd.testing.assert_frame_equal(a2, b2, check_dtype=False)
+
+
+def index_used(plan) -> bool:
+    return any(s.bucket_spec is not None for s in plan.leaves())
+
+
+def test_filter_query_uses_index_and_matches(session, hs, sample_parquet):
+    df = session.parquet(sample_parquet)
+    hs.create_index(df, IndexConfig("fidx", ["key"], ["value", "id"]))
+
+    query = df.filter(col("key") == 42).select("key", "value")
+
+    session.disable_hyperspace()
+    expected = session.to_pandas(query)
+    assert not index_used(session.optimized_plan(query))
+
+    session.enable_hyperspace()
+    opt = session.optimized_plan(query)
+    assert index_used(opt), "filter rewrite did not engage"
+    got = session.to_pandas(query)
+    frames_equal(got, expected)
+
+
+def test_filter_range_and_string_queries(session, hs, sample_parquet):
+    df = session.parquet(sample_parquet)
+    hs.create_index(df, IndexConfig("fidx2", ["name"], ["key"]))
+    session.enable_hyperspace()
+
+    q = df.filter((col("name") == "name_7") | (col("name") > "name_30")).select("name", "key")
+    opt = session.optimized_plan(q)
+    assert index_used(opt)
+    got = session.to_pandas(q)
+    session.disable_hyperspace()
+    frames_equal(got, session.to_pandas(q))
+
+
+def test_filter_not_rewritten_when_not_covering(session, hs, sample_parquet):
+    df = session.parquet(sample_parquet)
+    hs.create_index(df, IndexConfig("smallidx", ["key"]))  # covers only 'key'
+    session.enable_hyperspace()
+    q = df.filter(col("key") == 1).select("key", "value")  # needs 'value' too
+    assert not index_used(session.optimized_plan(q))
+
+
+def test_filter_requires_first_indexed_column(session, hs, sample_parquet):
+    df = session.parquet(sample_parquet)
+    hs.create_index(df, IndexConfig("kv", ["key", "id"], ["value"]))
+    session.enable_hyperspace()
+    # Filter on 'id' (second indexed col) only: rule must not engage.
+    q = df.filter(col("id") == 5).select("id", "value")
+    assert not index_used(session.optimized_plan(q))
+    # Filter on 'key' (first indexed col): engages.
+    q2 = df.filter(col("key") == 5).select("key", "value")
+    assert index_used(session.optimized_plan(q2))
+
+
+def test_join_query_zero_exchange(session, hs, tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(3)
+    n1, n2 = 800, 600
+    left_root = tmp_path / "left"
+    right_root = tmp_path / "right"
+    left_root.mkdir()
+    right_root.mkdir()
+    pq.write_table(
+        pa.table({"k": rng.integers(0, 200, n1).astype(np.int64), "lv": rng.standard_normal(n1)}),
+        left_root / "l.parquet",
+    )
+    pq.write_table(
+        pa.table({"k": rng.integers(0, 200, n2).astype(np.int64), "rv": rng.standard_normal(n2)}),
+        right_root / "r.parquet",
+    )
+    ldf = session.parquet(left_root)
+    rdf = session.parquet(right_root)
+    hs.create_index(ldf, IndexConfig("jl", ["k"], ["lv"]))
+    hs.create_index(rdf, IndexConfig("jr", ["k"], ["rv"]))
+
+    q = ldf.join(rdf, ["k"])
+
+    session.disable_hyperspace()
+    expected = session.to_pandas(q)
+
+    session.enable_hyperspace()
+    opt = session.optimized_plan(q)
+    scans = [s for s in opt.leaves() if s.bucket_spec is not None]
+    assert len(scans) == 2, "join rewrite must replace both sides"
+    assert scans[0].bucket_spec[0] == scans[1].bucket_spec[0]
+    got = session.to_pandas(q)
+    frames_equal(got, expected)
+
+
+def test_enable_disable_toggling(session, hs, sample_parquet):
+    df = session.parquet(sample_parquet)
+    hs.create_index(df, IndexConfig("tidx", ["key"], ["value"]))
+    q = df.filter(col("key") == 7).select("key", "value")
+    assert not index_used(session.optimized_plan(q))
+    session.enable_hyperspace()
+    assert index_used(session.optimized_plan(q))
+    session.disable_hyperspace()
+    assert not index_used(session.optimized_plan(q))
+
+
+def test_stale_index_not_used_until_refresh(session, hs, sample_parquet):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from pathlib import Path
+
+    df = session.parquet(sample_parquet)
+    hs.create_index(df, IndexConfig("sidx", ["key"], ["value"]))
+    session.enable_hyperspace()
+    q = df.filter(col("key") == 3).select("key", "value")
+    assert index_used(session.optimized_plan(q))
+
+    # Append data: signature mismatch ⇒ rule must stop engaging.
+    pq.write_table(
+        pa.table(
+            {
+                "id": np.arange(4, dtype=np.int64),
+                "key": np.array([3, 3, 3, 3], dtype=np.int64),
+                "value": np.ones(4),
+                "name": pa.array(["x"] * 4),
+            }
+        ),
+        Path(sample_parquet) / "extra.parquet",
+    )
+    session.manager.clear_cache()
+    assert not index_used(session.optimized_plan(q))
+
+    # Refresh rebuilds from lineage; rule engages again and sees new rows.
+    hs.refresh_index("sidx")
+    opt = session.optimized_plan(q)
+    assert index_used(opt)
+    got = session.to_pandas(q)
+    session.disable_hyperspace()
+    expected = session.to_pandas(q)
+    frames_equal(got, expected)
+    assert (got.key == 3).sum() >= 4
+
+
+def test_lifecycle_via_facade(session, hs, sample_parquet):
+    df = session.parquet(sample_parquet)
+    hs.create_index(df, IndexConfig("lidx", ["key"], ["value"]))
+    assert hs.indexes().iloc[0]["state"] == "ACTIVE"
+    hs.delete_index("lidx")
+    assert hs.indexes().iloc[0]["state"] == "DELETED"
+    session.enable_hyperspace()
+    q = df.filter(col("key") == 1).select("key", "value")
+    assert not index_used(session.optimized_plan(q)), "DELETED index must not be used"
+    hs.restore_index("lidx")
+    assert index_used(session.optimized_plan(q))
+    hs.delete_index("lidx")
+    hs.vacuum_index("lidx")
+    assert hs.indexes().iloc[0]["state"] == "DOESNOTEXIST"
+
+
+def test_optimize_index_compaction(session, hs, sample_parquet):
+    df = session.parquet(sample_parquet)
+    hs.create_index(df, IndexConfig("oidx", ["key"], ["value"]))
+    hs.optimize_index("oidx")
+    entry = session.manager.get_indexes()[0]
+    assert entry.content.directories == ["v__=1"]
+    session.enable_hyperspace()
+    q = df.filter(col("key") == 11).select("key", "value")
+    got = session.to_pandas(q)
+    session.disable_hyperspace()
+    frames_equal(got, session.to_pandas(q))
+
+
+def test_explain_output(session, hs, sample_parquet):
+    df = session.parquet(sample_parquet)
+    hs.create_index(df, IndexConfig("eidx", ["key"], ["value"]))
+    q = df.filter(col("key") == 5).select("key", "value")
+    text = hs.explain(q, verbose=True)
+    assert "eidx" in text
+    assert "IndexScan" in text
+    assert "ShuffleExchange-equivalents eliminated: 1" in text
+    # explain must not leave the session toggled on
+    assert not session.is_hyperspace_enabled()
