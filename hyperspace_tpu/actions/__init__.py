@@ -1,7 +1,7 @@
 from hyperspace_tpu.actions import states
 from hyperspace_tpu.actions.base import Action
 from hyperspace_tpu.actions.create import CreateAction, IndexWriter
-from hyperspace_tpu.actions.refresh import RefreshAction
+from hyperspace_tpu.actions.refresh import RefreshAction, RefreshIncrementalAction
 from hyperspace_tpu.actions.delete import DeleteAction
 from hyperspace_tpu.actions.restore import RestoreAction
 from hyperspace_tpu.actions.vacuum import VacuumAction
@@ -14,6 +14,7 @@ __all__ = [
     "CreateAction",
     "IndexWriter",
     "RefreshAction",
+    "RefreshIncrementalAction",
     "DeleteAction",
     "RestoreAction",
     "VacuumAction",

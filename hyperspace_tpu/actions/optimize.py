@@ -26,7 +26,7 @@ from hyperspace_tpu.metadata.log_manager import IndexLogManager
 
 
 class IndexCompactor(Protocol):
-    def compact(self, entry: IndexLogEntry, src_path: Path, dest_path: Path) -> None: ...
+    def compact(self, entry: IndexLogEntry, src_paths: list[Path], dest_path: Path) -> None: ...
 
 
 class OptimizeAction(Action):
@@ -67,6 +67,9 @@ class OptimizeAction(Action):
         prev_version = self.data_manager.get_latest_version_id()
         if prev_version is None:
             raise HyperspaceError("index has no data to optimize")
-        src = self.data_manager.get_path(prev_version)
+        # Compact EVERY live version dir (base + incremental-refresh deltas)
+        # into one sorted file per bucket in the next version.
+        root = Path(self.previous_entry.content.root)
+        srcs = [root / d for d in self.previous_entry.content.directories]
         dest = self.data_manager.get_path(self._version_id)
-        self.compactor.compact(self.previous_entry, src, dest)
+        self.compactor.compact(self.previous_entry, srcs, dest)

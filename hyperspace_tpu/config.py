@@ -18,6 +18,10 @@ from typing import Any
 INDEX_SYSTEM_PATH = "hyperspace.system.path"
 INDEX_NUM_BUCKETS = "hyperspace.index.num.buckets"
 INDEX_CACHE_EXPIRY_SECONDS = "hyperspace.index.cache.expiryDurationInSeconds"
+INDEX_HYBRID_SCAN_ENABLED = "hyperspace.index.hybridscan.enabled"
+# Hybrid scan only applies while appended bytes stay below this fraction of
+# the indexed source (past it, scanning deltas unindexed beats the index).
+INDEX_HYBRID_SCAN_MAX_APPENDED_RATIO = "hyperspace.index.hybridscan.maxAppendedRatio"
 
 # Directory-layout constants (reference index/IndexConstants.scala:38-39).
 HYPERSPACE_LOG_DIR = "_hyperspace_log"
@@ -26,6 +30,7 @@ LATEST_STABLE_LOG_NAME = "latestStable"
 
 DEFAULT_NUM_BUCKETS = 8
 DEFAULT_CACHE_EXPIRY_SECONDS = 300.0
+DEFAULT_HYBRID_SCAN_MAX_APPENDED_RATIO = 0.3
 
 
 @dataclasses.dataclass
@@ -35,6 +40,8 @@ class HyperspaceConf:
     system_path: str = ""
     num_buckets: int = DEFAULT_NUM_BUCKETS
     cache_expiry_seconds: float = DEFAULT_CACHE_EXPIRY_SECONDS
+    hybrid_scan_enabled: bool = False
+    hybrid_scan_max_appended_ratio: float = DEFAULT_HYBRID_SCAN_MAX_APPENDED_RATIO
     overrides: dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def __post_init__(self):
@@ -49,6 +56,10 @@ class HyperspaceConf:
             self.num_buckets = int(value)
         elif key == INDEX_CACHE_EXPIRY_SECONDS:
             self.cache_expiry_seconds = float(value)
+        elif key == INDEX_HYBRID_SCAN_ENABLED:
+            self.hybrid_scan_enabled = bool(value) if not isinstance(value, str) else value.lower() == "true"
+        elif key == INDEX_HYBRID_SCAN_MAX_APPENDED_RATIO:
+            self.hybrid_scan_max_appended_ratio = float(value)
 
     def get(self, key: str, default: Any = None) -> Any:
         if key in self.overrides:
@@ -59,4 +70,8 @@ class HyperspaceConf:
             return self.num_buckets
         if key == INDEX_CACHE_EXPIRY_SECONDS:
             return self.cache_expiry_seconds
+        if key == INDEX_HYBRID_SCAN_ENABLED:
+            return self.hybrid_scan_enabled
+        if key == INDEX_HYBRID_SCAN_MAX_APPENDED_RATIO:
+            return self.hybrid_scan_max_appended_ratio
         return default

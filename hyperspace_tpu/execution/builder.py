@@ -186,12 +186,15 @@ class DeviceIndexBuilder:
         hio.write_manifest(dest, num_buckets, indexed_columns, bucket_rows)
 
     # -- OptimizeAction's compactor seam ---------------------------------
-    def compact(self, entry, src_path: Path, dest_path: Path) -> None:
-        """Merge all files of each bucket (base + deltas) into one sorted
-        file per bucket in the new version dir."""
+    def compact(self, entry, src_paths: list[Path] | Path, dest_path: Path) -> None:
+        """Merge all files of each bucket across every live version dir
+        (base + incremental-refresh deltas) into one sorted file per bucket
+        in the new version dir."""
         num_buckets = entry.derived_dataset.num_buckets
         indexed = entry.derived_dataset.indexed_columns
-        files = [fi.path for fi in list_data_files(src_path)]
+        if isinstance(src_paths, (str, Path)):
+            src_paths = [src_paths]
+        files = [fi.path for src in src_paths for fi in list_data_files(src)]
         table = hio.read_parquet(files)
         self.write_table(table, indexed, num_buckets, dest_path)
 

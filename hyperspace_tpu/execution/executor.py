@@ -24,20 +24,23 @@ import numpy as np
 
 from hyperspace_tpu.exceptions import HyperspaceError
 from hyperspace_tpu.execution import io as hio
-from hyperspace_tpu.execution.builder import hash_scalar_key
+from hyperspace_tpu.execution.builder import compute_row_hashes, hash_scalar_key
 from hyperspace_tpu.execution.table import ColumnTable
 from hyperspace_tpu.dataset import list_data_files
 from hyperspace_tpu.ops.filter import apply_filter
 from hyperspace_tpu.ops.hashing import bucket_ids
 from hyperspace_tpu.ops import join as join_ops
 from hyperspace_tpu.plan.expr import BinOp, Col, Expr, Lit, split_conjuncts
-from hyperspace_tpu.plan.nodes import Filter, Join, LogicalPlan, Project, Scan
+from hyperspace_tpu.plan.nodes import Filter, Join, LogicalPlan, Project, Scan, Union
 
 
 @dataclasses.dataclass
 class AlignedSide:
     scan: Scan
     project: list[str] | None  # columns to keep after the join gather
+    # Hybrid scan: an unbucketed delta scan whose rows are bucketized
+    # on the fly and merged into the index buckets before the SMJ.
+    delta: Scan | None = None
 
 
 class Executor:
@@ -50,7 +53,26 @@ class Executor:
             return self.execute(plan.child).select(plan.columns)
         if isinstance(plan, Join):
             return self._join(plan)
+        if isinstance(plan, Union):
+            return self._union(plan)
         raise HyperspaceError(f"cannot execute plan node {type(plan).__name__}")
+
+    # -- union (hybrid scan) ----------------------------------------------
+    def _union(self, plan: Union) -> ColumnTable:
+        schema = plan.schema
+        parts = []
+        for child in plan.inputs:
+            t = self.execute(child)
+            # Remap onto the union schema's exact field names/order (child
+            # names are validated case-insensitively compatible).
+            cols, dicts = {}, {}
+            for f in schema.fields:
+                cf = t.schema.field(f.name)
+                cols[f.name] = t.columns[cf.name]
+                if cf.name in t.dictionaries:
+                    dicts[f.name] = t.dictionaries[cf.name]
+            parts.append(ColumnTable(schema, cols, dicts))
+        return ColumnTable.concat(parts)
 
     # -- scan ------------------------------------------------------------
     def _scan_files(self, scan: Scan) -> list[str]:
@@ -71,6 +93,16 @@ class Executor:
             if pruned is not None:
                 table = hio.read_parquet(pruned, columns=child.scan_schema.names, schema=child.scan_schema)
                 return apply_filter(table, plan.predicate)
+        if isinstance(child, Union):
+            # Hybrid scan: prune the bucketed input(s), keep deltas whole.
+            new_inputs: list[LogicalPlan] = []
+            for inp in child.inputs:
+                if isinstance(inp, Scan) and inp.bucket_spec is not None:
+                    pruned = self._prune_bucket_files(inp, plan.predicate)
+                    if pruned is not None:
+                        inp = dataclasses.replace(inp, files=pruned)
+                new_inputs.append(inp)
+            return apply_filter(self._union(Union(new_inputs)), plan.predicate)
         return apply_filter(self.execute(child), plan.predicate)
 
     def _prune_bucket_files(self, scan: Scan, predicate: Expr) -> list[str] | None:
@@ -116,27 +148,58 @@ class Executor:
         return self._partition_join(plan, [lt], [rt], presorted=False)
 
     def _aligned_side(self, plan: LogicalPlan) -> AlignedSide | None:
-        if isinstance(plan, Scan):
-            return AlignedSide(plan, None)
-        if isinstance(plan, Project) and isinstance(plan.child, Scan):
-            return AlignedSide(plan.child, plan.columns)
+        node, project = plan, None
+        if isinstance(node, Project):
+            project = node.columns
+            node = node.child
+        if isinstance(node, Union) and len(node.inputs) == 2:
+            base, delta = node.inputs
+            if isinstance(delta, Project) and isinstance(delta.child, Scan):
+                delta = delta.child
+            if (
+                isinstance(base, Scan)
+                and base.bucket_spec is not None
+                and isinstance(delta, Scan)
+                and delta.bucket_spec is None
+            ):
+                return AlignedSide(base, project, delta=delta)
+            return None
+        if isinstance(node, Scan):
+            return AlignedSide(node, project)
         return None
+
+    def _side_tables(self, side: AlignedSide, num_buckets: int):
+        """Per-bucket tables for one join side: the index bucket files,
+        plus (hybrid scan) delta rows bucketized on the fly with the same
+        canonical row hash the build used."""
+        schema = side.scan.scan_schema
+        groups = self._bucket_files_in_order(side.scan, num_buckets)
+        tables = [
+            hio.read_parquet(g, columns=schema.names, schema=schema) for g in groups
+        ]
+        presorted = all(len(g) == 1 for g in groups)
+        if side.delta is not None:
+            dt = self._scan(side.delta, columns=list(schema.names))
+            # Hash on the bucket columns in BUILD order (not join-key
+            # order) so delta rows land in the same buckets the index used.
+            row_hash = compute_row_hashes(dt, side.scan.bucket_spec[1])
+            db = bucket_ids(row_hash, num_buckets, np)
+            order = np.argsort(db, kind="stable")
+            starts = np.searchsorted(db[order], np.arange(num_buckets + 1))
+            for b in range(num_buckets):
+                lo, hi = int(starts[b]), int(starts[b + 1])
+                if hi > lo:
+                    tables[b] = ColumnTable.concat([tables[b], dt.take(order[lo:hi])])
+            presorted = False
+        return tables, presorted
 
     def _aligned_join(self, plan: Join, left: AlignedSide, right: AlignedSide) -> ColumnTable:
         """Per-bucket zero-exchange SMJ: read bucket b of each side, join
         bucket-locally in one vmapped kernel."""
         num_buckets = left.scan.bucket_spec[0]
-        lfiles = self._bucket_files_in_order(left.scan, num_buckets)
-        rfiles = self._bucket_files_in_order(right.scan, num_buckets)
-        ltables = [
-            hio.read_parquet([f], columns=left.scan.scan_schema.names, schema=left.scan.scan_schema)
-            for f in lfiles
-        ]
-        rtables = [
-            hio.read_parquet([f], columns=right.scan.scan_schema.names, schema=right.scan.scan_schema)
-            for f in rfiles
-        ]
-        out = self._partition_join(plan, ltables, rtables, presorted=True)
+        ltables, lsorted = self._side_tables(left, num_buckets)
+        rtables, rsorted = self._side_tables(right, num_buckets)
+        out = self._partition_join(plan, ltables, rtables, presorted=lsorted and rsorted)
         cols = None
         if left.project is not None or right.project is not None:
             keep = list(left.project if left.project is not None else left.scan.scan_schema.names)
@@ -147,9 +210,14 @@ class Executor:
             cols = keep
         return out.select(cols) if cols is not None else out
 
-    def _bucket_files_in_order(self, scan: Scan, num_buckets: int) -> list[str]:
+    def _bucket_files_in_order(self, scan: Scan, num_buckets: int) -> list[list[str]]:
+        """Per-bucket file groups. A bucket can have several files (base
+        version + incremental-refresh deltas); order within a group is the
+        sorted file-path order."""
         files = self._scan_files(scan)
-        by_name = {Path(f).name: f for f in files}
+        by_name: dict[str, list[str]] = {}
+        for f in sorted(files):
+            by_name.setdefault(Path(f).name, []).append(f)
         out = []
         for b in range(num_buckets):
             name = hio.bucket_file_name(b)

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 from collections import Counter
 
-from hyperspace_tpu.plan.nodes import Filter, Join, LogicalPlan, Project, Scan
+from hyperspace_tpu.plan.nodes import Filter, Join, LogicalPlan, Project, Scan, Union
 
 
 def pretty_plan(plan: LogicalPlan, indent: int = 0) -> str:
@@ -35,6 +35,10 @@ def pretty_plan(plan: LogicalPlan, indent: int = 0) -> str:
             + "\n"
             + pretty_plan(plan.right, indent + 1)
         )
+    if isinstance(plan, Union):
+        return f"{pad}HybridScanUnion\n" + "\n".join(
+            pretty_plan(c, indent + 1) for c in plan.inputs
+        )
     return f"{pad}{type(plan).__name__}"
 
 
@@ -54,13 +58,12 @@ def _operator_counts(plan: LogicalPlan) -> Counter:
 
 
 def _used_indexes(plan: LogicalPlan, session) -> list[str]:
+    """Match index-scan roots against the catalog
+    (PlanAnalyzer.scala:129-152,209-221)."""
     roots = {s.root for s in plan.leaves() if s.bucket_spec is not None}
     used = []
     for entry in session.manager.get_indexes():
-        from pathlib import Path
-
-        loc = str(Path(entry.content.root) / entry.content.directories[-1])
-        if loc in roots:
+        if str(entry.content.root) in roots:
             used.append(entry.name)
     return used
 

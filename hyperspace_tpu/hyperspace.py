@@ -70,7 +70,7 @@ class HyperspaceSession:
         if not self._enabled:
             return plan
         indexes = self.manager.get_indexes()
-        return apply_rules(plan, indexes)
+        return apply_rules(plan, indexes, conf=self.conf)
 
     def run(self, plan: LogicalPlan):
         """Execute a plan (rewriting through indexes when enabled);
@@ -103,8 +103,11 @@ class Hyperspace:
     def vacuum_index(self, name: str) -> None:
         self.session.manager.vacuum(name)
 
-    def refresh_index(self, name: str) -> None:
-        self.session.manager.refresh(name)
+    def refresh_index(self, name: str, mode: str = "full") -> None:
+        """Rebuild an index. mode="full" re-executes the logged lineage;
+        mode="incremental" indexes only appended source files into per-
+        bucket delta files (pair with optimize_index to compact)."""
+        self.session.manager.refresh(name, mode)
 
     def optimize_index(self, name: str) -> None:
         self.session.manager.optimize(name)

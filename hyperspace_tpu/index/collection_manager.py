@@ -17,6 +17,7 @@ from hyperspace_tpu.actions import (
     DeleteAction,
     OptimizeAction,
     RefreshAction,
+    RefreshIncrementalAction,
     RestoreAction,
     VacuumAction,
 )
@@ -69,9 +70,14 @@ class IndexCollectionManager:
         lm, dm, _ = self._managers(name)
         VacuumAction(lm, dm).run()
 
-    def refresh(self, name: str) -> None:
+    def refresh(self, name: str, mode: str = "full") -> None:
         lm, dm, path = self._managers(name)
-        RefreshAction(lm, dm, path, self.conf, self.writer_factory()).run()
+        if mode == "full":
+            RefreshAction(lm, dm, path, self.conf, self.writer_factory()).run()
+        elif mode == "incremental":
+            RefreshIncrementalAction(lm, dm, path, self.conf, self.writer_factory()).run()
+        else:
+            raise HyperspaceError(f"unknown refresh mode {mode!r} (full|incremental)")
 
     def optimize(self, name: str) -> None:
         lm, dm, _ = self._managers(name)
@@ -154,9 +160,9 @@ class CachingIndexCollectionManager(IndexCollectionManager):
         self.clear_cache()
         super().vacuum(name)
 
-    def refresh(self, name):
+    def refresh(self, name, mode: str = "full"):
         self.clear_cache()
-        super().refresh(name)
+        super().refresh(name, mode)
 
     def optimize(self, name):
         self.clear_cache()

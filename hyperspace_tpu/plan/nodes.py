@@ -129,6 +129,36 @@ class Project(LogicalPlan):
 
 
 @dataclasses.dataclass
+class Union(LogicalPlan):
+    """Concatenate rows of name-compatible children. Exists for Hybrid Scan:
+    an index scan unioned with a scan pinned to source files appended since
+    the index build (the analog of later-Hyperspace's hybrid scan plan,
+    which unions index data with an on-the-fly scan of appended files)."""
+
+    inputs: list[LogicalPlan]
+
+    def __post_init__(self):
+        if not self.inputs:
+            raise ValueError("union needs at least one input")
+        first = [n.lower() for n in self.inputs[0].schema.names]
+        for child in self.inputs[1:]:
+            if [n.lower() for n in child.schema.names] != first:
+                raise ValueError(
+                    f"union inputs must share column names: {first} vs {child.schema.names}"
+                )
+
+    @property
+    def schema(self) -> Schema:
+        return self.inputs[0].schema
+
+    def children(self) -> list[LogicalPlan]:
+        return list(self.inputs)
+
+    def to_json(self) -> dict[str, Any]:
+        return {"type": "union", "inputs": [c.to_json() for c in self.inputs]}
+
+
+@dataclasses.dataclass
 class Join(LogicalPlan):
     """Inner equi-join on key column lists (reference matches CNF of EqualTo,
     JoinIndexRule.scala:179-185; we make the equi-join structural)."""
@@ -195,6 +225,8 @@ def plan_from_json(d: dict[str, Any]) -> LogicalPlan:
         return Filter(plan_from_json(d["child"]), expr_from_json(d["predicate"]))
     if t == "project":
         return Project(plan_from_json(d["child"]), list(d["columns"]))
+    if t == "union":
+        return Union([plan_from_json(c) for c in d["inputs"]])
     if t == "join":
         return Join(
             plan_from_json(d["left"]),

@@ -10,6 +10,7 @@ source already rewritten to an index scan cannot be rewritten again
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 from pathlib import Path
 
@@ -26,16 +27,20 @@ logger = logging.getLogger("hyperspace_tpu")
 class Rule:
     name: str = "rule"
 
+    def __init__(self, conf=None):
+        # Session conf (hybrid-scan knobs); None ⇒ defaults (hybrid off).
+        self.conf = conf
+
     def apply(self, plan: LogicalPlan, indexes: list[IndexLogEntry]) -> LogicalPlan:
         raise NotImplementedError
 
 
-def apply_rules(plan: LogicalPlan, indexes: list[IndexLogEntry], rules=None) -> LogicalPlan:
+def apply_rules(plan: LogicalPlan, indexes: list[IndexLogEntry], rules=None, conf=None) -> LogicalPlan:
     if rules is None:
         from hyperspace_tpu.rules.filter_index_rule import FilterIndexRule
         from hyperspace_tpu.rules.join_index_rule import JoinIndexRule
 
-        rules = [JoinIndexRule(), FilterIndexRule()]
+        rules = [JoinIndexRule(conf), FilterIndexRule(conf)]
     for rule in rules:
         try:
             plan = rule.apply(plan, indexes)
@@ -47,14 +52,19 @@ def apply_rules(plan: LogicalPlan, indexes: list[IndexLogEntry], rules=None) -> 
 def index_scan_for(entry: IndexLogEntry) -> Scan:
     """Build the bucketed index Scan replacing a source relation — the
     analog of constructing the index-backed HadoopFsRelation with a
-    BucketSpec (JoinIndexRule.scala:124-153)."""
-    version_dir = Path(entry.content.root) / entry.content.directories[-1]
+    BucketSpec (JoinIndexRule.scala:124-153). All version dirs listed in
+    `content.directories` participate: bucket b's data is the union of the
+    bucket-b files across dirs (base + incremental-refresh deltas)."""
+    root = Path(entry.content.root)
     schema = Schema.from_json(entry.derived_dataset.schema)
-    files = [fi.path for fi in list_data_files(version_dir)]
-    manifest = hio.read_manifest(version_dir)
+    files: list[str] = []
+    for d in entry.content.directories:
+        files.extend(fi.path for fi in list_data_files(root / d))
+    first_dir = root / entry.content.directories[0]
+    manifest = hio.read_manifest(first_dir)
     num_buckets = manifest["numBuckets"] if manifest else entry.derived_dataset.num_buckets
     return Scan(
-        str(version_dir),
+        str(root),
         "parquet",
         schema,
         files=sorted(files),
@@ -62,18 +72,74 @@ def index_scan_for(entry: IndexLogEntry) -> Scan:
     )
 
 
+def hybrid_scan_for(match: "IndexMatch", source_scan: Scan):
+    """Plan fragment for a hybrid match: the bucketed index scan unioned
+    with a raw scan pinned to the appended source files, projected to the
+    index's column set so both union inputs line up."""
+    from hyperspace_tpu.plan.nodes import Project, Union
+
+    entry = match.entry
+    idx_scan = index_scan_for(entry)
+    delta_scan = Scan(
+        source_scan.root,
+        source_scan.format,
+        source_scan.scan_schema,
+        files=sorted(f.path for f in match.appended),
+    )
+    cols = [source_scan.scan_schema.field(c).name for c in entry.derived_dataset.all_columns]
+    return Union([idx_scan, Project(delta_scan, cols)])
+
+
+@dataclasses.dataclass
+class IndexMatch:
+    """How an index applies to a source relation: exactly (signature equal)
+    or via hybrid scan (index data + `appended` source files scanned raw)."""
+
+    entry: IndexLogEntry
+    appended: list  # FileInfo; empty ⇒ exact match
+
+    @property
+    def is_exact(self) -> bool:
+        return not self.appended
+
+
 class SignatureMatcher:
     """Memoized plan-fingerprint matching (the reference memoizes per
-    provider within one optimizer invocation, JoinIndexRule.scala:328-353)."""
+    provider within one optimizer invocation, JoinIndexRule.scala:328-353).
+    With hybrid scan enabled, a signature mismatch can still match when the
+    only divergence is appended source files within the configured ratio."""
 
-    def __init__(self):
+    def __init__(self, conf=None):
         self._provider = create_signature_provider()
         self._cache: dict[int, str | None] = {}
+        self._files_cache: dict[int, list] = {}
+        self._hybrid = bool(conf.hybrid_scan_enabled) if conf is not None else False
+        self._max_ratio = (
+            float(conf.hybrid_scan_max_appended_ratio) if conf is not None else 0.0
+        )
 
-    def matches(self, entry: IndexLogEntry, source: LogicalPlan) -> bool:
+    def match(self, entry: IndexLogEntry, source: LogicalPlan) -> IndexMatch | None:
         key = id(source)
         if key not in self._cache:
             fp = self._provider.signature(source)
             self._cache[key] = None if fp is None else fp.value
         value = self._cache[key]
-        return value is not None and value == entry.signature.value
+        if value is not None and value == entry.signature.value:
+            return IndexMatch(entry, [])
+        if not self._hybrid:
+            return None
+        from hyperspace_tpu.signature import collect_leaf_files, diff_source_files
+
+        # One live listing per source plan, reused across candidate entries.
+        if key not in self._files_cache:
+            current = []
+            for leaf in source.leaves():
+                current.extend(collect_leaf_files(leaf))
+            self._files_cache[key] = current
+        appended, deleted = diff_source_files(entry, source, current=self._files_cache[key])
+        if deleted or not appended:
+            return None
+        logged_bytes = sum(f.size for f in entry.source.files) or 1
+        if sum(f.size for f in appended) > self._max_ratio * logged_bytes:
+            return None
+        return IndexMatch(entry, appended)

@@ -22,14 +22,14 @@ import dataclasses
 
 from hyperspace_tpu.metadata.log_entry import IndexLogEntry
 from hyperspace_tpu.plan.nodes import Filter, LogicalPlan, Project, Scan
-from hyperspace_tpu.rules.base import Rule, SignatureMatcher, index_scan_for
+from hyperspace_tpu.rules.base import Rule, SignatureMatcher, hybrid_scan_for, index_scan_for
 
 
 class FilterIndexRule(Rule):
     name = "FilterIndexRule"
 
     def apply(self, plan: LogicalPlan, indexes: list[IndexLogEntry]) -> LogicalPlan:
-        matcher = SignatureMatcher()
+        matcher = SignatureMatcher(self.conf)
         return self._rewrite(plan, indexes, matcher)
 
     def _rewrite(self, plan: LogicalPlan, indexes, matcher) -> LogicalPlan:
@@ -58,7 +58,7 @@ class FilterIndexRule(Rule):
             return new
         return plan
 
-    def _replacement(self, scan: Scan, predicate, output_columns, indexes, matcher) -> Scan | None:
+    def _replacement(self, scan: Scan, predicate, output_columns, indexes, matcher) -> LogicalPlan | None:
         if scan.bucket_spec is not None:
             return None  # already an index scan — never rewrite twice
         filter_cols = {c.lower() for c in predicate.references()}
@@ -66,11 +66,12 @@ class FilterIndexRule(Rule):
         for entry in indexes:
             idx_cols = {c.lower() for c in entry.derived_dataset.all_columns}
             first_indexed = entry.indexed_columns[0].lower()
-            if (
-                required <= idx_cols
-                and first_indexed in filter_cols
-                and matcher.matches(entry, scan)
-            ):
+            if required <= idx_cols and first_indexed in filter_cols:
+                m = matcher.match(entry, scan)
+                if m is None:
+                    continue
                 # First matching candidate wins (FilterIndexRule.scala:222-228).
-                return index_scan_for(entry)
+                if m.is_exact:
+                    return index_scan_for(entry)
+                return hybrid_scan_for(m, scan)
         return None

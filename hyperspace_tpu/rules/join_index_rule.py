@@ -25,7 +25,7 @@ from __future__ import annotations
 
 from hyperspace_tpu.metadata.log_entry import IndexLogEntry
 from hyperspace_tpu.plan.nodes import Filter, Join, LogicalPlan, Project, Scan
-from hyperspace_tpu.rules.base import Rule, SignatureMatcher, index_scan_for
+from hyperspace_tpu.rules.base import Rule, SignatureMatcher, hybrid_scan_for, index_scan_for
 from hyperspace_tpu.rules.ranker import JoinIndexRanker
 
 
@@ -54,7 +54,7 @@ def _side_required_columns(plan: LogicalPlan, join_cols: list[str]) -> set[str]:
     return required
 
 
-def _replace_scan(plan: LogicalPlan, new_scan: Scan) -> LogicalPlan:
+def _replace_scan(plan: LogicalPlan, new_scan: LogicalPlan) -> LogicalPlan:
     if isinstance(plan, Scan):
         return new_scan
     if isinstance(plan, Project):
@@ -68,7 +68,7 @@ class JoinIndexRule(Rule):
     name = "JoinIndexRule"
 
     def apply(self, plan: LogicalPlan, indexes: list[IndexLogEntry]) -> LogicalPlan:
-        matcher = SignatureMatcher()
+        matcher = SignatureMatcher(self.conf)
         return self._rewrite(plan, indexes, matcher)
 
     def _rewrite(self, plan: LogicalPlan, indexes, matcher) -> LogicalPlan:
@@ -113,20 +113,35 @@ class JoinIndexRule(Rule):
         pairs = self._compatible_pairs(lcands, rcands, plan.left_on, plan.right_on)
         if not pairs:
             return None
-        best_l, best_r = JoinIndexRanker.rank(pairs)[0]
+        best_l, best_r = JoinIndexRanker.rank(
+            [(lm.entry, rm.entry) for lm, rm in pairs],
+        )[0]
+        lmatch = next(lm for lm, _ in pairs if lm.entry is best_l)
+        rmatch = next(rm for _, rm in pairs if rm.entry is best_r)
 
-        new_left = _replace_scan(plan.left, index_scan_for(best_l))
-        new_right = _replace_scan(plan.right, index_scan_for(best_r))
+        new_left = _replace_scan(plan.left, self._side_plan(lmatch, lscan))
+        new_right = _replace_scan(plan.right, self._side_plan(rmatch, rscan))
         return Join(new_left, new_right, plan.left_on, plan.right_on, plan.how)
 
-    def _usable(self, indexes, scan: Scan, join_cols, required: set[str], matcher) -> list[IndexLogEntry]:
+    @staticmethod
+    def _side_plan(match, scan: Scan) -> LogicalPlan:
+        """Exact match ⇒ the bucketed index scan; hybrid ⇒ index ∪ appended
+        (the executor bucketizes the appended rows on the fly, the analog of
+        later-Hyperspace's on-the-fly shuffle of appended data)."""
+        if match.is_exact:
+            return index_scan_for(match.entry)
+        return hybrid_scan_for(match, scan)
+
+    def _usable(self, indexes, scan: Scan, join_cols, required: set[str], matcher):
         out = []
         jset = {c.lower() for c in join_cols}
         for entry in indexes:
             iset = {c.lower() for c in entry.indexed_columns}
             cover = {c.lower() for c in entry.derived_dataset.all_columns}
-            if iset == jset and required <= cover and matcher.matches(entry, scan):
-                out.append(entry)
+            if iset == jset and required <= cover:
+                m = matcher.match(entry, scan)
+                if m is not None:
+                    out.append(m)
         return out
 
     def _compatible_pairs(self, lcands, rcands, left_on, right_on):
@@ -134,9 +149,9 @@ class JoinIndexRule(Rule):
         (JoinIndexRule.scala:547-594)."""
         l2r = {l.lower(): r.lower() for l, r in zip(left_on, right_on)}
         pairs = []
-        for le in lcands:
-            expected_r = [l2r[c.lower()] for c in le.indexed_columns]
-            for re in rcands:
-                if [c.lower() for c in re.indexed_columns] == expected_r:
-                    pairs.append((le, re))
+        for lm in lcands:
+            expected_r = [l2r[c.lower()] for c in lm.entry.indexed_columns]
+            for rm in rcands:
+                if [c.lower() for c in rm.entry.indexed_columns] == expected_r:
+                    pairs.append((lm, rm))
         return pairs

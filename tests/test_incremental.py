@@ -1,0 +1,242 @@
+"""Incremental refresh + Hybrid Scan contract tests.
+
+The reference v0.2 only has full-rebuild refresh; these cover the
+incremental/delta machinery the BASELINE configs require (TPC-DS Hybrid
+Scan; NYC-Taxi incremental refresh + compaction loop). The contract mirrors
+the E2E equality gate: with-index results must be row-identical to
+no-index results after every mutation.
+"""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col
+from hyperspace_tpu.config import (
+    INDEX_HYBRID_SCAN_ENABLED,
+    INDEX_HYBRID_SCAN_MAX_APPENDED_RATIO,
+)
+from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu.plan.nodes import Union
+
+
+@pytest.fixture
+def session(tmp_system_path):
+    return HyperspaceSession(system_path=tmp_system_path, num_buckets=8)
+
+
+@pytest.fixture
+def hs(session):
+    return Hyperspace(session)
+
+
+def frames_equal(a: pd.DataFrame, b: pd.DataFrame):
+    assert sorted(a.columns) == sorted(b.columns)
+    cols = sorted(a.columns)
+    a2 = a[cols].sort_values(cols).reset_index(drop=True)
+    b2 = b[cols].sort_values(cols).reset_index(drop=True)
+    pd.testing.assert_frame_equal(a2, b2, check_dtype=False)
+
+
+def index_used(plan) -> bool:
+    return any(s.bucket_spec is not None for s in plan.leaves())
+
+
+def has_union(plan) -> bool:
+    if isinstance(plan, Union):
+        return True
+    return any(has_union(c) for c in plan.children())
+
+
+def append_rows(root, n=300, seed=7, fname="part-appended.parquet"):
+    rng = np.random.default_rng(seed)
+    table = pa.table(
+        {
+            "id": pa.array(np.arange(100_000, 100_000 + n, dtype=np.int64)),
+            "key": pa.array(rng.integers(0, 100, size=n, dtype=np.int64)),
+            "value": pa.array(rng.standard_normal(n).astype(np.float64)),
+            "name": pa.array([f"name_{i % 37}" for i in range(n)]),
+        }
+    )
+    import pathlib
+
+    pq.write_table(table, pathlib.Path(root) / fname)
+
+
+class TestIncrementalRefresh:
+    def test_incremental_refresh_filter_equality(self, session, hs, sample_parquet):
+        df = session.parquet(sample_parquet)
+        hs.create_index(df, IndexConfig("inc1", ["key"], ["value", "id"]))
+        append_rows(sample_parquet)
+
+        hs.refresh_index("inc1", mode="incremental")
+
+        entry = session.manager.get_indexes()[0]
+        assert entry.content.directories == ["v__=0", "v__=1"]
+
+        q = df.filter(col("key") == 42).select("key", "value")
+        session.enable_hyperspace()
+        opt = session.optimized_plan(q)
+        assert index_used(opt), "index must match again after incremental refresh"
+        got = session.to_pandas(q)
+        session.disable_hyperspace()
+        frames_equal(got, session.to_pandas(q))
+
+    def test_incremental_refresh_join_equality(self, session, hs, sample_parquet, tmp_path):
+        rng = np.random.default_rng(3)
+        n = 400
+        other_root = tmp_path / "dim"
+        other_root.mkdir()
+        pq.write_table(
+            pa.table(
+                {
+                    "key": pa.array(np.arange(100, dtype=np.int64)),
+                    "label": pa.array([f"l{i}" for i in range(100)]),
+                }
+            ),
+            other_root / "dim-0.parquet",
+        )
+        fact = session.parquet(sample_parquet)
+        dim = session.parquet(other_root)
+        hs.create_index(fact, IndexConfig("factidx", ["key"], ["value"]))
+        hs.create_index(dim, IndexConfig("dimidx", ["key"], ["label"]))
+
+        append_rows(sample_parquet)
+        hs.refresh_index("factidx", mode="incremental")
+
+        q = fact.select("key", "value").join(dim.select("key", "label"), ["key"])
+        session.enable_hyperspace()
+        opt = session.optimized_plan(q)
+        assert index_used(opt)
+        got = session.to_pandas(q)
+        session.disable_hyperspace()
+        frames_equal(got, session.to_pandas(q))
+
+    def test_optimize_compacts_delta_versions(self, session, hs, sample_parquet):
+        df = session.parquet(sample_parquet)
+        hs.create_index(df, IndexConfig("inc2", ["key"], ["value"]))
+        append_rows(sample_parquet, seed=11, fname="a1.parquet")
+        hs.refresh_index("inc2", mode="incremental")
+        append_rows(sample_parquet, seed=12, fname="a2.parquet")
+        hs.refresh_index("inc2", mode="incremental")
+
+        entry = session.manager.get_indexes()[0]
+        assert len(entry.content.directories) == 3
+
+        hs.optimize_index("inc2")
+        entry = session.manager.get_indexes()[0]
+        assert entry.content.directories == ["v__=3"]
+
+        q = df.filter(col("key") == 5).select("key", "value")
+        session.enable_hyperspace()
+        assert index_used(session.optimized_plan(q))
+        got = session.to_pandas(q)
+        session.disable_hyperspace()
+        frames_equal(got, session.to_pandas(q))
+
+    def test_incremental_refresh_without_new_files_fails(self, session, hs, sample_parquet):
+        df = session.parquet(sample_parquet)
+        hs.create_index(df, IndexConfig("inc3", ["key"], ["value"]))
+        with pytest.raises(HyperspaceError, match="no appended"):
+            hs.refresh_index("inc3", mode="incremental")
+
+    def test_incremental_refresh_with_deleted_file_fails(self, session, hs, sample_parquet):
+        import pathlib
+
+        df = session.parquet(sample_parquet)
+        hs.create_index(df, IndexConfig("inc4", ["key"], ["value"]))
+        pathlib.Path(sample_parquet, "part-1.parquet").unlink()
+        with pytest.raises(HyperspaceError, match="deleted or modified"):
+            hs.refresh_index("inc4", mode="incremental")
+
+    def test_unknown_refresh_mode_rejected(self, session, hs, sample_parquet):
+        df = session.parquet(sample_parquet)
+        hs.create_index(df, IndexConfig("inc5", ["key"], ["value"]))
+        with pytest.raises(HyperspaceError, match="unknown refresh mode"):
+            hs.refresh_index("inc5", mode="sideways")
+
+
+class TestHybridScan:
+    def enable_hybrid(self, session, ratio=10.0):
+        session.conf.set(INDEX_HYBRID_SCAN_ENABLED, True)
+        session.conf.set(INDEX_HYBRID_SCAN_MAX_APPENDED_RATIO, ratio)
+
+    def test_filter_hybrid_scan_equality(self, session, hs, sample_parquet):
+        df = session.parquet(sample_parquet)
+        hs.create_index(df, IndexConfig("h1", ["key"], ["value", "id"]))
+        append_rows(sample_parquet)
+
+        q = df.filter(col("key") == 42).select("key", "value")
+        session.enable_hyperspace()
+        # Without hybrid scan: stale signature ⇒ no rewrite.
+        assert not index_used(session.optimized_plan(q))
+
+        self.enable_hybrid(session)
+        opt = session.optimized_plan(q)
+        assert index_used(opt) and has_union(opt), "hybrid scan union expected"
+        got = session.to_pandas(q)
+        session.disable_hyperspace()
+        frames_equal(got, session.to_pandas(q))
+
+    def test_join_hybrid_scan_equality(self, session, hs, sample_parquet, tmp_path):
+        other_root = tmp_path / "dim"
+        other_root.mkdir()
+        pq.write_table(
+            pa.table(
+                {
+                    "key": pa.array(np.arange(100, dtype=np.int64)),
+                    "label": pa.array([f"l{i}" for i in range(100)]),
+                }
+            ),
+            other_root / "dim-0.parquet",
+        )
+        fact = session.parquet(sample_parquet)
+        dim = session.parquet(other_root)
+        hs.create_index(fact, IndexConfig("hf", ["key"], ["value"]))
+        hs.create_index(dim, IndexConfig("hd", ["key"], ["label"]))
+        append_rows(sample_parquet)
+
+        q = fact.select("key", "value").join(dim.select("key", "label"), ["key"])
+        session.enable_hyperspace()
+        self.enable_hybrid(session)
+        opt = session.optimized_plan(q)
+        assert index_used(opt) and has_union(opt)
+        got = session.to_pandas(q)
+        session.disable_hyperspace()
+        frames_equal(got, session.to_pandas(q))
+
+    def test_hybrid_scan_respects_appended_ratio(self, session, hs, sample_parquet):
+        df = session.parquet(sample_parquet)
+        hs.create_index(df, IndexConfig("h2", ["key"], ["value"]))
+        append_rows(sample_parquet)
+
+        session.enable_hyperspace()
+        self.enable_hybrid(session, ratio=1e-9)  # appended bytes exceed this
+        q = df.filter(col("key") == 42).select("key", "value")
+        assert not index_used(session.optimized_plan(q))
+
+    def test_hybrid_scan_not_used_for_deletes(self, session, hs, sample_parquet):
+        import pathlib
+
+        df = session.parquet(sample_parquet)
+        hs.create_index(df, IndexConfig("h3", ["key"], ["value"]))
+        pathlib.Path(sample_parquet, "part-1.parquet").unlink()
+
+        session.enable_hyperspace()
+        self.enable_hybrid(session)
+        q = df.filter(col("key") == 42).select("key", "value")
+        assert not index_used(session.optimized_plan(q))
+
+    def test_hybrid_point_lookup_prunes_buckets(self, session, hs, sample_parquet):
+        """The union's index input still bucket-prunes on point predicates."""
+        df = session.parquet(sample_parquet)
+        hs.create_index(df, IndexConfig("h4", ["key"], ["value"]))
+        append_rows(sample_parquet)
+        session.enable_hyperspace()
+        self.enable_hybrid(session)
+        q = df.filter(col("key") == 7).select("key", "value")
+        got = session.to_pandas(q)
+        session.disable_hyperspace()
+        frames_equal(got, session.to_pandas(q))
