@@ -80,9 +80,21 @@ class CreateActionBase(Action):
     def _num_buckets(self) -> int:
         return int(self.conf.num_buckets)
 
+    def _source_files(self) -> list:
+        """The file snapshot this build indexes. Base: one live listing of
+        the plan's leaves. Subclasses that index a pre-computed snapshot
+        (incremental refresh) override this so the entry can never claim
+        files the build didn't see."""
+        from hyperspace_tpu.signature import collect_leaf_files
+
+        files = []
+        for leaf in self.plan.leaves():
+            files.extend(collect_leaf_files(leaf))
+        return files
+
     def build_log_entry(self) -> IndexLogEntry:
         from hyperspace_tpu.metadata.log_entry import Fingerprint
-        from hyperspace_tpu.signature import collect_leaf_files, fingerprint_files
+        from hyperspace_tpu.signature import fingerprint_files
 
         cfg = self.index_config
         plan_schema = self.plan.schema
@@ -90,9 +102,7 @@ class CreateActionBase(Action):
         num_buckets = self._num_buckets()
         # Single listing pass: the fingerprint and the recorded file list are
         # derived from the same snapshot so they can never diverge.
-        files = []
-        for leaf in self.plan.leaves():
-            files.extend(collect_leaf_files(leaf))
+        files = self._source_files()
         provider = create_signature_provider()
         fp = Fingerprint(kind=provider.name, value=fingerprint_files(files))
         version = self._version_id

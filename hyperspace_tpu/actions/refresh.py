@@ -58,7 +58,7 @@ class RefreshAction(CreateActionBase):
             )
 
 
-class RefreshIncrementalAction(CreateActionBase):
+class RefreshIncrementalAction(RefreshAction):
     """Incremental refresh: index ONLY the source files appended since the
     last build, writing per-bucket delta files into the next `v__=` version.
 
@@ -72,13 +72,12 @@ class RefreshIncrementalAction(CreateActionBase):
       function as the base build, so bucket b's data is the union of bucket
       b's files across all version dirs — query plans need no re-shuffle;
     - the new log entry lists ALL version dirs in `content.directories` and
-      refingerprints the full current snapshot;
+      records EXACTLY the indexed snapshot (previous files + the diff —
+      never a second live listing, which could claim files written after
+      the diff that op() will not index);
     - deleted/modified source files require a full refresh (round-1 scope;
       the reference's lineage-based delete handling is a later feature).
     """
-
-    transient_state = states.REFRESHING
-    final_state = states.ACTIVE
 
     def __init__(
         self,
@@ -88,30 +87,13 @@ class RefreshIncrementalAction(CreateActionBase):
         conf: HyperspaceConf,
         writer: IndexWriter,
     ):
-        prev = log_manager.get_latest_log()
-        if prev is None:
-            raise HyperspaceError("no index to refresh")
-        self.previous_entry = prev
-        plan = plan_from_json(prev.source.plan)
-        cfg = IndexConfig(
-            prev.name,
-            prev.derived_dataset.indexed_columns,
-            prev.derived_dataset.included_columns,
-        )
-        super().__init__(plan, cfg, log_manager, data_manager, index_path, conf, writer)
+        super().__init__(log_manager, data_manager, index_path, conf, writer)
         from hyperspace_tpu.signature import diff_source_files
 
         self._appended, self._deleted = diff_source_files(self.previous_entry, self.plan)
 
-    def _num_buckets(self) -> int:
-        return self.previous_entry.derived_dataset.num_buckets
-
     def validate(self) -> None:
-        if self.previous_entry.state != states.ACTIVE:
-            raise HyperspaceError(
-                f"refresh is only supported in {states.ACTIVE} state "
-                f"(found {self.previous_entry.state})"
-            )
+        super().validate()
         if self._deleted:
             raise HyperspaceError(
                 "incremental refresh cannot handle deleted or modified source "
@@ -123,30 +105,18 @@ class RefreshIncrementalAction(CreateActionBase):
                 "refresh aborted: no appended source data files found"
             )
 
-    def build_log_entry(self) -> IndexLogEntry:
-        from hyperspace_tpu.metadata.log_entry import Fingerprint
-        from hyperspace_tpu.signature import create_signature_provider, fingerprint_files
+    def _source_files(self) -> list:
+        return sorted(
+            list(self.previous_entry.source.files) + list(self._appended),
+            key=lambda f: f.path,
+        )
 
+    def build_log_entry(self) -> IndexLogEntry:
         entry = super().build_log_entry()
         # Keep every prior version dir live: bucket b = union over dirs.
         prev_dirs = list(self.previous_entry.content.directories)
         entry.content = dataclasses.replace(
             entry.content, directories=prev_dirs + [f"v__={self._version_id}"]
-        )
-        # Record EXACTLY the snapshot this action indexes: the previous
-        # entry's files plus the appended diff — not a second live listing,
-        # which could pick up files written after the diff that op() will
-        # never index (the entry would then claim an exact signature over
-        # data the index doesn't contain).
-        files = sorted(
-            list(self.previous_entry.source.files) + list(self._appended),
-            key=lambda f: f.path,
-        )
-        provider = create_signature_provider()
-        entry.source = dataclasses.replace(
-            entry.source,
-            files=files,
-            fingerprint=Fingerprint(kind=provider.name, value=fingerprint_files(files)),
         )
         return entry
 

@@ -20,7 +20,6 @@ compactor seam.
 
 from __future__ import annotations
 
-import functools
 import math
 from pathlib import Path
 
@@ -28,9 +27,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from jax.sharding import Mesh
 
 from hyperspace_tpu.dataset import list_data_files
 from hyperspace_tpu.exceptions import HyperspaceError
@@ -38,7 +35,7 @@ from hyperspace_tpu.execution import io as hio
 from hyperspace_tpu.execution.table import ColumnTable
 from hyperspace_tpu.ops.bucketize import AXIS, bucketize
 from hyperspace_tpu.ops.hashing import bucket_ids, combine_hashes, hash_int_column, string_dict_hashes
-from hyperspace_tpu.parallel.mesh import ensure_x64, make_mesh
+from hyperspace_tpu.parallel.mesh import enable_compile_cache, make_mesh
 from hyperspace_tpu.plan.nodes import LogicalPlan, Scan
 
 
@@ -69,22 +66,6 @@ def hash_scalar_key(values: list, fields) -> np.ndarray:
     return combine_hashes(hs, np)
 
 
-@functools.lru_cache(maxsize=64)
-def _make_local_sort(mesh: Mesh, num_keys: int, num_payloads: int):
-    @functools.partial(
-        shard_map,
-        mesh=mesh,
-        in_specs=(P(AXIS),) * (1 + num_keys + num_payloads),
-        out_specs=(P(AXIS),) * (1 + num_keys + num_payloads),
-    )
-    def fn(*arrays):
-        # arrays = (bucket, keys..., payloads...); invalid rows carry the
-        # sentinel bucket so they sink to the end of the shard.
-        return lax.sort(arrays, num_keys=1 + num_keys, is_stable=True)
-
-    return jax.jit(fn)
-
-
 def _pad_to(arr: np.ndarray, n: int, fill=0) -> np.ndarray:
     if len(arr) == n:
         return arr
@@ -98,6 +79,7 @@ class DeviceIndexBuilder:
     def __init__(self, mesh: Mesh | None = None, capacity_factor: float = 2.0):
         self._mesh = mesh
         self.capacity_factor = capacity_factor
+        enable_compile_cache()
 
     def _mesh_for(self, num_buckets: int) -> Mesh:
         mesh = self._mesh if self._mesh is not None else make_mesh()
@@ -128,7 +110,6 @@ class DeviceIndexBuilder:
         num_buckets: int,
         dest_path: Path,
     ) -> None:
-        ensure_x64()
         mesh = self._mesh_for(num_buckets)
         d = mesh.shape[AXIS]
         n = table.num_rows
@@ -137,43 +118,62 @@ class DeviceIndexBuilder:
         row_hash = compute_row_hashes(table, indexed_columns)
         bucket = bucket_ids(row_hash, num_buckets, np)
 
+        # Host: order-preserving int32 rank codes per key column. The
+        # device exchange + sort run entirely in native int32 (TPU has no
+        # native 64-bit sort; pushing int64/float64 payloads through a
+        # variadic lax.sort is both slow to compile and slow to run).
+        # Payload bytes never touch the device: the sort emits a row-id
+        # permutation and the host gathers the original columns by it.
+        key_names = [table.schema.field(c).name for c in indexed_columns]
+        key_codes = []
+        for kname in key_names:
+            f = table.schema.field(kname)
+            arr = table.columns[kname]
+            if f.is_string:
+                key_codes.append(arr.astype(np.int32))  # sorted-dict codes
+            else:
+                _, inv = np.unique(arr, return_inverse=True)
+                key_codes.append(inv.astype(np.int32))
+
         # Pad rows to a multiple of the mesh size.
         n_pad = max(d, math.ceil(max(n, 1) / d) * d)
         valid = _pad_to(np.ones(n, np.int32), n_pad)
-        bucket = _pad_to(bucket, n_pad)
+        bucket_p = _pad_to(bucket, n_pad)
+        gid = _pad_to(np.arange(n, dtype=np.int32), n_pad)
+        codes_p = [_pad_to(c, n_pad) for c in key_codes]
 
+        # Device: the exchange (Spark-shuffle analog, single all_to_all)
+        # fused with the per-shard lex sort by (bucket, key codes); the
+        # row-id rides along as the only payload.
+        out_cols, out_bucket, out_valid = bucketize(
+            mesh,
+            [jnp.asarray(c) for c in codes_p] + [jnp.asarray(gid)],
+            jnp.asarray(bucket_p),
+            jnp.asarray(valid),
+            num_buckets,
+            self.capacity_factor,
+            num_key_cols=len(key_names),
+        )
+        out_bucket_h = np.asarray(jax.device_get(out_bucket))
+        gid_h = np.asarray(jax.device_get(out_cols[-1]))
+        valid_mask = out_bucket_h < num_buckets  # sentinel marks invalid
+
+        # Host: gather every column by the device-computed permutation and
+        # carve into per-bucket files.
+        compact_bucket = out_bucket_h[valid_mask]
+        order = gid_h[valid_mask]
+        if len(order) != n:
+            raise HyperspaceError(
+                f"row count changed through exchange: {n} → {len(order)}"
+            )
         field_names = [f.name for f in table.schema.fields]
-        key_names = [table.schema.field(c).name for c in indexed_columns]
         payload_names = [c for c in field_names if c not in key_names]
         ordered = key_names + payload_names
-
-        cols = [_pad_to(self._device_repr(table, c), n_pad) for c in ordered]
-
-        # Device: the exchange (Spark-shuffle analog, single all_to_all).
-        out_cols, out_bucket, out_valid = bucketize(
-            mesh, [jnp.asarray(c) for c in cols], jnp.asarray(bucket), jnp.asarray(valid),
-            num_buckets, self.capacity_factor,
-        )
-
-        # Device: fused lex sort by (bucket, indexed cols) per shard.
-        sort_fn = _make_local_sort(mesh, len(key_names), len(payload_names))
-        sorted_arrays = sort_fn(out_bucket, *out_cols)
-        out_bucket = np.asarray(jax.device_get(sorted_arrays[0]))
-        host_cols = [np.asarray(jax.device_get(a)) for a in sorted_arrays[1:]]
-        out_valid_host = out_bucket < num_buckets  # sentinel marks invalid
-
-        # Host: compact and carve into per-bucket files.
-        compact_bucket = out_bucket[out_valid_host]
-        compact_cols = {name: arr[out_valid_host] for name, arr in zip(ordered, host_cols)}
-        if len(compact_bucket) != n:
-            raise HyperspaceError(
-                f"row count changed through exchange: {n} → {len(compact_bucket)}"
-            )
         # Devices own contiguous bucket ranges in mesh order and each shard
         # is bucket-sorted, so the compacted global bucket array is sorted.
         result = ColumnTable(
             table.schema.select(ordered),
-            {k: self._logical_repr(table, k, v) for k, v in compact_cols.items()},
+            {name: table.columns[name][order] for name in ordered},
             dict(table.dictionaries),
         )
         bucket_rows = []
@@ -204,17 +204,3 @@ class DeviceIndexBuilder:
             raise HyperspaceError("index builds materialize scan-only plans")
         files = plan.files if plan.files is not None else [fi.path for fi in list_data_files(plan.root)]
         return hio.read_parquet(files, columns=columns, schema=plan.schema)
-
-    @staticmethod
-    def _device_repr(table: ColumnTable, name: str) -> np.ndarray:
-        arr = table.columns[name]
-        if arr.dtype == np.bool_:
-            return arr.astype(np.int32)
-        return arr
-
-    @staticmethod
-    def _logical_repr(table: ColumnTable, name: str, arr: np.ndarray) -> np.ndarray:
-        orig = table.columns[name]
-        if orig.dtype == np.bool_:
-            return arr.astype(np.bool_)
-        return arr.astype(orig.dtype, copy=False)

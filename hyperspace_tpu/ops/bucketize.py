@@ -42,10 +42,14 @@ def _exchange_one_device(
     num_devices: int,
     buckets_per_device: int,
     capacity: int,
+    num_key_cols: int,
 ):
     """Per-device body run under shard_map. `cols` are the local columns
-    [R, ...]; `bucket` the per-row bucket id; `valid` marks real rows.
-    Returns (recv_cols, recv_bucket, recv_valid, overflowed)."""
+    [R, ...] (first `num_key_cols` are sort keys, rest payloads); `bucket`
+    the per-row bucket id; `valid` marks real rows. Returns
+    (recv_cols, recv_bucket, recv_valid, overflowed) with received rows
+    lex-sorted by (bucket, key cols) — the exchange AND the local sort run
+    in one fused device program."""
     r = bucket.shape[0]
     dest = jnp.where(valid, bucket // buckets_per_device, num_devices)  # invalid → sentinel D
 
@@ -54,37 +58,41 @@ def _exchange_one_device(
     dest_sorted = dest[order]
     bucket_sorted = bucket[order]
 
-    # Rank of each row within its destination group.
+    # Per-destination group extents.
     counts = jnp.bincount(dest_sorted, length=num_devices + 1)
     offsets = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(counts)[:-1].astype(jnp.int32)])
-    within = jnp.arange(r, dtype=jnp.int32) - offsets[dest_sorted]
-
     overflowed = jnp.max(counts[:num_devices]) > capacity
 
-    # Scatter into the [D, C] send buffer (invalid/overflow rows dropped).
-    slot_ok = (within < capacity) & (dest_sorted < num_devices)
-    flat_idx = jnp.where(slot_ok, dest_sorted * capacity + within, num_devices * capacity)
+    # Build the [D, C] send buffer by GATHER (TPU-friendly; scatters
+    # serialize): slot (d, c) reads sorted row offsets[d] + c when real.
+    slot_dst = jnp.repeat(jnp.arange(num_devices, dtype=jnp.int32), capacity)
+    slot_within = jnp.tile(jnp.arange(capacity, dtype=jnp.int32), num_devices)
+    slot_ok = slot_within < counts[slot_dst]
+    src = jnp.where(slot_ok, offsets[slot_dst] + slot_within, 0)
 
-    def scatter(col_sorted, fill):
-        buf = jnp.full((num_devices * capacity + 1,), fill, dtype=col_sorted.dtype)
-        buf = buf.at[flat_idx].set(col_sorted, mode="drop")
-        return buf[:-1].reshape(num_devices, capacity)
+    def fill_slots(col_sorted, fill):
+        """Gather per-ROW values into the [D, C] slot layout."""
+        vals = jnp.where(slot_ok, col_sorted[src], fill)
+        return vals.reshape(num_devices, capacity)
 
-    send_valid = scatter(slot_ok.astype(jnp.int32), 0)
-    send_bucket = scatter(jnp.where(slot_ok, bucket_sorted, -1), -1)
-    send_cols = [scatter(c[order], 0) for c in cols]
+    send_valid = slot_ok.astype(jnp.int32).reshape(num_devices, capacity)
+    send_bucket = fill_slots(bucket_sorted, -1)
+    send_cols = [fill_slots(c[order], 0) for c in cols]
 
     # THE exchange: one all_to_all over the mesh axis (ICI).
     recv_valid = lax.all_to_all(send_valid, AXIS, 0, 0, tiled=True)
     recv_bucket = lax.all_to_all(send_bucket, AXIS, 0, 0, tiled=True)
     recv_cols = [lax.all_to_all(c, AXIS, 0, 0, tiled=True) for c in send_cols]
 
-    # Flatten [D, C] → [D*C] and lex-sort by (validity, bucket) so real rows
-    # come first, grouped by bucket. Key sort happens later with the real
-    # key columns (builder adds them as leading sort keys).
+    # Flatten [D, C] → [D*C]; invalid rows get the sentinel bucket so they
+    # sink to the end, then ONE stable lex-sort by (bucket, key cols).
     rv = recv_valid.reshape(-1)
     rb = jnp.where(rv > 0, recv_bucket.reshape(-1), jnp.int32(2**30))
     rc = [c.reshape(-1) for c in recv_cols]
+    sorted_arrays = lax.sort((rb, *rc, rv), num_keys=1 + num_key_cols, is_stable=True)
+    rb = sorted_arrays[0]
+    rc = list(sorted_arrays[1:-1])
+    rv = sorted_arrays[-1]
     return rc, rb, rv, overflowed
 
 
@@ -94,8 +102,9 @@ def make_bucketize_fn(
     num_cols: int,
     num_buckets: int,
     capacity: int,
+    num_key_cols: int,
 ):
-    """Build the jitted shard_map'd exchange for a fixed column layout."""
+    """Build the jitted shard_map'd exchange+sort for a fixed column layout."""
     num_devices = mesh.shape[AXIS]
     if num_buckets % num_devices != 0:
         raise ValueError(f"num_buckets {num_buckets} must be a multiple of mesh size {num_devices}")
@@ -110,7 +119,7 @@ def make_bucketize_fn(
     )
     def fn(cols, bucket, valid):
         rc, rb, rv, overflow = _exchange_one_device(
-            list(cols), bucket, valid, num_devices, buckets_per_device, capacity
+            list(cols), bucket, valid, num_devices, buckets_per_device, capacity, num_key_cols
         )
         # overflow is a per-device scalar; reduce with OR (max) across mesh.
         overflow = lax.pmax(overflow.astype(jnp.int32), AXIS)
@@ -126,19 +135,25 @@ def bucketize(
     valid: jnp.ndarray,
     num_buckets: int,
     capacity_factor: float = 2.0,
+    num_key_cols: int | None = None,
 ):
     """Host wrapper with overflow retry (doubling the capacity factor).
 
     Inputs are global arrays whose leading dim is a multiple of the mesh
-    size (caller pads). Returns (cols, bucket, valid) where rows live on
-    their owning device, ordered valid-first by bucket."""
+    size (caller pads). The first `num_key_cols` of `cols` (default: all
+    but the last) are sort keys after the exchange. Returns
+    (cols, bucket, valid) where rows live on their owning device,
+    lex-sorted by (bucket, keys) with invalid rows sunk to each shard's
+    tail under the sentinel bucket."""
     num_devices = mesh.shape[AXIS]
     n = bucket.shape[0]
     per_dev = n // num_devices
+    if num_key_cols is None:
+        num_key_cols = max(0, len(cols) - 1)
     while True:
         capacity = max(1, math.ceil(per_dev / num_devices * capacity_factor))
         capacity = min(capacity, per_dev)  # no point exceeding local rows
-        fn = make_bucketize_fn(mesh, len(cols), num_buckets, capacity)
+        fn = make_bucketize_fn(mesh, len(cols), num_buckets, capacity, num_key_cols)
         out_cols, out_bucket, out_valid, overflow = fn(tuple(cols), bucket, valid)
         if not bool(jax.device_get(overflow).max()):
             return list(out_cols), out_bucket, out_valid

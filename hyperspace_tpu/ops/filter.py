@@ -39,21 +39,95 @@ def translate_predicate(table: ColumnTable, e: Expr) -> Expr:
     return e
 
 
+def _structure_key(e: Expr, lits: list) -> tuple:
+    """Structural fingerprint of an expression with literals abstracted out
+    (collected into `lits` in walk order). Predicates that differ only in
+    literal values share one compiled evaluator."""
+    if isinstance(e, Lit):
+        lits.append(e.value)
+        return ("lit",)
+    if isinstance(e, Col):
+        return ("col", e.name.lower())
+    if isinstance(e, BinOp):
+        return ("binop", e.op, _structure_key(e.left, lits), _structure_key(e.right, lits))
+    if isinstance(e, And):
+        return ("and", _structure_key(e.left, lits), _structure_key(e.right, lits))
+    if isinstance(e, Or):
+        return ("or", _structure_key(e.left, lits), _structure_key(e.right, lits))
+    if isinstance(e, Not):
+        return ("not", _structure_key(e.child, lits))
+    raise ValueError(f"cannot fingerprint {e!r}")
+
+
+def _eval_with_args(e: Expr, cols: dict, lit_iter) -> object:
+    """Evaluate against traced column arrays and traced literal scalars
+    (consumed in the same walk order _structure_key used)."""
+    if isinstance(e, Lit):
+        return next(lit_iter)
+    if isinstance(e, Col):
+        return cols[e.name.lower()]
+    if isinstance(e, BinOp):
+        a = _eval_with_args(e.left, cols, lit_iter)
+        b = _eval_with_args(e.right, cols, lit_iter)
+        return evaluate(BinOp(e.op, Lit(a), Lit(b)), None, jnp)
+    if isinstance(e, And):
+        return jnp.logical_and(_eval_with_args(e.left, cols, lit_iter), _eval_with_args(e.right, cols, lit_iter))
+    if isinstance(e, Or):
+        return jnp.logical_or(_eval_with_args(e.left, cols, lit_iter), _eval_with_args(e.right, cols, lit_iter))
+    if isinstance(e, Not):
+        return jnp.logical_not(_eval_with_args(e.child, cols, lit_iter))
+    raise ValueError(f"cannot evaluate {e!r}")
+
+
+# (structure, column layout, literal dtypes, padded length) → jitted fn.
+# Literals enter as traced scalars and shapes are padded to powers of two,
+# so repeated point lookups with different keys / different bucket sizes
+# hit the XLA compile cache instead of re-tracing per query.
+_MASK_FN_CACHE: dict = {}
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(1, (n - 1)).bit_length() if n > 1 else 1
+
+
 def eval_predicate_mask(table: ColumnTable, predicate: Expr) -> np.ndarray:
     """Evaluate the predicate on device; returns a host bool mask."""
+    from hyperspace_tpu.parallel.mesh import ensure_x64
+
+    # int64/float64 columns and literals must not truncate to 32-bit.
+    ensure_x64()
     predicate = translate_predicate(table, predicate)
+    lits: list = []
+    struct = _structure_key(predicate, lits)
     names = sorted(predicate.references())
-    resolved = {}
-    for n in names:
-        f = table.schema.field(n)
+
+    n = table.num_rows
+    n_pad = _pow2(n)
+    arrays = []
+    layout = []
+    for name in names:
+        f = table.schema.field(name)
         arr = table.columns[f.name]
-        resolved[n.lower()] = jnp.asarray(arr)
+        if len(arr) != n_pad:
+            arr = np.concatenate([arr, np.zeros(n_pad - n, dtype=arr.dtype)])
+        arrays.append(jnp.asarray(arr))
+        layout.append((name.lower(), arr.dtype.str))
+    lit_args = [np.asarray(v) for v in lits]
 
-    def fn(cols):
-        return evaluate(predicate, lambda name: cols[name.lower()], jnp)
+    key = (struct, tuple(layout), tuple(a.dtype.str for a in lit_args), n_pad)
+    fn = _MASK_FN_CACHE.get(key)
+    if fn is None:
+        lowered_names = [nm for nm, _ in layout]
 
-    mask = jax.jit(fn)(resolved)
-    return np.asarray(jax.device_get(mask)).astype(bool)
+        def raw(cols_tuple, lits_tuple):
+            cols = dict(zip(lowered_names, cols_tuple))
+            return _eval_with_args(predicate, cols, iter(lits_tuple))
+
+        fn = jax.jit(raw)
+        _MASK_FN_CACHE[key] = fn
+
+    mask = fn(tuple(arrays), tuple(jnp.asarray(v) for v in lit_args))
+    return np.asarray(jax.device_get(mask)).astype(bool)[:n]
 
 
 def apply_filter(table: ColumnTable, predicate: Expr) -> ColumnTable:

@@ -80,6 +80,11 @@ def merge_join(lkeys_np: np.ndarray, rkeys_np: np.ndarray):
     """Host wrapper. lkeys_np/rkeys_np: [B, L]/[B, R] sorted int64 code
     arrays with SENTINEL pads. Returns (li, ri, valid) numpy arrays of
     shape [B, cap]."""
+    from hyperspace_tpu.parallel.mesh import ensure_x64
+
+    # int64 codes (SENTINEL = int64 max) silently truncate under default
+    # 32-bit mode — x64 must be on before the first upload.
+    ensure_x64()
     lk = jnp.asarray(lkeys_np)
     rk = jnp.asarray(rkeys_np)
     start, cum, totals = join_counts(lk, rk)

@@ -29,6 +29,32 @@ def ensure_x64() -> None:
         _x64_enabled = True
 
 
+_cache_enabled = False
+
+
+def enable_compile_cache() -> None:
+    """Turn on XLA's persistent compilation cache. The build pipeline's
+    exchange+sort program takes tens of seconds to compile on TPU; caching
+    it on disk makes every process after the first start hot."""
+    global _cache_enabled
+    if _cache_enabled:
+        return
+    import os
+
+    cache_dir = os.environ.get(
+        "HYPERSPACE_TPU_COMPILE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "hyperspace_tpu", "xla"),
+    )
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # noqa: BLE001 — cache is an optimization, never fatal
+        pass
+    _cache_enabled = True
+
+
 def make_mesh(devices=None, n: int | None = None) -> Mesh:
     devices = list(jax.devices()) if devices is None else list(devices)
     if n is not None:
