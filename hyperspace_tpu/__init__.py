@@ -25,6 +25,7 @@ __all__ = [
     "Schema",
     "Hyperspace",
     "HyperspaceSession",
+    "VectorIndexConfig",
 ]
 
 
@@ -38,4 +39,8 @@ def __getattr__(name):
         from hyperspace_tpu.dataset import Dataset
 
         return Dataset
+    if name == "VectorIndexConfig":
+        from hyperspace_tpu.vector.index import VectorIndexConfig
+
+        return VectorIndexConfig
     raise AttributeError(name)

@@ -45,6 +45,11 @@ class OptimizeAction(Action):
         self.previous_entry = log_manager.get_latest_log()
         if self.previous_entry is None:
             raise HyperspaceError("no index to optimize")
+        dd = self.previous_entry.derived_dataset
+        if dd is not None and dd.kind != "CoveringIndex":
+            raise HyperspaceError(
+                f"optimize of {dd.kind} indexes is not supported yet"
+            )
 
     def validate(self) -> None:
         if self.previous_entry.state != states.ACTIVE:

@@ -37,6 +37,11 @@ class RefreshAction(CreateActionBase):
         prev = log_manager.get_latest_log()
         if prev is None:
             raise HyperspaceError("no index to refresh")
+        if prev.derived_dataset is not None and prev.derived_dataset.kind != "CoveringIndex":
+            raise HyperspaceError(
+                f"refresh of {prev.derived_dataset.kind} indexes is not supported yet; "
+                "drop and re-create the index"
+            )
         self.previous_entry = prev
         plan = plan_from_json(prev.source.plan)
         cfg = IndexConfig(

@@ -31,7 +31,7 @@ class ColumnTable:
     dictionaries: dict[str, np.ndarray]  # string name -> sorted object array
 
     def __post_init__(self):
-        lens = {len(v) for v in self.columns.values()}
+        lens = {len(v) for v in self.columns.values()}  # len = rows for 2D too
         if len(lens) > 1:
             raise HyperspaceError(f"ragged columns: {lens}")
 
@@ -73,6 +73,22 @@ class ColumnTable:
                 dictionary, codes = np.unique(values.astype(str), return_inverse=True)
                 columns[f.name] = codes.astype(np.int32)
                 dictionaries[f.name] = dictionary
+            elif f.is_vector:
+                import pyarrow as pa
+
+                combined = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+                # .values, NOT .flatten(): flatten silently drops null list
+                # slots and misaligns rows (top-level nulls are rejected
+                # above, but .values is the physical buffer either way).
+                child = combined.values
+                if child.null_count:
+                    raise HyperspaceError(
+                        f"vector column {f.name!r} contains null elements"
+                    )
+                flat = child.to_numpy(zero_copy_only=False)
+                columns[f.name] = (
+                    np.ascontiguousarray(flat).astype(np.float32, copy=False).reshape(-1, f.dim)
+                )
             else:
                 import pyarrow as pa
 
@@ -151,7 +167,21 @@ class ColumnTable:
     def to_arrow(self):
         import pyarrow as pa
 
-        return pa.table({k: v for k, v in self.decode().items()})
+        arrays = {}
+        decoded = None
+        for f in self.schema.fields:
+            if f.is_string:
+                decoded = decoded if decoded is not None else self.decode()
+                v = decoded[f.name]
+            else:
+                v = self.columns[f.name]
+            if f.is_vector:
+                arrays[f.name] = pa.FixedSizeListArray.from_arrays(
+                    pa.array(v.reshape(-1), type=pa.float32()), f.dim
+                )
+            else:
+                arrays[f.name] = pa.array(v)
+        return pa.table(arrays)
 
     @staticmethod
     def concat(tables: list["ColumnTable"]) -> "ColumnTable":

@@ -94,6 +94,18 @@ class Hyperspace:
     def create_index(self, plan: LogicalPlan, index_config: IndexConfig) -> None:
         self.session.manager.create(plan, index_config)
 
+    def create_vector_index(self, plan: LogicalPlan, config) -> None:
+        """Build an ANN index over an embedding column (VectorIndexConfig)."""
+        self.session.manager.create_vector(plan, config)
+
+    def ann_search(self, plan: LogicalPlan, queries, k: int, nprobe: int | None = None,
+                   embedding_column: str | None = None, metric: str = "l2"):
+        """Top-k nearest neighbours; probes a matching vector index when
+        hyperspace is enabled, else brute-forces the source (exact)."""
+        from hyperspace_tpu.vector.search import ann_search
+
+        return ann_search(self.session, plan, queries, k, nprobe, embedding_column, metric)
+
     def delete_index(self, name: str) -> None:
         self.session.manager.delete(name)
 

@@ -58,6 +58,12 @@ class IndexCollectionManager:
         lm, dm, path = self._managers(config.index_name)
         CreateAction(plan, config, lm, dm, path, self.conf, self.writer_factory()).run()
 
+    def create_vector(self, plan: LogicalPlan, config) -> None:
+        from hyperspace_tpu.vector.index import VectorCreateAction
+
+        lm, dm, path = self._managers(config.index_name)
+        VectorCreateAction(plan, config, lm, dm, path, self.conf).run()
+
     def delete(self, name: str) -> None:
         lm, _, _ = self._managers(name)
         DeleteAction(lm).run()
@@ -106,19 +112,26 @@ class IndexCollectionManager:
 
         rows = []
         for entry in self.get_indexes(states_filter=tuple(states.ALL_STATES)):
+            dd = entry.derived_dataset
+            indexed = (
+                list(dd.indexed_columns)
+                if dd.kind == "CoveringIndex"
+                else [dd.embedding_column]
+            )
             rows.append(
                 {
                     "name": entry.name,
-                    "indexedColumns": list(entry.indexed_columns),
-                    "includedColumns": list(entry.included_columns),
-                    "numBuckets": entry.num_buckets,
-                    "schema": [f["name"] for f in entry.derived_dataset.schema],
+                    "kind": dd.kind,
+                    "indexedColumns": indexed,
+                    "includedColumns": list(dd.included_columns),
+                    "numBuckets": dd.num_buckets,
+                    "schema": [f["name"] for f in dd.schema],
                     "indexLocation": str(Path(entry.content.root) / entry.content.directories[-1]),
                     "state": entry.state,
                 }
             )
         return pd.DataFrame(rows, columns=[
-            "name", "indexedColumns", "includedColumns", "numBuckets", "schema", "indexLocation", "state",
+            "name", "kind", "indexedColumns", "includedColumns", "numBuckets", "schema", "indexLocation", "state",
         ])
 
 
@@ -147,6 +160,10 @@ class CachingIndexCollectionManager(IndexCollectionManager):
     def create(self, plan, config):
         self.clear_cache()
         super().create(plan, config)
+
+    def create_vector(self, plan, config):
+        self.clear_cache()
+        super().create_vector(plan, config)
 
     def delete(self, name):
         self.clear_cache()

@@ -73,6 +73,8 @@ class CoveringIndex:
     schema: list[dict[str, Any]]  # Schema.to_json() output
     num_buckets: int
 
+    kind = "CoveringIndex"
+
     def to_json(self) -> dict[str, Any]:
         return {
             "kind": "CoveringIndex",
@@ -97,6 +99,57 @@ class CoveringIndex:
     @property
     def all_columns(self) -> list[str]:
         return list(self.indexed_columns) + list(self.included_columns)
+
+
+@dataclasses.dataclass
+class VectorIndex:
+    """Derived dataset for the ANN/embedding covering index (no analog in
+    the v0.2 reference; required by BASELINE config 5). Rows are
+    partitioned by nearest k-means centroid; a query probes the nprobe
+    closest partitions with a matmul + top-k."""
+
+    embedding_column: str
+    included_columns: list[str]
+    schema: list[dict[str, Any]]  # Schema.to_json() output
+    num_partitions: int
+    dim: int
+    metric: str = "l2"  # l2 | ip | cos
+
+    kind = "VectorIndex"
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "kind": "VectorIndex",
+            "properties": {
+                "embeddingColumn": self.embedding_column,
+                "includedColumns": self.included_columns,
+                "schema": self.schema,
+                "numPartitions": self.num_partitions,
+                "dim": self.dim,
+                "metric": self.metric,
+            },
+        }
+
+    @staticmethod
+    def from_json(d: dict[str, Any]) -> "VectorIndex":
+        p = d["properties"]
+        return VectorIndex(
+            p["embeddingColumn"],
+            list(p["includedColumns"]),
+            list(p["schema"]),
+            int(p["numPartitions"]),
+            int(p["dim"]),
+            p.get("metric", "l2"),
+        )
+
+    @property
+    def all_columns(self) -> list[str]:
+        return [self.embedding_column] + list(self.included_columns)
+
+    # Shared bucket-count vocabulary with CoveringIndex (partition == bucket).
+    @property
+    def num_buckets(self) -> int:
+        return self.num_partitions
 
 
 @dataclasses.dataclass
@@ -209,12 +262,24 @@ class IndexLogEntry(LogEntry):
             enabled=bool(d.get("enabled", True)),
             name=d["name"],
             derived_dataset=(
-                CoveringIndex.from_json(d["derivedDataset"]) if d.get("derivedDataset") else None
+                _derived_dataset_from_json(d["derivedDataset"]) if d.get("derivedDataset") else None
             ),
             content=Content.from_json(d["content"]) if d.get("content") else None,
             source=Source.from_json(d["source"]) if d.get("source") else None,
             extra=dict(d.get("extra", {})),
         )
+
+
+_DERIVED_KINDS = {"CoveringIndex": CoveringIndex, "VectorIndex": VectorIndex}
+
+
+def _derived_dataset_from_json(d: dict[str, Any]):
+    """Polymorphic decode keyed on `kind` (the reference keys decoding on
+    the envelope version, LogEntry.scala:33-46; kinds compose with it)."""
+    kind = d.get("kind", "CoveringIndex")
+    if kind not in _DERIVED_KINDS:
+        raise ValueError(f"unknown derived dataset kind {kind!r}")
+    return _DERIVED_KINDS[kind].from_json(d)
 
 
 def entry_from_json(d: dict[str, Any]) -> IndexLogEntry:

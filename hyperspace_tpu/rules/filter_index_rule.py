@@ -64,6 +64,8 @@ class FilterIndexRule(Rule):
         filter_cols = {c.lower() for c in predicate.references()}
         required = filter_cols | {c.lower() for c in output_columns}
         for entry in indexes:
+            if entry.derived_dataset.kind != "CoveringIndex":
+                continue  # vector indexes serve ann_search, not filters
             idx_cols = {c.lower() for c in entry.derived_dataset.all_columns}
             first_indexed = entry.indexed_columns[0].lower()
             if required <= idx_cols and first_indexed in filter_cols:

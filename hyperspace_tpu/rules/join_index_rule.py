@@ -136,6 +136,8 @@ class JoinIndexRule(Rule):
         out = []
         jset = {c.lower() for c in join_cols}
         for entry in indexes:
+            if entry.derived_dataset.kind != "CoveringIndex":
+                continue  # vector indexes serve ann_search, not joins
             iset = {c.lower() for c in entry.indexed_columns}
             cover = {c.lower() for c in entry.derived_dataset.all_columns}
             if iset == jset and required <= cover:

@@ -27,6 +27,7 @@ _SUPPORTED = {
     "string": np.int32,  # dictionary codes on device
     "date": np.int32,  # days since epoch
     "timestamp": np.int64,  # microseconds since epoch
+    "vector": np.float32,  # fixed-dim embedding, [n, dim] float32 on device
 }
 
 
@@ -35,10 +36,14 @@ class Field:
     name: str
     dtype: str  # logical type name, one of _SUPPORTED
     nullable: bool = False
+    # Embedding dimensionality; required iff dtype == "vector".
+    dim: int | None = None
 
     def __post_init__(self):
         if self.dtype not in _SUPPORTED:
             raise ValueError(f"unsupported dtype {self.dtype!r} for field {self.name!r}")
+        if (self.dtype == "vector") != (self.dim is not None):
+            raise ValueError(f"field {self.name!r}: dim is required iff dtype is 'vector'")
 
     @property
     def device_dtype(self) -> np.dtype:
@@ -49,12 +54,19 @@ class Field:
     def is_string(self) -> bool:
         return self.dtype == "string"
 
+    @property
+    def is_vector(self) -> bool:
+        return self.dtype == "vector"
+
     def to_json(self) -> dict[str, Any]:
-        return {"name": self.name, "dtype": self.dtype, "nullable": self.nullable}
+        d = {"name": self.name, "dtype": self.dtype, "nullable": self.nullable}
+        if self.dim is not None:
+            d["dim"] = self.dim
+        return d
 
     @staticmethod
     def from_json(d: dict[str, Any]) -> "Field":
-        return Field(d["name"], d["dtype"], d.get("nullable", False))
+        return Field(d["name"], d["dtype"], d.get("nullable", False), d.get("dim"))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -124,6 +136,9 @@ class Schema:
                 dt = "date"
             elif pa.types.is_timestamp(t):
                 dt = "timestamp"
+            elif pa.types.is_fixed_size_list(t) and pa.types.is_floating(t.value_type):
+                fields.append(Field(f.name, "vector", f.nullable, dim=t.list_size))
+                continue
             else:
                 raise ValueError(f"unsupported arrow type {t} for column {f.name!r}")
             fields.append(Field(f.name, dt, f.nullable))

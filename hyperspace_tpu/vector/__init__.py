@@ -1,0 +1,14 @@
+from hyperspace_tpu.vector.index import (
+    VectorCreateAction,
+    VectorIndexBuilder,
+    VectorIndexConfig,
+)
+from hyperspace_tpu.vector.search import ann_search, brute_force_search
+
+__all__ = [
+    "VectorCreateAction",
+    "VectorIndexBuilder",
+    "VectorIndexConfig",
+    "ann_search",
+    "brute_force_search",
+]

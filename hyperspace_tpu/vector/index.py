@@ -1,0 +1,214 @@
+"""Vector (ANN) covering index: config, build pipeline, create action.
+
+No analog exists in the v0.2 reference (its covering index is relational
+only); BASELINE config 5 requires an embedding-column ANN index. The design
+follows the same two-plane split as the covering index:
+
+- metadata: a `VectorIndex` derived dataset inside the standard
+  IndexLogEntry, so the whole lifecycle machinery (op-log CAS, states,
+  delete/restore/vacuum/cancel) applies unchanged;
+- device: build = k-means coarse quantizer (ops/kmeans.py, pure MXU
+  matmuls) + partition carve; query = matmul scoring + Pallas top-k
+  (ops/topk.py) over the probed partitions.
+
+On-disk layout mirrors the covering index: one parquet file per partition
+(`bucket-XXXXX.parquet`, embedding + included columns) in a `v__=n` dir,
+plus the manifest and a `_centroids.npy`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+
+import numpy as np
+
+from hyperspace_tpu.actions.create import CreateActionBase
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu.execution import io as hio
+from hyperspace_tpu.execution.table import ColumnTable
+from hyperspace_tpu.metadata.data_manager import IndexDataManager
+from hyperspace_tpu.metadata.log_entry import (
+    Content,
+    Fingerprint,
+    IndexLogEntry,
+    Source,
+    VectorIndex,
+)
+from hyperspace_tpu.metadata.log_manager import IndexLogManager
+from hyperspace_tpu.ops.kmeans import assign_partitions, train_centroids
+from hyperspace_tpu.plan.nodes import LogicalPlan, Scan
+from hyperspace_tpu.signature import create_signature_provider, fingerprint_files
+from hyperspace_tpu.utils.name_utils import normalize_index_name
+
+CENTROIDS_NAME = "_centroids.npy"
+
+_METRICS = ("l2", "ip", "cos")
+
+
+@dataclasses.dataclass
+class VectorIndexConfig:
+    """User spec for a vector index (the IndexConfig analog)."""
+
+    index_name: str
+    embedding_column: str
+    included_columns: list[str] = dataclasses.field(default_factory=list)
+    num_partitions: int | None = None  # default: conf.num_buckets
+    metric: str = "l2"
+
+    def __post_init__(self):
+        self.index_name = normalize_index_name(self.index_name)
+        if not self.index_name:
+            raise HyperspaceError("index name cannot be empty")
+        if self.metric not in _METRICS:
+            raise HyperspaceError(f"unknown metric {self.metric!r}; one of {_METRICS}")
+        low = [self.embedding_column.lower()] + [c.lower() for c in self.included_columns]
+        if len(set(low)) != len(low):
+            raise HyperspaceError("duplicate columns in vector index config")
+
+    @property
+    def all_columns(self) -> list[str]:
+        return [self.embedding_column] + list(self.included_columns)
+
+
+class VectorIndexBuilder:
+    """The build pipeline (IndexWriter-shaped seam for VectorCreateAction)."""
+
+    def __init__(self, kmeans_iters: int = 8, seed: int = 0):
+        from hyperspace_tpu.parallel.mesh import enable_compile_cache
+
+        enable_compile_cache()
+        self.kmeans_iters = kmeans_iters
+        self.seed = seed
+
+    def write(
+        self,
+        plan: LogicalPlan,
+        columns: list[str],
+        embedding_column: str,
+        num_partitions: int,
+        dest_path: Path,
+        metric: str,
+    ) -> np.ndarray:
+        """Build partitions under dest_path; returns the centroids."""
+        from hyperspace_tpu.dataset import list_data_files
+
+        if not isinstance(plan, Scan):
+            raise HyperspaceError("vector index builds materialize scan-only plans")
+        files = plan.files if plan.files is not None else [
+            fi.path for fi in list_data_files(plan.root)
+        ]
+        table = hio.read_parquet(files, columns=columns, schema=plan.schema)
+        if table.num_rows == 0:
+            raise HyperspaceError("cannot build a vector index over an empty source")
+        emb_field = table.schema.field(embedding_column)
+        emb = table.columns[emb_field.name]
+        if metric == "cos":
+            norms = np.linalg.norm(emb, axis=1, keepdims=True)
+            emb = emb / np.maximum(norms, 1e-12)
+
+        centroids = train_centroids(
+            emb, num_partitions, iters=self.kmeans_iters, seed=self.seed
+        )
+        part = assign_partitions(emb, centroids)
+
+        order = np.argsort(part, kind="stable")
+        sorted_part = part[order]
+        starts = np.searchsorted(sorted_part, np.arange(num_partitions + 1))
+        dest = Path(dest_path)
+        bucket_rows = []
+        for p in range(num_partitions):
+            lo, hi = int(starts[p]), int(starts[p + 1])
+            hio.write_bucket(dest, p, table.take(order[lo:hi]))
+            bucket_rows.append(hi - lo)
+        hio.write_manifest(dest, num_partitions, [embedding_column], bucket_rows)
+        np.save(dest / CENTROIDS_NAME, centroids)
+        return centroids
+
+
+class VectorCreateAction(CreateActionBase):
+    """CREATING → ACTIVE for a vector index; same 2-phase op-log commit."""
+
+    def __init__(
+        self,
+        plan: LogicalPlan,
+        config: VectorIndexConfig,
+        log_manager: IndexLogManager,
+        data_manager: IndexDataManager,
+        index_path: Path,
+        conf: HyperspaceConf,
+        builder: VectorIndexBuilder | None = None,
+    ):
+        from hyperspace_tpu.index.index_config import IndexConfig
+
+        # The base class wants an IndexConfig; give it the column view.
+        base_cfg = IndexConfig(config.index_name, [config.embedding_column], config.included_columns)
+        super().__init__(plan, base_cfg, log_manager, data_manager, index_path, conf, None)
+        self.vconfig = config
+        self.builder = builder or VectorIndexBuilder()
+
+    def _num_partitions(self) -> int:
+        if self.vconfig.num_partitions is not None:
+            return int(self.vconfig.num_partitions)
+        return int(self.conf.num_buckets)
+
+    def validate(self) -> None:
+        if not isinstance(self.plan, Scan):
+            raise HyperspaceError("only scan-only plans are supported for vector indexes")
+        schema = self.plan.schema
+        for c in self.vconfig.all_columns:
+            if c not in schema:
+                raise HyperspaceError(f"column {c!r} not found in source schema {schema.names}")
+        emb = schema.field(self.vconfig.embedding_column)
+        if not emb.is_vector:
+            raise HyperspaceError(
+                f"embedding column {emb.name!r} must have vector dtype (got {emb.dtype!r})"
+            )
+        latest = self.log_manager.get_latest_log()
+        from hyperspace_tpu.actions import states
+
+        if latest is not None and latest.state != states.DOESNOTEXIST:
+            raise HyperspaceError(
+                f"another index with name {self.vconfig.index_name!r} already exists "
+                f"(state={latest.state})"
+            )
+
+    def build_log_entry(self) -> IndexLogEntry:
+        schema = self.plan.schema
+        selected = schema.select(self.vconfig.all_columns)
+        emb = schema.field(self.vconfig.embedding_column)
+        files = self._source_files()
+        provider = create_signature_provider()
+        version = self._version_id
+        return IndexLogEntry(
+            name=self.vconfig.index_name,
+            derived_dataset=VectorIndex(
+                embedding_column=emb.name,
+                included_columns=[schema.field(c).name for c in self.vconfig.included_columns],
+                schema=selected.to_json(),
+                num_partitions=self._num_partitions(),
+                dim=int(emb.dim),
+                metric=self.vconfig.metric,
+            ),
+            content=Content(root=str(self.index_path), directories=[f"v__={version}"]),
+            source=Source(
+                plan=self.plan.to_json(),
+                fingerprint=Fingerprint(
+                    kind=provider.name, value=fingerprint_files(files)
+                ),
+                files=files,
+            ),
+        )
+
+    def op(self) -> None:
+        entry = self.log_entry
+        dest = self.data_manager.get_path(self._version_id)
+        self.builder.write(
+            self.plan,
+            entry.derived_dataset.all_columns,
+            entry.derived_dataset.embedding_column,
+            entry.derived_dataset.num_partitions,
+            dest,
+            entry.derived_dataset.metric,
+        )

@@ -1,0 +1,191 @@
+"""Vector (ANN) index contract tests.
+
+The equality gate mirrors the covering-index E2E contract: with
+nprobe == num_partitions the index search must return EXACTLY the
+brute-force top-k (same scores, same rows); with smaller nprobe recall
+must stay high on clustered data. Lifecycle (delete/restore/vacuum)
+applies to vector indexes unchanged because they share the log-entry
+envelope.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import Hyperspace, HyperspaceSession, VectorIndexConfig
+from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu.ops.topk import topk
+
+
+@pytest.fixture
+def session(tmp_system_path):
+    return HyperspaceSession(system_path=tmp_system_path, num_buckets=8)
+
+
+@pytest.fixture
+def hs(session):
+    return Hyperspace(session)
+
+
+@pytest.fixture
+def emb_parquet(tmp_path):
+    """Clustered embeddings (so k-means partitions are meaningful)."""
+    rng = np.random.default_rng(0)
+    n, d, c = 4000, 32, 16
+    centers = rng.standard_normal((c, d)).astype(np.float32) * 5
+    assign = rng.integers(0, c, n)
+    emb = centers[assign] + rng.standard_normal((n, d)).astype(np.float32)
+    table = pa.table(
+        {
+            "id": pa.array(np.arange(n, dtype=np.int64)),
+            "emb": pa.FixedSizeListArray.from_arrays(
+                pa.array(emb.reshape(-1), type=pa.float32()), d
+            ),
+            "label": pa.array([f"l{i % 5}" for i in range(n)]),
+        }
+    )
+    root = tmp_path / "embdata"
+    root.mkdir()
+    pq.write_table(table, root / "part-0.parquet")
+    return str(root), emb
+
+
+def test_topk_pallas_matches_xla():
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((5, 3000)).astype(np.float32)
+    pv, pi = topk(x, 7, impl="pallas")
+    xv, xi = topk(x, 7, impl="xla")
+    np.testing.assert_allclose(pv, xv, rtol=1e-6)
+    np.testing.assert_array_equal(
+        np.take_along_axis(x, pi, 1), np.take_along_axis(x, xi, 1)
+    )
+
+
+def test_vector_index_full_probe_equals_brute_force(session, hs, emb_parquet):
+    root, emb = emb_parquet
+    df = session.parquet(root)
+    hs.create_vector_index(df, VectorIndexConfig("vidx", "emb", ["id", "label"], num_partitions=16))
+
+    rng = np.random.default_rng(2)
+    queries = emb[rng.choice(len(emb), 6, replace=False)] + 0.01
+
+    session.disable_hyperspace()
+    exact = hs.ann_search(df, queries, k=10)
+
+    session.enable_hyperspace()
+    approx = hs.ann_search(df, queries, k=10, nprobe=16)  # all partitions
+
+    np.testing.assert_allclose(
+        np.sort(exact.scores, axis=1), np.sort(approx.scores, axis=1), rtol=1e-4
+    )
+    # Same ids per query (order may differ on score ties).
+    eids = exact.rows.columns["id"].reshape(6, -1)
+    aids = approx.rows.columns["id"].reshape(6, -1)
+    for i in range(6):
+        assert set(eids[i]) == set(aids[i])
+
+
+def test_vector_index_partial_probe_recall(session, hs, emb_parquet):
+    root, emb = emb_parquet
+    df = session.parquet(root)
+    hs.create_vector_index(df, VectorIndexConfig("vidx2", "emb", ["id"], num_partitions=16))
+    rng = np.random.default_rng(3)
+    queries = emb[rng.choice(len(emb), 8, replace=False)]
+
+    session.disable_hyperspace()
+    exact = hs.ann_search(df, queries, k=10)
+    session.enable_hyperspace()
+    approx = hs.ann_search(df, queries, k=10, nprobe=4)
+
+    eids = exact.rows.columns["id"].reshape(8, -1)
+    aids = approx.rows.columns["id"].reshape(8, -1)
+    recall = np.mean([len(set(eids[i]) & set(aids[i])) / 10 for i in range(8)])
+    assert recall >= 0.8, f"recall@10 too low: {recall}"
+
+
+def test_vector_index_metrics(session, hs, emb_parquet):
+    root, emb = emb_parquet
+    df = session.parquet(root)
+    hs.create_vector_index(
+        df, VectorIndexConfig("vip", "emb", ["id"], num_partitions=8, metric="ip")
+    )
+    session.enable_hyperspace()
+    q = emb[:3]
+    res = hs.ann_search(df, q, k=5, nprobe=8)
+    session.disable_hyperspace()
+    exact = hs.ann_search(df, q, k=5, embedding_column="emb", metric="ip")
+    np.testing.assert_allclose(
+        np.sort(res.scores, axis=1), np.sort(exact.scores, axis=1), rtol=1e-4
+    )
+
+
+def test_vector_index_lifecycle_and_summary(session, hs, emb_parquet):
+    root, _ = emb_parquet
+    df = session.parquet(root)
+    hs.create_vector_index(df, VectorIndexConfig("vlife", "emb", ["id"]))
+    summary = hs.indexes()
+    row = summary[summary["name"] == "vlife"].iloc[0]
+    assert row["kind"] == "VectorIndex"
+    assert row["state"] == "ACTIVE"
+
+    hs.delete_index("vlife")
+    assert hs.indexes().iloc[0]["state"] == "DELETED"
+    hs.restore_index("vlife")
+    assert hs.indexes().iloc[0]["state"] == "ACTIVE"
+
+    with pytest.raises(HyperspaceError, match="not supported yet"):
+        hs.refresh_index("vlife")
+    with pytest.raises(HyperspaceError, match="not supported yet"):
+        hs.optimize_index("vlife")
+
+
+def test_fewer_candidates_than_k_drops_unprobed_rows(session, hs, emb_parquet):
+    """A query probing partitions with < k rows must NOT surface rows from
+    partitions it never probed; missing slots carry -inf scores."""
+    root, emb = emb_parquet
+    df = session.parquet(root)
+    hs.create_vector_index(df, VectorIndexConfig("vsmall", "emb", ["id"], num_partitions=64))
+    session.enable_hyperspace()
+    q = emb[:2]
+    res = hs.ann_search(df, q, k=500, nprobe=1)  # one partition of ~62 rows
+    n_rows = res.rows.num_rows
+    assert n_rows < 2 * 500, "short results must be trimmed"
+    # every -inf slot (candidate from an unprobed partition) is dropped
+    assert np.isinf(res.scores).sum() == res.scores.size - n_rows
+    assert np.all(np.isfinite(res.scores[:, 0]))  # best match always real
+
+
+def test_vector_index_requires_vector_column(session, hs, emb_parquet):
+    root, _ = emb_parquet
+    df = session.parquet(root)
+    with pytest.raises(HyperspaceError, match="vector dtype"):
+        hs.create_vector_index(df, VectorIndexConfig("bad", "id"))
+
+
+def test_stale_vector_index_falls_back_to_brute_force(session, hs, emb_parquet, tmp_path):
+    root, emb = emb_parquet
+    df = session.parquet(root)
+    hs.create_vector_index(df, VectorIndexConfig("vstale", "emb", ["id"]))
+    # Append data: signature mismatch => index unusable, falls back exact.
+    rng = np.random.default_rng(5)
+    extra = rng.standard_normal((50, 32)).astype(np.float32)
+    t = pa.table(
+        {
+            "id": pa.array(np.arange(10_000, 10_050, dtype=np.int64)),
+            "emb": pa.FixedSizeListArray.from_arrays(
+                pa.array(extra.reshape(-1), type=pa.float32()), 32
+            ),
+            "label": pa.array(["x"] * 50),
+        }
+    )
+    import pathlib
+
+    pq.write_table(t, pathlib.Path(root) / "part-new.parquet")
+
+    session.enable_hyperspace()
+    res = hs.ann_search(df, extra[:2], k=3)
+    # Brute force sees the appended rows; their ids must surface as the
+    # exact matches of their own vectors.
+    ids = res.rows.columns["id"].reshape(2, -1)
+    assert 10_000 in ids[0] and 10_001 in ids[1]
