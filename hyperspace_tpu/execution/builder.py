@@ -66,6 +66,14 @@ def hash_scalar_key(values: list, fields) -> np.ndarray:
     return combine_hashes(hs, np)
 
 
+def _fast_take(arr: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """Threaded native gather when built, numpy fancy-index otherwise."""
+    from hyperspace_tpu import native
+
+    out = native.take_rows(arr, idx)
+    return out if out is not None else arr[idx]
+
+
 def _pad_to(arr: np.ndarray, n: int, fill=0) -> np.ndarray:
     if len(arr) == n:
         return arr
@@ -173,17 +181,12 @@ class DeviceIndexBuilder:
         # is bucket-sorted, so the compacted global bucket array is sorted.
         result = ColumnTable(
             table.schema.select(ordered),
-            {name: table.columns[name][order] for name in ordered},
+            {name: _fast_take(table.columns[name], order) for name in ordered},
             dict(table.dictionaries),
         )
-        bucket_rows = []
-        starts = np.searchsorted(compact_bucket, np.arange(num_buckets + 1))
-        dest = Path(dest_path)
-        for b in range(num_buckets):
-            lo, hi = int(starts[b]), int(starts[b + 1])
-            hio.write_bucket(dest, b, result.take(np.arange(lo, hi)))
-            bucket_rows.append(hi - lo)
-        hio.write_manifest(dest, num_buckets, indexed_columns, bucket_rows)
+        hio.carve_and_write(
+            Path(dest_path), result, compact_bucket, num_buckets, indexed_columns
+        )
 
     # -- OptimizeAction's compactor seam ---------------------------------
     def compact(self, entry, src_paths: list[Path] | Path, dest_path: Path) -> None:

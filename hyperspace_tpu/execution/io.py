@@ -58,3 +58,36 @@ def read_manifest(version_dir: Path) -> dict | None:
     if not p.exists():
         return None
     return json.loads(p.read_text())
+
+
+def carve_and_write(
+    dest: Path,
+    table: "ColumnTable",
+    sorted_partition: "np.ndarray",
+    num_partitions: int,
+    indexed_columns: list[str],
+    order: "np.ndarray | None" = None,
+) -> list[int]:
+    """Carve `table` into one parquet file per partition + manifest.
+
+    `sorted_partition` is the non-decreasing partition id per carved row;
+    `order` (optional) maps carved row i to `table` row order[i] (identity
+    when the table is already in carved order). Parquet encode releases
+    the GIL, so buckets are written concurrently. Returns per-partition
+    row counts (also persisted in the manifest)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    dest = Path(dest)
+    dest.mkdir(parents=True, exist_ok=True)
+    starts = np.searchsorted(sorted_partition, np.arange(num_partitions + 1))
+    rows = [int(starts[p + 1] - starts[p]) for p in range(num_partitions)]
+
+    def write_one(p: int) -> None:
+        lo, hi = int(starts[p]), int(starts[p + 1])
+        sel = np.arange(lo, hi) if order is None else order[lo:hi]
+        write_bucket(dest, p, table.take(sel))
+
+    with ThreadPoolExecutor(max_workers=min(8, max(1, num_partitions))) as ex:
+        list(ex.map(write_one, range(num_partitions)))
+    write_manifest(dest, num_partitions, indexed_columns, rows)
+    return rows

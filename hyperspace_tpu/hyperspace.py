@@ -99,7 +99,7 @@ class Hyperspace:
         self.session.manager.create_vector(plan, config)
 
     def ann_search(self, plan: LogicalPlan, queries, k: int, nprobe: int | None = None,
-                   embedding_column: str | None = None, metric: str = "l2"):
+                   embedding_column: str | None = None, metric: str | None = None):
         """Top-k nearest neighbours; probes a matching vector index when
         hyperspace is enabled, else brute-forces the source (exact)."""
         from hyperspace_tpu.vector.search import ann_search

@@ -1,0 +1,151 @@
+"""Loader for the native host-runtime kernels (hashing.cpp).
+
+Compiles the C++ on first use with g++ (cached as a .so keyed by source
+hash under ~/.cache/hyperspace_tpu/native) and binds it via ctypes — no
+pybind11 dependency. Every caller falls back to the numpy implementation
+when the toolchain or the build is unavailable, so this module is a pure
+accelerator: `available()` reports which path is active.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+from pathlib import Path
+
+import numpy as np
+
+_SRC = Path(__file__).with_name("hashing.cpp")
+
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _cache_dir() -> Path:
+    root = os.environ.get(
+        "HYPERSPACE_TPU_NATIVE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "hyperspace_tpu", "native"),
+    )
+    return Path(root)
+
+
+def _build() -> Path | None:
+    src = _SRC.read_bytes()
+    tag = hashlib.sha256(src).hexdigest()[:16]
+    out = _cache_dir() / f"libhs_native_{tag}.so"
+    if out.exists():
+        return out
+    out.parent.mkdir(parents=True, exist_ok=True)
+    tmp = out.with_suffix(".so.tmp")
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        "-march=native", str(_SRC), "-o", str(tmp),
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except Exception:
+        try:  # retry without -march=native (portability)
+            cmd.remove("-march=native")
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        except Exception:
+            return None
+    os.replace(tmp, out)  # atomic publish; concurrent builders converge
+    return out
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("HYPERSPACE_TPU_DISABLE_NATIVE"):
+        return None
+    path = _build()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(str(path))
+    except OSError:
+        return None
+    u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+    lib.hs_hash_i64.argtypes = [i64p, u32p, ctypes.c_int64]
+    lib.hs_hash_i32.argtypes = [i32p, u32p, ctypes.c_int64]
+    lib.hs_md5_prefix.argtypes = [u8p, i64p, u32p, ctypes.c_int64]
+    lib.hs_take_rows.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, i64p, ctypes.c_int64, ctypes.c_int64,
+    ]
+    lib.hs_combine.argtypes = [u32p, u32p, ctypes.c_int64]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+# ---- typed wrappers (None ⇒ caller uses the numpy path) --------------------
+
+def hash_i64(arr: np.ndarray) -> np.ndarray | None:
+    lib = _load()
+    if lib is None:
+        return None
+    arr = np.ascontiguousarray(arr, dtype=np.int64)
+    out = np.empty(len(arr), dtype=np.uint32)
+    lib.hs_hash_i64(arr, out, len(arr))
+    return out
+
+
+def hash_i32(arr: np.ndarray) -> np.ndarray | None:
+    lib = _load()
+    if lib is None:
+        return None
+    arr = np.ascontiguousarray(arr, dtype=np.int32)
+    out = np.empty(len(arr), dtype=np.uint32)
+    lib.hs_hash_i32(arr, out, len(arr))
+    return out
+
+
+def md5_prefix(strings: np.ndarray) -> np.ndarray | None:
+    """uint32 md5-prefix per entry of an object array of strings."""
+    lib = _load()
+    if lib is None:
+        return None
+    encoded = [str(s).encode("utf-8") for s in strings]
+    offsets = np.zeros(len(encoded) + 1, dtype=np.int64)
+    np.cumsum([len(b) for b in encoded], out=offsets[1:])
+    blob = np.frombuffer(b"".join(encoded), dtype=np.uint8) if encoded else np.zeros(0, np.uint8)
+    blob = np.ascontiguousarray(blob)
+    out = np.empty(len(encoded), dtype=np.uint32)
+    lib.hs_md5_prefix(blob if len(blob) else np.zeros(1, np.uint8), offsets, out, len(encoded))
+    return out
+
+
+def take_rows(arr: np.ndarray, idx: np.ndarray) -> np.ndarray | None:
+    """arr[idx] for 1-D/2-D contiguous arrays, threaded."""
+    lib = _load()
+    if lib is None:
+        return None
+    arr = np.ascontiguousarray(arr)
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    row_bytes = arr.dtype.itemsize * (arr.shape[1] if arr.ndim == 2 else 1)
+    out = np.empty((len(idx),) + arr.shape[1:], dtype=arr.dtype)
+    lib.hs_take_rows(
+        arr.ctypes.data_as(ctypes.c_void_p),
+        out.ctypes.data_as(ctypes.c_void_p),
+        idx, len(idx), row_bytes,
+    )
+    return out
+
+
+def combine(acc: np.ndarray, h: np.ndarray) -> np.ndarray | None:
+    lib = _load()
+    if lib is None:
+        return None
+    acc = np.ascontiguousarray(acc, dtype=np.uint32).copy()
+    lib.hs_combine(acc, np.ascontiguousarray(h, dtype=np.uint32), len(acc))
+    return acc

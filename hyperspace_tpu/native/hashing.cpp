@@ -1,0 +1,183 @@
+// hyperspace_tpu native host-runtime kernels.
+//
+// The TPU analog of the engine-side native machinery the reference leans on
+// (SURVEY.md §2.2: Spark's JVM codegen'd operators, Netty shuffle, Parquet
+// codecs — all "provided" native code). The device plane is XLA/Pallas; this
+// library covers the HOST hot loops of the build/query pipeline:
+//
+//   - murmur3-fmix32 row hashing for bucket assignment (bit-identical to
+//     ops/hashing.py's numpy/jnp implementation — bucket pruning and
+//     on-disk indexes depend on the match),
+//   - MD5 prefix hashes for string dictionaries (RFC 1321, replacing a
+//     per-entry Python hashlib loop),
+//   - threaded row gather (the permutation apply after the device sort).
+//
+// Built on demand by hyperspace_tpu/native/__init__.py with g++ -O3; every
+// entry point has a numpy fallback, so the library is an accelerator, never
+// a dependency.
+
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <thread>
+#include <vector>
+
+namespace {
+
+inline uint32_t mix32(uint32_t x) {
+  x ^= x >> 16;
+  x *= 0x85EBCA6Bu;
+  x ^= x >> 13;
+  x *= 0xC2B2AE35u;
+  x ^= x >> 16;
+  return x;
+}
+
+void parallel_for(int64_t n, int64_t grain, const std::function<void(int64_t, int64_t)>& fn) {
+  unsigned hw = std::thread::hardware_concurrency();
+  int64_t nthreads = hw ? static_cast<int64_t>(hw) : 4;
+  if (nthreads > (n + grain - 1) / grain) nthreads = (n + grain - 1) / grain;
+  if (nthreads <= 1) {
+    fn(0, n);
+    return;
+  }
+  std::vector<std::thread> threads;
+  int64_t chunk = (n + nthreads - 1) / nthreads;
+  for (int64_t t = 0; t < nthreads; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = lo + chunk < n ? lo + chunk : n;
+    if (lo >= hi) break;
+    threads.emplace_back(fn, lo, hi);
+  }
+  for (auto& th : threads) th.join();
+}
+
+// ---- compact MD5 (RFC 1321) ------------------------------------------------
+
+struct MD5 {
+  uint32_t a0 = 0x67452301, b0 = 0xefcdab89, c0 = 0x98badcfe, d0 = 0x10325476;
+
+  static constexpr uint32_t K[64] = {
+      0xd76aa478, 0xe8c7b756, 0x242070db, 0xc1bdceee, 0xf57c0faf, 0x4787c62a,
+      0xa8304613, 0xfd469501, 0x698098d8, 0x8b44f7af, 0xffff5bb1, 0x895cd7be,
+      0x6b901122, 0xfd987193, 0xa679438e, 0x49b40821, 0xf61e2562, 0xc040b340,
+      0x265e5a51, 0xe9b6c7aa, 0xd62f105d, 0x02441453, 0xd8a1e681, 0xe7d3fbc8,
+      0x21e1cde6, 0xc33707d6, 0xf4d50d87, 0x455a14ed, 0xa9e3e905, 0xfcefa3f8,
+      0x676f02d9, 0x8d2a4c8a, 0xfffa3942, 0x8771f681, 0x6d9d6122, 0xfde5380c,
+      0xa4beea44, 0x4bdecfa9, 0xf6bb4b60, 0xbebfbc70, 0x289b7ec6, 0xeaa127fa,
+      0xd4ef3085, 0x04881d05, 0xd9d4d039, 0xe6db99e5, 0x1fa27cf8, 0xc4ac5665,
+      0xf4292244, 0x432aff97, 0xab9423a7, 0xfc93a039, 0x655b59c3, 0x8f0ccc92,
+      0xffeff47d, 0x85845dd1, 0x6fa87e4f, 0xfe2ce6e0, 0xa3014314, 0x4e0811a1,
+      0xf7537e82, 0xbd3af235, 0x2ad7d2bb, 0xeb86d391};
+  static constexpr int S[64] = {7, 12, 17, 22, 7, 12, 17, 22, 7, 12, 17, 22,
+                                7, 12, 17, 22, 5, 9,  14, 20, 5, 9,  14, 20,
+                                5, 9,  14, 20, 5, 9,  14, 20, 4, 11, 16, 23,
+                                4, 11, 16, 23, 4, 11, 16, 23, 4, 11, 16, 23,
+                                6, 10, 15, 21, 6, 10, 15, 21, 6, 10, 15, 21,
+                                6, 10, 15, 21};
+
+  static uint32_t rotl(uint32_t x, int c) { return (x << c) | (x >> (32 - c)); }
+
+  void block(const uint8_t* p) {
+    uint32_t M[16];
+    std::memcpy(M, p, 64);
+    uint32_t A = a0, B = b0, C = c0, D = d0;
+    for (int i = 0; i < 64; ++i) {
+      uint32_t F;
+      int g;
+      if (i < 16) {
+        F = (B & C) | (~B & D);
+        g = i;
+      } else if (i < 32) {
+        F = (D & B) | (~D & C);
+        g = (5 * i + 1) & 15;
+      } else if (i < 48) {
+        F = B ^ C ^ D;
+        g = (3 * i + 5) & 15;
+      } else {
+        F = C ^ (B | ~D);
+        g = (7 * i) & 15;
+      }
+      F += A + K[i] + M[g];
+      A = D;
+      D = C;
+      C = B;
+      B += rotl(F, S[i]);
+    }
+    a0 += A;
+    b0 += B;
+    c0 += C;
+    d0 += D;
+  }
+
+  // Digest prefix (first 4 bytes, little-endian) of one message.
+  static uint32_t prefix32(const uint8_t* msg, uint64_t len) {
+    MD5 m;
+    uint64_t full = len / 64;
+    for (uint64_t i = 0; i < full; ++i) m.block(msg + i * 64);
+    uint8_t tail[128] = {0};
+    uint64_t rem = len - full * 64;
+    std::memcpy(tail, msg + full * 64, rem);
+    tail[rem] = 0x80;
+    uint64_t tail_len = (rem + 9 <= 64) ? 64 : 128;
+    uint64_t bitlen = len * 8;
+    std::memcpy(tail + tail_len - 8, &bitlen, 8);
+    m.block(tail);
+    if (tail_len == 128) m.block(tail + 64);
+    return m.a0;  // little-endian word 0 == first 4 digest bytes LE
+  }
+};
+
+constexpr uint32_t MD5::K[64];
+constexpr int MD5::S[64];
+
+}  // namespace
+
+extern "C" {
+
+// out[i] = mix32(lo ^ (mix32(hi) * 0x9E3779B1)) — int64 lanes.
+void hs_hash_i64(const int64_t* in, uint32_t* out, int64_t n) {
+  parallel_for(n, 1 << 16, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      uint64_t v = static_cast<uint64_t>(in[i]);
+      uint32_t l = static_cast<uint32_t>(v & 0xFFFFFFFFu);
+      uint32_t h = static_cast<uint32_t>(v >> 32);
+      out[i] = mix32(l ^ (mix32(h) * 0x9E3779B1u));
+    }
+  });
+}
+
+// out[i] = mix32(in[i]) — 32-bit lanes.
+void hs_hash_i32(const int32_t* in, uint32_t* out, int64_t n) {
+  parallel_for(n, 1 << 16, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i)
+      out[i] = mix32(static_cast<uint32_t>(in[i]));
+  });
+}
+
+// MD5-prefix hash per string: bytes in [offsets[i], offsets[i+1]).
+void hs_md5_prefix(const uint8_t* bytes, const int64_t* offsets, uint32_t* out,
+                   int64_t n) {
+  parallel_for(n, 1 << 10, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i)
+      out[i] = MD5::prefix32(bytes + offsets[i],
+                             static_cast<uint64_t>(offsets[i + 1] - offsets[i]));
+  });
+}
+
+// dst[i, :] = src[idx[i], :] for row_bytes-wide rows (any dtype/2D shape).
+void hs_take_rows(const uint8_t* src, uint8_t* dst, const int64_t* idx,
+                  int64_t n_idx, int64_t row_bytes) {
+  parallel_for(n_idx, 1 << 14, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i)
+      std::memcpy(dst + i * row_bytes, src + idx[i] * row_bytes, row_bytes);
+  });
+}
+
+// acc = mix32(acc * 31 + h) column combine, in place on acc.
+void hs_combine(uint32_t* acc, const uint32_t* h, int64_t n) {
+  parallel_for(n, 1 << 16, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) acc[i] = mix32(acc[i] * 31u + h[i]);
+  });
+}
+}

@@ -38,6 +38,8 @@ def hash_int_column(arr, xp):
 
     int64/float64 are viewed as two 32-bit words and both words mixed;
     32-bit types mix directly. Works with numpy or jax.numpy via `xp`.
+    The numpy path dispatches to the threaded C++ kernel when built
+    (hyperspace_tpu/native — bit-identical by construction and test).
     """
     dtype = arr.dtype
     if dtype in (np.dtype(np.float32),):
@@ -50,16 +52,33 @@ def hash_int_column(arr, xp):
         arr = arr.astype(np.int32 if xp is np else xp.int32)
         dtype = arr.dtype
     if dtype in (np.dtype(np.int64), np.dtype(np.uint64)):
+        if xp is np:
+            from hyperspace_tpu import native
+
+            out = native.hash_i64(arr.view(np.int64))
+            if out is not None:
+                return out
         lo = (arr & 0xFFFFFFFF).astype(xp.uint32)
         hi = ((arr >> 32) & 0xFFFFFFFF).astype(xp.uint32)
         return _mix32(lo ^ (_mix32(hi, xp) * xp.uint32(0x9E3779B1)), xp)
     # 32-bit lane
+    if xp is np:
+        from hyperspace_tpu import native
+
+        out = native.hash_i32(arr.view(np.int32) if arr.dtype != np.int32 else arr)
+        if out is not None:
+            return out
     return _mix32(arr.astype(xp.uint32), xp)
 
 
 def string_dict_hashes(dictionary: np.ndarray) -> np.ndarray:
     """uint32 hash per dictionary entry, a pure function of the bytes
     (md5 prefix) — stable across processes and dictionaries."""
+    from hyperspace_tpu import native
+
+    out = native.md5_prefix(dictionary)
+    if out is not None:
+        return out
     out = np.empty(len(dictionary), dtype=np.uint32)
     for i, s in enumerate(dictionary):
         h = hashlib.md5(str(s).encode("utf-8")).digest()
@@ -70,6 +89,16 @@ def string_dict_hashes(dictionary: np.ndarray) -> np.ndarray:
 def combine_hashes(hashes: list, xp):
     """Order-dependent combine of per-column uint32 hashes."""
     acc = hashes[0]
+    if xp is np and len(hashes) > 1:
+        from hyperspace_tpu import native
+
+        for h in hashes[1:]:
+            nat = native.combine(acc, h)
+            if nat is None:
+                acc = _mix32(acc * xp.uint32(31) + h, xp)
+            else:
+                acc = nat
+        return acc
     for h in hashes[1:]:
         acc = _mix32(acc * xp.uint32(31) + h, xp)
     return acc

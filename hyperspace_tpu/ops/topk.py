@@ -106,6 +106,11 @@ def topk(scores, k: int, impl: str = "auto") -> tuple[np.ndarray, np.ndarray]:
     if scores.ndim == 1:
         v, i = topk(scores[None, :], k, impl)
         return v[0], i[0]
+    # NaN scores are treated as -inf in BOTH paths: the Pallas kernel's
+    # max/argmax rounds would otherwise never mask a NaN (x == NaN is
+    # false) and emit an out-of-range index, and lax.top_k would rank NaN
+    # first. -inf gives one deterministic, sane semantic for corrupt rows.
+    scores = jnp.where(jnp.isnan(scores), -jnp.inf, scores)
     q, n = scores.shape
     k = min(k, n)
     tile = min(_TILE, _next_mult(max(n, 128), 128))

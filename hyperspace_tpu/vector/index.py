@@ -114,15 +114,10 @@ class VectorIndexBuilder:
         part = assign_partitions(emb, centroids)
 
         order = np.argsort(part, kind="stable")
-        sorted_part = part[order]
-        starts = np.searchsorted(sorted_part, np.arange(num_partitions + 1))
         dest = Path(dest_path)
-        bucket_rows = []
-        for p in range(num_partitions):
-            lo, hi = int(starts[p]), int(starts[p + 1])
-            hio.write_bucket(dest, p, table.take(order[lo:hi]))
-            bucket_rows.append(hi - lo)
-        hio.write_manifest(dest, num_partitions, [embedding_column], bucket_rows)
+        hio.carve_and_write(
+            dest, table, part[order], num_partitions, [embedding_column], order=order
+        )
         np.save(dest / CENTROIDS_NAME, centroids)
         return centroids
 

@@ -124,12 +124,13 @@ def ann_search(
     k: int,
     nprobe: int | None = None,
     embedding_column: str | None = None,
-    metric: str = "l2",
+    metric: str | None = None,
 ) -> AnnResult:
     """Approximate nearest neighbours of `queries` [q, d] over the scanned
     dataset. Uses a matching vector index when hyperspace is enabled and
-    one exists (scoring with the INDEX's metric); otherwise brute-forces
-    the source exactly, scoring with `metric`."""
+    one exists (scoring with the INDEX's metric; an explicitly different
+    `metric` raises instead of being silently ignored); otherwise
+    brute-forces the source exactly, scoring with `metric` (default l2)."""
     queries = np.asarray(queries, dtype=np.float32)
     if queries.ndim == 1:
         queries = queries[None, :]
@@ -153,9 +154,15 @@ def ann_search(
         from hyperspace_tpu.execution.executor import Executor
 
         table = Executor().execute(plan)
-        return brute_force_search(table, embedding_column, queries, k, metric)
+        return brute_force_search(table, embedding_column, queries, k, metric or "l2")
 
     dd = entry.derived_dataset
+    if metric is not None and metric != dd.metric:
+        raise HyperspaceError(
+            f"metric {metric!r} conflicts with index {entry.name!r} built with "
+            f"metric {dd.metric!r}; omit metric or disable hyperspace for an "
+            "exact search with the requested metric"
+        )
     version_dir = Path(entry.content.root) / entry.content.directories[-1]
     centroids = np.load(version_dir / CENTROIDS_NAME)
     num_partitions = dd.num_partitions

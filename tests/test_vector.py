@@ -51,6 +51,17 @@ def emb_parquet(tmp_path):
     return str(root), emb
 
 
+def test_topk_nan_scores_treated_as_minus_inf():
+    x = np.random.default_rng(4).standard_normal((3, 2000)).astype(np.float32)
+    x[0, 5] = np.nan
+    x[2, :] = np.nan
+    for impl in ("pallas", "xla"):
+        v, i = topk(x, 5, impl=impl)
+        assert not np.isnan(v).any(), impl
+        assert (i < 2000).all(), impl  # never out-of-range
+        assert np.isinf(v[2]).all(), impl  # all-NaN row → all -inf
+
+
 def test_topk_pallas_matches_xla():
     rng = np.random.default_rng(1)
     x = rng.standard_normal((5, 3000)).astype(np.float32)
