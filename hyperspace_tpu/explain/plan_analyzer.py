@@ -16,30 +16,68 @@ from collections import Counter
 from hyperspace_tpu.plan.nodes import Filter, Join, LogicalPlan, Project, Scan, Union
 
 
-def pretty_plan(plan: LogicalPlan, indent: int = 0) -> str:
-    pad = "  " * indent
+def _node_label(plan: LogicalPlan) -> str:
+    """One-line description of a node WITHOUT its children."""
     if isinstance(plan, Scan):
         kind = "IndexScan" if plan.bucket_spec is not None else "Scan"
         extra = ""
         if plan.bucket_spec is not None:
             extra = f" buckets={plan.bucket_spec[0]} bucketCols={plan.bucket_spec[1]}"
-        return f"{pad}{kind} root={plan.root} cols={plan.scan_schema.names}{extra}"
+        return f"{kind} root={plan.root} cols={plan.scan_schema.names}{extra}"
     if isinstance(plan, Filter):
-        return f"{pad}Filter {plan.predicate.to_json()}\n" + pretty_plan(plan.child, indent + 1)
+        return f"Filter {plan.predicate.to_json()}"
     if isinstance(plan, Project):
-        return f"{pad}Project {plan.columns}\n" + pretty_plan(plan.child, indent + 1)
+        return f"Project {plan.columns}"
     if isinstance(plan, Join):
-        return (
-            f"{pad}Join on {list(zip(plan.left_on, plan.right_on))}\n"
-            + pretty_plan(plan.left, indent + 1)
-            + "\n"
-            + pretty_plan(plan.right, indent + 1)
-        )
+        return f"Join on {list(zip(plan.left_on, plan.right_on))}"
     if isinstance(plan, Union):
-        return f"{pad}HybridScanUnion\n" + "\n".join(
-            pretty_plan(c, indent + 1) for c in plan.inputs
-        )
-    return f"{pad}{type(plan).__name__}"
+        return "HybridScanUnion"
+    return type(plan).__name__
+
+
+def _render_lines(plan: LogicalPlan, indent: int = 0, path: tuple = ()):
+    """[(occurrence path, rendered line)] in pre-order. Paths (child-index
+    tuples from the root) identify OCCURRENCES, not objects — plans are
+    DAGs when a dataframe is reused, and a shared node highlighted in one
+    leg must not light up its aliases elsewhere."""
+    out = [(path, "  " * indent + _node_label(plan))]
+    for i, c in enumerate(plan.children()):
+        out.extend(_render_lines(c, indent + 1, path + (i,)))
+    return out
+
+
+def pretty_plan(plan: LogicalPlan, indent: int = 0) -> str:
+    return "\n".join(
+        line for _, line in _render_lines(plan, indent)
+    )
+
+
+def _mark_diff(
+    a: LogicalPlan, b: LogicalPlan, marked_a: set, marked_b: set, path: tuple = ()
+) -> None:
+    """Queue-style pairwise walk (PlanAnalyzer.scala:56-101): nodes whose
+    labels match recurse into their children; any mismatch marks BOTH
+    whole subtrees (by occurrence path) as differing."""
+
+    def mark_subtree(p: LogicalPlan, acc: set, at: tuple) -> None:
+        acc.add(at)
+        for i, c in enumerate(p.children()):
+            mark_subtree(c, acc, at + (i,))
+
+    ca, cb = a.children(), b.children()
+    if _node_label(a) != _node_label(b) or len(ca) != len(cb):
+        mark_subtree(a, marked_a, path)
+        mark_subtree(b, marked_b, path)
+        return
+    for i, (x, y) in enumerate(zip(ca, cb)):
+        _mark_diff(x, y, marked_a, marked_b, path + (i,))
+
+
+def _render_highlighted(plan: LogicalPlan, marked: set, mode) -> str:
+    lines = []
+    for at, line in _render_lines(plan):
+        lines.append(mode.highlight(line) if at in marked else line)
+    return "\n".join(lines)
 
 
 def _operator_counts(plan: LogicalPlan) -> Counter:
@@ -68,8 +106,17 @@ def _used_indexes(plan: LogicalPlan, session) -> list[str]:
     return used
 
 
-def explain_string(plan: LogicalPlan, session, verbose: bool = False) -> str:
-    """Run the rewriter off and on, diff (PlanAnalyzer.scala:163-178)."""
+def explain_string(
+    plan: LogicalPlan, session, verbose: bool = False, mode=None
+) -> str:
+    """Run the rewriter off and on, diff with differing subtrees
+    highlighted in the configured display mode
+    (PlanAnalyzer.scala:45-126, DisplayMode.scala:24-89)."""
+    from hyperspace_tpu.explain.display_mode import display_mode_from_conf
+
+    if mode is None:
+        mode = display_mode_from_conf(getattr(session, "conf", None))
+
     was_enabled = session.is_hyperspace_enabled()
     try:
         session.enable_hyperspace()
@@ -78,15 +125,17 @@ def explain_string(plan: LogicalPlan, session, verbose: bool = False) -> str:
         if not was_enabled:
             session.disable_hyperspace()
 
-    before = pretty_plan(plan)
-    after = pretty_plan(with_plan)
+    marked_before: set = set()
+    marked_after: set = set()
+    _mark_diff(plan, with_plan, marked_before, marked_after)
+
     out = []
     out.append("=" * 64)
     out.append("Plan with indexes:")
-    out.append(after)
+    out.append(_render_highlighted(with_plan, marked_after, mode))
     out.append("=" * 64)
     out.append("Plan without indexes:")
-    out.append(before)
+    out.append(_render_highlighted(plan, marked_before, mode))
     out.append("=" * 64)
     out.append("Indexes used:")
     for name in _used_indexes(with_plan, session):
@@ -101,4 +150,4 @@ def explain_string(plan: LogicalPlan, session, verbose: bool = False) -> str:
         # The headline: every source scan turned into a bucketed index scan
         # is one exchange the executor never has to run.
         out.append(f"  ShuffleExchange-equivalents eliminated: {ca.get('IndexScan', 0)}")
-    return "\n".join(out)
+    return mode.finalize("\n".join(out))

@@ -1,0 +1,107 @@
+"""Explain output tests (analog of the reference's ExplainTest, which pins
+exact explain strings per display mode)."""
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col
+from hyperspace_tpu.explain.display_mode import (
+    EXPLAIN_DISPLAY_MODE,
+    ConsoleMode,
+    HTMLMode,
+    PlainTextMode,
+    display_mode_from_conf,
+)
+
+
+@pytest.fixture
+def session(tmp_system_path):
+    return HyperspaceSession(system_path=tmp_system_path, num_buckets=8)
+
+
+@pytest.fixture
+def hs(session):
+    return Hyperspace(session)
+
+
+def test_display_mode_selection(session):
+    assert isinstance(display_mode_from_conf(session.conf), PlainTextMode)
+    session.conf.set(EXPLAIN_DISPLAY_MODE, "console")
+    assert isinstance(display_mode_from_conf(session.conf), ConsoleMode)
+    session.conf.set(EXPLAIN_DISPLAY_MODE, "html")
+    assert isinstance(display_mode_from_conf(session.conf), HTMLMode)
+
+
+def test_explain_highlights_replaced_subtree(session, hs, sample_parquet):
+    df = session.parquet(sample_parquet)
+    hs.create_index(df, IndexConfig("eidx", ["key"], ["value"]))
+    q = df.filter(col("key") == 1).select("key", "value")
+
+    text = hs.explain(q)
+    assert "Plan with indexes:" in text
+    assert "Plan without indexes:" in text
+    assert "IndexScan" in text
+    assert "eidx" in text  # listed under "Indexes used"
+    # plaintext mode: the replaced scans get trailing markers
+    marked = [l for l in text.splitlines() if l.endswith("<----")]
+    assert any("IndexScan" in l for l in marked)
+    assert any("Scan" in l and "IndexScan" not in l for l in marked)
+    # unchanged nodes (Project/Filter) are NOT highlighted
+    assert not any("Project" in l for l in marked)
+
+
+def test_explain_console_and_html_modes(session, hs, sample_parquet):
+    df = session.parquet(sample_parquet)
+    hs.create_index(df, IndexConfig("eidx2", ["key"], ["value"]))
+    q = df.filter(col("key") == 1).select("key", "value")
+
+    session.conf.set(EXPLAIN_DISPLAY_MODE, "console")
+    text = hs.explain(q)
+    assert "\x1b[7m" in text and "\x1b[27m" in text
+
+    session.conf.set(EXPLAIN_DISPLAY_MODE, "html")
+    text = hs.explain(q)
+    assert "<b>" in text and "</b>" in text
+    assert "<br/>" in text and "\n" not in text
+    assert text.startswith("<pre>") and text.endswith("</pre>")
+
+    session.conf.set(EXPLAIN_DISPLAY_MODE, "bogus")
+    with pytest.raises(ValueError, match="unknown"):
+        display_mode_from_conf(session.conf)
+
+
+def test_explain_verbose_counts_eliminated_exchanges(session, hs, sample_parquet):
+    df = session.parquet(sample_parquet)
+    hs.create_index(df, IndexConfig("eidx3", ["key"], ["value"]))
+    q = df.filter(col("key") == 1).select("key", "value")
+    text = hs.explain(q, verbose=True)
+    assert "Physical operator stats:" in text
+    assert "IndexScan: 0 -> 1" in text
+    assert "Scan: 1 -> 0" in text
+    assert "ShuffleExchange-equivalents eliminated: 1" in text
+
+
+def test_explain_no_rewrite_has_no_highlights(session, hs, sample_parquet):
+    df = session.parquet(sample_parquet)  # no index created
+    q = df.filter(col("key") == 1).select("key", "value")
+    text = hs.explain(q)
+    assert "<----" not in text
+
+
+def test_explain_shared_node_marks_only_rewritten_occurrence(session, hs, sample_parquet):
+    """The same df (one Scan OBJECT) on both join legs: only the leg the
+    rewriter replaced may be highlighted — occurrence-path marking, not
+    object identity."""
+    df = session.parquet(sample_parquet)
+    hs.create_index(df, IndexConfig("shidx", ["key"], ["value"]))
+    # Left leg coverable by the index; right leg projects a non-covered
+    # column so it stays a raw source scan of the SAME Scan object.
+    q = df.select("key", "value").join(df.select("key", "name"), ["key"])
+    text = hs.explain(q)
+    without = text.split("Plan without indexes:")[1].split("=" * 64)[0]
+    marked = [l for l in without.splitlines() if l.endswith("<----")]
+    unmarked_scans = [
+        l for l in without.splitlines() if "Scan" in l and not l.endswith("<----")
+    ]
+    if marked:  # a rewrite happened on one leg only
+        assert unmarked_scans, "the unchanged occurrence must not be highlighted"
