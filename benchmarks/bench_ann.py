@@ -1,0 +1,74 @@
+"""BASELINE config 5 analog: embedding-column ANN covering index.
+
+Builds a vector index (k-means partitions, Pallas top-k probe) and
+measures query throughput vs exact brute force, with recall@10 as the
+quality gate. vs_baseline = speedup * recall (a fast-but-wrong index
+scores low).
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main(n: int = 500_000, dim: int = 128, partitions: int = 64, nprobe: int = 8):
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    from benchmarks.datagen import gen_embeddings
+    from hyperspace_tpu import Hyperspace, HyperspaceSession, VectorIndexConfig
+
+    tmp = Path(tempfile.mkdtemp(prefix="hs_benchann_"))
+    try:
+        emb = gen_embeddings(tmp / "emb", n, dim, clusters=partitions)
+        session = HyperspaceSession(system_path=str(tmp / "indexes"))
+        hs = Hyperspace(session)
+        df = session.parquet(tmp / "emb")
+
+        t0 = time.perf_counter()
+        hs.create_vector_index(
+            df, VectorIndexConfig("annidx", "emb", ["id"], num_partitions=partitions)
+        )
+        log(f"vector index build: {time.perf_counter() - t0:.2f}s for {n}x{dim}")
+
+        rng = np.random.default_rng(9)
+        queries = emb[rng.choice(n, 32, replace=False)] + 0.01
+
+        session.enable_hyperspace()
+        hs.ann_search(df, queries, k=10, nprobe=nprobe)  # warmup
+        t0 = time.perf_counter()
+        res = hs.ann_search(df, queries, k=10, nprobe=nprobe)
+        t_idx = time.perf_counter() - t0
+
+        session.disable_hyperspace()
+        hs.ann_search(df, queries, k=10)  # warmup
+        t0 = time.perf_counter()
+        exact = hs.ann_search(df, queries, k=10)
+        t_bf = time.perf_counter() - t0
+
+        a = res.rows.columns["id"].reshape(len(queries), -1)
+        e = exact.rows.columns["id"].reshape(len(queries), -1)
+        recall = float(np.mean([len(set(a[i]) & set(e[i])) / e.shape[1] for i in range(len(queries))]))
+        speedup = t_bf / t_idx
+        log(f"indexed {t_idx*1000:.0f}ms  brute {t_bf*1000:.0f}ms  recall@10 {recall:.3f}")
+        print(json.dumps({
+            "metric": "ann_query_speedup_recall_weighted",
+            "value": round(speedup * recall, 3),
+            "unit": "x",
+            "vs_baseline": round(speedup * recall, 3),
+        }))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

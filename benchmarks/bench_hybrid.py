@@ -1,0 +1,97 @@
+"""BASELINE config 3 analog: Hybrid Scan — query freshness without refresh.
+
+After appending files to an indexed dataset, hybrid scan lets the stale
+index keep serving (index buckets ∪ raw appended files) until the next
+incremental refresh. Measures the hybrid-scan query cost relative to the
+fresh-index query AND asserts correctness against the full scan.
+vs_baseline = full-scan time / hybrid time (how much of the index's value
+survives staleness).
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main(n: int = 1_000_000, append_n: int = 100_000):
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    from benchmarks.datagen import gen_lineitem
+    from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col
+    from hyperspace_tpu.config import INDEX_HYBRID_SCAN_ENABLED
+
+    tmp = Path(tempfile.mkdtemp(prefix="hs_benchhybrid_"))
+    try:
+        data = tmp / "lineitem"
+        gen_lineitem(data, n)
+        session = HyperspaceSession(system_path=str(tmp / "indexes"), num_buckets=32)
+        hs = Hyperspace(session)
+        df = session.parquet(data)
+        hs.create_index(df, IndexConfig("hidx", ["l_orderkey"], ["l_extendedprice"]))
+
+        # Append ~10% new data WITHOUT refreshing.
+        rng = np.random.default_rng(1)
+        pq.write_table(
+            pa.table(
+                {
+                    "l_orderkey": rng.integers(0, n // 4, append_n).astype(np.int64),
+                    "l_partkey": rng.integers(0, 200_000, append_n).astype(np.int64),
+                    "l_quantity": rng.integers(1, 51, append_n).astype(np.int64),
+                    "l_extendedprice": (rng.random(append_n) * 100_000),
+                    "l_discount": (rng.random(append_n) * 0.1),
+                }
+            ),
+            data / "part-append.parquet",
+        )
+        session.conf.set(INDEX_HYBRID_SCAN_ENABLED, True)
+        session.enable_hyperspace()
+
+        keys = rng.integers(0, n // 4, 8)
+
+        def run_queries():
+            total = 0
+            for kk in keys:
+                q = df.filter(col("l_orderkey") == int(kk)).select(
+                    "l_orderkey", "l_extendedprice"
+                )
+                total += len(session.run(q).columns["l_orderkey"])
+            return total
+
+        rows_hybrid = run_queries()  # warmup
+        t0 = time.perf_counter()
+        rows_hybrid = run_queries()
+        t_hybrid = time.perf_counter() - t0
+
+        session.disable_hyperspace()
+        rows_full = run_queries()  # warmup
+        t0 = time.perf_counter()
+        rows_full = run_queries()
+        t_full = time.perf_counter() - t0
+
+        assert rows_hybrid == rows_full, f"hybrid results wrong: {rows_hybrid} vs {rows_full}"
+        speedup = t_full / t_hybrid
+        log(f"hybrid {t_hybrid:.2f}s  full-scan {t_full:.2f}s  rows={rows_hybrid}")
+        print(json.dumps({
+            "metric": "hybrid_scan_stale_index_speedup",
+            "value": round(speedup, 3),
+            "unit": "x",
+            "vs_baseline": round(speedup, 3),
+        }))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,80 @@
+"""BASELINE config 2 analog: orders ⋈ lineitem shuffle-free join.
+
+Both sides carry a covering index bucketed on the join key with EQUAL
+bucket counts, so the rewritten join runs per-bucket with zero exchange
+(the reference's headline: ShuffleExchange count drops to 0,
+JoinIndexRanker.scala:28-37). Prints one JSON line; vs_baseline normalizes
+against 1x (parity with the un-indexed join) — higher is better.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main(n_lineitem: int = 1_000_000):
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    from benchmarks.datagen import gen_lineitem, gen_orders
+    from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig
+
+    tmp = Path(tempfile.mkdtemp(prefix="hs_benchjoin_"))
+    try:
+        n_orders = n_lineitem // 4
+        li_bytes = gen_lineitem(tmp / "lineitem", n_lineitem)
+        o_bytes = gen_orders(tmp / "orders", n_orders)
+        log(f"lineitem={n_lineitem} rows, orders={n_orders} rows, "
+            f"{(li_bytes + o_bytes) / 1e9:.3f} GB")
+
+        session = HyperspaceSession(system_path=str(tmp / "indexes"), num_buckets=32)
+        hs = Hyperspace(session)
+        li = session.parquet(tmp / "lineitem")
+        orders = session.parquet(tmp / "orders")
+
+        t0 = time.perf_counter()
+        hs.create_index(li, IndexConfig("li_ok", ["l_orderkey"], ["l_extendedprice", "l_discount"]))
+        hs.create_index(orders, IndexConfig("o_ok", ["o_orderkey"], ["o_totalprice"]))
+        log(f"index builds: {time.perf_counter() - t0:.2f}s")
+
+        q = li.select("l_orderkey", "l_extendedprice").join(
+            orders.select("o_orderkey", "o_totalprice"),
+            ["l_orderkey"], ["o_orderkey"],
+        )
+
+        session.enable_hyperspace()
+        opt = session.optimized_plan(q)
+        assert all(s.bucket_spec is not None for s in opt.leaves()), "join rewrite missed"
+        n_idx = len(session.run(q).columns["l_orderkey"])  # warmup + count
+        t0 = time.perf_counter()
+        session.run(q)
+        t_indexed = time.perf_counter() - t0
+
+        session.disable_hyperspace()
+        n_no = len(session.run(q).columns["l_orderkey"])  # warmup + count
+        t0 = time.perf_counter()
+        session.run(q)
+        t_noindex = time.perf_counter() - t0
+
+        assert n_idx == n_no, f"result mismatch {n_idx} vs {n_no}"
+        speedup = t_noindex / t_indexed
+        log(f"indexed {t_indexed:.2f}s  no-index {t_noindex:.2f}s  rows={n_idx}")
+        print(json.dumps({
+            "metric": "tpch_join_shuffle_free_speedup",
+            "value": round(speedup, 3),
+            "unit": "x",
+            "vs_baseline": round(speedup, 3),
+        }))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000)

@@ -1,0 +1,83 @@
+"""Synthetic data generators for the benchmark suite.
+
+TPC-H-shaped tables (lineitem/orders with a shared orderkey domain), a
+NYC-Taxi-shaped trips table for the incremental-refresh loop, and
+clustered embeddings for the ANN config. Deterministic under a seed so
+runs are comparable across rounds.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+
+def gen_lineitem(root: Path, n: int, seed: int = 42, orders: int | None = None) -> int:
+    """lineitem-shaped parquet under root; returns byte size."""
+    rng = np.random.default_rng(seed)
+    orders = orders or n // 4
+    t = pa.table(
+        {
+            "l_orderkey": rng.integers(0, orders, n).astype(np.int64),
+            "l_partkey": rng.integers(0, 200_000, n).astype(np.int64),
+            "l_quantity": rng.integers(1, 51, n).astype(np.int64),
+            "l_extendedprice": (rng.random(n) * 100_000),
+            "l_discount": (rng.random(n) * 0.1),
+        }
+    )
+    root.mkdir(parents=True, exist_ok=True)
+    pq.write_table(t, root / "part-0.parquet")
+    return t.nbytes
+
+
+def gen_orders(root: Path, n_orders: int, seed: int = 43) -> int:
+    rng = np.random.default_rng(seed)
+    t = pa.table(
+        {
+            "o_orderkey": np.arange(n_orders, dtype=np.int64),
+            "o_custkey": rng.integers(0, n_orders // 10 + 1, n_orders).astype(np.int64),
+            "o_totalprice": (rng.random(n_orders) * 500_000),
+        }
+    )
+    root.mkdir(parents=True, exist_ok=True)
+    pq.write_table(t, root / "part-0.parquet")
+    return t.nbytes
+
+
+def gen_trips_batch(root: Path, n: int, batch: int, seed: int = 50) -> int:
+    """One append batch of taxi-trip-shaped rows (file per batch)."""
+    rng = np.random.default_rng(seed + batch)
+    t = pa.table(
+        {
+            "trip_id": (np.arange(n, dtype=np.int64) + batch * n),
+            "zone": rng.integers(0, 265, n).astype(np.int64),
+            "fare": (rng.random(n) * 80),
+            "distance": (rng.random(n) * 30),
+        }
+    )
+    root.mkdir(parents=True, exist_ok=True)
+    pq.write_table(t, root / f"batch-{batch:04d}.parquet")
+    return t.nbytes
+
+
+def gen_embeddings(root: Path, n: int, dim: int, clusters: int, seed: int = 7) -> np.ndarray:
+    """Clustered embedding table; returns the raw matrix for querying."""
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((clusters, dim)).astype(np.float32) * 4
+    emb = centers[rng.integers(0, clusters, n)] + rng.standard_normal((n, dim)).astype(
+        np.float32
+    )
+    t = pa.table(
+        {
+            "id": pa.array(np.arange(n, dtype=np.int64)),
+            "emb": pa.FixedSizeListArray.from_arrays(
+                pa.array(emb.reshape(-1), type=pa.float32()), dim
+            ),
+        }
+    )
+    root.mkdir(parents=True, exist_ok=True)
+    pq.write_table(t, root / "part-0.parquet")
+    return emb

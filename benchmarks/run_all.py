@@ -1,0 +1,24 @@
+"""Run every benchmark; one JSON line per benchmark on stdout.
+
+`python bench.py` at the repo root remains the driver's flagship entry
+(TPC-H point lookup); this harness covers the remaining BASELINE configs.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from benchmarks import bench_ann, bench_hybrid, bench_join, bench_refresh  # noqa: E402
+
+
+def main():
+    for mod in (bench_join, bench_hybrid, bench_refresh, bench_ann):
+        print(f"=== {mod.__name__} ===", file=sys.stderr, flush=True)
+        mod.main()
+
+
+if __name__ == "__main__":
+    main()

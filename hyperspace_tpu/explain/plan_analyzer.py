@@ -148,6 +148,9 @@ def explain_string(
         for op in sorted(set(cb) | set(ca)):
             out.append(f"  {op}: {cb.get(op, 0)} -> {ca.get(op, 0)}")
         # The headline: every source scan turned into a bucketed index scan
-        # is one exchange the executor never has to run.
-        out.append(f"  ShuffleExchange-equivalents eliminated: {ca.get('IndexScan', 0)}")
+        # is one exchange the executor never has to run. Delta, not the
+        # absolute after-count — a plan already holding index scans did not
+        # have them "eliminated" by this rewrite.
+        eliminated = ca.get("IndexScan", 0) - cb.get("IndexScan", 0)
+        out.append(f"  ShuffleExchange-equivalents eliminated: {eliminated}")
     return mode.finalize("\n".join(out))

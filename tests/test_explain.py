@@ -92,16 +92,30 @@ def test_explain_shared_node_marks_only_rewritten_occurrence(session, hs, sample
     """The same df (one Scan OBJECT) on both join legs: only the leg the
     rewriter replaced may be highlighted — occurrence-path marking, not
     object identity."""
+    from hyperspace_tpu.plan.nodes import Filter as FilterNode, Join, Project
+
     df = session.parquet(sample_parquet)
     hs.create_index(df, IndexConfig("shidx", ["key"], ["value"]))
-    # Left leg coverable by the index; right leg projects a non-covered
-    # column so it stays a raw source scan of the SAME Scan object.
-    q = df.select("key", "value").join(df.select("key", "name"), ["key"])
+    # Left leg: Project(Filter(Scan)) covered by the index → FilterIndexRule
+    # rewrites it. Right leg: the SAME Scan object projecting a non-covered
+    # column ('name') → stays a raw source scan.
+    q = Join(
+        Project(FilterNode(df, col("key") == 1), ["key", "value"]),
+        Project(df, ["key", "name"]),
+        ["key"],
+        ["key"],
+    )
+    session.enable_hyperspace()
+    opt = session.optimized_plan(q)
+    session.disable_hyperspace()
+    rewritten = [s for s in opt.leaves() if s.bucket_spec is not None]
+    assert len(rewritten) == 1, "exactly the left leg must be rewritten"
+
     text = hs.explain(q)
     without = text.split("Plan without indexes:")[1].split("=" * 64)[0]
     marked = [l for l in without.splitlines() if l.endswith("<----")]
     unmarked_scans = [
         l for l in without.splitlines() if "Scan" in l and not l.endswith("<----")
     ]
-    if marked:  # a rewrite happened on one leg only
-        assert unmarked_scans, "the unchanged occurrence must not be highlighted"
+    assert marked, "the rewritten occurrence must be highlighted"
+    assert unmarked_scans, "the unchanged occurrence must not be highlighted"

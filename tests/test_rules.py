@@ -1,0 +1,322 @@
+"""Fake-backend rule tests: synthetic plans + injectable signature provider.
+
+Mirror of the reference's level-3 rule tests (rules/JoinIndexRuleTest.scala,
+FilterIndexRuleTest.scala, RuleTestHelper.scala:193-202): plans are built by
+hand over nonexistent paths, index entries are fabricated, and the
+signature provider fingerprints the scan ROOT string — so rule logic is
+exercised with zero file IO. 15+ positive/negative join-condition shapes
+(JoinIndexRuleTest.scala:107-343 has the analogous matrix).
+"""
+
+import pytest
+
+from hyperspace_tpu.metadata.log_entry import (
+    Content,
+    CoveringIndex,
+    Fingerprint,
+    IndexLogEntry,
+    Source,
+    VectorIndex,
+)
+from hyperspace_tpu.plan.expr import col
+from hyperspace_tpu.plan.nodes import Filter, Join, Project, Scan, Union
+from hyperspace_tpu.rules import base as rules_base
+from hyperspace_tpu.rules.base import apply_rules
+from hyperspace_tpu.rules.filter_index_rule import FilterIndexRule
+from hyperspace_tpu.rules.join_index_rule import JoinIndexRule
+from hyperspace_tpu.rules.ranker import JoinIndexRanker
+from hyperspace_tpu.schema import Field, Schema
+from hyperspace_tpu.signature import SignatureProvider
+
+
+class RootSignatureProvider(SignatureProvider):
+    """Fingerprint = sorted scan roots — no IO (RuleTestHelper analog)."""
+
+    name = "rootBased"
+
+    def signature(self, plan):
+        roots = sorted(s.root for s in plan.leaves())
+        return Fingerprint(kind=self.name, value="|".join(roots))
+
+
+@pytest.fixture(autouse=True)
+def root_signatures(monkeypatch):
+    monkeypatch.setattr(
+        rules_base, "create_signature_provider", lambda name="rootBased": RootSignatureProvider()
+    )
+
+
+T1 = Schema.of(Field("a", "int64"), Field("b", "int64"), Field("v", "float64"))
+T2 = Schema.of(Field("c", "int64"), Field("d", "int64"), Field("w", "float64"))
+
+
+def scan1() -> Scan:
+    return Scan("/nonexistent/t1", "parquet", T1)
+
+
+def scan2() -> Scan:
+    return Scan("/nonexistent/t2", "parquet", T2)
+
+
+def entry(name, root, schema, indexed, included, buckets=8) -> IndexLogEntry:
+    sel = schema.select(indexed + included)
+    return IndexLogEntry(
+        id=1,
+        state="ACTIVE",
+        name=name,
+        derived_dataset=CoveringIndex(indexed, included, sel.to_json(), buckets),
+        content=Content(root=f"/nonexistent/idx/{name}", directories=["v__=0"]),
+        source=Source(
+            plan=Scan(root, "parquet", schema).to_json(),
+            fingerprint=Fingerprint(kind="rootBased", value=root),
+            files=[],
+        ),
+    )
+
+
+def vector_entry(name, root) -> IndexLogEntry:
+    return IndexLogEntry(
+        id=1,
+        state="ACTIVE",
+        name=name,
+        derived_dataset=VectorIndex("emb", ["a"], [], 8, 16),
+        content=Content(root=f"/nonexistent/idx/{name}", directories=["v__=0"]),
+        source=Source(
+            plan=Scan(root, "parquet", T1).to_json(),
+            fingerprint=Fingerprint(kind="rootBased", value=root),
+            files=[],
+        ),
+    )
+
+
+def join_plan(left_on=("a",), right_on=("c",)):
+    return Join(
+        Project(scan1(), ["a", "v"]),
+        Project(scan2(), ["c", "w"]),
+        list(left_on),
+        list(right_on),
+    )
+
+
+def rewritten_sides(plan):
+    return [s for s in plan.leaves() if s.bucket_spec is not None]
+
+
+class TestJoinIndexRule:
+    def run(self, plan, entries):
+        return JoinIndexRule().apply(plan, entries)
+
+    def test_exact_pair_rewrites_both_sides(self):
+        out = self.run(
+            join_plan(),
+            [
+                entry("l", "/nonexistent/t1", T1, ["a"], ["v"]),
+                entry("r", "/nonexistent/t2", T2, ["c"], ["w"]),
+            ],
+        )
+        assert len(rewritten_sides(out)) == 2
+
+    def test_no_rewrite_without_candidates_for_one_side(self):
+        out = self.run(join_plan(), [entry("l", "/nonexistent/t1", T1, ["a"], ["v"])])
+        assert not rewritten_sides(out)
+
+    def test_indexed_columns_must_be_set_equal_to_join_cols(self):
+        # Index on (a, b) but join only on a — superset is NOT usable
+        # (JoinIndexRule.scala:515-524).
+        out = self.run(
+            join_plan(),
+            [
+                entry("l", "/nonexistent/t1", T1, ["a", "b"], ["v"]),
+                entry("r", "/nonexistent/t2", T2, ["c"], ["w"]),
+            ],
+        )
+        assert not rewritten_sides(out)
+
+    def test_index_must_cover_required_columns(self):
+        out = self.run(
+            join_plan(),
+            [
+                entry("l", "/nonexistent/t1", T1, ["a"], []),  # v not covered
+                entry("r", "/nonexistent/t2", T2, ["c"], ["w"]),
+            ],
+        )
+        assert not rewritten_sides(out)
+
+    def test_signature_mismatch_blocks_side(self):
+        out = self.run(
+            join_plan(),
+            [
+                entry("l", "/other/root", T1, ["a"], ["v"]),  # wrong fingerprint
+                entry("r", "/nonexistent/t2", T2, ["c"], ["w"]),
+            ],
+        )
+        assert not rewritten_sides(out)
+
+    def test_compound_keys_compatible_order_rewrites(self):
+        plan = Join(scan1(), scan2(), ["a", "b"], ["c", "d"])
+        out = self.run(
+            plan,
+            [
+                entry("l", "/nonexistent/t1", T1, ["a", "b"], ["v"]),
+                entry("r", "/nonexistent/t2", T2, ["c", "d"], ["w"]),
+            ],
+        )
+        assert len(rewritten_sides(out)) == 2
+
+    def test_compound_keys_order_mismatch_blocks(self):
+        # Left lists (a, b); the mapped right order must be (c, d) — an
+        # index on (d, c) is incompatible (JoinIndexRule.scala:547-594).
+        plan = Join(scan1(), scan2(), ["a", "b"], ["c", "d"])
+        out = self.run(
+            plan,
+            [
+                entry("l", "/nonexistent/t1", T1, ["a", "b"], ["v"]),
+                entry("r", "/nonexistent/t2", T2, ["d", "c"], ["w"]),
+            ],
+        )
+        assert not rewritten_sides(out)
+
+    def test_repeated_join_column_blocks(self):
+        plan = Join(scan1(), scan2(), ["a", "a"], ["c", "d"])
+        out = self.run(
+            plan,
+            [
+                entry("l", "/nonexistent/t1", T1, ["a"], ["v"]),
+                entry("r", "/nonexistent/t2", T2, ["c", "d"], ["w"]),
+            ],
+        )
+        assert not rewritten_sides(out)
+
+    def test_self_join_same_relation_object_blocks(self):
+        s = scan1()
+        plan = Join(Project(s, ["a", "v"]), Project(s, ["a", "b"]), ["a"], ["a"])
+        out = self.run(plan, [entry("l", "/nonexistent/t1", T1, ["a"], ["v", "b"])])
+        assert not rewritten_sides(out)
+
+    def test_filter_side_requires_predicate_columns_covered(self):
+        plan = Join(
+            Filter(scan1(), col("b") > 1),  # b required by the predicate
+            Project(scan2(), ["c", "w"]),
+            ["a"],
+            ["c"],
+        )
+        out = self.run(
+            plan,
+            [
+                entry("l", "/nonexistent/t1", T1, ["a"], ["v"]),  # b missing
+                entry("r", "/nonexistent/t2", T2, ["c"], ["w"]),
+            ],
+        )
+        assert not rewritten_sides(out)
+
+    def test_ranker_prefers_equal_bucket_pair(self):
+        e_l8 = entry("l8", "/nonexistent/t1", T1, ["a"], ["v", "b"], buckets=8)
+        e_l16 = entry("l16", "/nonexistent/t1", T1, ["a"], ["v", "b"], buckets=16)
+        e_r8 = entry("r8", "/nonexistent/t2", T2, ["c"], ["w", "d"], buckets=8)
+        out = self.run(join_plan(), [e_l8, e_l16, e_r8])
+        sides = rewritten_sides(out)
+        assert len(sides) == 2
+        assert all(s.bucket_spec[0] == 8 for s in sides), "equal-bucket pair must win"
+
+    def test_vector_index_entries_are_skipped(self):
+        out = self.run(join_plan(), [vector_entry("vl", "/nonexistent/t1")])
+        assert not rewritten_sides(out)
+
+    def test_inner_join_of_nested_plan_rewritten_via_recursion(self):
+        inner = join_plan()
+        outer = Project(inner, ["a", "v", "w"])
+        out = JoinIndexRule().apply(
+            outer,
+            [
+                entry("l", "/nonexistent/t1", T1, ["a"], ["v"]),
+                entry("r", "/nonexistent/t2", T2, ["c"], ["w"]),
+            ],
+        )
+        assert len(rewritten_sides(out)) == 2
+
+
+class TestFilterIndexRule:
+    def run(self, plan, entries):
+        return FilterIndexRule().apply(plan, entries)
+
+    def test_covering_filter_rewrites(self):
+        plan = Project(Filter(scan1(), col("a") == 5), ["a", "v"])
+        out = self.run(plan, [entry("f", "/nonexistent/t1", T1, ["a"], ["v"])])
+        assert rewritten_sides(out)
+
+    def test_filter_must_reference_first_indexed_column(self):
+        plan = Project(Filter(scan1(), col("b") == 5), ["b", "v"])
+        out = self.run(plan, [entry("f", "/nonexistent/t1", T1, ["a", "b"], ["v"])])
+        assert not rewritten_sides(out)
+
+    def test_coverage_required(self):
+        plan = Project(Filter(scan1(), col("a") == 5), ["a", "v"])
+        out = self.run(plan, [entry("f", "/nonexistent/t1", T1, ["a"], [])])
+        assert not rewritten_sides(out)
+
+    def test_bare_filter_requires_full_schema_coverage(self):
+        plan = Filter(scan1(), col("a") == 5)  # output = all of T1
+        out = self.run(plan, [entry("f", "/nonexistent/t1", T1, ["a"], ["v"])])  # b missing
+        assert not rewritten_sides(out)
+        out = self.run(plan, [entry("f2", "/nonexistent/t1", T1, ["a"], ["b", "v"])])
+        assert rewritten_sides(out)
+
+    def test_index_scan_never_rewritten_twice(self):
+        idx_scan = Scan("/nonexistent/idx", "parquet", T1.select(["a", "v"]), bucket_spec=(8, ["a"]))
+        plan = Project(Filter(idx_scan, col("a") == 5), ["a", "v"])
+        out = self.run(plan, [entry("f", "/nonexistent/idx", T1, ["a"], ["v"])])
+        assert out is plan or rewritten_sides(out) == [idx_scan]
+
+    def test_signature_mismatch_blocks(self):
+        plan = Project(Filter(scan1(), col("a") == 5), ["a", "v"])
+        out = self.run(plan, [entry("f", "/other/root", T1, ["a"], ["v"])])
+        assert not rewritten_sides(out)
+
+    def test_vector_index_entries_are_skipped(self):
+        plan = Project(Filter(scan1(), col("a") == 5), ["a", "v"])
+        out = self.run(plan, [vector_entry("v", "/nonexistent/t1")])
+        assert not rewritten_sides(out)
+
+
+class TestRuleOrderingAndSafety:
+    def test_join_rule_runs_before_filter_rule(self):
+        # A filter-under-join side: the JOIN rewrite must win the relation
+        # (ordering is load-bearing, package.scala:23-33).
+        plan = Join(
+            Filter(scan1(), col("a") > 0),
+            Project(scan2(), ["c", "w"]),
+            ["a"],
+            ["c"],
+        )
+        entries = [
+            entry("l", "/nonexistent/t1", T1, ["a"], ["v", "b"]),
+            entry("r", "/nonexistent/t2", T2, ["c"], ["w", "d"]),
+        ]
+        out = apply_rules(plan, entries)
+        sides = rewritten_sides(out)
+        assert len(sides) == 2
+        assert all(s.bucket_spec is not None for s in sides)
+
+    def test_rule_exception_downgrades_to_noop(self):
+        class ExplodingRule(FilterIndexRule):
+            def apply(self, plan, indexes):
+                raise RuntimeError("boom")
+
+        plan = Project(Filter(scan1(), col("a") == 5), ["a", "v"])
+        out = apply_rules(plan, [], rules=[ExplodingRule()])
+        assert out is plan  # never breaks the query (FilterIndexRule.scala:76-80)
+
+
+def test_ranker_ordering_matrix():
+    def e(buckets):
+        return entry(f"e{buckets}", "/r", T1, ["a"], [], buckets=buckets)
+
+    p_eq_small = (e(8), e(8))
+    p_eq_big = (e(16), e(16))
+    p_uneq_big = (e(32), e(16))
+    ranked = JoinIndexRanker.rank([p_uneq_big, p_eq_small, p_eq_big])
+    # Equal-bucket pairs first, larger equal pair preferred
+    # (JoinIndexRanker.scala:28-37).
+    assert ranked[0] == p_eq_big
+    assert ranked[1] == p_eq_small
+    assert ranked[2] == p_uneq_big
