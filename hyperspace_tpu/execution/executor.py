@@ -243,8 +243,9 @@ class Executor:
         b = len(ltables)
         lmax = max((len(c) for c in lcodes), default=1) or 1
         rmax = max((len(c) for c in rcodes), default=1) or 1
-        lk = np.full((b, lmax), join_ops.SENTINEL, dtype=np.int64)
-        rk = np.full((b, rmax), join_ops.SENTINEL, dtype=np.int64)
+        sentinel = join_ops.sentinel_for(np.int32)  # pads sort last
+        lk = np.full((b, lmax), sentinel, dtype=np.int32)
+        rk = np.full((b, rmax), sentinel, dtype=np.int32)
         lorder = []
         rorder = []
         for i in range(b):
@@ -263,16 +264,18 @@ class Executor:
             lorder.append(lo)
             rorder.append(ro)
 
-        li, ri, valid = join_ops.merge_join(lk, rk)
+        li_flat, ri_flat, totals = join_ops.merge_join(lk, rk)
+        offs = np.concatenate([[0], np.cumsum(totals)]).astype(np.int64)
 
-        # Gather output rows per partition on host.
+        # Gather output rows per partition on host (bucket b's matches are
+        # the dense flat range [offs[b], offs[b+1])).
         rkeys_low = {k.lower() for k in rkeys}
         out_parts: list[ColumnTable] = []
         out_schema = plan.schema
         for i in range(b):
-            v = valid[i]
-            lidx = lorder[i][li[i][v]]
-            ridx = rorder[i][ri[i][v]]
+            sl = slice(int(offs[i]), int(offs[i + 1]))
+            lidx = lorder[i][li_flat[sl]]
+            ridx = rorder[i][ri_flat[sl]]
             lt, rt = ltables[i], rtables[i]
             cols: dict[str, np.ndarray] = {}
             dicts: dict[str, np.ndarray] = {}
@@ -291,8 +294,25 @@ class Executor:
 
 
 def _factorize_keys(ltables, rtables, lkeys, rkeys):
-    """Map each partition's key tuples to a shared int64 code space whose
-    order matches the lexicographic order of the raw key tuples."""
+    """Map each partition's key tuples to a shared int32 rank-code space
+    whose order matches the lexicographic order of the raw key tuples.
+    int32 keeps the device merge-join kernels on native 32-bit lanes (TPU
+    emulates 64-bit); ranks always fit (bounded by total row count)."""
+    # Fast path: a single integer key whose values already fit int32 needs
+    # no ranking at all — the raw values ARE order-preserving codes.
+    if len(lkeys) == 1:
+        lvals = [_logical_key(t, lkeys[0]) for t in ltables]
+        rvals = [_logical_key(t, rkeys[0]) for t in rtables]
+        if all(np.issubdtype(v.dtype, np.integer) for v in lvals + rvals):
+            lo = min((int(v.min()) for v in lvals + rvals if len(v)), default=0)
+            hi = max((int(v.max()) for v in lvals + rvals if len(v)), default=0)
+            # Strictly below int32 max: the sentinel pad must sort last.
+            if lo >= np.iinfo(np.int32).min and hi < np.iinfo(np.int32).max:
+                return (
+                    [v.astype(np.int32) for v in lvals],
+                    [v.astype(np.int32) for v in rvals],
+                )
+
     per_col_codes_l: list[list[np.ndarray]] = [[] for _ in ltables]
     per_col_codes_r: list[list[np.ndarray]] = [[] for _ in rtables]
     cards: list[int] = []
@@ -319,7 +339,38 @@ def _factorize_keys(ltables, rtables, lkeys, rkeys):
             out.append(acc)
         return out
 
-    return combine(per_col_codes_l), combine(per_col_codes_r)
+    import math
+
+    if math.prod(cards) >= np.iinfo(np.int64).max:
+        # The int64 mixed-radix combination itself would wrap — the codes
+        # in `combine` below would collide before any re-rank could help.
+        raise HyperspaceError(
+            f"join key cardinalities {cards} overflow the int64 code space"
+        )
+    lcomb, rcomb = combine(per_col_codes_l), combine(per_col_codes_r)
+    int32_max = np.iinfo(np.int32).max
+    # Mixed-radix codes that provably fit int32 cast directly — no
+    # re-rank pass needed (math.prod is exact, arbitrary precision).
+    if math.prod(cards) < int32_max:
+        return [c.astype(np.int32) for c in lcomb], [c.astype(np.int32) for c in rcomb]
+    # Otherwise re-rank the combined codes down to int32 (order preserved
+    # by np.unique).
+    allc = np.concatenate(lcomb + rcomb) if (lcomb or rcomb) else np.zeros(0, np.int64)
+    uniq, inv = np.unique(allc, return_inverse=True)
+    if len(uniq) >= int32_max:
+        raise HyperspaceError(
+            f"join key space has {len(uniq)} distinct tuples — exceeds the "
+            "int32 code space"
+        )
+    inv = inv.astype(np.int32)
+    pos, out_l, out_r = 0, [], []
+    for c in lcomb:
+        out_l.append(inv[pos : pos + len(c)])
+        pos += len(c)
+    for c in rcomb:
+        out_r.append(inv[pos : pos + len(c)])
+        pos += len(c)
+    return out_l, out_r
 
 
 def _logical_key(table: ColumnTable, name: str) -> np.ndarray:

@@ -6,8 +6,9 @@ proposition of the reference's JoinIndexRule
 (index/rules/JoinIndexRule.scala:38-52,124-153). Design:
 
 - both sides arrive as [B, L] bucket-major padded arrays whose key lanes
-  are int64 codes from a shared, order-preserving factorization (the
-  executor guarantees this); pads carry the int64 max sentinel;
+  are integer codes (int32 where ranks fit — TPU-native — else int64)
+  from a shared, order-preserving factorization (the executor guarantees
+  this); pads carry the dtype's max value as the sentinel;
 - per bucket, the join is the classic sorted expansion: for each left row,
   `searchsorted(right, key, left/right)` bounds its match run — XLA compiles
   this to a fused vectorized binary search, the TPU-friendly formulation of
@@ -33,19 +34,25 @@ import jax.numpy as jnp
 SENTINEL = np.iinfo(np.int64).max
 
 
+def sentinel_for(dtype) -> int:
+    """Pad value that sorts after every real key code of `dtype`."""
+    return np.iinfo(np.dtype(dtype)).max
+
+
 def _sort_bucket(keys: jnp.ndarray) -> jnp.ndarray:
     return jnp.sort(keys)
 
 
 @jax.jit
 def join_counts(lkeys: jnp.ndarray, rkeys: jnp.ndarray):
-    """Per-bucket match counts. lkeys/rkeys: [B, L]/[B, R] sorted int64
-    with SENTINEL pads. Returns (start [B,L], cum [B,L], totals [B])."""
+    """Per-bucket match counts. lkeys/rkeys: [B, L]/[B, R] sorted integer
+    codes padded with their dtype's max (sentinel_for). Returns
+    (start [B,L], cum [B,L], totals [B])."""
 
     def one(lk, rk):
         start = jnp.searchsorted(rk, lk, side="left").astype(jnp.int32)
         end = jnp.searchsorted(rk, lk, side="right").astype(jnp.int32)
-        real = lk < SENTINEL
+        real = lk < jnp.iinfo(lk.dtype).max  # dtype's own sentinel
         cnt = jnp.where(real, end - start, 0)
         cum = jnp.cumsum(cnt).astype(jnp.int32)
         return start, cum, cum[-1] if cum.shape[0] else jnp.int32(0)
@@ -76,23 +83,59 @@ def next_pow2(n: int) -> int:
     return 1 << (int(n - 1).bit_length())
 
 
-def merge_join(lkeys_np: np.ndarray, rkeys_np: np.ndarray):
-    """Host wrapper. lkeys_np/rkeys_np: [B, L]/[B, R] sorted int64 code
-    arrays with SENTINEL pads. Returns (li, ri, valid) numpy arrays of
-    shape [B, cap]."""
-    from hyperspace_tpu.parallel.mesh import ensure_x64
+@functools.partial(jax.jit, static_argnames=("m_pad", "pack16"))
+def _compact_pairs(li, ri, totals, m_pad: int, pack16: bool):
+    """[B, cap] padded match pairs → dense bucket-major [m_pad] arrays.
 
-    # int64 codes (SENTINEL = int64 max) silently truncate under default
-    # 32-bit mode — x64 must be on before the first upload.
-    ensure_x64()
+    Output position p belongs to bucket b with offs[b] <= p < offs[b+1]
+    (valid entries of a bucket are exactly its first totals[b] slots).
+    Runs on device so the host downloads ONLY real matches — on tunneled
+    TPUs device→host bandwidth dominates the whole join otherwise. With
+    pack16 (both sides' bucket rows < 2^16) the pair downloads as ONE
+    uint32 per match, halving the transfer again."""
+    num_b, cap = li.shape
+    offs = jnp.concatenate(
+        [jnp.zeros(1, dtype=jnp.int32), jnp.cumsum(totals).astype(jnp.int32)]
+    )
+    p = jnp.arange(m_pad, dtype=jnp.int32)
+    b = jnp.clip(jnp.searchsorted(offs, p, side="right").astype(jnp.int32) - 1, 0, num_b - 1)
+    t = jnp.clip(p - offs[b], 0, cap - 1)
+    lf, rf = li[b, t], ri[b, t]
+    if pack16:
+        return (lf.astype(jnp.uint32) << 16) | rf.astype(jnp.uint32)
+    return lf, rf
+
+
+def merge_join(lkeys_np: np.ndarray, rkeys_np: np.ndarray):
+    """Host wrapper. lkeys_np/rkeys_np: [B, L]/[B, R] sorted int32/int64
+    code arrays padded with their dtype's max (sentinel_for). Returns
+    (li_flat, ri_flat, totals): bucket-major dense local row indices —
+    bucket b's matches occupy [cumsum(totals)[b-1], cumsum(totals)[b])."""
+    if lkeys_np.dtype.itemsize > 4 or rkeys_np.dtype.itemsize > 4:
+        from hyperspace_tpu.parallel.mesh import ensure_x64
+
+        # int64 codes (sentinel = int64 max) silently truncate under
+        # default 32-bit mode — x64 must be on before the first upload.
+        ensure_x64()
     lk = jnp.asarray(lkeys_np)
     rk = jnp.asarray(rkeys_np)
     start, cum, totals = join_counts(lk, rk)
     totals_h = np.asarray(jax.device_get(totals))
     cap = next_pow2(int(totals_h.max()) if totals_h.size else 1)
-    li, ri, valid = join_expand(start, cum, totals, cap)
+    li, ri, _valid = join_expand(start, cum, totals, cap)
+    total = int(totals_h.sum())
+    m_pad = next_pow2(max(total, 1))
+    pack16 = lkeys_np.shape[1] < (1 << 16) and rkeys_np.shape[1] < (1 << 16)
+    if pack16:
+        packed = np.asarray(jax.device_get(_compact_pairs(li, ri, totals, m_pad, True)))[:total]
+        return (
+            (packed >> 16).astype(np.int32),
+            (packed & np.uint32(0xFFFF)).astype(np.int32),
+            totals_h,
+        )
+    li_flat, ri_flat = _compact_pairs(li, ri, totals, m_pad, False)
     return (
-        np.asarray(jax.device_get(li)),
-        np.asarray(jax.device_get(ri)),
-        np.asarray(jax.device_get(valid)),
+        np.asarray(jax.device_get(li_flat))[:total],
+        np.asarray(jax.device_get(ri_flat))[:total],
+        totals_h,
     )

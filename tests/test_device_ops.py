@@ -91,10 +91,11 @@ def test_merge_join_kernel():
     S = join_ops.SENTINEL
     lk = np.array([[1, 1, 2, 5], [10, 20, S, S]], dtype=np.int64)
     rk = np.array([[1, 2, 2, 7], [20, 20, 30, S]], dtype=np.int64)
-    li, ri, valid = join_ops.merge_join(lk, rk)
+    li, ri, totals = join_ops.merge_join(lk, rk)
     # bucket 0: (0,0),(1,0),(2,1),(2,2); bucket 1: (1,0),(1,1)
-    got0 = sorted(zip(li[0][valid[0]].tolist(), ri[0][valid[0]].tolist()))
-    got1 = sorted(zip(li[1][valid[1]].tolist(), ri[1][valid[1]].tolist()))
+    assert totals.tolist() == [4, 2]
+    got0 = sorted(zip(li[:4].tolist(), ri[:4].tolist()))
+    got1 = sorted(zip(li[4:6].tolist(), ri[4:6].tolist()))
     assert got0 == [(0, 0), (1, 0), (2, 1), (2, 2)]
     assert got1 == [(1, 0), (1, 1)]
 
@@ -103,5 +104,65 @@ def test_merge_join_empty():
     S = join_ops.SENTINEL
     lk = np.full((2, 3), S, dtype=np.int64)
     rk = np.full((2, 4), S, dtype=np.int64)
-    li, ri, valid = join_ops.merge_join(lk, rk)
-    assert valid.sum() == 0
+    li, ri, totals = join_ops.merge_join(lk, rk)
+    assert totals.sum() == 0 and len(li) == 0 and len(ri) == 0
+
+
+def test_merge_join_wide_bucket_unpacked_path():
+    """Bucket width >= 2^16 takes the non-pack16 download branch."""
+    rng = np.random.default_rng(3)
+    w = 70_000
+    lvals = np.sort(rng.integers(0, 50_000, w)).astype(np.int32)
+    rvals = np.sort(rng.integers(0, 50_000, w)).astype(np.int32)
+    li, ri, totals = join_ops.merge_join(lvals[None, :], rvals[None, :])
+    # verify against a host-side expansion
+    import pandas as pd
+
+    expected = pd.merge(
+        pd.DataFrame({"k": lvals, "li": np.arange(w)}),
+        pd.DataFrame({"k": rvals, "ri": np.arange(w)}),
+        on="k",
+    )
+    assert totals.sum() == len(expected)
+    got = set(zip(li.tolist(), ri.tolist()))
+    want = set(zip(expected["li"].tolist(), expected["ri"].tolist()))
+    assert got == want
+
+
+def test_multi_key_join_rerank_path_equality():
+    """Three key columns with cardinalities whose product exceeds int32 —
+    exercises the executor's int32 re-rank of mixed-radix codes."""
+    import pandas as pd
+
+    from hyperspace_tpu.execution.executor import _factorize_keys
+    from hyperspace_tpu.execution.table import ColumnTable
+    from hyperspace_tpu.schema import Field, Schema
+
+    rng = np.random.default_rng(4)
+    n = 2000
+    schema = Schema.of(Field("a", "int64"), Field("b", "int64"), Field("c", "int64"))
+
+    def tbl(seed):
+        r = np.random.default_rng(seed)
+        return ColumnTable(
+            schema,
+            {
+                "a": r.integers(0, 1400, n).astype(np.int64),
+                "b": r.integers(0, 1400, n).astype(np.int64),
+                "c": r.integers(0, 1400, n).astype(np.int64),
+            },
+            {},
+        )
+
+    lt, rt = tbl(1), tbl(2)
+    lcodes, rcodes = _factorize_keys([lt], [rt], ["a", "b", "c"], ["a", "b", "c"])
+    assert lcodes[0].dtype == np.int32 and rcodes[0].dtype == np.int32
+    # code equality ⇔ full key-tuple equality
+    ldf = pd.DataFrame({k: lt.columns[k] for k in ("a", "b", "c")})
+    rdf = pd.DataFrame({k: rt.columns[k] for k in ("a", "b", "c")})
+    merged = pd.merge(ldf.assign(lc=lcodes[0]), rdf.assign(rc=rcodes[0]), on=["a", "b", "c"])
+    assert (merged["lc"] == merged["rc"]).all()
+    # codes must also be order-preserving within the shared space
+    order = np.argsort(lcodes[0], kind="stable")
+    sorted_tuples = list(zip(*(lt.columns[k][order] for k in ("a", "b", "c"))))
+    assert sorted_tuples == sorted(sorted_tuples)
