@@ -90,11 +90,14 @@ class DeviceIndexBuilder:
         enable_compile_cache()
 
     def _mesh_for(self, num_buckets: int) -> Mesh:
+        from hyperspace_tpu.parallel.mesh import mesh_size
+
         mesh = self._mesh if self._mesh is not None else make_mesh()
-        d = mesh.shape[AXIS]
+        d = mesh_size(mesh)
         if num_buckets % d == 0:
             return mesh
-        # Shrink to the largest device count dividing num_buckets.
+        # Shrink to the largest device count dividing num_buckets
+        # (dropping any multi-slice structure — correctness first).
         while num_buckets % d != 0:
             d -= 1
         return make_mesh(list(mesh.devices.flat), n=d)
@@ -118,8 +121,10 @@ class DeviceIndexBuilder:
         num_buckets: int,
         dest_path: Path,
     ) -> None:
+        from hyperspace_tpu.parallel.mesh import mesh_size
+
         mesh = self._mesh_for(num_buckets)
-        d = mesh.shape[AXIS]
+        d = mesh_size(mesh)
         n = table.num_rows
 
         # Host: bucket assignment from the canonical row hash.

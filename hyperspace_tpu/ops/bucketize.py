@@ -43,6 +43,7 @@ def _exchange_one_device(
     buckets_per_device: int,
     capacity: int,
     num_key_cols: int,
+    axes=(AXIS,),
 ):
     """Per-device body run under shard_map. `cols` are the local columns
     [R, ...] (first `num_key_cols` are sort keys, rest payloads); `bucket`
@@ -79,10 +80,11 @@ def _exchange_one_device(
     send_bucket = fill_slots(bucket_sorted, -1)
     send_cols = [fill_slots(c[order], 0) for c in cols]
 
-    # THE exchange: one all_to_all over the mesh axis (ICI).
-    recv_valid = lax.all_to_all(send_valid, AXIS, 0, 0, tiled=True)
-    recv_bucket = lax.all_to_all(send_bucket, AXIS, 0, 0, tiled=True)
-    recv_cols = [lax.all_to_all(c, AXIS, 0, 0, tiled=True) for c in send_cols]
+    # THE exchange: one all_to_all over the mesh axes (ICI within a
+    # slice; ICI+DCN on a multi-slice mesh).
+    recv_valid = lax.all_to_all(send_valid, axes, 0, 0, tiled=True)
+    recv_bucket = lax.all_to_all(send_bucket, axes, 0, 0, tiled=True)
+    recv_cols = [lax.all_to_all(c, axes, 0, 0, tiled=True) for c in send_cols]
 
     # Flatten [D, C] → [D*C]; invalid rows get the sentinel bucket so they
     # sink to the end, then ONE stable lex-sort by (bucket, key cols).
@@ -104,25 +106,36 @@ def make_bucketize_fn(
     capacity: int,
     num_key_cols: int,
 ):
-    """Build the jitted shard_map'd exchange+sort for a fixed column layout."""
-    num_devices = mesh.shape[AXIS]
+    """Build the jitted shard_map'd exchange+sort for a fixed column layout.
+
+    Works on a 1-D ("x") or 2-D ("dcn", "x") mesh: the exchange runs over
+    the COMBINED axes, so on a multi-slice mesh XLA routes the
+    within-slice portion over ICI and the cross-slice portion over DCN.
+    Device order (and therefore contiguous bucket ownership) follows the
+    flattened mesh order."""
+    from hyperspace_tpu.parallel.mesh import mesh_axes, mesh_size
+
+    axes = mesh_axes(mesh)
+    num_devices = mesh_size(mesh)
     if num_buckets % num_devices != 0:
         raise ValueError(f"num_buckets {num_buckets} must be a multiple of mesh size {num_devices}")
     buckets_per_device = num_buckets // num_devices
+    spec = P(axes)  # dim 0 sharded over the combined mesh axes
 
     @functools.partial(
         shard_map,
         mesh=mesh,
-        in_specs=(tuple(P(AXIS) for _ in range(num_cols)), P(AXIS), P(AXIS)),
-        out_specs=(tuple(P(AXIS) for _ in range(num_cols)), P(AXIS), P(AXIS), P()),
+        in_specs=(tuple(spec for _ in range(num_cols)), spec, spec),
+        out_specs=(tuple(spec for _ in range(num_cols)), spec, spec, P()),
         check_vma=False,
     )
     def fn(cols, bucket, valid):
         rc, rb, rv, overflow = _exchange_one_device(
-            list(cols), bucket, valid, num_devices, buckets_per_device, capacity, num_key_cols
+            list(cols), bucket, valid, num_devices, buckets_per_device, capacity,
+            num_key_cols, axes,
         )
         # overflow is a per-device scalar; reduce with OR (max) across mesh.
-        overflow = lax.pmax(overflow.astype(jnp.int32), AXIS)
+        overflow = lax.pmax(overflow.astype(jnp.int32), axes)
         return tuple(rc), rb, rv, overflow[None] if overflow.ndim == 0 else overflow
 
     return jax.jit(fn)
@@ -145,7 +158,9 @@ def bucketize(
     (cols, bucket, valid) where rows live on their owning device,
     lex-sorted by (bucket, keys) with invalid rows sunk to each shard's
     tail under the sentinel bucket."""
-    num_devices = mesh.shape[AXIS]
+    from hyperspace_tpu.parallel.mesh import mesh_size
+
+    num_devices = mesh_size(mesh)
     n = bucket.shape[0]
     per_dev = n // num_devices
     if num_key_cols is None:

@@ -2,10 +2,13 @@
 
 SURVEY.md §2.3 names this a first-class component for the TPU build: the
 analog of "N shuffle partitions over a Spark cluster" is "N buckets sharded
-over a device mesh". One 1-D mesh axis ("x") spans all chips; build-time
-bucketize rides ICI via all_to_all over this axis, query-time bucket-aligned
-ops need no collective at all. Multi-slice (DCN) meshes slot in here later
-by adding an outer axis.
+over a device mesh". The inner axis ("x") spans the chips of one slice —
+build-time bucketize rides ICI via all_to_all over it; query-time
+bucket-aligned ops need no collective at all. Multi-slice deployments add
+an outer "dcn" axis (make_multislice_mesh): the exchange then runs over
+the combined (dcn, x) axes and XLA routes the inter-slice portion over
+DCN. Bucket ownership stays contiguous in flattened mesh order either way,
+so the carve/query planes are mesh-shape agnostic.
 """
 
 from __future__ import annotations
@@ -16,6 +19,19 @@ import jax
 from jax.sharding import Mesh
 
 AXIS = "x"
+DCN_AXIS = "dcn"
+
+
+def mesh_axes(mesh: Mesh) -> tuple:
+    """The mesh's data axes, innermost last ((x,) or (dcn, x))."""
+    return tuple(mesh.axis_names)
+
+
+def mesh_size(mesh: Mesh) -> int:
+    out = 1
+    for name in mesh.axis_names:
+        out *= mesh.shape[name]
+    return out
 
 _x64_enabled = False
 
@@ -60,6 +76,18 @@ def make_mesh(devices=None, n: int | None = None) -> Mesh:
     if n is not None:
         devices = devices[:n]
     return Mesh(np.array(devices), (AXIS,))
+
+
+def make_multislice_mesh(num_slices: int, devices=None) -> Mesh:
+    """2-D (dcn, x) mesh: outer axis spans slices (DCN), inner axis the
+    chips within a slice (ICI)."""
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if len(devices) % num_slices != 0:
+        raise ValueError(
+            f"{len(devices)} devices do not split into {num_slices} equal slices"
+        )
+    per = len(devices) // num_slices
+    return Mesh(np.array(devices).reshape(num_slices, per), (DCN_AXIS, AXIS))
 
 
 def default_mesh() -> Mesh:
