@@ -33,9 +33,9 @@ from hyperspace_tpu.dataset import list_data_files
 from hyperspace_tpu.exceptions import HyperspaceError
 from hyperspace_tpu.execution import io as hio
 from hyperspace_tpu.execution.table import ColumnTable
-from hyperspace_tpu.ops.bucketize import AXIS, bucketize
+from hyperspace_tpu.ops.bucketize import bucketize
 from hyperspace_tpu.ops.hashing import bucket_ids, combine_hashes, hash_int_column, string_dict_hashes
-from hyperspace_tpu.parallel.mesh import enable_compile_cache, make_mesh
+from hyperspace_tpu.parallel.mesh import enable_compile_cache, make_mesh, mesh_size
 from hyperspace_tpu.plan.nodes import LogicalPlan, Scan
 
 
@@ -90,8 +90,6 @@ class DeviceIndexBuilder:
         enable_compile_cache()
 
     def _mesh_for(self, num_buckets: int) -> Mesh:
-        from hyperspace_tpu.parallel.mesh import mesh_size
-
         mesh = self._mesh if self._mesh is not None else make_mesh()
         d = mesh_size(mesh)
         if num_buckets % d == 0:
@@ -121,8 +119,6 @@ class DeviceIndexBuilder:
         num_buckets: int,
         dest_path: Path,
     ) -> None:
-        from hyperspace_tpu.parallel.mesh import mesh_size
-
         mesh = self._mesh_for(num_buckets)
         d = mesh_size(mesh)
         n = table.num_rows

@@ -82,7 +82,7 @@ def make_multislice_mesh(num_slices: int, devices=None) -> Mesh:
     """2-D (dcn, x) mesh: outer axis spans slices (DCN), inner axis the
     chips within a slice (ICI)."""
     devices = list(jax.devices()) if devices is None else list(devices)
-    if len(devices) % num_slices != 0:
+    if num_slices < 1 or len(devices) % num_slices != 0:
         raise ValueError(
             f"{len(devices)} devices do not split into {num_slices} equal slices"
         )
