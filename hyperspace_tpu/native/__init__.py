@@ -38,7 +38,9 @@ def _build() -> Path | None:
     if out.exists():
         return out
     out.parent.mkdir(parents=True, exist_ok=True)
-    tmp = out.with_suffix(".so.tmp")
+    # Per-process temp name: concurrent builders must not interleave writes
+    # into one file, or os.replace could publish a corrupted .so.
+    tmp = out.parent / f"{out.name}.{os.getpid()}.tmp"
     cmd = [
         "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
         "-march=native", str(_SRC), "-o", str(tmp),
@@ -50,6 +52,7 @@ def _build() -> Path | None:
             cmd.remove("-march=native")
             subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         except Exception:
+            tmp.unlink(missing_ok=True)
             return None
     os.replace(tmp, out)  # atomic publish; concurrent builders converge
     return out

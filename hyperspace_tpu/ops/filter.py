@@ -5,9 +5,20 @@ The analog of Spark's WholeStageCodegen'd filter/project over the index scan
 computation over the columns — XLA fuses the comparisons/boolean algebra
 into a single pass over HBM, which is the TPU equivalent of the JVM's fused
 codegen operator.
+
+Device compute stays 32-bit native (TPU lanes are 32-bit; the process-wide
+`jax_enable_x64` flag is never touched). 64-bit columns are handled by
+*pairing*: each comparison against an int64/float64 column is lowered to an
+equivalent boolean expression over two virtual uint32 columns — the hi/lo
+words of an order-preserving 64-bit key (sign-flipped for ints, IEEE
+total-order mapped for floats) — with the literal split the same way on
+host. Comparisons XLA can't express this way (64-bit arithmetic, exotic
+mixed-type shapes) fall back to one vectorized numpy evaluation on host.
 """
 
 from __future__ import annotations
+
+import math
 
 import numpy as np
 
@@ -16,6 +27,15 @@ import jax.numpy as jnp
 
 from hyperspace_tpu.execution.table import ColumnTable
 from hyperspace_tpu.plan.expr import And, BinOp, Col, Expr, Lit, Not, Or, evaluate
+
+# Virtual-column name pieces for the 64-bit pair lowering. "\x00" cannot
+# appear in a real column name, so these never collide with the schema.
+_SEP = "\x00"
+_FLIP = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le", "eq": "eq", "ne": "ne"}
+
+
+class _HostFallback(Exception):
+    """Raised by the lowering pass when the predicate needs host numpy."""
 
 
 def translate_predicate(table: ColumnTable, e: Expr) -> Expr:
@@ -27,8 +47,7 @@ def translate_predicate(table: ColumnTable, e: Expr) -> Expr:
         if isinstance(l, Col) and isinstance(r, Lit) and table.schema.field(l.name).is_string:
             return BinOp(e.op, l, Lit(table.translate_literal(l.name, r.value, e.op)))
         if isinstance(r, Col) and isinstance(l, Lit) and table.schema.field(r.name).is_string:
-            flip = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le", "eq": "eq", "ne": "ne"}
-            return translate_predicate(table, BinOp(flip[e.op], r, l))
+            return translate_predicate(table, BinOp(_FLIP[e.op], r, l))
         return e
     if isinstance(e, And):
         return And(translate_predicate(table, e.left), translate_predicate(table, e.right))
@@ -38,6 +57,257 @@ def translate_predicate(table: ColumnTable, e: Expr) -> Expr:
         return Not(translate_predicate(table, e.child))
     return e
 
+
+# -- 64-bit pair lowering ----------------------------------------------------
+
+def _col_kind(table: ColumnTable, name: str) -> tuple[str, int]:
+    """('i'|'f'|'b', byte width) of a column's device array."""
+    f = table.schema.field(name)
+    dt = np.dtype(f.device_dtype)
+    if dt == np.bool_:
+        return "b", 1
+    return ("f" if dt.kind == "f" else "i"), dt.itemsize
+
+
+def _ordered_u64(arr: np.ndarray, domain: str) -> np.ndarray:
+    """Map a column to uint64 keys whose unsigned order equals the value
+    order of `domain` ('i' = int64 order, 'f' = float64 total order with
+    -0.0 canonicalized and NaN above +inf)."""
+    if domain == "i":
+        a = arr.astype(np.int64, copy=False)
+        return a.view(np.uint64) ^ np.uint64(1 << 63)
+    a = arr.astype(np.float64, copy=False)
+    a = np.where(a == 0.0, 0.0, a)  # -0.0 → +0.0 so == matches IEEE
+    a = np.where(np.isnan(a), np.nan, a)  # negative NaNs → canonical NaN,
+    # so EVERY NaN keys above +inf and the guards catch them uniformly
+    u = a.view(np.uint64)
+    neg = (u >> np.uint64(63)).astype(bool)
+    return np.where(neg, ~u, u | np.uint64(1 << 63))
+
+
+def _key_parts(value: float | int, domain: str) -> tuple[np.uint32, np.uint32] | None:
+    """hi/lo uint32 words of one literal's ordered key (None = NaN)."""
+    if domain == "f":
+        v = np.float64(value)
+        if np.isnan(v):
+            return None
+        u = int(_ordered_u64(np.array([v]), "f")[0])
+    else:
+        u = int(_ordered_u64(np.array([int(value)], dtype=np.int64), "i")[0])
+    return np.uint32(u >> 32), np.uint32(u & 0xFFFFFFFF)
+
+
+def _pair_cols(name: str, domain: str) -> tuple[Col, Col]:
+    return Col(f"{name}{_SEP}{domain}hi"), Col(f"{name}{_SEP}{domain}lo")
+
+
+def _pair_cmp(op: str, hi, lo, hi2, lo2) -> Expr:
+    """Lexicographic (hi, lo) comparison as a boolean expression. Operands
+    are Col/Lit exprs over uint32 values."""
+    if op == "eq":
+        return And(BinOp("eq", hi, hi2), BinOp("eq", lo, lo2))
+    if op == "ne":
+        return Or(BinOp("ne", hi, hi2), BinOp("ne", lo, lo2))
+    strict = {"lt": "lt", "le": "lt", "gt": "gt", "ge": "gt"}[op]
+    inner = {"lt": "lt", "le": "le", "gt": "gt", "ge": "ge"}[op]
+    return Or(
+        BinOp(strict, hi, hi2),
+        And(BinOp("eq", hi, hi2), BinOp(inner, lo, lo2)),
+    )
+
+
+_INT32_MIN, _INT32_MAX = np.iinfo(np.int32).min, np.iinfo(np.int32).max
+_INT64_MIN, _INT64_MAX = np.iinfo(np.int64).min, np.iinfo(np.int64).max
+
+
+def _normalize_int_literal(value, op: str):
+    """Reduce a numeric literal compared against an INTEGER column to an
+    int literal + op, or a constant bool when the comparison is decided.
+
+    Returns ("const", bool) | ("cmp", op, int_value)."""
+    if isinstance(value, (bool, np.bool_)):
+        value = int(value)
+    if isinstance(value, (float, np.floating)):
+        f = float(value)
+        if math.isnan(f):
+            return ("const", op == "ne")
+        if f == math.inf:
+            return ("const", op in ("lt", "le", "ne"))
+        if f == -math.inf:
+            return ("const", op in ("gt", "ge", "ne"))
+        if f == int(f):
+            value = int(f)
+        else:
+            # x OP non-integral f over integers decides by floor/ceil.
+            if op == "eq":
+                return ("const", False)
+            if op == "ne":
+                return ("const", True)
+            if op in ("lt", "le"):
+                return ("cmp", "le", math.floor(f))
+            return ("cmp", "ge", math.ceil(f))  # gt, ge
+    v = int(value)
+    if v > _INT64_MAX:
+        return ("const", op in ("lt", "le", "ne"))
+    if v < _INT64_MIN:
+        return ("const", op in ("gt", "ge", "ne"))
+    return ("cmp", op, v)
+
+
+def _lower_col_lit(table: ColumnTable, op: str, colname: str, value) -> Expr:
+    """Lower `col OP literal` to a device-safe expression."""
+    kind, width = _col_kind(table, colname)
+    if kind == "b":
+        if isinstance(value, (bool, np.bool_)):
+            return BinOp(op, Col(colname), Lit(np.bool_(value)))
+        raise _HostFallback  # bool vs numeric literal: numpy int semantics
+    if kind == "i":
+        if isinstance(value, (float, np.floating)) and width > 4:
+            # numpy compares int64 arrays with float scalars in float64,
+            # ROUNDING the column above 2^53 — match it by comparing in the
+            # float64 key domain (the pair prep casts the column the same
+            # lossy way numpy does).
+            return _float_domain_cmp(colname, op, value)
+        norm = _normalize_int_literal(value, op)
+        if norm[0] == "const":
+            return Lit(np.bool_(norm[1]))
+        _, op, v = norm
+        if width <= 4:
+            # int32 → float64 is exact, so floor/ceil normalization of a
+            # float literal is equivalent to numpy's float64 comparison.
+            if _INT32_MIN <= v <= _INT32_MAX:
+                return BinOp(op, Col(colname), Lit(np.int32(v)))
+            return Lit(np.bool_(op in ("lt", "le", "ne") if v > _INT32_MAX else op in ("gt", "ge", "ne")))
+        hi, lo = _key_parts(v, "i")
+        chi, clo = _pair_cols(colname, "i")
+        return _pair_cmp(op, chi, clo, Lit(hi), Lit(lo))
+    # float column
+    if width <= 4:
+        weak = type(value) in (int, float, bool) or isinstance(value, (np.bool_, np.float32))
+        if weak:
+            # numpy weak-scalar promotion (NEP 50): a python scalar against
+            # a float32 array compares IN float32 — round the literal.
+            return BinOp(op, Col(colname), Lit(np.float32(value)))
+        # Strong 64-bit numpy scalar: numpy promotes to float64; widen the
+        # column to the float64 pair domain (float32→float64 is exact).
+    return _float_domain_cmp(colname, op, value)
+
+
+def _float_domain_cmp(colname: str, op: str, value) -> Expr:
+    """`col OP literal` in the float64 ordered-key pair domain."""
+    parts = _key_parts(value, "f")
+    if parts is None:  # NaN literal: IEEE says everything compares false
+        return Lit(np.bool_(op == "ne"))
+    hi, lo = parts
+    chi, clo = _pair_cols(colname, "f")
+    out = _pair_cmp(op, chi, clo, Lit(hi), Lit(lo))
+    if op in ("gt", "ge"):
+        # NaN keys sort above +inf; gt/ge must exclude them (IEEE: false).
+        ihi, ilo = _key_parts(math.inf, "f")
+        out = And(out, _pair_cmp("le", chi, clo, Lit(ihi), Lit(ilo)))
+    return out
+
+
+def _lower_col_col(table: ColumnTable, op: str, lname: str, rname: str) -> Expr:
+    lkind, lwidth = _col_kind(table, lname)
+    rkind, rwidth = _col_kind(table, rname)
+    if lkind == "b" or rkind == "b":
+        if lkind == rkind:
+            return BinOp(op, Col(lname), Col(rname))
+        raise _HostFallback
+    if lwidth <= 4 and rwidth <= 4 and lkind == rkind:
+        return BinOp(op, Col(lname), Col(rname))
+    # Widen both sides into a shared ordered-key domain: int-int compares in
+    # int64 order; anything involving a float compares in float64 order
+    # (ints cast to float64 — numpy's promotion does the same).
+    domain = "i" if (lkind == "i" and rkind == "i") else "f"
+    lhi, llo = _pair_cols(lname, domain)
+    rhi, rlo = _pair_cols(rname, domain)
+    out = _pair_cmp(op, lhi, llo, rhi, rlo)
+    if domain == "f":
+        # NaN keys (any sign, canonicalized) sort above +inf; exclude them
+        # on whichever side the op could leak through (IEEE: any comparison
+        # with NaN is false, != is true).
+        ihi, ilo = _key_parts(math.inf, "f")
+        l_finite = _pair_cmp("le", lhi, llo, Lit(ihi), Lit(ilo))
+        r_finite = _pair_cmp("le", rhi, rlo, Lit(ihi), Lit(ilo))
+        if op in ("gt", "ge"):
+            out = And(out, l_finite)
+        elif op in ("lt", "le"):
+            out = And(out, r_finite)
+        elif op == "eq":  # NaN == NaN must be false despite equal keys
+            out = And(out, l_finite)
+        elif op == "ne":  # NaN != NaN must be true despite equal keys
+            out = Or(out, Not(l_finite))
+    return out
+
+
+def _subtree_kinds(table: ColumnTable, e: Expr) -> set[str] | None:
+    """Value kinds ('i'/'f'/'b') a non-comparison subtree touches, or None
+    when it can't evaluate correctly in 32-bit device mode (64-bit columns,
+    literals not 32-bit exact, or int division — numpy divides ints in
+    float64, jnp in float32, so threshold comparisons could diverge)."""
+    if isinstance(e, Col):
+        kind, width = _col_kind(table, e.name)
+        return {kind} if width <= 4 else None
+    if isinstance(e, Lit):
+        v = e.value
+        if isinstance(v, (bool, np.bool_)):
+            return {"b"}
+        if isinstance(v, (int, np.integer)):
+            return {"i"} if _INT32_MIN <= int(v) <= _INT32_MAX else None
+        if isinstance(v, (float, np.floating)):
+            ok = np.isnan(v) or float(np.float32(v)) == float(v)
+            return {"f"} if ok else None
+        return None
+    if isinstance(e, BinOp):
+        l = _subtree_kinds(table, e.left)
+        r = _subtree_kinds(table, e.right)
+        if l is None or r is None:
+            return None
+        kinds = l | r
+        if len(kinds) > 1:
+            # Mixed-kind arithmetic: numpy promotes int⊕float to float64,
+            # the device would use float32 — lossy above 2^24. Host only.
+            return None
+        if e.op == "div" and kinds != {"f"}:
+            return None  # int division: numpy float64, device float32
+        return kinds
+    return None
+
+
+def _lower(table: ColumnTable, e: Expr) -> Expr:
+    """Lower a (string-translated) predicate to a device-safe tree, raising
+    _HostFallback where 32-bit device semantics can't match numpy."""
+    if isinstance(e, And):
+        return And(_lower(table, e.left), _lower(table, e.right))
+    if isinstance(e, Or):
+        return Or(_lower(table, e.left), _lower(table, e.right))
+    if isinstance(e, Not):
+        return Not(_lower(table, e.child))
+    if isinstance(e, BinOp) and e.is_comparison:
+        l, r = e.left, e.right
+        if isinstance(l, Lit) and isinstance(r, Col):
+            return _lower(table, BinOp(_FLIP[e.op], r, l))
+        if isinstance(l, Col) and isinstance(r, Lit):
+            return _lower_col_lit(table, e.op, l.name, r.value)
+        if isinstance(l, Col) and isinstance(r, Col):
+            return _lower_col_col(table, e.op, l.name, r.name)
+        # Compound arithmetic sides: keep on device only when every piece
+        # is exactly representable in 32-bit lanes AND both sides share one
+        # value kind (mixed int/float comparisons promote to float64 under
+        # numpy but float32 on device).
+        lk = _subtree_kinds(table, l)
+        rk = _subtree_kinds(table, r)
+        if lk is not None and rk is not None and len(lk | rk) == 1:
+            return e
+        raise _HostFallback
+    if isinstance(e, Lit) and isinstance(e.value, (bool, np.bool_)):
+        return e
+    raise _HostFallback
+
+
+# -- compiled evaluation ----------------------------------------------------
 
 def _structure_key(e: Expr, lits: list) -> tuple:
     """Structural fingerprint of an expression with literals abstracted out
@@ -90,24 +360,52 @@ def _pow2(n: int) -> int:
     return 1 << max(1, (n - 1)).bit_length() if n > 1 else 1
 
 
+def _resolve_column(table: ColumnTable, name: str, memo: dict) -> np.ndarray:
+    """A physical or virtual (pair-lowered hi/lo) column as a host array."""
+    if _SEP not in name:
+        return table.columns[table.schema.field(name).name]
+    base, tag = name.split(_SEP, 1)
+    domain, word = tag[0], tag[1:]
+    key = (base.lower(), domain)
+    u = memo.get(key)
+    if u is None:
+        u = _ordered_u64(table.columns[table.schema.field(base).name], domain)
+        memo[key] = u
+    if word == "hi":
+        return (u >> np.uint64(32)).astype(np.uint32)
+    return (u & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+
+
+def _host_mask(table: ColumnTable, predicate: Expr) -> np.ndarray:
+    """Vectorized numpy fallback with full 64-bit semantics."""
+
+    def resolve(name: str):
+        return table.columns[table.schema.field(name).name]
+
+    with np.errstate(all="ignore"):
+        mask = evaluate(predicate, resolve, np)
+    return np.broadcast_to(np.asarray(mask, dtype=bool), (table.num_rows,))
+
+
 def eval_predicate_mask(table: ColumnTable, predicate: Expr) -> np.ndarray:
     """Evaluate the predicate on device; returns a host bool mask."""
-    from hyperspace_tpu.parallel.mesh import ensure_x64
-
-    # int64/float64 columns and literals must not truncate to 32-bit.
-    ensure_x64()
     predicate = translate_predicate(table, predicate)
+    try:
+        lowered = _lower(table, predicate)
+    except _HostFallback:
+        return _host_mask(table, predicate)
+
     lits: list = []
-    struct = _structure_key(predicate, lits)
-    names = sorted(predicate.references())
+    struct = _structure_key(lowered, lits)
+    names = sorted(lowered.references())
 
     n = table.num_rows
     n_pad = _pow2(n)
     arrays = []
     layout = []
+    memo: dict = {}
     for name in names:
-        f = table.schema.field(name)
-        arr = table.columns[f.name]
+        arr = _resolve_column(table, name, memo)
         if len(arr) != n_pad:
             arr = np.concatenate([arr, np.zeros(n_pad - n, dtype=arr.dtype)])
         arrays.append(jnp.asarray(arr))
@@ -119,9 +417,10 @@ def eval_predicate_mask(table: ColumnTable, predicate: Expr) -> np.ndarray:
     if fn is None:
         lowered_names = [nm for nm, _ in layout]
 
-        def raw(cols_tuple, lits_tuple):
+        def raw(cols_tuple, lits_tuple, expr=lowered):
             cols = dict(zip(lowered_names, cols_tuple))
-            return _eval_with_args(predicate, cols, iter(lits_tuple))
+            out = _eval_with_args(expr, cols, iter(lits_tuple))
+            return jnp.broadcast_to(out, (n_pad,))
 
         fn = jax.jit(raw)
         _MASK_FN_CACHE[key] = fn

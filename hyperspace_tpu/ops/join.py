@@ -106,17 +106,36 @@ def _compact_pairs(li, ri, totals, m_pad: int, pack16: bool):
     return lf, rf
 
 
+def _rank_codes_to_int32(lkeys_np: np.ndarray, rkeys_np: np.ndarray):
+    """Order-preserving re-rank of 64-bit key codes into int32 (device
+    lanes stay 32-bit native; the process-wide x64 flag is never touched).
+    The 64-bit sentinel maps to the int32 sentinel."""
+    # Each side's pads carry ITS dtype's max — mark them before the merge
+    # (mixed int32/int64 inputs have different sentinels).
+    is_pad = np.concatenate([
+        (lkeys_np == sentinel_for(lkeys_np.dtype)).reshape(-1),
+        (rkeys_np == sentinel_for(rkeys_np.dtype)).reshape(-1),
+    ])
+    allv = np.concatenate([
+        lkeys_np.reshape(-1).astype(np.int64),
+        rkeys_np.reshape(-1).astype(np.int64),
+    ])
+    uniq, inv = np.unique(allv, return_inverse=True)
+    if len(uniq) >= np.iinfo(np.int32).max:
+        raise ValueError(f"{len(uniq)} distinct join keys exceed the int32 code space")
+    codes = inv.astype(np.int32)
+    codes[is_pad] = sentinel_for(np.int32)
+    nl = lkeys_np.size
+    return codes[:nl].reshape(lkeys_np.shape), codes[nl:].reshape(rkeys_np.shape)
+
+
 def merge_join(lkeys_np: np.ndarray, rkeys_np: np.ndarray):
     """Host wrapper. lkeys_np/rkeys_np: [B, L]/[B, R] sorted int32/int64
     code arrays padded with their dtype's max (sentinel_for). Returns
     (li_flat, ri_flat, totals): bucket-major dense local row indices —
     bucket b's matches occupy [cumsum(totals)[b-1], cumsum(totals)[b])."""
     if lkeys_np.dtype.itemsize > 4 or rkeys_np.dtype.itemsize > 4:
-        from hyperspace_tpu.parallel.mesh import ensure_x64
-
-        # int64 codes (sentinel = int64 max) silently truncate under
-        # default 32-bit mode — x64 must be on before the first upload.
-        ensure_x64()
+        lkeys_np, rkeys_np = _rank_codes_to_int32(lkeys_np, rkeys_np)
     lk = jnp.asarray(lkeys_np)
     rk = jnp.asarray(rkeys_np)
     start, cum, totals = join_counts(lk, rk)

@@ -33,18 +33,6 @@ def mesh_size(mesh: Mesh) -> int:
         out *= mesh.shape[name]
     return out
 
-_x64_enabled = False
-
-
-def ensure_x64() -> None:
-    """int64 key columns require x64 (jax defaults to 32-bit). TPU lowers
-    s64 to a pair of 32-bit lanes; the builder narrows where values fit."""
-    global _x64_enabled
-    if not _x64_enabled:
-        jax.config.update("jax_enable_x64", True)
-        _x64_enabled = True
-
-
 _cache_enabled = False
 
 

@@ -216,7 +216,11 @@ def ann_search(
 
     # Stage 4: payload gather — read ONLY the partitions owning winning
     # rows, one batched take per owner, reassembled into slot order.
-    flat = idx.reshape(-1)
+    flat = np.asarray(idx).reshape(-1)
+    # Pad-lane top-k partials can carry indices >= m with -inf scores; if
+    # one survives the merge, its index would fall past the last offset.
+    # Point those slots at row 0 — callers drop them via the -inf score.
+    flat = np.where(np.isfinite(np.asarray(vals).reshape(-1)), flat, 0)
     owner = np.searchsorted(offsets, flat, side="right") - 1
     local = flat - offsets[owner]
     group_order = np.argsort(owner, kind="stable")

@@ -1,0 +1,266 @@
+"""64-bit predicate evaluation without the global x64 flag.
+
+Device lanes stay 32-bit native; comparisons against int64/float64 columns
+are lowered to hi/lo uint32 pair comparisons (ops/filter.py). These tests
+pin numpy-equality of the masks across dtypes, literal shapes, and both
+orders of first use — and that `jax_enable_x64` is never flipped.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from hyperspace_tpu.execution.table import ColumnTable
+from hyperspace_tpu.ops.filter import eval_predicate_mask
+from hyperspace_tpu.plan.expr import col, lit
+from hyperspace_tpu.schema import Field, Schema
+
+
+def _table():
+    rng = np.random.default_rng(0)
+    n = 500
+    big = rng.integers(-(2**62), 2**62, n).astype(np.int64)
+    big[:3] = [0, np.iinfo(np.int64).min, np.iinfo(np.int64).max]
+    f64 = rng.standard_normal(n) * 1e12
+    f64[:4] = [0.0, -0.0, np.inf, -np.inf]
+    f64[4] = np.nan
+    schema = Schema.of(
+        Field("i64", "int64"),
+        Field("i32", "int32"),
+        Field("f64", "float64"),
+        Field("f32", "float32"),
+    )
+    return ColumnTable(
+        schema,
+        {
+            "i64": big,
+            "i32": rng.integers(-1000, 1000, n).astype(np.int32),
+            "f64": f64,
+            "f32": rng.standard_normal(n).astype(np.float32),
+        },
+        {},
+    )
+
+
+def _np_mask(t, fn):
+    with np.errstate(all="ignore"):
+        return np.broadcast_to(np.asarray(fn(t.columns), dtype=bool), (t.num_rows,))
+
+
+OPS = [
+    ("eq", lambda a, b: a == b),
+    ("ne", lambda a, b: a != b),
+    ("lt", lambda a, b: a < b),
+    ("le", lambda a, b: a <= b),
+    ("gt", lambda a, b: a > b),
+    ("ge", lambda a, b: a >= b),
+]
+
+
+def test_x64_flag_never_flips():
+    t = _table()
+    for _, f in OPS:
+        eval_predicate_mask(t, f(col("i64"), lit(2**40 + 7)))
+        eval_predicate_mask(t, f(col("f64"), lit(1.2345678901234e11)))
+    assert jax.config.jax_enable_x64 is False
+
+
+@pytest.mark.parametrize("opname,f", OPS)
+def test_int64_literal_beyond_int32(opname, f):
+    t = _table()
+    v = t.columns["i64"][10]  # an actual huge value: exact-match matters
+    for litval in (int(v), 2**40 + 7, -(2**50) + 3, 0):
+        got = eval_predicate_mask(t, f(col("i64"), lit(litval)))
+        want = _np_mask(t, lambda c: f(c["i64"], litval))
+        np.testing.assert_array_equal(got, want, err_msg=f"{opname} {litval}")
+
+
+@pytest.mark.parametrize("opname,f", OPS)
+def test_int64_extremes_and_float_literals(opname, f):
+    t = _table()
+    for litval in (np.iinfo(np.int64).max, np.iinfo(np.int64).min, 10.5, -0.5, 2.0**70, float("inf")):
+        got = eval_predicate_mask(t, f(col("i64"), lit(litval)))
+        want = _np_mask(t, lambda c: f(c["i64"].astype(np.float64) if isinstance(litval, float) else c["i64"], litval))
+        np.testing.assert_array_equal(got, want, err_msg=f"{opname} {litval}")
+
+
+@pytest.mark.parametrize("opname,f", OPS)
+def test_float64_literals(opname, f):
+    t = _table()
+    v = float(t.columns["f64"][20])
+    for litval in (v, 0.0, -0.0, 1.2345678901234e11, float("inf"), float("-inf"), float("nan")):
+        got = eval_predicate_mask(t, f(col("f64"), lit(litval)))
+        want = _np_mask(t, lambda c: f(c["f64"], litval))
+        np.testing.assert_array_equal(got, want, err_msg=f"{opname} {litval}")
+
+
+@pytest.mark.parametrize("opname,f", OPS)
+def test_float32_column_with_inexact_literal(opname, f):
+    """Weak python-float literals against a float32 column follow numpy's
+    NEP-50 promotion: the comparison runs IN float32 (literal rounded)."""
+    t = _table()
+    for litval in (0.1234567890123456789, 16777217.0):
+        got = eval_predicate_mask(t, f(col("f32"), lit(litval)))
+        want = _np_mask(t, lambda c: f(c["f32"], litval))
+        np.testing.assert_array_equal(got, want, err_msg=f"{opname} {litval}")
+    # Strong np.float64 scalars promote the comparison to float64 instead.
+    litval = np.float64(16777217.0)
+    got = eval_predicate_mask(t, f(col("f32"), lit(litval)))
+    want = _np_mask(t, lambda c: f(c["f32"], litval))
+    np.testing.assert_array_equal(got, want, err_msg=f"{opname} strong {litval}")
+
+
+def test_int64_vs_float_literal_rounds_like_numpy():
+    """numpy compares int64 arrays with float scalars in float64, rounding
+    the column above 2^53 — the device pair path must match."""
+    schema = Schema.of(Field("x", "int64"))
+    arr = np.array([2**62 + 1, 2**62, 5, -(2**62) - 1], dtype=np.int64)
+    t = ColumnTable(schema, {"x": arr}, {})
+    for _, f in OPS:
+        for litval in (float(2**62), 5.0, 5.5):
+            got = eval_predicate_mask(t, f(col("x"), lit(litval)))
+            want = np.asarray(f(arr, litval))
+            np.testing.assert_array_equal(got, want, err_msg=f"{litval}")
+
+
+def test_mixed_kind_arithmetic_falls_back():
+    """int ⊕ float arithmetic promotes to float64 under numpy but would be
+    float32 on device — must fall back to host above 2^24."""
+    schema = Schema.of(Field("x", "int32"))
+    arr = np.array([33554433, 5], dtype=np.int32)
+    t = ColumnTable(schema, {"x": arr}, {})
+    got = eval_predicate_mask(t, (col("x") * lit(2.0)) > lit(67108864.0))
+    want = (arr * 2.0) > 67108864.0
+    np.testing.assert_array_equal(got, want)
+    # Mixed-kind comparison of compound sides, too.
+    got = eval_predicate_mask(t, (col("x") + lit(1)) > lit(33554432.7))
+    want = (arr + 1) > 33554432.7
+    np.testing.assert_array_equal(got, want)
+
+
+def test_int32_out_of_range_literal_folds():
+    t = _table()
+    got = eval_predicate_mask(t, col("i32") < lit(2**40))
+    assert got.all()
+    got = eval_predicate_mask(t, col("i32") > lit(2**40))
+    assert not got.any()
+    got = eval_predicate_mask(t, col("i32") == lit(-(2**40)))
+    assert not got.any()
+
+
+def test_col_col_64bit_pairs():
+    t = _table()
+    got = eval_predicate_mask(t, col("i64") < col("i64"))
+    assert not got.any()
+    # float64 vs float32: widened to float64 domain on both sides.
+    got = eval_predicate_mask(t, col("f64") < col("f32"))
+    want = _np_mask(t, lambda c: c["f64"] < c["f32"].astype(np.float64))
+    np.testing.assert_array_equal(got, want)
+    # int64 vs int32 compares in int64 order.
+    got = eval_predicate_mask(t, col("i64") >= col("i32"))
+    want = _np_mask(t, lambda c: c["i64"] >= c["i32"].astype(np.int64))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_conjunction_mixing_widths():
+    t = _table()
+    pred = (col("i64") > lit(0)) & (col("i32") < lit(100)) & (col("f64") <= lit(1e11))
+    got = eval_predicate_mask(t, pred)
+    want = _np_mask(
+        t, lambda c: (c["i64"] > 0) & (c["i32"] < 100) & (c["f64"] <= 1e11)
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_arithmetic_on_int64_falls_back_to_host():
+    """64-bit arithmetic can't run in 32-bit lanes — host numpy fallback
+    must produce exact results."""
+    t = _table()
+    pred = (col("i64") + lit(1)) > lit(0)
+    got = eval_predicate_mask(t, pred)
+    want = _np_mask(t, lambda c: (c["i64"] + 1) > 0)
+    np.testing.assert_array_equal(got, want)
+    assert jax.config.jax_enable_x64 is False
+
+
+def test_both_orders_of_first_use():
+    """int64 predicates before AND after int32 predicates — no global
+    state leaks between them (the old ensure_x64 hazard)."""
+    t = _table()
+    m64 = eval_predicate_mask(t, col("i64") > lit(0))
+    m32 = eval_predicate_mask(t, col("i32") > lit(0))
+    m64b = eval_predicate_mask(t, col("i64") > lit(0))
+    m32b = eval_predicate_mask(t, col("i32") > lit(0))
+    np.testing.assert_array_equal(m64, m64b)
+    np.testing.assert_array_equal(m32, m32b)
+    np.testing.assert_array_equal(m64, _np_mask(t, lambda c: c["i64"] > 0))
+    np.testing.assert_array_equal(m32, _np_mask(t, lambda c: c["i32"] > 0))
+
+
+def test_negative_nan_canonicalized():
+    """Negative-sign NaNs must behave exactly like positive NaNs (IEEE:
+    every comparison false, != true)."""
+    neg_nan = np.frombuffer(np.uint64(0xFFF8000000000000).tobytes(), dtype=np.float64)[0]
+    assert np.isnan(neg_nan)
+    schema = Schema.of(Field("x", "float64"))
+    arr = np.array([1.0, neg_nan, np.nan, -np.inf, 5.0])
+    t = ColumnTable(schema, {"x": arr}, {})
+    for _, f in OPS:
+        got = eval_predicate_mask(t, f(col("x"), lit(2.0)))
+        with np.errstate(all="ignore"):
+            want = np.asarray(f(arr, 2.0))
+        np.testing.assert_array_equal(got, want)
+
+
+def test_col_col_nan_eq_ne():
+    """NaN == NaN must be False and NaN != NaN True on the device pair path."""
+    schema = Schema.of(Field("a", "float64"), Field("b", "float64"))
+    a = np.array([1.0, np.nan, 3.0, np.nan])
+    b = np.array([1.0, np.nan, 4.0, 2.0])
+    t = ColumnTable(schema, {"a": a, "b": b}, {})
+    np.testing.assert_array_equal(
+        eval_predicate_mask(t, col("a") == col("b")), np.array([True, False, False, False])
+    )
+    np.testing.assert_array_equal(
+        eval_predicate_mask(t, col("a") != col("b")), np.array([False, True, True, True])
+    )
+
+
+def test_int_division_matches_numpy_float64():
+    """numpy divides ints in float64; the device's float32 would round
+    67108863/67108864 to exactly 1.0 — must fall back to host."""
+    schema = Schema.of(Field("x", "int32"))
+    arr = np.array([67108863, 67108864, 1], dtype=np.int32)
+    t = ColumnTable(schema, {"x": arr}, {})
+    got = eval_predicate_mask(t, (col("x") / lit(67108864)) < lit(1.0))
+    want = (arr / 67108864) < 1.0
+    np.testing.assert_array_equal(got, want)
+
+
+def test_bool_column_vs_numeric_literal():
+    schema = Schema.of(Field("flag", "bool"))
+    arr = np.array([True, False, True])
+    t = ColumnTable(schema, {"flag": arr}, {})
+    np.testing.assert_array_equal(
+        eval_predicate_mask(t, col("flag") == lit(5)), np.asarray(arr == 5)
+    )
+    np.testing.assert_array_equal(
+        eval_predicate_mask(t, col("flag") == lit(True)), arr
+    )
+
+
+def test_merge_join_mixed_dtype_sentinels():
+    """int64 keys on one side, int32 on the other: each side's pads use its
+    own dtype's max and must not collide with real keys."""
+    from hyperspace_tpu.ops import join as join_ops
+
+    i32max = np.iinfo(np.int32).max
+    # Left int64 holds a REAL key equal to int32 max; right int32 pads with it.
+    lk = np.array([[5, i32max, np.iinfo(np.int64).max]], dtype=np.int64)
+    rk = np.array([[5, 5, i32max]], dtype=np.int32)  # last slot is a pad
+    li, ri, totals = join_ops.merge_join(lk, rk)
+    # Only the key 5 matches (twice); the real int32max key must NOT match
+    # the right side's pad slot.
+    assert totals.tolist() == [2]
+    assert sorted(zip(li.tolist(), ri.tolist())) == [(0, 0), (0, 1)]
