@@ -39,6 +39,12 @@ from hyperspace_tpu.parallel.mesh import enable_compile_cache, make_mesh, mesh_s
 from hyperspace_tpu.plan.nodes import LogicalPlan, Scan
 
 
+# The fixed hash contribution of a NULL key slot: nulls bucket
+# deterministically (they can never match an equality literal, so bucket
+# pruning by literal hash stays correct regardless).
+NULL_HASH = np.uint32(0x9E3779B9)
+
+
 def compute_row_hashes(table: ColumnTable, key_columns: list[str]) -> np.ndarray:
     """Host-side uint32 row hash over the key columns. Deterministic and
     dictionary-independent (ops/hashing.py), so the query plane can prune
@@ -49,9 +55,13 @@ def compute_row_hashes(table: ColumnTable, key_columns: list[str]) -> np.ndarray
         arr = table.columns[f.name]
         if f.is_string:
             dh = string_dict_hashes(table.dictionaries[f.name])
-            hashes.append(dh[arr])
+            h = dh[arr]
         else:
-            hashes.append(hash_int_column(arr, np))
+            h = hash_int_column(arr, np)
+        valid = table.valid_mask(name)
+        if valid is not None:
+            h = np.where(valid, h, NULL_HASH)
+        hashes.append(h)
     return combine_hashes(hashes, np)
 
 
@@ -139,10 +149,14 @@ class DeviceIndexBuilder:
             f = table.schema.field(kname)
             arr = table.columns[kname]
             if f.is_string:
-                key_codes.append(arr.astype(np.int32))  # sorted-dict codes
+                codes = arr.astype(np.int32)  # sorted-dict codes (copy)
             else:
                 _, inv = np.unique(arr, return_inverse=True)
-                key_codes.append(inv.astype(np.int32))
+                codes = inv.astype(np.int32)
+            valid = table.valid_mask(kname)
+            if valid is not None:
+                codes[~valid] = -1  # nulls sort FIRST within their bucket
+            key_codes.append(codes)
 
         # Pad rows to a multiple of the mesh size.
         n_pad = max(d, math.ceil(max(n, 1) / d) * d)
@@ -184,6 +198,7 @@ class DeviceIndexBuilder:
             table.schema.select(ordered),
             {name: _fast_take(table.columns[name], order) for name in ordered},
             dict(table.dictionaries),
+            {name: table.validity[name][order] for name in ordered if name in table.validity},
         )
         hio.carve_and_write(
             Path(dest_path), result, compact_bucket, num_buckets, indexed_columns

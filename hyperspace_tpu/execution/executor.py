@@ -65,13 +65,15 @@ class Executor:
             t = self.execute(child)
             # Remap onto the union schema's exact field names/order (child
             # names are validated case-insensitively compatible).
-            cols, dicts = {}, {}
+            cols, dicts, val = {}, {}, {}
             for f in schema.fields:
                 cf = t.schema.field(f.name)
                 cols[f.name] = t.columns[cf.name]
                 if cf.name in t.dictionaries:
                     dicts[f.name] = t.dictionaries[cf.name]
-            parts.append(ColumnTable(schema, cols, dicts))
+                if cf.name in t.validity:
+                    val[f.name] = t.validity[cf.name]
+            parts.append(ColumnTable(schema, cols, dicts, val))
         return ColumnTable.concat(parts)
 
     # -- scan ------------------------------------------------------------
@@ -279,18 +281,47 @@ class Executor:
             lt, rt = ltables[i], rtables[i]
             cols: dict[str, np.ndarray] = {}
             dicts: dict[str, np.ndarray] = {}
+            val: dict[str, np.ndarray] = {}
             for f in lt.schema.fields:
                 cols[f.name] = lt.columns[f.name][lidx]
                 if f.name in lt.dictionaries:
                     dicts[f.name] = lt.dictionaries[f.name]
+                if f.name in lt.validity:
+                    val[f.name] = lt.validity[f.name][lidx]
             for f in rt.schema.fields:
                 if f.name.lower() in rkeys_low:
                     continue
                 cols[f.name] = rt.columns[f.name][ridx]
                 if f.name in rt.dictionaries:
                     dicts[f.name] = rt.dictionaries[f.name]
-            out_parts.append(ColumnTable(out_schema, cols, dicts))
+                if f.name in rt.validity:
+                    val[f.name] = rt.validity[f.name][ridx]
+            out_parts.append(ColumnTable(out_schema, cols, dicts, val))
         return ColumnTable.concat(out_parts)
+
+
+def _key_null_mask(table: ColumnTable, keys: list[str]) -> np.ndarray | None:
+    """True where ANY key column is null (such rows never join — SQL:
+    NULL = NULL is not true). None when every key column is null-free."""
+    m = None
+    for k in keys:
+        valid = table.valid_mask(k)
+        if valid is not None:
+            m = ~valid if m is None else (m | ~valid)
+    return m
+
+
+def _apply_null_codes(lcodes, rcodes, lnulls, rnulls):
+    """Null-keyed rows get side-distinct negative codes (-2 left, -1
+    right): they sort first and can never equal across sides, so the merge
+    kernel drops them with zero extra work."""
+    for c, m in zip(lcodes, lnulls):
+        if m is not None:
+            c[m] = -2
+    for c, m in zip(rcodes, rnulls):
+        if m is not None:
+            c[m] = -1
+    return lcodes, rcodes
 
 
 def _factorize_keys(ltables, rtables, lkeys, rkeys):
@@ -298,9 +329,13 @@ def _factorize_keys(ltables, rtables, lkeys, rkeys):
     whose order matches the lexicographic order of the raw key tuples.
     int32 keeps the device merge-join kernels on native 32-bit lanes (TPU
     emulates 64-bit); ranks always fit (bounded by total row count)."""
+    lnulls = [_key_null_mask(t, lkeys) for t in ltables]
+    rnulls = [_key_null_mask(t, rkeys) for t in rtables]
+    has_nulls = any(m is not None for m in lnulls + rnulls)
     # Fast path: a single integer key whose values already fit int32 needs
     # no ranking at all — the raw values ARE order-preserving codes.
-    if len(lkeys) == 1:
+    # (Skipped with nulls: raw values could collide with the null codes.)
+    if len(lkeys) == 1 and not has_nulls:
         lvals = [_logical_key(t, lkeys[0]) for t in ltables]
         rvals = [_logical_key(t, rkeys[0]) for t in rtables]
         if all(np.issubdtype(v.dtype, np.integer) for v in lvals + rvals):
@@ -352,7 +387,12 @@ def _factorize_keys(ltables, rtables, lkeys, rkeys):
     # Mixed-radix codes that provably fit int32 cast directly — no
     # re-rank pass needed (math.prod is exact, arbitrary precision).
     if math.prod(cards) < int32_max:
-        return [c.astype(np.int32) for c in lcomb], [c.astype(np.int32) for c in rcomb]
+        return _apply_null_codes(
+            [c.astype(np.int32) for c in lcomb],
+            [c.astype(np.int32) for c in rcomb],
+            lnulls,
+            rnulls,
+        )
     # Otherwise re-rank the combined codes down to int32 (order preserved
     # by np.unique).
     allc = np.concatenate(lcomb + rcomb) if (lcomb or rcomb) else np.zeros(0, np.int64)
@@ -370,7 +410,7 @@ def _factorize_keys(ltables, rtables, lkeys, rkeys):
     for c in rcomb:
         out_r.append(inv[pos : pos + len(c)])
         pos += len(c)
-    return out_l, out_r
+    return _apply_null_codes(out_l, out_r, lnulls, rnulls)
 
 
 def _logical_key(table: ColumnTable, name: str) -> np.ndarray:

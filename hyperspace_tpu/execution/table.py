@@ -6,7 +6,13 @@ Struct-of-arrays over numpy, with TPU-compatible physical types only:
 - strings are dictionary-encoded with a SORTED dictionary, so int32 codes
   preserve the string sort order — equality AND range predicates evaluate
   correctly on codes once literals are translated (schema.py describes the
-  logical types).
+  logical types);
+- nulls are carried as per-column validity masks (True = valid), the analog
+  of Arrow validity bitmaps / Spark nullable columns
+  (reference stores nullable schemas, index/IndexLogEntry.scala:39-47).
+  Null slots hold a deterministic zero in the physical array; every
+  consumer that cares (predicates, key codes, hashing, output encode)
+  reads the mask, so device kernels stay branch-free and dense.
 
 This is the analog of the reference's reliance on Spark's columnar batches
 (FileSourceScanExec / vectorized Parquet read, SURVEY.md §2.2) — but as an
@@ -29,6 +35,8 @@ class ColumnTable:
     schema: Schema
     columns: dict[str, np.ndarray]  # physical arrays (codes for strings)
     dictionaries: dict[str, np.ndarray]  # string name -> sorted object array
+    # column name -> bool array, True = valid. Absent key = no nulls.
+    validity: dict[str, np.ndarray] = dataclasses.field(default_factory=dict)
 
     def __post_init__(self):
         lens = {len(v) for v in self.columns.values()}  # len = rows for 2D too
@@ -49,33 +57,46 @@ class ColumnTable:
         f = self.schema.field(name)
         return self.dictionaries.get(f.name)
 
+    def valid_mask(self, name: str) -> np.ndarray | None:
+        """Validity of a column (True = valid), or None when null-free."""
+        f = self.schema.field(name)
+        return self.validity.get(f.name)
+
     # -- construction ----------------------------------------------------
     @staticmethod
     def from_arrow(table, schema: Schema | None = None) -> "ColumnTable":
-        """Build from a pyarrow Table, dictionary-encoding string columns."""
+        """Build from a pyarrow Table, dictionary-encoding string columns
+        and extracting validity masks for nullable data."""
+        import pyarrow as pa
+        import pyarrow.compute as pc
+
         if schema is None:
             schema = Schema.from_arrow(table.schema)
         columns: dict[str, np.ndarray] = {}
         dictionaries: dict[str, np.ndarray] = {}
+        validity: dict[str, np.ndarray] = {}
         for f in schema.fields:
             arr = table.column(f.name)
+            valid = None
             if arr.null_count:
-                # Nulls would silently corrupt: arrow→numpy turns int nulls
-                # into NaN→INT_MIN and string nulls into the value "nan".
-                raise HyperspaceError(
-                    f"column {f.name!r} contains {arr.null_count} null values; "
-                    "null handling is not supported — drop or fill nulls first"
-                )
+                if f.is_vector:
+                    raise HyperspaceError(
+                        f"vector column {f.name!r} contains {arr.null_count} null "
+                        "rows; null embeddings are not supported"
+                    )
+                valid = np.asarray(pc.is_valid(arr).combine_chunks())
+                validity[f.name] = valid
             if f.is_string:
                 values = arr.to_pandas().to_numpy(dtype=object)
+                if valid is not None:
+                    values = values.copy()
+                    values[~valid] = ""  # deterministic physical slot value
                 # np.unique gives a sorted dictionary + inverse codes, so
                 # codes are order-preserving.
                 dictionary, codes = np.unique(values.astype(str), return_inverse=True)
                 columns[f.name] = codes.astype(np.int32)
                 dictionaries[f.name] = dictionary
             elif f.is_vector:
-                import pyarrow as pa
-
                 combined = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
                 # .values, NOT .flatten(): flatten silently drops null list
                 # slots and misaligns rows (top-level nulls are rejected
@@ -90,19 +111,21 @@ class ColumnTable:
                     np.ascontiguousarray(flat).astype(np.float32, copy=False).reshape(-1, f.dim)
                 )
             else:
-                import pyarrow as pa
-
                 if f.dtype == "date":
                     arr = arr.cast(pa.int32())
                 elif f.dtype == "timestamp":
                     arr = arr.cast(pa.int64())
+                if valid is not None:
+                    # Zero the null slots with a TYPED scalar (a bare int
+                    # fill crashes on bool columns).
+                    arr = pc.fill_null(arr, pa.scalar(False if f.dtype == "bool" else 0, arr.type))
                 np_arr = arr.to_numpy(zero_copy_only=False)
                 columns[f.name] = np.ascontiguousarray(np_arr).astype(f.device_dtype, copy=False)
-        return ColumnTable(schema, columns, dictionaries)
+        return ColumnTable(schema, columns, dictionaries, validity)
 
     @staticmethod
-    def from_numpy(schema: Schema, columns: dict[str, np.ndarray], dictionaries=None) -> "ColumnTable":
-        return ColumnTable(schema, dict(columns), dict(dictionaries or {}))
+    def from_numpy(schema: Schema, columns: dict[str, np.ndarray], dictionaries=None, validity=None) -> "ColumnTable":
+        return ColumnTable(schema, dict(columns), dict(dictionaries or {}), dict(validity or {}))
 
     # -- transforms ------------------------------------------------------
     def select(self, names: Iterable[str]) -> "ColumnTable":
@@ -110,15 +133,18 @@ class ColumnTable:
         sub = self.schema.select(names)
         cols = {f.name: self.columns[f.name] for f in sub.fields}
         dicts = {f.name: self.dictionaries[f.name] for f in sub.fields if f.name in self.dictionaries}
-        return ColumnTable(sub, cols, dicts)
+        val = {f.name: self.validity[f.name] for f in sub.fields if f.name in self.validity}
+        return ColumnTable(sub, cols, dicts, val)
 
     def take(self, indices: np.ndarray) -> "ColumnTable":
         cols = {k: v[indices] for k, v in self.columns.items()}
-        return ColumnTable(self.schema, cols, dict(self.dictionaries))
+        val = {k: v[indices] for k, v in self.validity.items()}
+        return ColumnTable(self.schema, cols, dict(self.dictionaries), val)
 
     def filter_mask(self, mask: np.ndarray) -> "ColumnTable":
         cols = {k: v[mask] for k, v in self.columns.items()}
-        return ColumnTable(self.schema, cols, dict(self.dictionaries))
+        val = {k: v[mask] for k, v in self.validity.items()}
+        return ColumnTable(self.schema, cols, dict(self.dictionaries), val)
 
     def translate_literal(self, column: str, value: Any, op: str) -> Any:
         """Map a literal to the physical domain of `column`.
@@ -154,33 +180,43 @@ class ColumnTable:
         return pos
 
     def decode(self) -> dict[str, np.ndarray]:
-        """Materialize logical values (strings decoded) for result checks."""
+        """Materialize logical values (strings decoded, null slots as None
+        in object arrays) for result checks."""
         out = {}
         for f in self.schema.fields:
             arr = self.columns[f.name]
             if f.is_string:
-                out[f.name] = self.dictionaries[f.name][arr]
+                vals = self.dictionaries[f.name][arr]
             else:
-                out[f.name] = arr
+                vals = arr
+            valid = self.validity.get(f.name)
+            if valid is not None:
+                vals = vals.astype(object)
+                vals[~valid] = None
+            out[f.name] = vals
         return out
 
     def to_arrow(self):
         import pyarrow as pa
 
         arrays = {}
-        decoded = None
         for f in self.schema.fields:
             if f.is_string:
-                decoded = decoded if decoded is not None else self.decode()
-                v = decoded[f.name]
+                v = self.dictionaries[f.name][self.columns[f.name]]
             else:
                 v = self.columns[f.name]
+            valid = self.validity.get(f.name)
+            mask = ~valid if valid is not None else None  # pa: True = null
             if f.is_vector:
                 arrays[f.name] = pa.FixedSizeListArray.from_arrays(
                     pa.array(v.reshape(-1), type=pa.float32()), f.dim
                 )
+            elif f.dtype == "date":
+                arrays[f.name] = pa.array(v, type=pa.date32(), mask=mask)
+            elif f.dtype == "timestamp":
+                arrays[f.name] = pa.array(v, type=pa.timestamp("us"), mask=mask)
             else:
-                arrays[f.name] = pa.array(v)
+                arrays[f.name] = pa.array(v, mask=mask)
         return pa.table(arrays)
 
     @staticmethod
@@ -194,6 +230,7 @@ class ColumnTable:
         schema = tables[0].schema
         cols: dict[str, np.ndarray] = {}
         dicts: dict[str, np.ndarray] = {}
+        validity: dict[str, np.ndarray] = {}
         for f in schema.fields:
             if f.is_string:
                 decoded = np.concatenate([t.dictionaries[f.name][t.columns[f.name]] for t in tables])
@@ -202,4 +239,8 @@ class ColumnTable:
                 dicts[f.name] = dictionary
             else:
                 cols[f.name] = np.concatenate([t.columns[f.name] for t in tables])
-        return ColumnTable(schema, cols, dicts)
+            if any(f.name in t.validity for t in tables):
+                validity[f.name] = np.concatenate(
+                    [t.validity.get(f.name, np.ones(t.num_rows, dtype=bool)) for t in tables]
+                )
+        return ColumnTable(schema, cols, dicts, validity)

@@ -18,6 +18,7 @@ mixed-type shapes) fall back to one vectorized numpy evaluation on host.
 
 from __future__ import annotations
 
+import dataclasses
 import math
 
 import numpy as np
@@ -36,6 +37,33 @@ _FLIP = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le", "eq": "eq", "ne": "ne"}
 
 class _HostFallback(Exception):
     """Raised by the lowering pass when the predicate needs host numpy."""
+
+
+@dataclasses.dataclass(eq=False, repr=True)
+class _Cmp3(Expr):
+    """A 3-valued comparison: `value` is the device boolean expression,
+    `null` (optional) an expression over virtual is-null columns — when it
+    is true the comparison's outcome is UNKNOWN (SQL semantics: any
+    comparison with NULL is neither true nor false)."""
+
+    value: Expr
+    null: Expr | None
+
+    def references(self):
+        refs = self.value.references()
+        return refs | self.null.references() if self.null is not None else refs
+
+
+def _null_expr(table: ColumnTable, names: list[str]) -> Expr | None:
+    """OR of is-null virtual columns for the given base columns (only those
+    that actually carry validity masks); None when none do."""
+    out: Expr | None = None
+    for name in names:
+        if table.valid_mask(name) is None:
+            continue
+        c = Col(f"{table.schema.field(name).name}{_SEP}nul")
+        out = c if out is None else Or(out, c)
+    return out
 
 
 def translate_predicate(table: ColumnTable, e: Expr) -> Expr:
@@ -277,7 +305,8 @@ def _subtree_kinds(table: ColumnTable, e: Expr) -> set[str] | None:
 
 
 def _lower(table: ColumnTable, e: Expr) -> Expr:
-    """Lower a (string-translated) predicate to a device-safe tree, raising
+    """Lower a (string-translated) predicate to a device-safe tree of
+    And/Or/Not over _Cmp3 leaves (3-valued comparisons), raising
     _HostFallback where 32-bit device semantics can't match numpy."""
     if isinstance(e, And):
         return And(_lower(table, e.left), _lower(table, e.right))
@@ -290,9 +319,11 @@ def _lower(table: ColumnTable, e: Expr) -> Expr:
         if isinstance(l, Lit) and isinstance(r, Col):
             return _lower(table, BinOp(_FLIP[e.op], r, l))
         if isinstance(l, Col) and isinstance(r, Lit):
-            return _lower_col_lit(table, e.op, l.name, r.value)
+            value = _lower_col_lit(table, e.op, l.name, r.value)
+            return _Cmp3(value, _null_expr(table, [l.name]))
         if isinstance(l, Col) and isinstance(r, Col):
-            return _lower_col_col(table, e.op, l.name, r.name)
+            value = _lower_col_col(table, e.op, l.name, r.name)
+            return _Cmp3(value, _null_expr(table, [l.name, r.name]))
         # Compound arithmetic sides: keep on device only when every piece
         # is exactly representable in 32-bit lanes AND both sides share one
         # value kind (mixed int/float comparisons promote to float64 under
@@ -300,10 +331,11 @@ def _lower(table: ColumnTable, e: Expr) -> Expr:
         lk = _subtree_kinds(table, l)
         rk = _subtree_kinds(table, r)
         if lk is not None and rk is not None and len(lk | rk) == 1:
-            return e
+            # A null in ANY input makes the whole comparison unknown.
+            return _Cmp3(e, _null_expr(table, sorted(e.references())))
         raise _HostFallback
     if isinstance(e, Lit) and isinstance(e.value, (bool, np.bool_)):
-        return e
+        return _Cmp3(e, None)
     raise _HostFallback
 
 
@@ -313,6 +345,12 @@ def _structure_key(e: Expr, lits: list) -> tuple:
     """Structural fingerprint of an expression with literals abstracted out
     (collected into `lits` in walk order). Predicates that differ only in
     literal values share one compiled evaluator."""
+    if isinstance(e, _Cmp3):
+        return (
+            "cmp3",
+            _structure_key(e.value, lits),
+            _structure_key(e.null, lits) if e.null is not None else None,
+        )
     if isinstance(e, Lit):
         lits.append(e.value)
         return ("lit",)
@@ -349,6 +387,31 @@ def _eval_with_args(e: Expr, cols: dict, lit_iter) -> object:
     raise ValueError(f"cannot evaluate {e!r}")
 
 
+def _eval3(e: Expr, cols: dict, lit_iter):
+    """Kleene evaluation → (definitely-true, definitely-false) mask pair.
+    Unknown = neither. This is how SQL's 3-valued logic stays a pair of
+    plain boolean lanes the TPU fuses for free."""
+    if isinstance(e, _Cmp3):
+        v = _eval_with_args(e.value, cols, lit_iter)
+        if e.null is None:
+            return v, jnp.logical_not(v)
+        n = _eval_with_args(e.null, cols, lit_iter)
+        known = jnp.logical_not(n)
+        return jnp.logical_and(v, known), jnp.logical_and(jnp.logical_not(v), known)
+    if isinstance(e, And):
+        t1, f1 = _eval3(e.left, cols, lit_iter)
+        t2, f2 = _eval3(e.right, cols, lit_iter)
+        return jnp.logical_and(t1, t2), jnp.logical_or(f1, f2)
+    if isinstance(e, Or):
+        t1, f1 = _eval3(e.left, cols, lit_iter)
+        t2, f2 = _eval3(e.right, cols, lit_iter)
+        return jnp.logical_or(t1, t2), jnp.logical_and(f1, f2)
+    if isinstance(e, Not):
+        t, f = _eval3(e.child, cols, lit_iter)
+        return f, t
+    raise ValueError(f"cannot 3-value evaluate {e!r}")
+
+
 # (structure, column layout, literal dtypes, padded length) → jitted fn.
 # Literals enter as traced scalars and shapes are padded to powers of two,
 # so repeated point lookups with different keys / different bucket sizes
@@ -361,10 +424,13 @@ def _pow2(n: int) -> int:
 
 
 def _resolve_column(table: ColumnTable, name: str, memo: dict) -> np.ndarray:
-    """A physical or virtual (pair-lowered hi/lo) column as a host array."""
+    """A physical or virtual (pair-lowered hi/lo, is-null) column as a
+    host array."""
     if _SEP not in name:
         return table.columns[table.schema.field(name).name]
     base, tag = name.split(_SEP, 1)
+    if tag == "nul":
+        return ~table.valid_mask(base)
     domain, word = tag[0], tag[1:]
     key = (base.lower(), domain)
     u = memo.get(key)
@@ -377,14 +443,43 @@ def _resolve_column(table: ColumnTable, name: str, memo: dict) -> np.ndarray:
 
 
 def _host_mask(table: ColumnTable, predicate: Expr) -> np.ndarray:
-    """Vectorized numpy fallback with full 64-bit semantics."""
+    """Vectorized numpy fallback: full 64-bit semantics + Kleene logic.
+    Returns the definitely-true mask (what a SQL filter keeps)."""
 
     def resolve(name: str):
         return table.columns[table.schema.field(name).name]
 
-    with np.errstate(all="ignore"):
-        mask = evaluate(predicate, resolve, np)
-    return np.broadcast_to(np.asarray(mask, dtype=bool), (table.num_rows,))
+    n_rows = table.num_rows
+
+    def known_mask(e: Expr) -> np.ndarray:
+        """True where every column input of `e` is non-null."""
+        known = np.ones(n_rows, dtype=bool)
+        for name in e.references():
+            valid = table.valid_mask(name)
+            if valid is not None:
+                known = known & valid
+        return known
+
+    def tri(e: Expr):
+        if isinstance(e, And):
+            t1, f1 = tri(e.left)
+            t2, f2 = tri(e.right)
+            return t1 & t2, f1 | f2
+        if isinstance(e, Or):
+            t1, f1 = tri(e.left)
+            t2, f2 = tri(e.right)
+            return t1 | t2, f1 & f2
+        if isinstance(e, Not):
+            t, f = tri(e.child)
+            return f, t
+        # Leaf comparison/expression: any null input makes it unknown.
+        with np.errstate(all="ignore"):
+            v = np.broadcast_to(np.asarray(evaluate(e, resolve, np), dtype=bool), (n_rows,))
+        known = known_mask(e)
+        return v & known, ~v & known
+
+    t, _ = tri(predicate)
+    return t
 
 
 def eval_predicate_mask(table: ColumnTable, predicate: Expr) -> np.ndarray:
@@ -419,8 +514,8 @@ def eval_predicate_mask(table: ColumnTable, predicate: Expr) -> np.ndarray:
 
         def raw(cols_tuple, lits_tuple, expr=lowered):
             cols = dict(zip(lowered_names, cols_tuple))
-            out = _eval_with_args(expr, cols, iter(lits_tuple))
-            return jnp.broadcast_to(out, (n_pad,))
+            t, _f = _eval3(expr, cols, iter(lits_tuple))
+            return jnp.broadcast_to(t, (n_pad,))
 
         fn = jax.jit(raw)
         _MASK_FN_CACHE[key] = fn

@@ -85,7 +85,7 @@ def _result_with_query_ids(rows: ColumnTable, vals: np.ndarray) -> AnnResult:
     qcol = np.repeat(np.arange(q, dtype=np.int64), k)
     schema = Schema((Field("__query__", "int64"),) + rows.schema.fields)
     cols = {"__query__": qcol, **rows.columns}
-    out = ColumnTable(schema, cols, dict(rows.dictionaries))
+    out = ColumnTable(schema, cols, dict(rows.dictionaries), dict(rows.validity))
     valid = np.isfinite(vals.reshape(-1))
     if not valid.all():
         out = out.filter_mask(valid)

@@ -1,0 +1,249 @@
+"""Null handling end to end.
+
+Spark columns are nullable by default and the reference indexes them
+untouched (schema captured with nullability, index/IndexLogEntry.scala:39-47).
+Here nulls ride validity masks through ColumnTable, predicates evaluate with
+SQL 3-valued logic (filters keep only definitely-true rows), null keys never
+equi-join, and parquet round-trips preserve the masks.
+"""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col
+from hyperspace_tpu.execution.table import ColumnTable
+from hyperspace_tpu.ops.filter import eval_predicate_mask
+from hyperspace_tpu.plan.expr import lit
+
+
+@pytest.fixture
+def session(tmp_system_path):
+    return HyperspaceSession(system_path=tmp_system_path, num_buckets=8)
+
+
+@pytest.fixture
+def hs(session):
+    return Hyperspace(session)
+
+
+def _nullable_parquet(tmp_path, n=800, seed=11):
+    """key + payload columns, every one carrying nulls."""
+    rng = np.random.default_rng(seed)
+    key = rng.integers(0, 60, n).astype(np.int64)
+    val = rng.standard_normal(n)
+    name = np.array([f"n{i % 23}" for i in range(n)], dtype=object)
+    knull = rng.random(n) < 0.15
+    vnull = rng.random(n) < 0.15
+    snull = rng.random(n) < 0.15
+    table = pa.table(
+        {
+            "key": pa.array([None if m else int(k) for k, m in zip(key, knull)], type=pa.int64()),
+            "value": pa.array([None if m else float(v) for v, m in zip(val, vnull)], type=pa.float64()),
+            "name": pa.array([None if m else s for s, m in zip(name, snull)], type=pa.string()),
+        }
+    )
+    root = tmp_path / "nullable"
+    root.mkdir()
+    pq.write_table(table.slice(0, n // 2), root / "a.parquet")
+    pq.write_table(table.slice(n // 2), root / "b.parquet")
+    return str(root), table.to_pandas()
+
+
+def frames_equal(a: pd.DataFrame, b: pd.DataFrame):
+    cols = sorted(a.columns)
+    assert sorted(b.columns) == cols
+    a2 = a[cols].sort_values(cols, na_position="last").reset_index(drop=True)
+    b2 = b[cols].sort_values(cols, na_position="last").reset_index(drop=True)
+    pd.testing.assert_frame_equal(a2, b2, check_dtype=False)
+
+
+def _canon(df: pd.DataFrame) -> pd.DataFrame:
+    """Normalize None→NaN so decode() output compares against pandas."""
+    return df.fillna(np.nan) if len(df) else df
+
+
+# -- container round-trip ----------------------------------------------------
+
+def test_arrow_round_trip_preserves_nulls(tmp_path):
+    root, pdf = _nullable_parquet(tmp_path)
+    from hyperspace_tpu.execution import io as hio
+    from hyperspace_tpu.dataset import list_data_files
+
+    files = [fi.path for fi in list_data_files(root)]
+    t = hio.read_parquet(files)
+    assert set(t.validity) == {"key", "value", "name"}
+    back = t.to_arrow().to_pandas()
+    frames_equal(back, pdf)
+
+
+# -- 3-valued predicate logic ------------------------------------------------
+
+def _masked_table(n=400, seed=3):
+    rng = np.random.default_rng(seed)
+    from hyperspace_tpu.schema import Field, Schema
+
+    schema = Schema.of(Field("a", "int64", nullable=True), Field("b", "float64", nullable=True))
+    a = rng.integers(-50, 50, n).astype(np.int64)
+    b = rng.standard_normal(n)
+    va = rng.random(n) > 0.2
+    vb = rng.random(n) > 0.2
+    t = ColumnTable(schema, {"a": a, "b": b}, {}, {"a": va, "b": vb})
+    return t, a, b, va, vb
+
+
+def test_filter_comparison_null_is_not_true():
+    t, a, b, va, vb = _masked_table()
+    got = eval_predicate_mask(t, col("a") > lit(0))
+    np.testing.assert_array_equal(got, (a > 0) & va)
+    got = eval_predicate_mask(t, col("a") != lit(3))
+    np.testing.assert_array_equal(got, (a != 3) & va)
+
+
+def test_filter_kleene_and_or_not():
+    t, a, b, va, vb = _masked_table()
+    # OR: (false OR unknown) = unknown → dropped; (true OR unknown) = true.
+    got = eval_predicate_mask(t, (col("a") > lit(0)) | (col("b") > lit(0)))
+    want = ((a > 0) & va) | ((b > 0) & vb)
+    np.testing.assert_array_equal(got, want)
+    # AND with Kleene: true only when both definitely true.
+    got = eval_predicate_mask(t, (col("a") > lit(0)) & (col("b") > lit(0)))
+    want = (a > 0) & va & (b > 0) & vb
+    np.testing.assert_array_equal(got, want)
+    # NOT(unknown) = unknown → dropped either way.
+    got = eval_predicate_mask(t, ~(col("a") > lit(0)))
+    want = ~(a > 0) & va
+    np.testing.assert_array_equal(got, want)
+
+
+def test_filter_host_fallback_kleene():
+    """Arithmetic on a nullable int64 column runs on host — same 3-valued
+    result."""
+    t, a, b, va, vb = _masked_table()
+    got = eval_predicate_mask(t, (col("a") + lit(1)) > lit(0))
+    np.testing.assert_array_equal(got, ((a + 1) > 0) & va)
+
+
+def test_filter_64bit_pair_path_with_nulls():
+    from hyperspace_tpu.schema import Field, Schema
+
+    rng = np.random.default_rng(9)
+    n = 300
+    a = rng.integers(-(2**60), 2**60, n).astype(np.int64)
+    va = rng.random(n) > 0.3
+    schema = Schema.of(Field("a", "int64", nullable=True))
+    t = ColumnTable(schema, {"a": a}, {}, {"a": va})
+    got = eval_predicate_mask(t, col("a") >= lit(2**40))
+    np.testing.assert_array_equal(got, (a >= 2**40) & va)
+
+
+# -- index build + rewritten query equality ----------------------------------
+
+def test_create_index_and_filter_equality_with_nulls(session, hs, tmp_path):
+    root, _ = _nullable_parquet(tmp_path)
+    df = session.parquet(root)
+    hs.create_index(df, IndexConfig("nullidx", ["key"], ["value", "name"]))
+
+    queries = [
+        df.filter(col("key") == 17).select("key", "value"),
+        df.filter((col("key") > 30) & (col("value") < 0.5)).select("key", "value", "name"),
+        df.filter((col("name") == "n7") | (col("key") <= 5)).select("name", "key"),
+    ]
+    for q in queries:
+        session.enable_hyperspace()
+        opt = session.optimized_plan(q)
+        assert any(s.bucket_spec is not None for s in opt.leaves()), "rewrite missed"
+        got = _canon(session.to_pandas(q))
+        session.disable_hyperspace()
+        want = _canon(session.to_pandas(q))
+        frames_equal(got, want)
+
+
+def test_string_index_key_with_nulls(session, hs, tmp_path):
+    root, _ = _nullable_parquet(tmp_path)
+    df = session.parquet(root)
+    hs.create_index(df, IndexConfig("sidx", ["name"], ["key"]))
+    q = df.filter(col("name") == "n3").select("name", "key")
+    session.enable_hyperspace()
+    assert any(s.bucket_spec is not None for s in session.optimized_plan(q).leaves())
+    got = _canon(session.to_pandas(q))
+    session.disable_hyperspace()
+    frames_equal(got, _canon(session.to_pandas(q)))
+
+
+# -- joins: null keys never match -------------------------------------------
+
+def test_join_null_keys_never_match(session, hs, tmp_path):
+    rng = np.random.default_rng(21)
+    n = 600
+    lkey = [None if rng.random() < 0.2 else int(k) for k in rng.integers(0, 40, n)]
+    lval = rng.standard_normal(n)
+    left = pa.table({"k": pa.array(lkey, type=pa.int64()), "lv": pa.array(lval)})
+    m = 200
+    rkey = [None if rng.random() < 0.2 else int(k) for k in rng.integers(0, 40, m)]
+    rpay = [f"p{i}" for i in range(m)]
+    right = pa.table({"k": pa.array(rkey, type=pa.int64()), "rp": pa.array(rpay)})
+    lroot = tmp_path / "jl"
+    rroot = tmp_path / "jr"
+    lroot.mkdir()
+    rroot.mkdir()
+    pq.write_table(left, lroot / "l.parquet")
+    pq.write_table(right, rroot / "r.parquet")
+
+    ldf = session.parquet(lroot)
+    rdf = session.parquet(rroot)
+    hs.create_index(ldf, IndexConfig("jln", ["k"], ["lv"]))
+    hs.create_index(rdf, IndexConfig("jrn", ["k"], ["rp"]))
+
+    q = ldf.select("k", "lv").join(rdf.select("k", "rp"), ["k"])
+    session.enable_hyperspace()
+    opt = session.optimized_plan(q)
+    assert all(s.bucket_spec is not None for s in opt.leaves()), "join rewrite missed"
+    got = _canon(session.to_pandas(q))
+    session.disable_hyperspace()
+    raw = _canon(session.to_pandas(q))
+    frames_equal(got, raw)
+
+    # SQL semantics: rows with null keys on either side never appear.
+    lpd = left.to_pandas().dropna(subset=["k"])
+    rpd = right.to_pandas().dropna(subset=["k"])
+    want = lpd.merge(rpd, on="k")
+    assert len(got) == len(want)
+    frames_equal(got, want)
+
+
+def test_join_payload_nulls_survive(session, hs, tmp_path):
+    left = pa.table(
+        {
+            "k": pa.array([1, 2, 3], type=pa.int64()),
+            "lv": pa.array([None, 1.5, None], type=pa.float64()),
+        }
+    )
+    right = pa.table(
+        {
+            "k": pa.array([1, 2, 3], type=pa.int64()),
+            "rp": pa.array(["x", None, "z"]),
+        }
+    )
+    lroot = tmp_path / "pl"
+    rroot = tmp_path / "pr"
+    lroot.mkdir()
+    rroot.mkdir()
+    pq.write_table(left, lroot / "l.parquet")
+    pq.write_table(right, rroot / "r.parquet")
+    ldf = session.parquet(lroot)
+    rdf = session.parquet(rroot)
+    q = ldf.join(rdf, ["k"])
+    got = _canon(session.to_pandas(q)).sort_values("k").reset_index(drop=True)
+    assert got["lv"].isna().tolist() == [True, False, True]
+    assert got["rp"].isna().tolist() == [False, True, False]
+
+
+def test_nullable_bool_column_round_trip():
+    t = pa.table({"b": pa.array([True, None, False]), "k": pa.array([1, 2, 3], type=pa.int64())})
+    ct = ColumnTable.from_arrow(t)
+    assert ct.validity["b"].tolist() == [True, False, True]
+    back = ct.to_arrow().to_pandas()
+    assert back["b"].tolist()[0] is True and pd.isna(back["b"].tolist()[1])
