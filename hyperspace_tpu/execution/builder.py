@@ -100,15 +100,11 @@ class DeviceIndexBuilder:
         enable_compile_cache()
 
     def _mesh_for(self, num_buckets: int) -> Mesh:
-        mesh = self._mesh if self._mesh is not None else make_mesh()
-        d = mesh_size(mesh)
-        if num_buckets % d == 0:
-            return mesh
         # Shrink to the largest device count dividing num_buckets
         # (dropping any multi-slice structure — correctness first).
-        while num_buckets % d != 0:
-            d -= 1
-        return make_mesh(list(mesh.devices.flat), n=d)
+        from hyperspace_tpu.parallel.mesh import mesh_for_parallelism
+
+        return mesh_for_parallelism(self._mesh, num_buckets)
 
     # -- IndexWriter -----------------------------------------------------
     def write(

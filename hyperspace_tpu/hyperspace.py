@@ -77,7 +77,10 @@ class HyperspaceSession:
         returns a ColumnTable."""
         from hyperspace_tpu.execution.executor import Executor
 
-        return Executor().execute(self.optimized_plan(plan))
+        executor = Executor(mesh=self.mesh)
+        result = executor.execute(self.optimized_plan(plan))
+        self.last_query_stats = executor.stats
+        return result
 
     def to_pandas(self, plan: LogicalPlan):
         import pandas as pd

@@ -482,8 +482,11 @@ def _host_mask(table: ColumnTable, predicate: Expr) -> np.ndarray:
     return t
 
 
-def eval_predicate_mask(table: ColumnTable, predicate: Expr) -> np.ndarray:
-    """Evaluate the predicate on device; returns a host bool mask."""
+def eval_predicate_mask(table: ColumnTable, predicate: Expr, mesh=None) -> np.ndarray:
+    """Evaluate the predicate on device; returns a host bool mask. With a
+    mesh, the row dimension is sharded across it (purely elementwise —
+    zero collectives; the analog of the reference keeping full scan
+    parallelism in the filter rewrite, FilterIndexRule.scala:114-120)."""
     predicate = translate_predicate(table, predicate)
     try:
         lowered = _lower(table, predicate)
@@ -496,6 +499,13 @@ def eval_predicate_mask(table: ColumnTable, predicate: Expr) -> np.ndarray:
 
     n = table.num_rows
     n_pad = _pow2(n)
+    sharding = None
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+        from hyperspace_tpu.parallel.mesh import mesh_axes, mesh_size
+
+        if mesh_size(mesh) > 1 and n_pad % mesh_size(mesh) == 0:
+            sharding = NamedSharding(mesh, PartitionSpec(mesh_axes(mesh)))
     arrays = []
     layout = []
     memo: dict = {}
@@ -503,7 +513,8 @@ def eval_predicate_mask(table: ColumnTable, predicate: Expr) -> np.ndarray:
         arr = _resolve_column(table, name, memo)
         if len(arr) != n_pad:
             arr = np.concatenate([arr, np.zeros(n_pad - n, dtype=arr.dtype)])
-        arrays.append(jnp.asarray(arr))
+        dev = jnp.asarray(arr) if sharding is None else jax.device_put(arr, sharding)
+        arrays.append(dev)
         layout.append((name.lower(), arr.dtype.str))
     lit_args = [np.asarray(v) for v in lits]
 
@@ -524,8 +535,8 @@ def eval_predicate_mask(table: ColumnTable, predicate: Expr) -> np.ndarray:
     return np.asarray(jax.device_get(mask)).astype(bool)[:n]
 
 
-def apply_filter(table: ColumnTable, predicate: Expr) -> ColumnTable:
+def apply_filter(table: ColumnTable, predicate: Expr, mesh=None) -> ColumnTable:
     if table.num_rows == 0:
         return table
-    mask = eval_predicate_mask(table, predicate)
+    mask = eval_predicate_mask(table, predicate, mesh=mesh)
     return table.filter_mask(mask)

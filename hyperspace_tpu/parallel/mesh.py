@@ -80,3 +80,16 @@ def make_multislice_mesh(num_slices: int, devices=None) -> Mesh:
 
 def default_mesh() -> Mesh:
     return make_mesh()
+
+
+def mesh_for_parallelism(mesh: Mesh | None, n_units: int) -> Mesh:
+    """The largest prefix of `mesh` (flattened order) whose size divides
+    `n_units`, so contiguous ownership of units (buckets) is exact. Used by
+    both the build and the distributed query plane."""
+    mesh = mesh if mesh is not None else make_mesh()
+    d = mesh_size(mesh)
+    if n_units % d == 0:
+        return mesh
+    while n_units % d != 0:
+        d -= 1
+    return make_mesh(list(mesh.devices.flat), n=d)
