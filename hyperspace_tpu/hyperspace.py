@@ -35,6 +35,8 @@ class HyperspaceSession:
         self.mesh = mesh
         self._enabled = False
         self._manager: CachingIndexCollectionManager | None = None
+        # Executed-plan evidence of the most recent run() (Executor.stats).
+        self.last_query_stats: dict = {}
 
     # -- rule toggle (package.scala:46-70) --------------------------------
     def enable_hyperspace(self) -> "HyperspaceSession":

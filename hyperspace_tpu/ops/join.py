@@ -50,21 +50,23 @@ def _sort_bucket(keys: jnp.ndarray) -> jnp.ndarray:
     return jnp.sort(keys)
 
 
+def _count_one(lk, rk):
+    """Match-count phase for one sorted bucket (shared by the single-device
+    and bucket-sharded kernels)."""
+    start = jnp.searchsorted(rk, lk, side="left").astype(jnp.int32)
+    end = jnp.searchsorted(rk, lk, side="right").astype(jnp.int32)
+    real = lk < jnp.iinfo(lk.dtype).max  # dtype's own sentinel
+    cnt = jnp.where(real, end - start, 0)
+    cum = jnp.cumsum(cnt).astype(jnp.int32)
+    return start, cum, cum[-1] if cum.shape[0] else jnp.int32(0)
+
+
 @jax.jit
 def join_counts(lkeys: jnp.ndarray, rkeys: jnp.ndarray):
     """Per-bucket match counts. lkeys/rkeys: [B, L]/[B, R] sorted integer
     codes padded with their dtype's max (sentinel_for). Returns
     (start [B,L], cum [B,L], totals [B])."""
-
-    def one(lk, rk):
-        start = jnp.searchsorted(rk, lk, side="left").astype(jnp.int32)
-        end = jnp.searchsorted(rk, lk, side="right").astype(jnp.int32)
-        real = lk < jnp.iinfo(lk.dtype).max  # dtype's own sentinel
-        cnt = jnp.where(real, end - start, 0)
-        cum = jnp.cumsum(cnt).astype(jnp.int32)
-        return start, cum, cum[-1] if cum.shape[0] else jnp.int32(0)
-
-    return jax.vmap(one)(lkeys, rkeys)
+    return jax.vmap(_count_one)(lkeys, rkeys)
 
 
 @functools.partial(jax.jit, static_argnames=("cap",))
@@ -171,16 +173,7 @@ def merge_join(lkeys_np: np.ndarray, rkeys_np: np.ndarray):
 
 def _count_local(lk, rk):
     """Per-bucket counts for one device's bucket range [b_loc, L]/[b_loc, R]."""
-
-    def one(lkb, rkb):
-        start = jnp.searchsorted(rkb, lkb, side="left").astype(jnp.int32)
-        end = jnp.searchsorted(rkb, lkb, side="right").astype(jnp.int32)
-        real = lkb < jnp.iinfo(lkb.dtype).max
-        cnt = jnp.where(real, end - start, 0)
-        cum = jnp.cumsum(cnt).astype(jnp.int32)
-        return start, cum, cum[-1] if cum.shape[0] else jnp.int32(0)
-
-    return jax.vmap(one)(lk, rk)
+    return jax.vmap(_count_one)(lk, rk)
 
 
 @functools.lru_cache(maxsize=64)
