@@ -22,6 +22,11 @@ INDEX_HYBRID_SCAN_ENABLED = "hyperspace.index.hybridscan.enabled"
 # Hybrid scan only applies while appended bytes stay below this fraction of
 # the indexed source (past it, scanning deltas unindexed beats the index).
 INDEX_HYBRID_SCAN_MAX_APPENDED_RATIO = "hyperspace.index.hybridscan.maxAppendedRatio"
+# Out-of-core build: sources whose uncompressed estimate exceeds the memory
+# budget stream through row-group chunks of at most chunkBytes (0 = derive
+# from the budget).
+INDEX_BUILD_MEMORY_BUDGET = "hyperspace.index.build.memoryBudgetBytes"
+INDEX_BUILD_CHUNK_BYTES = "hyperspace.index.build.chunkBytes"
 
 # Directory-layout constants (reference index/IndexConstants.scala:38-39).
 HYPERSPACE_LOG_DIR = "_hyperspace_log"
@@ -31,6 +36,7 @@ LATEST_STABLE_LOG_NAME = "latestStable"
 DEFAULT_NUM_BUCKETS = 8
 DEFAULT_CACHE_EXPIRY_SECONDS = 300.0
 DEFAULT_HYBRID_SCAN_MAX_APPENDED_RATIO = 0.3
+DEFAULT_BUILD_MEMORY_BUDGET = 4 << 30
 
 
 @dataclasses.dataclass
@@ -42,6 +48,8 @@ class HyperspaceConf:
     cache_expiry_seconds: float = DEFAULT_CACHE_EXPIRY_SECONDS
     hybrid_scan_enabled: bool = False
     hybrid_scan_max_appended_ratio: float = DEFAULT_HYBRID_SCAN_MAX_APPENDED_RATIO
+    build_memory_budget_bytes: int = DEFAULT_BUILD_MEMORY_BUDGET
+    build_chunk_bytes: int = 0  # 0 = derived from the budget
     overrides: dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def __post_init__(self):
@@ -60,6 +68,10 @@ class HyperspaceConf:
             self.hybrid_scan_enabled = bool(value) if not isinstance(value, str) else value.lower() == "true"
         elif key == INDEX_HYBRID_SCAN_MAX_APPENDED_RATIO:
             self.hybrid_scan_max_appended_ratio = float(value)
+        elif key == INDEX_BUILD_MEMORY_BUDGET:
+            self.build_memory_budget_bytes = int(value)
+        elif key == INDEX_BUILD_CHUNK_BYTES:
+            self.build_chunk_bytes = int(value)
 
     def get(self, key: str, default: Any = None) -> Any:
         if key in self.overrides:
@@ -74,4 +86,8 @@ class HyperspaceConf:
             return self.hybrid_scan_enabled
         if key == INDEX_HYBRID_SCAN_MAX_APPENDED_RATIO:
             return self.hybrid_scan_max_appended_ratio
+        if key == INDEX_BUILD_MEMORY_BUDGET:
+            return self.build_memory_budget_bytes
+        if key == INDEX_BUILD_CHUNK_BYTES:
+            return self.build_chunk_bytes
         return default

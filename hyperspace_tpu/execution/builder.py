@@ -25,17 +25,15 @@ from pathlib import Path
 
 import numpy as np
 
-import jax
-import jax.numpy as jnp
 from jax.sharding import Mesh
 
+from hyperspace_tpu.config import DEFAULT_BUILD_MEMORY_BUDGET
 from hyperspace_tpu.dataset import list_data_files
 from hyperspace_tpu.exceptions import HyperspaceError
 from hyperspace_tpu.execution import io as hio
 from hyperspace_tpu.execution.table import ColumnTable
-from hyperspace_tpu.ops.bucketize import bucketize
 from hyperspace_tpu.ops.hashing import bucket_ids, combine_hashes, hash_int_column, string_dict_hashes
-from hyperspace_tpu.parallel.mesh import enable_compile_cache, make_mesh, mesh_size
+from hyperspace_tpu.parallel.mesh import enable_compile_cache, mesh_size
 from hyperspace_tpu.plan.nodes import LogicalPlan, Scan
 
 
@@ -76,14 +74,6 @@ def hash_scalar_key(values: list, fields) -> np.ndarray:
     return combine_hashes(hs, np)
 
 
-def _fast_take(arr: np.ndarray, idx: np.ndarray) -> np.ndarray:
-    """Threaded native gather when built, numpy fancy-index otherwise."""
-    from hyperspace_tpu import native
-
-    out = native.take_rows(arr, idx)
-    return out if out is not None else arr[idx]
-
-
 def _pad_to(arr: np.ndarray, n: int, fill=0) -> np.ndarray:
     if len(arr) == n:
         return arr
@@ -92,11 +82,36 @@ def _pad_to(arr: np.ndarray, n: int, fill=0) -> np.ndarray:
 
 
 class DeviceIndexBuilder:
-    """IndexWriter over a device mesh (defaults to all local devices)."""
+    """IndexWriter over a device mesh (defaults to all local devices).
 
-    def __init__(self, mesh: Mesh | None = None, capacity_factor: float = 2.0):
+    Two build paths, chosen by the parquet footers' uncompressed-size
+    estimate against `memory_budget_bytes`:
+
+    - **in-memory** (fits): one host decode, one fused device
+      exchange+sort returning just the row permutation, one host gather,
+      threaded per-bucket write;
+    - **streaming** (doesn't fit): the out-of-core pipeline the reference
+      gets from Spark's pipelined scan (actions/CreateActionBase.scala:
+      99-120 scans sources of any size) — chunked row-group decode
+      (prefetch-overlapped) → per-chunk host bucket partition → per-bucket
+      spill row groups → batched device key-sort per bucket → final files.
+      Host memory is bounded by `chunk_bytes`, never the source size.
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh | None = None,
+        capacity_factor: float = 2.0,
+        memory_budget_bytes: int | None = None,
+        chunk_bytes: int | None = None,
+    ):
         self._mesh = mesh
         self.capacity_factor = capacity_factor
+        if memory_budget_bytes is None:
+            memory_budget_bytes = DEFAULT_BUILD_MEMORY_BUDGET
+        self.memory_budget_bytes = memory_budget_bytes
+        self.chunk_bytes = chunk_bytes or max(16 << 20, memory_budget_bytes // 8)
+        self.last_build_stats: dict = {}
         enable_compile_cache()
 
     def _mesh_for(self, num_buckets: int) -> Mesh:
@@ -115,8 +130,20 @@ class DeviceIndexBuilder:
         num_buckets: int,
         dest_path: Path,
     ) -> None:
-        table = self._materialize(plan, columns)
+        if not isinstance(plan, Scan):
+            raise HyperspaceError("index builds materialize scan-only plans")
+        files = plan.files if plan.files is not None else [fi.path for fi in list_data_files(plan.root)]
+        footers = hio.read_footers(files)
+        est = hio.estimate_uncompressed_bytes(files, columns, footers=footers)
+        if est > self.memory_budget_bytes:
+            self._write_streaming(
+                files, plan.scan_schema, columns, indexed_columns, num_buckets,
+                dest_path, est, footers=footers,
+            )
+            return
+        table = hio.read_parquet(files, columns=columns, schema=plan.schema)
         self.write_table(table, indexed_columns, num_buckets, dest_path)
+        self.last_build_stats = {"path": "in-memory", "bytes_estimate": est, "rows": table.num_rows}
 
     def write_table(
         self,
@@ -125,6 +152,9 @@ class DeviceIndexBuilder:
         num_buckets: int,
         dest_path: Path,
     ) -> None:
+        from hyperspace_tpu.ops.bucketize import bucketize_perm
+        from hyperspace_tpu.ops.sortkeys import key_lanes
+
         mesh = self._mesh_for(num_buckets)
         d = mesh_size(mesh)
         n = table.num_rows
@@ -133,89 +163,188 @@ class DeviceIndexBuilder:
         row_hash = compute_row_hashes(table, indexed_columns)
         bucket = bucket_ids(row_hash, num_buckets, np)
 
-        # Host: order-preserving int32 rank codes per key column. The
-        # device exchange + sort run entirely in native int32 (TPU has no
-        # native 64-bit sort; pushing int64/float64 payloads through a
-        # variadic lax.sort is both slow to compile and slow to run).
-        # Payload bytes never touch the device: the sort emits a row-id
-        # permutation and the host gathers the original columns by it.
+        # Host: decompose key columns into order-preserving 32-bit lanes
+        # (ops/sortkeys.py — no np.unique rank pass; streaming-safe).
+        # Payload bytes never touch the device: the exchange+sort emits a
+        # row-id permutation and the host gathers columns by it.
         key_names = [table.schema.field(c).name for c in indexed_columns]
-        key_codes = []
-        for kname in key_names:
-            f = table.schema.field(kname)
-            arr = table.columns[kname]
-            if f.is_string:
-                codes = arr.astype(np.int32)  # sorted-dict codes (copy)
-            else:
-                _, inv = np.unique(arr, return_inverse=True)
-                codes = inv.astype(np.int32)
-            valid = table.valid_mask(kname)
-            if valid is not None:
-                codes[~valid] = -1  # nulls sort FIRST within their bucket
-            key_codes.append(codes)
+        lanes = key_lanes(table, indexed_columns)
 
-        # Pad rows to a multiple of the mesh size.
+        # Pad rows to a multiple of the mesh size; rows past n are pads
+        # (the device derives validity from the global row id).
         n_pad = max(d, math.ceil(max(n, 1) / d) * d)
-        valid = _pad_to(np.ones(n, np.int32), n_pad)
         bucket_p = _pad_to(bucket, n_pad)
-        gid = _pad_to(np.arange(n, dtype=np.int32), n_pad)
-        codes_p = [_pad_to(c, n_pad) for c in key_codes]
+        lanes_p = [_pad_to(l, n_pad) for l in lanes]
 
         # Device: the exchange (Spark-shuffle analog, single all_to_all)
-        # fused with the per-shard lex sort by (bucket, key codes); the
-        # row-id rides along as the only payload.
-        out_cols, out_bucket, out_valid = bucketize(
-            mesh,
-            [jnp.asarray(c) for c in codes_p] + [jnp.asarray(gid)],
-            jnp.asarray(bucket_p),
-            jnp.asarray(valid),
-            num_buckets,
-            self.capacity_factor,
-            num_key_cols=len(key_names),
+        # fused with the per-shard lex sort by (bucket, key lanes); ONE
+        # int32-per-row readback (the permutation).
+        order, bucket_rows = bucketize_perm(
+            mesh, lanes_p, bucket_p, n, num_buckets, self.capacity_factor
         )
-        out_bucket_h = np.asarray(jax.device_get(out_bucket))
-        gid_h = np.asarray(jax.device_get(out_cols[-1]))
-        valid_mask = out_bucket_h < num_buckets  # sentinel marks invalid
-
-        # Host: gather every column by the device-computed permutation and
-        # carve into per-bucket files.
-        compact_bucket = out_bucket_h[valid_mask]
-        order = gid_h[valid_mask]
         if len(order) != n:
             raise HyperspaceError(
                 f"row count changed through exchange: {n} → {len(order)}"
             )
+        compact_bucket = np.repeat(
+            np.arange(num_buckets, dtype=np.int32), bucket_rows
+        )
+
+        # Host: carve into per-bucket files, gathering each bucket's rows
+        # by its slice of the permutation INSIDE the write threads (the
+        # gather overlaps the parquet encode of other buckets).
         field_names = [f.name for f in table.schema.fields]
         payload_names = [c for c in field_names if c not in key_names]
         ordered = key_names + payload_names
         # Devices own contiguous bucket ranges in mesh order and each shard
         # is bucket-sorted, so the compacted global bucket array is sorted.
-        result = ColumnTable(
-            table.schema.select(ordered),
-            {name: _fast_take(table.columns[name], order) for name in ordered},
-            dict(table.dictionaries),
-            {name: table.validity[name][order] for name in ordered if name in table.validity},
-        )
         hio.carve_and_write(
-            Path(dest_path), result, compact_bucket, num_buckets, indexed_columns
+            Path(dest_path), table.select(ordered), compact_bucket, num_buckets,
+            indexed_columns, order=order,
         )
+
+    # -- streaming out-of-core build -------------------------------------
+    def _write_streaming(
+        self,
+        files: list[str],
+        schema,
+        columns: list[str],
+        indexed_columns: list[str],
+        num_buckets: int,
+        dest_path: Path,
+        est_bytes: int,
+        footers=None,
+    ) -> None:
+        import shutil
+        from concurrent.futures import ThreadPoolExecutor
+
+        import pyarrow.parquet as pq
+
+        from hyperspace_tpu.ops.sortkeys import device_sort_perms
+
+        dest = Path(dest_path)
+        spill = dest.parent / (dest.name + ".spill")
+        if spill.exists():
+            shutil.rmtree(spill)
+        spill.mkdir(parents=True, exist_ok=True)
+        sub_schema = schema.select(columns)
+        key_names = [sub_schema.field(c).name for c in indexed_columns]
+        payload_names = [f.name for f in sub_schema.fields if f.name not in key_names]
+        ordered = key_names + payload_names
+
+        chunks = hio.plan_row_group_chunks(files, self.chunk_bytes, columns, footers=footers)
+        writers: dict[int, pq.ParquetWriter] = {}
+        total_rows = 0
+        try:
+            # Phase 1: stream chunks; decode of chunk i+1 overlaps the
+            # hash/partition/spill of chunk i.
+            with ThreadPoolExecutor(max_workers=1) as prefetcher:
+                nxt = prefetcher.submit(hio.read_chunk, chunks[0], columns) if chunks else None
+                for i in range(len(chunks)):
+                    at = nxt.result()
+                    if i + 1 < len(chunks):
+                        nxt = prefetcher.submit(hio.read_chunk, chunks[i + 1], columns)
+                    ct = ColumnTable.from_arrow(at, sub_schema).select(ordered)
+                    total_rows += ct.num_rows
+                    bucket = bucket_ids(
+                        compute_row_hashes(ct, indexed_columns), num_buckets, np
+                    )
+                    order = np.argsort(bucket, kind="stable")
+                    sb = bucket[order]
+                    starts = np.searchsorted(sb, np.arange(num_buckets + 1))
+                    arrow_sorted = ct.take(order).to_arrow()
+                    for b in range(num_buckets):
+                        lo, hi = int(starts[b]), int(starts[b + 1])
+                        if hi <= lo:
+                            continue
+                        w = writers.get(b)
+                        if w is None:
+                            w = pq.ParquetWriter(
+                                spill / hio.bucket_file_name(b), arrow_sorted.schema
+                            )
+                            writers[b] = w
+                        w.write_table(arrow_sorted.slice(lo, hi - lo))
+            for w in writers.values():
+                w.close()
+
+            # Phase 2: per-bucket key sort. Batches are planned from the
+            # SPILL FOOTERS (uncompressed bytes per bucket), so at most
+            # ~chunk_bytes of bucket data is resident at once — the memory
+            # bound holds end to end, not just in phase 1. Within a batch,
+            # reads and writes are threaded; the sort is one device call.
+            dest.mkdir(parents=True, exist_ok=True)
+            bucket_rows = [0] * num_buckets
+            spill_files = {
+                b: str(spill / hio.bucket_file_name(b))
+                for b in range(num_buckets)
+                if (spill / hio.bucket_file_name(b)).exists()
+            }
+            spill_footers = hio.read_footers(list(spill_files.values()))
+            bucket_bytes = {
+                b: hio.estimate_uncompressed_bytes([p], footers={p: spill_footers[p]})
+                for b, p in spill_files.items()
+            }
+            batches: list[list[int]] = []
+            cur: list[int] = []
+            cur_bytes = 0
+            for b in sorted(spill_files):
+                if cur and cur_bytes + bucket_bytes[b] > self.chunk_bytes:
+                    batches.append(cur)
+                    cur, cur_bytes = [], 0
+                cur.append(b)
+                cur_bytes += bucket_bytes[b]
+            if cur:
+                batches.append(cur)
+
+            with ThreadPoolExecutor(max_workers=8) as pool:
+                empty = ColumnTable.empty(sub_schema.select(ordered))
+                for b in range(num_buckets):
+                    if b not in spill_files:
+                        hio.write_bucket(dest, b, empty)
+                for ids in batches:
+                    tables = list(pool.map(lambda b: hio.read_parquet([spill_files[b]]), ids))
+                    perms = device_sort_perms(tables, indexed_columns)
+                    futs = [
+                        pool.submit(hio.write_bucket, dest, b, t.take(p))
+                        for b, t, p in zip(ids, tables, perms)
+                    ]
+                    for b, t in zip(ids, tables):
+                        bucket_rows[b] = t.num_rows
+                    for f in futs:
+                        f.result()
+            hio.write_manifest(dest, num_buckets, indexed_columns, bucket_rows)
+        finally:
+            shutil.rmtree(spill, ignore_errors=True)
+        self.last_build_stats = {
+            "path": "streaming",
+            "bytes_estimate": est_bytes,
+            "chunks": len(chunks),
+            "rows": total_rows,
+        }
 
     # -- OptimizeAction's compactor seam ---------------------------------
     def compact(self, entry, src_paths: list[Path] | Path, dest_path: Path) -> None:
         """Merge all files of each bucket across every live version dir
         (base + incremental-refresh deltas) into one sorted file per bucket
-        in the new version dir."""
+        in the new version dir. Indexes too large for the in-memory path
+        compact through the same streaming pipeline that built them."""
+        from hyperspace_tpu.schema import Schema
+
         num_buckets = entry.derived_dataset.num_buckets
         indexed = entry.derived_dataset.indexed_columns
         if isinstance(src_paths, (str, Path)):
             src_paths = [src_paths]
         files = [fi.path for src in src_paths for fi in list_data_files(src)]
+        footers = hio.read_footers(files)
+        est = hio.estimate_uncompressed_bytes(files, footers=footers)
+        if est > self.memory_budget_bytes:
+            import pyarrow.parquet as pq
+
+            schema = Schema.from_arrow(pq.ParquetFile(files[0]).schema_arrow)
+            self._write_streaming(
+                files, schema, list(schema.names), indexed, num_buckets,
+                dest_path, est, footers=footers,
+            )
+            return
         table = hio.read_parquet(files)
         self.write_table(table, indexed, num_buckets, dest_path)
-
-    # -- helpers ---------------------------------------------------------
-    def _materialize(self, plan: LogicalPlan, columns: list[str]) -> ColumnTable:
-        if not isinstance(plan, Scan):
-            raise HyperspaceError("index builds materialize scan-only plans")
-        files = plan.files if plan.files is not None else [fi.path for fi in list_data_files(plan.root)]
-        return hio.read_parquet(files, columns=columns, schema=plan.schema)

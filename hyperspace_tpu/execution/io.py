@@ -27,11 +27,92 @@ MANIFEST_NAME = "_index_manifest.json"
 def read_parquet(files: list[str], columns: list[str] | None = None, schema: Schema | None = None) -> ColumnTable:
     if not files:
         raise HyperspaceError("no files to read")
-    tables = [pq.read_table(f, columns=columns) for f in files]
+    if len(files) == 1:
+        tables = [pq.read_table(files[0], columns=columns)]
+    else:
+        # Parquet decode releases the GIL; overlap files.
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=min(8, len(files))) as ex:
+            tables = list(ex.map(lambda f: pq.read_table(f, columns=columns), files))
     table = pa.concat_tables(tables, promote_options="default") if len(tables) > 1 else tables[0]
     if schema is not None and columns is not None:
         schema = schema.select(columns)
     return ColumnTable.from_arrow(table, schema)
+
+
+def read_footers(files: list[str]) -> dict[str, "pq.FileMetaData"]:
+    """One footer parse per file, reused by the size estimate, the chunk
+    planner, and the spill batcher (footers can be remote round-trips)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    if len(files) == 1:
+        return {files[0]: pq.ParquetFile(files[0]).metadata}
+    with ThreadPoolExecutor(max_workers=min(8, len(files))) as ex:
+        mds = list(ex.map(lambda f: pq.ParquetFile(f).metadata, files))
+    return dict(zip(files, mds))
+
+
+def _row_group_bytes(md, rg: int, want: set | None) -> int:
+    g = md.row_group(rg)
+    total = 0
+    for ci in range(g.num_columns):
+        col = g.column(ci)
+        name = col.path_in_schema.split(".")[0]
+        if want is None or name.lower() in want:
+            total += col.total_uncompressed_size
+    return total
+
+
+def estimate_uncompressed_bytes(
+    files: list[str], columns: list[str] | None = None, footers=None
+) -> int:
+    """Uncompressed in-memory size estimate from parquet footers (no data
+    read) — drives the in-memory vs streaming build decision."""
+    footers = footers if footers is not None else read_footers(files)
+    want = {c.lower() for c in columns} if columns is not None else None
+    return sum(
+        _row_group_bytes(md, rg, want)
+        for f, md in footers.items()
+        for rg in range(md.num_row_groups)
+    )
+
+
+def plan_row_group_chunks(
+    files: list[str], chunk_bytes: int, columns: list[str] | None = None, footers=None
+) -> list[list[tuple[str, int]]]:
+    """Split (file, row-group) units into chunks of ≤ chunk_bytes
+    uncompressed (each chunk holds at least one row group). The streaming
+    build's host-memory unit."""
+    footers = footers if footers is not None else read_footers(files)
+    want = {c.lower() for c in columns} if columns is not None else None
+    chunks: list[list[tuple[str, int]]] = []
+    cur: list[tuple[str, int]] = []
+    cur_bytes = 0
+    for f in files:
+        md = footers[f]
+        for rg in range(md.num_row_groups):
+            sz = _row_group_bytes(md, rg, want)
+            if cur and cur_bytes + sz > chunk_bytes:
+                chunks.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append((f, rg))
+            cur_bytes += sz
+    if cur:
+        chunks.append(cur)
+    return chunks
+
+
+def read_chunk(chunk: list[tuple[str, int]], columns: list[str] | None = None):
+    """Decode one planned chunk to a pyarrow Table."""
+    by_file: dict[str, list[int]] = {}
+    for f, rg in chunk:
+        by_file.setdefault(f, []).append(rg)
+    parts = [
+        pq.ParquetFile(f).read_row_groups(rgs, columns=columns)
+        for f, rgs in by_file.items()
+    ]
+    return pa.concat_tables(parts, promote_options="default") if len(parts) > 1 else parts[0]
 
 
 def bucket_file_name(bucket: int) -> str:

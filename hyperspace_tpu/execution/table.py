@@ -30,6 +30,19 @@ from hyperspace_tpu.exceptions import HyperspaceError
 from hyperspace_tpu.schema import Schema
 
 
+def _take(arr: np.ndarray, idx) -> np.ndarray:
+    """Row gather: the threaded native kernel for large gathers (it
+    releases the GIL, so concurrent carve/write threads overlap), numpy
+    fancy indexing otherwise."""
+    if isinstance(idx, np.ndarray) and idx.dtype.kind in "iu" and len(idx) > 4096:
+        from hyperspace_tpu import native
+
+        out = native.take_rows(arr, idx)
+        if out is not None:
+            return out
+    return arr[idx]
+
+
 @dataclasses.dataclass
 class ColumnTable:
     schema: Schema
@@ -127,6 +140,22 @@ class ColumnTable:
     def from_numpy(schema: Schema, columns: dict[str, np.ndarray], dictionaries=None, validity=None) -> "ColumnTable":
         return ColumnTable(schema, dict(columns), dict(dictionaries or {}), dict(validity or {}))
 
+    @staticmethod
+    def empty(schema: Schema) -> "ColumnTable":
+        """Zero-row table for a schema (empty sorted dictionaries for
+        string fields)."""
+        cols: dict[str, np.ndarray] = {}
+        dicts: dict[str, np.ndarray] = {}
+        for f in schema.fields:
+            if f.is_string:
+                cols[f.name] = np.zeros(0, dtype=np.int32)
+                dicts[f.name] = np.zeros(0, dtype=object)
+            elif f.is_vector:
+                cols[f.name] = np.zeros((0, f.dim), dtype=np.float32)
+            else:
+                cols[f.name] = np.zeros(0, dtype=f.device_dtype)
+        return ColumnTable(schema, cols, dicts, {})
+
     # -- transforms ------------------------------------------------------
     def select(self, names: Iterable[str]) -> "ColumnTable":
         names = list(names)
@@ -137,8 +166,8 @@ class ColumnTable:
         return ColumnTable(sub, cols, dicts, val)
 
     def take(self, indices: np.ndarray) -> "ColumnTable":
-        cols = {k: v[indices] for k, v in self.columns.items()}
-        val = {k: v[indices] for k, v in self.validity.items()}
+        cols = {k: _take(v, indices) for k, v in self.columns.items()}
+        val = {k: _take(v, indices) for k, v in self.validity.items()}
         return ColumnTable(self.schema, cols, dict(self.dictionaries), val)
 
     def filter_mask(self, mask: np.ndarray) -> "ColumnTable":

@@ -57,7 +57,11 @@ class HyperspaceSession:
             def writer_factory():
                 from hyperspace_tpu.execution.builder import DeviceIndexBuilder
 
-                return DeviceIndexBuilder(mesh=self.mesh)
+                return DeviceIndexBuilder(
+                    mesh=self.mesh,
+                    memory_budget_bytes=self.conf.build_memory_budget_bytes,
+                    chunk_bytes=self.conf.build_chunk_bytes or None,
+                )
 
             self._manager = CachingIndexCollectionManager(self.conf, writer_factory)
         return self._manager

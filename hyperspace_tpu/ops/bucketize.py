@@ -141,6 +141,117 @@ def make_bucketize_fn(
     return jax.jit(fn)
 
 
+@functools.lru_cache(maxsize=64)
+def make_bucketize_perm_fn(
+    mesh: Mesh,
+    lane_dtypes: tuple,
+    num_buckets: int,
+    capacity: int,
+):
+    """Exchange + lex-sort that returns ONLY (permutation, counts).
+
+    The full-row variant above downloads every exchanged column; on
+    tunneled TPUs device→host readback is the build bottleneck
+    (~20 MB/s), so this program keeps payloads off the device entirely:
+    inputs are the key LANES (ops/sortkeys.py) + per-row bucket id, the
+    global row id is generated on device (iota + axis offset), and the
+    outputs are the key-sorted global row permutation [n_pad] plus
+    per-device per-bucket valid-row counts [D, num_buckets]. The host
+    gathers payload columns by the permutation and carves by the counts —
+    one int32-per-row readback total."""
+    from hyperspace_tpu.parallel.mesh import mesh_axes, mesh_size
+
+    axes = mesh_axes(mesh)
+    num_devices = mesh_size(mesh)
+    if num_buckets % num_devices != 0:
+        raise ValueError(f"num_buckets {num_buckets} must be a multiple of mesh size {num_devices}")
+    buckets_per_device = num_buckets // num_devices
+    num_lanes = len(lane_dtypes)
+    spec = P(axes)
+    axis_sizes = {ax: mesh.shape[ax] for ax in axes}
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(tuple(spec for _ in range(num_lanes)), spec, P()),
+        out_specs=(spec, P(axes, None), P()),
+        check_vma=False,
+    )
+    def fn(lanes, bucket, n_rows):
+        r = bucket.shape[0]
+        flat_idx = jnp.int32(0)
+        for ax in axes:
+            flat_idx = flat_idx * axis_sizes[ax] + lax.axis_index(ax)
+        gid = flat_idx * r + jnp.arange(r, dtype=jnp.int32)
+        valid = (gid < n_rows[0]).astype(jnp.int32)
+        rc, rb, rv, overflow = _exchange_one_device(
+            list(lanes) + [gid], bucket, valid, num_devices, buckets_per_device,
+            capacity, num_lanes, axes,
+        )
+        perm = rc[-1]
+        # Valid rows carry their true bucket; invalid rows carry the 2^30
+        # sentinel, which bincount's bounded scatter drops.
+        counts = jnp.bincount(rb, length=num_buckets).astype(jnp.int32)
+        overflow = lax.pmax(overflow.astype(jnp.int32), axes)
+        return perm, counts[None, :], overflow[None] if overflow.ndim == 0 else overflow
+
+    return jax.jit(fn)
+
+
+def bucketize_perm(
+    mesh: Mesh,
+    lanes: list,
+    bucket,
+    n: int,
+    num_buckets: int,
+    capacity_factor: float = 2.0,
+):
+    """Host wrapper for the permutation-only exchange (overflow retry as in
+    `bucketize`). `lanes`/`bucket` are host arrays padded to a multiple of
+    the mesh size; rows past `n` are pads. Returns (order [n] int32 global
+    row ids in (bucket, key) order, bucket_rows [num_buckets])."""
+    import numpy as _np
+
+    from hyperspace_tpu.parallel.mesh import mesh_size
+
+    num_devices = mesh_size(mesh)
+    n_pad = bucket.shape[0]
+    if n_pad >= 2**31:
+        raise ValueError("bucketize_perm row ids exceed int32")
+    per_dev = n_pad // num_devices
+    lane_dtypes = tuple(str(_np.dtype(l.dtype)) for l in lanes)
+    n_arr = jnp.asarray(_np.array([n], dtype=_np.int32))
+    dev_lanes = tuple(jnp.asarray(l) for l in lanes)
+    dev_bucket = jnp.asarray(bucket)
+    while True:
+        capacity = max(1, math.ceil(per_dev / num_devices * capacity_factor))
+        capacity = min(capacity, per_dev)
+        fn = make_bucketize_perm_fn(mesh, lane_dtypes, num_buckets, capacity)
+        perm, counts, overflow = fn(dev_lanes, dev_bucket, n_arr)
+        # ONE fused readback (overflow + perm + counts): every device_get
+        # round-trip costs ~0.3-1s of latency on tunneled TPUs, and
+        # overflow is rare enough that optimistically downloading perm
+        # alongside it wins on average.
+        perm_h, counts_h, overflow_h = jax.device_get((perm, counts, overflow))
+        if not bool(_np.asarray(overflow_h).max()):
+            break
+        if capacity >= per_dev:
+            raise AssertionError("bucketize overflow with full capacity — impossible")
+        capacity_factor *= 2.0
+    perm_h = _np.asarray(perm_h)
+    counts_h = _np.asarray(counts_h)  # [D, num_buckets]
+    # Each shard's output is its flattened [D, capacity] recv buffer
+    # (valid rows sorted to the front), so the global array is [D * D*cap].
+    shard_len = num_devices * capacity
+    valid_per_shard = counts_h.sum(axis=1)
+    parts = [
+        perm_h[i * shard_len : i * shard_len + int(valid_per_shard[i])]
+        for i in range(num_devices)
+    ]
+    order = _np.concatenate(parts) if parts else perm_h[:0]
+    return order, counts_h.sum(axis=0)
+
+
 def bucketize(
     mesh: Mesh,
     cols: list,

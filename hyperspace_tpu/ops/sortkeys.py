@@ -1,0 +1,158 @@
+"""Order-preserving 32-bit lane decomposition of key columns.
+
+The device plane sorts rows by key with `lax.sort` on native 32-bit lanes
+(TPU emulates 64-bit). Instead of ranking values to int32 codes with a
+host `np.unique` pass (O(n log n) host work, impossible to stream), each
+logical key column decomposes into 1-3 int32/uint32 lanes whose
+lexicographic order equals the logical order of the column:
+
+- int8/16/32, date32, bool  → one int32 lane;
+- int64, timestamp          → (hi int32, lo uint32) word pair;
+- uint64                    → (hi uint32, lo uint32);
+- float32                   → one uint32 lane via the IEEE-754 total-order
+  bit flip (negatives reversed, sign bit toggled);
+- float64                   → the same flip on 64 bits, split hi/lo;
+- strings                   → the table's sorted-dictionary codes (already
+  rank codes; only valid WITHIN one table/dictionary);
+- nullable columns          → a leading validity lane (0 null, 1 valid),
+  so nulls sort first — matching the query plane's null-first codes.
+
+This is the streaming-safe scheme VERDICT.md round 1 asked for: lanes are
+a pure per-row function of the value, so chunks of any size decompose
+independently. The reference gets the analogous property for free from
+Spark's typed sort (index/DataFrameWriterExtensions.scala:49-66 sorts
+raw column values, not ranks).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from hyperspace_tpu.exceptions import HyperspaceError
+
+
+def _flip32(v: np.ndarray) -> np.ndarray:
+    """IEEE-754 int32 bit pattern → uint32 whose unsigned order equals the
+    float order (negatives reversed, sign toggled)."""
+    mask = (v >> 31) | np.int32(-(2**31))  # v>=0: 0x80000000, v<0: 0xFFFFFFFF
+    return (v ^ mask).view(np.uint32)
+
+
+def _flip64(v: np.ndarray) -> np.ndarray:
+    mask = (v >> 63) | np.int64(-(2**63))
+    return (v ^ mask).view(np.uint64)
+
+
+def _split64(u: np.ndarray) -> list[np.ndarray]:
+    """uint64 → (hi uint32, lo uint32) lanes (unsigned lexicographic)."""
+    return [(u >> np.uint64(32)).astype(np.uint32), (u & np.uint64(0xFFFFFFFF)).astype(np.uint32)]
+
+
+def value_lanes(arr: np.ndarray) -> list[np.ndarray]:
+    """Decompose one physical array into order-preserving 32-bit lanes."""
+    dt = np.dtype(arr.dtype)
+    if dt == np.bool_:
+        return [arr.astype(np.int32)]
+    if dt.kind == "i" and dt.itemsize <= 4:
+        return [arr.astype(np.int32, copy=False)]
+    if dt.kind == "u" and dt.itemsize < 4:
+        return [arr.astype(np.int32)]
+    if dt == np.uint32:
+        return [arr]
+    if dt == np.int64:
+        return [(arr >> 32).astype(np.int32), (arr & 0xFFFFFFFF).astype(np.uint32)]
+    if dt == np.uint64:
+        return _split64(arr)
+    if dt == np.float32:
+        return [_flip32(arr.view(np.int32))]
+    if dt == np.float64:
+        return _split64(_flip64(arr.view(np.int64)))
+    raise HyperspaceError(f"unsupported key dtype {dt}")
+
+
+def column_lanes(table, name: str, force_validity: bool = False) -> list[np.ndarray]:
+    """Lanes for a named column of a ColumnTable (validity lane first when
+    the column has nulls; null slots zeroed so output is deterministic).
+    `force_validity` emits the validity lane even for null-free columns so
+    lane layouts match across tables (batched sorts)."""
+    f = table.schema.field(name)
+    arr = table.columns[f.name]
+    lanes: list[np.ndarray] = []
+    valid = table.valid_mask(name)
+    if valid is not None:
+        lanes.append(valid.astype(np.int32))
+        zero = np.zeros((), dtype=arr.dtype)
+        arr = np.where(valid, arr, zero)
+    elif force_validity:
+        lanes.append(np.ones(len(arr), dtype=np.int32))
+    if f.is_string:
+        lanes.append(np.ascontiguousarray(arr, dtype=np.int32))
+        return lanes
+    lanes.extend(value_lanes(arr))
+    return lanes
+
+
+def key_lanes(table, key_columns: list[str], force_validity: bool = False) -> list[np.ndarray]:
+    """All lanes for a key-column list, in sort-significance order."""
+    out: list[np.ndarray] = []
+    for c in key_columns:
+        out.extend(column_lanes(table, c, force_validity=force_validity))
+    return out
+
+
+def lexsort_lanes(lanes: list[np.ndarray]) -> np.ndarray:
+    """Host (numpy) stable argsort by the lanes — the reference ordering
+    the device sort must reproduce. np.lexsort keys are LAST-significant
+    first, so reverse."""
+    if not lanes:
+        return np.arange(0)
+    return np.lexsort(tuple(reversed(lanes)))
+
+
+@functools.lru_cache(maxsize=32)
+def _make_batch_sort(num_operands: int, num_keys: int):
+    import jax
+    from jax import lax
+
+    def f(*ops):
+        return lax.sort(ops, num_keys=num_keys, is_stable=True)[-1]
+
+    return jax.jit(f)
+
+
+def device_sort_perms(tables, key_columns: list[str]) -> list[np.ndarray]:
+    """Batched per-table stable key-sort permutation on device.
+
+    Pads every table to a common power-of-two length; a leading is_pad
+    lane sinks pads unambiguously (a lane-max pad value could collide
+    with real data). ONE lax.sort call sorts all tables (lax.sort
+    batches over leading dims), one readback returns all permutations —
+    this is the streaming build's phase-2 device kernel."""
+    import jax
+    import jax.numpy as jnp
+
+    if not tables:
+        return []
+    lens = [t.num_rows for t in tables]
+    lanes_list = [key_lanes(t, key_columns, force_validity=True) for t in tables]
+    num_lanes = len(lanes_list[0])
+    b = len(tables)
+    mx = max(max(lens), 1)
+    l_pad = 1 << (int(mx - 1).bit_length()) if mx > 1 else 1
+    is_pad = np.zeros((b, l_pad), np.int32)
+    for i, n in enumerate(lens):
+        is_pad[i, n:] = 1
+    stacked = []
+    for j in range(num_lanes):
+        dt = lanes_list[0][j].dtype
+        buf = np.zeros((b, l_pad), dt)
+        for i, lanes in enumerate(lanes_list):
+            buf[i, : lens[i]] = lanes[j]
+        stacked.append(buf)
+    iota = np.broadcast_to(np.arange(l_pad, dtype=np.int32), (b, l_pad))
+    ops = [jnp.asarray(is_pad)] + [jnp.asarray(s) for s in stacked] + [jnp.asarray(np.ascontiguousarray(iota))]
+    fn = _make_batch_sort(len(ops), 1 + num_lanes)
+    perm = np.asarray(jax.device_get(fn(*ops)))
+    return [perm[i, : lens[i]] for i in range(b)]
