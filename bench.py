@@ -1,15 +1,18 @@
-"""Benchmark: TPC-H-style lineitem point-lookup, indexed vs un-indexed.
+"""Benchmark: TPC-H SF1 lineitem point-lookup, indexed vs un-indexed.
 
 The BASELINE.json config 1 analog ("TPC-H SF1 lineitem single-column
-CoveringIndex + FilterIndexRule point-lookup"): generate a lineitem-like
-table, build a covering index on the lookup key, then time point-lookup
+CoveringIndex + FilterIndexRule point-lookup") on the REAL SF1 scale:
+6,001,215-row lineitem with the full 16-column TPC-H schema (strings,
+dates, decimals), generated deterministically and cached under the system
+tmp dir. Builds a covering index on l_orderkey, then times point-lookup
 queries with hyperspace enabled (bucket-pruned sorted index scan) vs
 disabled (full scan + device filter). Prints ONE JSON line:
 
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
 vs_baseline normalizes against the driver's ≥5× query-speedup target
-(BASELINE.md). Auxiliary numbers (build GB/s/chip) go to stderr.
+(BASELINE.md). Auxiliary numbers (build GB/s/chip at two scales — the
+throughput curve) go to stderr.
 """
 
 from __future__ import annotations
@@ -28,58 +31,52 @@ def log(*args):
     print(*args, file=sys.stderr, flush=True)
 
 
-def main():
-    import pyarrow as pa
-    import pyarrow.parquet as pq
+INDEXED = ["l_orderkey"]
+INCLUDED = ["l_partkey", "l_quantity", "l_extendedprice", "l_discount"]
 
+
+def build_once(session_path: Path, data_root: Path, num_buckets: int):
+    from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig
+    from hyperspace_tpu.execution import io as hio
+    from hyperspace_tpu.dataset import list_data_files
+
+    session = HyperspaceSession(system_path=str(session_path), num_buckets=num_buckets)
+    hs = Hyperspace(session)
+    df = session.parquet(data_root)
+    files = [fi.path for fi in list_data_files(data_root)]
+    sel_bytes = hio.estimate_uncompressed_bytes(files, INDEXED + INCLUDED)
+    t0 = time.perf_counter()
+    hs.create_index(df, IndexConfig("lineitem_orderkey", INDEXED, INCLUDED))
+    build_s = time.perf_counter() - t0
+    return session, hs, df, sel_bytes, build_s
+
+
+def main():
     import jax
 
-    from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col
+    from hyperspace_tpu import col
+    from benchmarks.datagen import cached_tpch, gen_tpch_lineitem, TPCH_SF1_ORDERS_ROWS
 
     devices = jax.devices()
     log(f"devices: {devices}")
 
+    li_root, _orders_root = cached_tpch(sf=1.0)
     tmp = Path(tempfile.mkdtemp(prefix="hs_bench_"))
     try:
-        # ---- data: lineitem-ish, ~2M rows ------------------------------
-        n = 2_000_000
-        rng = np.random.default_rng(42)
-        orderkey = rng.integers(0, n // 4, n).astype(np.int64)
-        table = pa.table(
-            {
-                "l_orderkey": orderkey,
-                "l_partkey": rng.integers(0, 200_000, n).astype(np.int64),
-                "l_quantity": rng.integers(1, 51, n).astype(np.int64),
-                "l_extendedprice": (rng.random(n) * 100_000).astype(np.float64),
-                "l_discount": (rng.random(n) * 0.1).astype(np.float64),
-            }
-        )
-        data_root = tmp / "lineitem"
-        data_root.mkdir()
-        pq.write_table(table, data_root / "part-0.parquet")
-        input_bytes = table.nbytes
-        log(f"rows={n} input={input_bytes/1e9:.3f} GB")
+        # ---- GB/s curve point at SF0.1 (amortization evidence) ---------
+        small = tmp / "li_small"
+        gen_tpch_lineitem(small, sf=0.1)
+        _, _, _, sb, bs = build_once(tmp / "idx_small", small, 64)
+        log(f"build sf=0.1: {bs:.2f}s -> {sb/1e9/bs:.3f} GB/s/chip (selected cols)")
 
-        session = HyperspaceSession(system_path=str(tmp / "indexes"), num_buckets=64)
-        hs = Hyperspace(session)
-        df = session.parquet(data_root)
-
-        # ---- index build (report GB/s/chip to stderr) ------------------
-        t0 = time.perf_counter()
-        hs.create_index(
-            df,
-            IndexConfig(
-                "lineitem_orderkey",
-                ["l_orderkey"],
-                ["l_partkey", "l_quantity", "l_extendedprice", "l_discount"],
-            ),
-        )
-        build_s = time.perf_counter() - t0
-        gbps = input_bytes / 1e9 / build_s
-        log(f"index build: {build_s:.2f}s -> {gbps:.3f} GB/s/chip")
+        # ---- SF1 build --------------------------------------------------
+        session, hs, df, sel_bytes, build_s = build_once(tmp / "indexes", li_root, 200)
+        gbps = sel_bytes / 1e9 / build_s
+        log(f"build sf=1:   {build_s:.2f}s -> {gbps:.3f} GB/s/chip (selected cols, 6,001,215 rows)")
 
         # ---- point lookups ---------------------------------------------
-        keys = rng.integers(0, n // 4, 12).astype(np.int64)
+        rng = np.random.default_rng(7)
+        keys = rng.integers(0, TPCH_SF1_ORDERS_ROWS, 12).astype(np.int64)
 
         def run_lookups():
             total = 0
@@ -103,6 +100,7 @@ def main():
         t_noindex = time.perf_counter() - t0
 
         assert rows_idx == rows_no, f"result mismatch: {rows_idx} vs {rows_no}"
+        assert rows_idx > 0, "lookups matched nothing"
         speedup = t_noindex / t_indexed
         log(f"indexed: {t_indexed:.3f}s  no-index: {t_noindex:.3f}s  speedup: {speedup:.2f}x")
 

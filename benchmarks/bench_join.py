@@ -21,31 +21,26 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def main(n_lineitem: int = 1_000_000):
+def main(sf: float = 1.0):
     sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
-    from benchmarks.datagen import gen_lineitem, gen_orders
+    from benchmarks.datagen import cached_tpch
     from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig
 
     tmp = Path(tempfile.mkdtemp(prefix="hs_benchjoin_"))
     try:
-        n_orders = n_lineitem // 4
-        li_bytes = gen_lineitem(tmp / "lineitem", n_lineitem)
-        o_bytes = gen_orders(tmp / "orders", n_orders)
-        log(f"lineitem={n_lineitem} rows, orders={n_orders} rows, "
-            f"{(li_bytes + o_bytes) / 1e9:.3f} GB")
-
-        session = HyperspaceSession(system_path=str(tmp / "indexes"), num_buckets=32)
+        li_root, o_root = cached_tpch(sf=sf)
+        session = HyperspaceSession(system_path=str(tmp / "indexes"), num_buckets=64)
         hs = Hyperspace(session)
-        li = session.parquet(tmp / "lineitem")
-        orders = session.parquet(tmp / "orders")
+        li = session.parquet(li_root)
+        orders = session.parquet(o_root)
 
         t0 = time.perf_counter()
         hs.create_index(li, IndexConfig("li_ok", ["l_orderkey"], ["l_extendedprice", "l_discount"]))
-        hs.create_index(orders, IndexConfig("o_ok", ["o_orderkey"], ["o_totalprice"]))
-        log(f"index builds: {time.perf_counter() - t0:.2f}s")
+        hs.create_index(orders, IndexConfig("o_ok", ["o_orderkey"], ["o_totalprice", "o_orderpriority"]))
+        log(f"index builds (sf={sf:g}): {time.perf_counter() - t0:.2f}s")
 
         q = li.select("l_orderkey", "l_extendedprice").join(
-            orders.select("o_orderkey", "o_totalprice"),
+            orders.select("o_orderkey", "o_totalprice", "o_orderpriority"),
             ["l_orderkey"], ["o_orderkey"],
         )
 
@@ -56,6 +51,7 @@ def main(n_lineitem: int = 1_000_000):
         t0 = time.perf_counter()
         session.run(q)
         t_indexed = time.perf_counter() - t0
+        assert session.last_query_stats["join_path"] == "zero-exchange-aligned"
 
         session.disable_hyperspace()
         n_no = len(session.run(q).columns["l_orderkey"])  # warmup + count
@@ -77,4 +73,4 @@ def main(n_lineitem: int = 1_000_000):
 
 
 if __name__ == "__main__":
-    main(int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000)
+    main(float(sys.argv[1]) if len(sys.argv) > 1 else 1.0)

@@ -63,6 +63,135 @@ def gen_trips_batch(root: Path, n: int, batch: int, seed: int = 50) -> int:
     return t.nbytes
 
 
+TPCH_SF1_LINEITEM_ROWS = 6_001_215
+TPCH_SF1_ORDERS_ROWS = 1_500_000
+
+_RETURNFLAGS = np.array(["A", "N", "R"], dtype=object)
+_LINESTATUS = np.array(["F", "O"], dtype=object)
+_SHIPINSTRUCT = np.array(
+    ["COLLECT COD", "DELIVER IN PERSON", "NONE", "TAKE BACK RETURN"], dtype=object
+)
+_SHIPMODE = np.array(
+    ["AIR", "FOB", "MAIL", "RAIL", "REG AIR", "SHIP", "TRUCK"], dtype=object
+)
+_ORDERPRIORITY = np.array(
+    ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"], dtype=object
+)
+_ORDERSTATUS = np.array(["F", "O", "P"], dtype=object)
+_EPOCH_1992 = 8035  # days from 1970-01-01 to 1992-01-01
+_DATE_SPAN = 2525  # order dates span 1992-01-01 .. 1998-12-01 (TPC-H 4.2.3)
+
+
+def gen_tpch_lineitem(
+    root: Path, sf: float = 1.0, seed: int = 42, files: int = 8
+) -> int:
+    """TPC-H-faithful lineitem: full 16-column schema (ints, decimals as
+    float64, 1-char flags, dates, mode/instruction strings, comments),
+    SF1 row count 6,001,215, ~4 lines per order. Synthetic value
+    distributions (no dbgen), deterministic under the seed; returns
+    in-memory byte size."""
+    n = int(TPCH_SF1_LINEITEM_ROWS * sf)
+    n_orders = int(TPCH_SF1_ORDERS_ROWS * sf)
+    rng = np.random.default_rng(seed)
+    # ~4 lines per order: repeat each orderkey a random 1-7 times.
+    orderkey = np.repeat(
+        np.arange(n_orders, dtype=np.int64), rng.integers(1, 8, n_orders)
+    )[:n]
+    orderkey = np.concatenate(
+        [orderkey, rng.integers(0, n_orders, max(0, n - len(orderkey))).astype(np.int64)]
+    )[:n]
+    m = len(orderkey)
+    linenumber = np.ones(m, dtype=np.int32)
+    shipdate = (
+        _EPOCH_1992 + rng.integers(0, _DATE_SPAN, m) + rng.integers(1, 122, m)
+    ).astype(np.int32)
+    quantity = rng.integers(1, 51, m).astype(np.float64)
+    extendedprice = np.round(quantity * (900 + rng.random(m) * 100_000) / 100, 2)
+    comments = np.char.add(
+        np.char.add(
+            _SHIPMODE[rng.integers(0, len(_SHIPMODE), m)].astype(str), " carefully "
+        ),
+        _SHIPINSTRUCT[rng.integers(0, len(_SHIPINSTRUCT), m)].astype(str),
+    )
+    t = pa.table(
+        {
+            "l_orderkey": orderkey,
+            "l_partkey": rng.integers(0, int(200_000 * max(sf, 0.01)), m).astype(np.int64),
+            "l_suppkey": rng.integers(0, int(10_000 * max(sf, 0.01)), m).astype(np.int64),
+            "l_linenumber": linenumber,
+            "l_quantity": quantity,
+            "l_extendedprice": extendedprice,
+            "l_discount": np.round(rng.integers(0, 11, m) / 100.0, 2),
+            "l_tax": np.round(rng.integers(0, 9, m) / 100.0, 2),
+            "l_returnflag": pa.array(_RETURNFLAGS[rng.integers(0, 3, m)]),
+            "l_linestatus": pa.array(_LINESTATUS[(shipdate > _EPOCH_1992 + 1260).astype(int)]),
+            "l_shipdate": pa.array(shipdate, type=pa.date32()),
+            "l_commitdate": pa.array(shipdate + rng.integers(-30, 31, m).astype(np.int32), type=pa.date32()),
+            "l_receiptdate": pa.array(shipdate + rng.integers(1, 31, m).astype(np.int32), type=pa.date32()),
+            "l_shipinstruct": pa.array(_SHIPINSTRUCT[rng.integers(0, 4, m)]),
+            "l_shipmode": pa.array(_SHIPMODE[rng.integers(0, 7, m)]),
+            "l_comment": pa.array(comments.astype(object)),
+        }
+    )
+    root.mkdir(parents=True, exist_ok=True)
+    per = (m + files - 1) // files
+    for i in range(files):
+        part = t.slice(i * per, per)
+        if part.num_rows:
+            pq.write_table(part, root / f"part-{i}.parquet", row_group_size=262_144)
+    return t.nbytes
+
+
+def gen_tpch_orders(root: Path, sf: float = 1.0, seed: int = 43, files: int = 4) -> int:
+    """TPC-H-faithful orders (9 columns, SF1 = 1.5M rows)."""
+    n = int(TPCH_SF1_ORDERS_ROWS * sf)
+    rng = np.random.default_rng(seed)
+    orderdate = (_EPOCH_1992 + rng.integers(0, _DATE_SPAN, n)).astype(np.int32)
+    t = pa.table(
+        {
+            "o_orderkey": np.arange(n, dtype=np.int64),
+            "o_custkey": rng.integers(0, n // 10 + 1, n).astype(np.int64),
+            "o_orderstatus": pa.array(_ORDERSTATUS[rng.integers(0, 3, n)]),
+            "o_totalprice": np.round(rng.random(n) * 500_000, 2),
+            "o_orderdate": pa.array(orderdate, type=pa.date32()),
+            "o_orderpriority": pa.array(_ORDERPRIORITY[rng.integers(0, 5, n)]),
+            "o_clerk": pa.array(
+                np.char.add("Clerk#", rng.integers(1, 1001, n).astype("U6")).astype(object)
+            ),
+            "o_shippriority": np.zeros(n, dtype=np.int32),
+            "o_comment": pa.array(
+                _ORDERPRIORITY[rng.integers(0, 5, n)].astype(str).astype(object)
+            ),
+        }
+    )
+    root.mkdir(parents=True, exist_ok=True)
+    per = (n + files - 1) // files
+    for i in range(files):
+        part = t.slice(i * per, per)
+        if part.num_rows:
+            pq.write_table(part, root / f"part-{i}.parquet", row_group_size=262_144)
+    return t.nbytes
+
+
+def cached_tpch(sf: float = 1.0, cache_root: Path | None = None) -> tuple[Path, Path]:
+    """Generate (or reuse) the TPC-H tables under a cache dir keyed by
+    scale factor; bench reruns skip the ~20s generation."""
+    import tempfile
+
+    import shutil
+
+    base = cache_root or Path(tempfile.gettempdir()) / f"hs_tpch_sf{sf:g}"
+    li, orders = base / "lineitem", base / "orders"
+    # A _COMPLETE marker written AFTER generation guards against reusing a
+    # partial dataset from an interrupted run.
+    for root, gen in ((li, gen_tpch_lineitem), (orders, gen_tpch_orders)):
+        if not (root / "_COMPLETE").exists():
+            shutil.rmtree(root, ignore_errors=True)
+            gen(root, sf)
+            (root / "_COMPLETE").touch()
+    return li, orders
+
+
 def gen_embeddings(root: Path, n: int, dim: int, clusters: int, seed: int = 7) -> np.ndarray:
     """Clustered embedding table; returns the raw matrix for querying."""
     rng = np.random.default_rng(seed)

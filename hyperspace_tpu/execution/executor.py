@@ -43,6 +43,49 @@ class AlignedSide:
     delta: Scan | None = None
 
 
+@dataclasses.dataclass
+class SideData:
+    """One join side in concatenated bucket-grouped layout: rows of bucket
+    b occupy [offsets[b], offsets[b+1])."""
+
+    table: ColumnTable
+    offsets: np.ndarray  # [B+1] int64
+    sorted_within: bool  # buckets key-sorted (index files are)?
+
+
+def _bucket_sorted_codes(codes: np.ndarray, side: SideData):
+    """Ensure codes are non-decreasing within each bucket. Returns
+    (sorted codes, perm) where perm maps sorted positions back to the
+    side's row order (None when already sorted — the index-file case,
+    verified with one vectorized pass)."""
+    n = len(codes)
+    if n == 0:
+        return codes, None
+    counts = np.diff(side.offsets)
+    bucket_of = np.repeat(np.arange(len(counts), dtype=np.int64), counts)
+    if side.sorted_within:
+        d = np.diff(codes)
+        same = bucket_of[:-1] == bucket_of[1:]
+        if not np.any(d[same] < 0):
+            return codes, None
+    perm = np.lexsort((codes, bucket_of))  # stable; regroups identically
+    return codes[perm], perm
+
+
+def _pad_bucket_major(codes: np.ndarray, offsets: np.ndarray) -> np.ndarray:
+    """[n] bucket-grouped codes → [B, Lmax] padded array (pads carry the
+    dtype's max so they sort last), built with one vectorized gather."""
+    counts = np.diff(offsets)
+    b = len(counts)
+    lmax = max(int(counts.max()) if counts.size else 1, 1)
+    idx = offsets[:-1, None] + np.arange(lmax, dtype=np.int64)[None, :]
+    mask = np.arange(lmax)[None, :] < counts[:, None]
+    sentinel = join_ops.sentinel_for(codes.dtype)
+    if len(codes) == 0:
+        return np.full((b, lmax), sentinel, dtype=codes.dtype)
+    return np.where(mask, codes[np.minimum(idx, len(codes) - 1)], sentinel)
+
+
 class Executor:
     """Runs plans on the device plane. With a mesh, the query plane is
     distributed: the bucket-aligned SMJ shards its bucket dimension over
@@ -64,12 +107,17 @@ class Executor:
         }
 
     def execute(self, plan: LogicalPlan) -> ColumnTable:
+        from hyperspace_tpu.plan.prune import prune_columns
+
+        return self._execute(prune_columns(plan))
+
+    def _execute(self, plan: LogicalPlan) -> ColumnTable:
         if isinstance(plan, Scan):
             return self._scan(plan)
         if isinstance(plan, Filter):
             return self._filter(plan)
         if isinstance(plan, Project):
-            return self.execute(plan.child).select(plan.columns)
+            return self._execute(plan.child).select(plan.columns)
         if isinstance(plan, Join):
             return self._join(plan)
         if isinstance(plan, Union):
@@ -81,7 +129,7 @@ class Executor:
         schema = plan.schema
         parts = []
         for child in plan.inputs:
-            t = self.execute(child)
+            t = self._execute(child)
             # Remap onto the union schema's exact field names/order (child
             # names are validated case-insensitively compatible).
             cols, dicts, val = {}, {}, {}
@@ -101,9 +149,20 @@ class Executor:
             return list(scan.files)
         return [fi.path for fi in list_data_files(scan.root)]
 
+    def _cached_read(self, files: list[str], columns, schema) -> ColumnTable:
+        """Index-file read through the decoded-table cache; files_read
+        counts only physical (miss) reads."""
+        before = hio.table_cache_stats()["miss_files"]
+        table = hio.read_parquet_cached(files, columns=columns, schema=schema)
+        self.stats["files_read"] += hio.table_cache_stats()["miss_files"] - before
+        return table
+
     def _scan(self, scan: Scan, columns: list[str] | None = None) -> ColumnTable:
         files = self._scan_files(scan)
         cols = columns if columns is not None else scan.scan_schema.names
+        if scan.bucket_spec is not None:
+            # Index files are immutable per version — cache their decode.
+            return self._cached_read(files, cols, scan.scan_schema)
         self.stats["files_read"] += len(files)
         return hio.read_parquet(files, columns=cols, schema=scan.scan_schema)
 
@@ -113,8 +172,7 @@ class Executor:
         if isinstance(child, Scan) and child.bucket_spec is not None:
             pruned = self._prune_bucket_files(child, plan.predicate)
             if pruned is not None:
-                self.stats["files_read"] += len(pruned)
-                table = hio.read_parquet(pruned, columns=child.scan_schema.names, schema=child.scan_schema)
+                table = self._cached_read(pruned, child.scan_schema.names, child.scan_schema)
                 return apply_filter(table, plan.predicate, mesh=self.mesh)
         if isinstance(child, Union):
             # Hybrid scan: prune the bucketed input(s), keep deltas whole.
@@ -126,7 +184,7 @@ class Executor:
                         inp = dataclasses.replace(inp, files=pruned)
                 new_inputs.append(inp)
             return apply_filter(self._union(Union(new_inputs)), plan.predicate, mesh=self.mesh)
-        return apply_filter(self.execute(child), plan.predicate, mesh=self.mesh)
+        return apply_filter(self._execute(child), plan.predicate, mesh=self.mesh)
 
     def _prune_bucket_files(self, scan: Scan, predicate: Expr) -> list[str] | None:
         """If the predicate pins every bucket column with an equality
@@ -171,9 +229,10 @@ class Executor:
             return self._aligned_join(plan, left_side, right_side)
         # General path: single partition (bucket count 1).
         self.stats["join_path"] = "single-partition"
-        lt = self.execute(plan.left)
-        rt = self.execute(plan.right)
-        return self._partition_join(plan, [lt], [rt], presorted=False)
+        lt = self._execute(plan.left)
+        rt = self._execute(plan.right)
+        one = lambda t: SideData(t, np.array([0, t.num_rows], dtype=np.int64), False)  # noqa: E731
+        return self._partition_join(plan, one(lt), one(rt))
 
     def _aligned_side(self, plan: LogicalPlan) -> AlignedSide | None:
         node, project = plan, None
@@ -196,39 +255,49 @@ class Executor:
             return AlignedSide(node, project)
         return None
 
-    def _side_tables(self, side: AlignedSide, num_buckets: int):
-        """Per-bucket tables for one join side: the index bucket files,
-        plus (hybrid scan) delta rows bucketized on the fly with the same
+    def _side_data(self, side: AlignedSide, num_buckets: int) -> "SideData":
+        """One concatenated bucket-grouped table per join side (bucket
+        files read in parallel through the decoded-table cache), plus
+        (hybrid scan) delta rows bucketized on the fly with the same
         canonical row hash the build used."""
+        from concurrent.futures import ThreadPoolExecutor
+
         schema = side.scan.scan_schema
         groups = self._bucket_files_in_order(side.scan, num_buckets)
-        self.stats["files_read"] += sum(len(g) for g in groups)
-        tables = [
-            hio.read_parquet(g, columns=schema.names, schema=schema) for g in groups
-        ]
-        presorted = all(len(g) == 1 for g in groups)
+        before = hio.table_cache_stats()["miss_files"]
+        with ThreadPoolExecutor(max_workers=8) as pool:
+            tables = list(
+                pool.map(lambda g: hio.read_parquet_cached(g, columns=schema.names, schema=schema), groups)
+            )
+        self.stats["files_read"] += hio.table_cache_stats()["miss_files"] - before
+        counts = np.array([t.num_rows for t in tables], dtype=np.int64)
+        base = ColumnTable.concat(tables)
+        offsets = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+        sorted_within = all(len(g) == 1 for g in groups)
         if side.delta is not None:
             dt = self._scan(side.delta, columns=list(schema.names))
             # Hash on the bucket columns in BUILD order (not join-key
             # order) so delta rows land in the same buckets the index used.
             row_hash = compute_row_hashes(dt, side.scan.bucket_spec[1])
             db = bucket_ids(row_hash, num_buckets, np)
-            order = np.argsort(db, kind="stable")
-            starts = np.searchsorted(db[order], np.arange(num_buckets + 1))
-            for b in range(num_buckets):
-                lo, hi = int(starts[b]), int(starts[b + 1])
-                if hi > lo:
-                    tables[b] = ColumnTable.concat([tables[b], dt.take(order[lo:hi])])
-            presorted = False
-        return tables, presorted
+            all_bucket = np.concatenate(
+                [np.repeat(np.arange(num_buckets, dtype=np.int32), counts), db]
+            )
+            combined = ColumnTable.concat([base, dt])
+            order = np.argsort(all_bucket, kind="stable")
+            counts2 = np.bincount(all_bucket, minlength=num_buckets)
+            offsets = np.concatenate([[0], np.cumsum(counts2)]).astype(np.int64)
+            return SideData(combined.take(order), offsets, False)
+        return SideData(base, offsets, sorted_within)
 
     def _aligned_join(self, plan: Join, left: AlignedSide, right: AlignedSide) -> ColumnTable:
-        """Per-bucket zero-exchange SMJ: read bucket b of each side, join
-        bucket-locally in one vmapped kernel."""
+        """Bucket-aligned zero-exchange SMJ: both sides arrive grouped by
+        the same bucket function, so per-bucket merge joins concatenated
+        equal the global join."""
         num_buckets = left.scan.bucket_spec[0]
-        ltables, lsorted = self._side_tables(left, num_buckets)
-        rtables, rsorted = self._side_tables(right, num_buckets)
-        out = self._partition_join(plan, ltables, rtables, presorted=lsorted and rsorted)
+        lside = self._side_data(left, num_buckets)
+        rside = self._side_data(right, num_buckets)
+        out = self._partition_join(plan, lside, rside)
         cols = None
         if left.project is not None or right.project is not None:
             keep = list(left.project if left.project is not None else left.scan.scan_schema.names)
@@ -255,43 +324,24 @@ class Executor:
             out.append(by_name[name])
         return out
 
-    def _partition_join(
-        self,
-        plan: Join,
-        ltables: list[ColumnTable],
-        rtables: list[ColumnTable],
-        presorted: bool,
-    ) -> ColumnTable:
-        """Join partition i of left with partition i of right, concat."""
-        lkeys = [ltables[0].schema.field(c).name for c in plan.left_on]
-        rkeys = [rtables[0].schema.field(c).name for c in plan.right_on]
+    def _partition_join(self, plan: Join, lside: "SideData", rside: "SideData") -> ColumnTable:
+        """Per-bucket merge join over the concatenated bucket-grouped
+        layout: everything host-side is vectorized (pad-gather in, one
+        repeat+add to globalize match indices, ONE native gather per
+        column out) — no per-bucket Python loop (round 1 weakness #4)."""
+        lt, rt = lside.table, rside.table
+        lkeys = [lt.schema.field(c).name for c in plan.left_on]
+        rkeys = [rt.schema.field(c).name for c in plan.right_on]
 
         # Shared order-preserving factorization of the key tuples.
-        lcodes, rcodes = _factorize_keys(ltables, rtables, lkeys, rkeys)
+        lc, rc = _factorize_keys([lt], [rt], lkeys, rkeys)
+        lcodes, rcodes = lc[0], rc[0]
 
-        b = len(ltables)
-        lmax = max((len(c) for c in lcodes), default=1) or 1
-        rmax = max((len(c) for c in rcodes), default=1) or 1
-        sentinel = join_ops.sentinel_for(np.int32)  # pads sort last
-        lk = np.full((b, lmax), sentinel, dtype=np.int32)
-        rk = np.full((b, rmax), sentinel, dtype=np.int32)
-        lorder = []
-        rorder = []
-        for i in range(b):
-            lo = np.argsort(lcodes[i], kind="stable") if not presorted else np.arange(len(lcodes[i]))
-            ro = np.argsort(rcodes[i], kind="stable") if not presorted else np.arange(len(rcodes[i]))
-            # Even "presorted" index buckets are verified cheaply.
-            lc = lcodes[i][lo]
-            rc = rcodes[i][ro]
-            if presorted and (np.any(np.diff(lc) < 0) or np.any(np.diff(rc) < 0)):
-                lo = np.argsort(lcodes[i], kind="stable")
-                ro = np.argsort(rcodes[i], kind="stable")
-                lc = lcodes[i][lo]
-                rc = rcodes[i][ro]
-            lk[i, : len(lc)] = lc
-            rk[i, : len(rc)] = rc
-            lorder.append(lo)
-            rorder.append(ro)
+        lcodes, lperm = _bucket_sorted_codes(lcodes, lside)
+        rcodes, rperm = _bucket_sorted_codes(rcodes, rside)
+        lk = _pad_bucket_major(lcodes, lside.offsets)
+        rk = _pad_bucket_major(rcodes, rside.offsets)
+        b = lk.shape[0]
 
         if self.mesh is not None:
             from hyperspace_tpu.parallel.mesh import mesh_for_parallelism, mesh_size
@@ -302,37 +352,26 @@ class Executor:
         else:
             li_flat, ri_flat, totals = join_ops.merge_join(lk, rk)
         self.stats["num_buckets"] = b
-        offs = np.concatenate([[0], np.cumsum(totals)]).astype(np.int64)
 
-        # Gather output rows per partition on host (bucket b's matches are
-        # the dense flat range [offs[b], offs[b+1])).
+        # Local (within-bucket) match indices → global row indices.
+        lidx = np.repeat(lside.offsets[:-1], totals) + li_flat
+        ridx = np.repeat(rside.offsets[:-1], totals) + ri_flat
+        if lperm is not None:
+            lidx = lperm[lidx]
+        if rperm is not None:
+            ridx = rperm[ridx]
+
         rkeys_low = {k.lower() for k in rkeys}
-        out_parts: list[ColumnTable] = []
-        out_schema = plan.schema
-        for i in range(b):
-            sl = slice(int(offs[i]), int(offs[i + 1]))
-            lidx = lorder[i][li_flat[sl]]
-            ridx = rorder[i][ri_flat[sl]]
-            lt, rt = ltables[i], rtables[i]
-            cols: dict[str, np.ndarray] = {}
-            dicts: dict[str, np.ndarray] = {}
-            val: dict[str, np.ndarray] = {}
-            for f in lt.schema.fields:
-                cols[f.name] = lt.columns[f.name][lidx]
-                if f.name in lt.dictionaries:
-                    dicts[f.name] = lt.dictionaries[f.name]
-                if f.name in lt.validity:
-                    val[f.name] = lt.validity[f.name][lidx]
-            for f in rt.schema.fields:
-                if f.name.lower() in rkeys_low:
-                    continue
-                cols[f.name] = rt.columns[f.name][ridx]
-                if f.name in rt.dictionaries:
-                    dicts[f.name] = rt.dictionaries[f.name]
-                if f.name in rt.validity:
-                    val[f.name] = rt.validity[f.name][ridx]
-            out_parts.append(ColumnTable(out_schema, cols, dicts, val))
-        return ColumnTable.concat(out_parts)
+        lgather = lt.take(lidx)
+        cols = dict(lgather.columns)
+        dicts = dict(lgather.dictionaries)
+        val = dict(lgather.validity)
+        rnames = [f.name for f in rt.schema.fields if f.name.lower() not in rkeys_low]
+        rgather = rt.select(rnames).take(ridx)
+        cols.update(rgather.columns)
+        dicts.update(rgather.dictionaries)
+        val.update(rgather.validity)
+        return ColumnTable(plan.schema, cols, dicts, val)
 
 
 def _key_null_mask(table: ColumnTable, keys: list[str]) -> np.ndarray | None:

@@ -23,6 +23,86 @@ from hyperspace_tpu.schema import Schema
 
 MANIFEST_NAME = "_index_manifest.json"
 
+# -- decoded-table cache ------------------------------------------------------
+# Index bucket files are read on every query; decoding them once and
+# revalidating by mtime removes the host IO floor from the read hot path
+# (round 1 weakness #4/#5). Entries are treated as immutable by callers.
+# Callers read concurrently from thread pools — all cache state is guarded
+# by one lock (reads/decodes themselves run unlocked).
+import threading
+
+_CACHE_BUDGET = 512 << 20
+_cache: "dict[tuple, tuple[tuple, int, ColumnTable]]" = {}
+_cache_bytes = 0
+_cache_lock = threading.Lock()
+_cache_stats = {"hits": 0, "misses": 0, "miss_files": 0}
+
+
+def set_table_cache_budget(nbytes: int) -> None:
+    global _CACHE_BUDGET
+    with _cache_lock:
+        _CACHE_BUDGET = int(nbytes)
+        _evict_locked()
+
+
+def clear_table_cache() -> None:
+    global _cache_bytes
+    with _cache_lock:
+        _cache.clear()
+        _cache_bytes = 0
+
+
+def table_cache_stats() -> dict:
+    with _cache_lock:
+        return dict(_cache_stats)
+
+
+def _evict_locked() -> None:
+    global _cache_bytes
+    while _cache_bytes > _CACHE_BUDGET and _cache:
+        k = next(iter(_cache))
+        _, nb, _ = _cache.pop(k)
+        _cache_bytes -= nb
+
+
+def _table_nbytes(t: ColumnTable) -> int:
+    total = sum(v.nbytes for v in t.columns.values())
+    total += sum(v.nbytes for v in t.validity.values())
+    for d in t.dictionaries.values():
+        total += sum(len(str(s)) for s in d) + 8 * len(d)
+    return total
+
+
+def read_parquet_cached(files: list[str], columns: list[str] | None = None, schema: Schema | None = None) -> ColumnTable:
+    """read_parquet through the mtime-validated decoded-table cache."""
+    import os
+
+    key = (tuple(files), tuple(columns) if columns is not None else None)
+    try:
+        mtimes = tuple(os.stat(f).st_mtime_ns for f in files)
+    except OSError:
+        return read_parquet(files, columns=columns, schema=schema)
+    with _cache_lock:
+        hit = _cache.get(key)
+        if hit is not None and hit[0] == mtimes:
+            # Re-insert for LRU recency (dict preserves insertion order).
+            _cache[key] = _cache.pop(key)
+            _cache_stats["hits"] += 1
+            return hit[2]
+        _cache_stats["misses"] += 1
+        _cache_stats["miss_files"] += len(files)
+    table = read_parquet(files, columns=columns, schema=schema)
+    nb = _table_nbytes(table)
+    global _cache_bytes
+    with _cache_lock:
+        if nb <= _CACHE_BUDGET // 4:
+            if key in _cache:
+                _cache_bytes -= _cache.pop(key)[1]
+            _cache[key] = (mtimes, nb, table)
+            _cache_bytes += nb
+            _evict_locked()
+    return table
+
 
 def read_parquet(files: list[str], columns: list[str] | None = None, schema: Schema | None = None) -> ColumnTable:
     if not files:

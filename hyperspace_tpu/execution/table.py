@@ -250,8 +250,10 @@ class ColumnTable:
 
     @staticmethod
     def concat(tables: list["ColumnTable"]) -> "ColumnTable":
-        """Concatenate tables with the same schema, re-encoding string
-        columns onto a merged dictionary."""
+        """Concatenate tables with the same schema. String columns merge
+        on the DICTIONARIES (small) and remap codes with one searchsorted
+        per part — never decoding row values (the O(n log n) re-encode was
+        round 1's hot-path weakness #5)."""
         if not tables:
             raise HyperspaceError("cannot concat zero tables")
         if len(tables) == 1:
@@ -262,10 +264,25 @@ class ColumnTable:
         validity: dict[str, np.ndarray] = {}
         for f in schema.fields:
             if f.is_string:
-                decoded = np.concatenate([t.dictionaries[f.name][t.columns[f.name]] for t in tables])
-                dictionary, codes = np.unique(decoded.astype(str), return_inverse=True)
-                cols[f.name] = codes.astype(np.int32)
-                dicts[f.name] = dictionary
+                parts_dicts = [t.dictionaries[f.name] for t in tables]
+                if all(
+                    len(d) == len(parts_dicts[0]) and np.array_equal(d, parts_dicts[0])
+                    for d in parts_dicts[1:]
+                ):
+                    # Identical dictionaries (common: buckets of one index
+                    # version) — codes concatenate directly.
+                    dicts[f.name] = parts_dicts[0]
+                    cols[f.name] = np.concatenate([t.columns[f.name] for t in tables])
+                else:
+                    merged = np.unique(np.concatenate(parts_dicts).astype(str))
+                    remapped = []
+                    for t, d in zip(tables, parts_dicts):
+                        # Old code -> position of its string in the merged
+                        # sorted dictionary (exact: every entry is present).
+                        old_to_new = np.searchsorted(merged, d.astype(str)).astype(np.int32)
+                        remapped.append(old_to_new[t.columns[f.name]] if len(d) else t.columns[f.name])
+                    dicts[f.name] = merged.astype(object)
+                    cols[f.name] = np.concatenate(remapped)
             else:
                 cols[f.name] = np.concatenate([t.columns[f.name] for t in tables])
             if any(f.name in t.validity for t in tables):

@@ -92,16 +92,26 @@ def next_pow2(n: int) -> int:
     return 1 << (int(n - 1).bit_length())
 
 
-@functools.partial(jax.jit, static_argnames=("m_pad", "pack16"))
-def _compact_pairs(li, ri, totals, m_pad: int, pack16: bool):
+def pack_shift(l_len: int, r_len: int) -> int | None:
+    """Bits for the right index when an (li, ri) pair fits one uint32
+    (asymmetric split: ceil(log2 L) + ceil(log2 R) ≤ 32), else None."""
+    bits_l = max(int(l_len - 1).bit_length(), 1)
+    bits_r = max(int(r_len - 1).bit_length(), 1)
+    if bits_l + bits_r <= 32:
+        return bits_r
+    return None
+
+
+@functools.partial(jax.jit, static_argnames=("m_pad", "shift"))
+def _compact_pairs(li, ri, totals, m_pad: int, shift: int | None):
     """[B, cap] padded match pairs → dense bucket-major [m_pad] arrays.
 
     Output position p belongs to bucket b with offs[b] <= p < offs[b+1]
     (valid entries of a bucket are exactly its first totals[b] slots).
     Runs on device so the host downloads ONLY real matches — on tunneled
     TPUs device→host bandwidth dominates the whole join otherwise. With
-    pack16 (both sides' bucket rows < 2^16) the pair downloads as ONE
-    uint32 per match, halving the transfer again."""
+    `shift` set (the two sides' index bits fit 32 together) the pair
+    downloads as ONE uint32 per match, halving the transfer again."""
     num_b, cap = li.shape
     offs = jnp.concatenate(
         [jnp.zeros(1, dtype=jnp.int32), jnp.cumsum(totals).astype(jnp.int32)]
@@ -110,9 +120,16 @@ def _compact_pairs(li, ri, totals, m_pad: int, pack16: bool):
     b = jnp.clip(jnp.searchsorted(offs, p, side="right").astype(jnp.int32) - 1, 0, num_b - 1)
     t = jnp.clip(p - offs[b], 0, cap - 1)
     lf, rf = li[b, t], ri[b, t]
-    if pack16:
-        return (lf.astype(jnp.uint32) << 16) | rf.astype(jnp.uint32)
+    if shift is not None:
+        return (lf.astype(jnp.uint32) << shift) | rf.astype(jnp.uint32)
     return lf, rf
+
+
+def _unpack_pairs(packed: np.ndarray, shift: int):
+    return (
+        (packed >> shift).astype(np.int32),
+        (packed & np.uint32((1 << shift) - 1)).astype(np.int32),
+    )
 
 
 def _rank_codes_to_int32(lkeys_np: np.ndarray, rkeys_np: np.ndarray):
@@ -138,6 +155,26 @@ def _rank_codes_to_int32(lkeys_np: np.ndarray, rkeys_np: np.ndarray):
     return codes[:nl].reshape(lkeys_np.shape), codes[nl:].reshape(rkeys_np.shape)
 
 
+@functools.partial(jax.jit, static_argnames=("cap", "m_pad", "shift"))
+def _fused_join(lk, rk, cap: int, m_pad: int, shift: int | None):
+    """count → expand → compact in ONE program with speculative static
+    capacities, plus an overflow flag. One dispatch, one readback."""
+    start, cum, totals = join_counts(lk, rk)
+    overflow = (jnp.max(totals) > cap) | (jnp.sum(totals) > m_pad)
+    li, ri, _valid = join_expand(start, cum, totals, cap)
+    if shift is not None:
+        out = _compact_pairs(li, ri, totals, m_pad, shift)
+        return out, None, totals, overflow
+    lf, rf = _compact_pairs(li, ri, totals, m_pad, None)
+    return lf, rf, totals, overflow
+
+
+# Speculative (cap, m_pad) per key-array shape: repeated queries over the
+# same index sync ONCE instead of twice (each device_get round-trip costs
+# ~0.3-1s of latency on tunneled TPUs).
+_cap_cache: dict[tuple, tuple[int, int]] = {}
+
+
 def merge_join(lkeys_np: np.ndarray, rkeys_np: np.ndarray):
     """Host wrapper. lkeys_np/rkeys_np: [B, L]/[B, R] sorted int32/int64
     code arrays padded with their dtype's max (sentinel_for). Returns
@@ -147,21 +184,42 @@ def merge_join(lkeys_np: np.ndarray, rkeys_np: np.ndarray):
         lkeys_np, rkeys_np = _rank_codes_to_int32(lkeys_np, rkeys_np)
     lk = jnp.asarray(lkeys_np)
     rk = jnp.asarray(rkeys_np)
+    shift = pack_shift(lkeys_np.shape[1], rkeys_np.shape[1])
+    shape_key = (lkeys_np.shape, rkeys_np.shape, str(lkeys_np.dtype))
+
+    guess = _cap_cache.get(shape_key)
+    if guess is not None:
+        cap, m_pad = guess
+        a, b, totals, overflow = _fused_join(lk, rk, cap, m_pad, shift)
+        if shift is not None:
+            packed, totals_h, ov = jax.device_get((a, totals, overflow))
+            if not bool(ov):
+                total = int(np.asarray(totals_h).sum())
+                li_flat, ri_flat = _unpack_pairs(np.asarray(packed)[:total], shift)
+                return li_flat, ri_flat, np.asarray(totals_h)
+        else:
+            lf, rf, totals_h, ov = jax.device_get((a, b, totals, overflow))
+            if not bool(ov):
+                total = int(np.asarray(totals_h).sum())
+                return (
+                    np.asarray(lf)[:total],
+                    np.asarray(rf)[:total],
+                    np.asarray(totals_h),
+                )
+
+    # Exact two-phase path (first run for this shape, or guess overflowed).
     start, cum, totals = join_counts(lk, rk)
     totals_h = np.asarray(jax.device_get(totals))
     cap = next_pow2(int(totals_h.max()) if totals_h.size else 1)
     li, ri, _valid = join_expand(start, cum, totals, cap)
     total = int(totals_h.sum())
     m_pad = next_pow2(max(total, 1))
-    pack16 = lkeys_np.shape[1] < (1 << 16) and rkeys_np.shape[1] < (1 << 16)
-    if pack16:
-        packed = np.asarray(jax.device_get(_compact_pairs(li, ri, totals, m_pad, True)))[:total]
-        return (
-            (packed >> 16).astype(np.int32),
-            (packed & np.uint32(0xFFFF)).astype(np.int32),
-            totals_h,
-        )
-    li_flat, ri_flat = _compact_pairs(li, ri, totals, m_pad, False)
+    _cap_cache[shape_key] = (cap, m_pad)
+    if shift is not None:
+        packed = np.asarray(jax.device_get(_compact_pairs(li, ri, totals, m_pad, shift)))[:total]
+        li_flat, ri_flat = _unpack_pairs(packed, shift)
+        return li_flat, ri_flat, totals_h
+    li_flat, ri_flat = _compact_pairs(li, ri, totals, m_pad, None)
     return (
         np.asarray(jax.device_get(li_flat))[:total],
         np.asarray(jax.device_get(ri_flat))[:total],
@@ -191,7 +249,7 @@ def _make_sharded_count(mesh: Mesh, axes: tuple):
 
 
 @functools.lru_cache(maxsize=64)
-def _make_sharded_emit(mesh: Mesh, axes: tuple, cap: int, out_cap: int, pack16: bool):
+def _make_sharded_emit(mesh: Mesh, axes: tuple, cap: int, out_cap: int, shift: int | None):
     """Count + expand + compact, all bucket-local per device. Each device
     emits a dense [out_cap] bucket-major segment of its own matches — the
     concatenated segments are the global bucket-major match list. Zero
@@ -212,8 +270,8 @@ def _make_sharded_emit(mesh: Mesh, axes: tuple, cap: int, out_cap: int, pack16: 
         b = jnp.clip(jnp.searchsorted(offs, p, side="right").astype(jnp.int32) - 1, 0, b_loc - 1)
         t = jnp.clip(p - offs[b], 0, cap - 1)
         lf, rf = li[b, t], ri[b, t]
-        if pack16:
-            return ((lf.astype(jnp.uint32) << 16) | rf.astype(jnp.uint32)), totals
+        if shift is not None:
+            return ((lf.astype(jnp.uint32) << shift) | rf.astype(jnp.uint32)), totals
         # Unpacked: stack into one [2, out_cap]-style pair via int64-free
         # encoding — emit two rows packed along dim 0 is not possible with
         # one spec'd output, so interleave (even = left, odd = right).
@@ -244,18 +302,15 @@ def merge_join_sharded(lkeys_np: np.ndarray, rkeys_np: np.ndarray, mesh: Mesh):
     cap = next_pow2(int(totals_h.max()) if totals_h.size else 1)
     seg = totals_h.reshape(d, num_b // d).sum(axis=1)  # per-device match counts
     out_cap = next_pow2(int(seg.max()) if seg.size else 1)
-    pack16 = lkeys_np.shape[1] < (1 << 16) and rkeys_np.shape[1] < (1 << 16)
+    shift = pack_shift(lkeys_np.shape[1], rkeys_np.shape[1])
 
-    out, _totals2 = _make_sharded_emit(mesh, axes, cap, out_cap, pack16)(lk, rk)
+    out, _totals2 = _make_sharded_emit(mesh, axes, cap, out_cap, shift)(lk, rk)
     out_h = np.asarray(jax.device_get(out))
-    if pack16:
+    if shift is not None:
         segs = [out_h[i * out_cap : i * out_cap + int(seg[i])] for i in range(d)]
         packed = np.concatenate(segs) if segs else out_h[:0]
-        return (
-            (packed >> 16).astype(np.int32),
-            (packed & np.uint32(0xFFFF)).astype(np.int32),
-            totals_h,
-        )
+        li_flat, ri_flat = _unpack_pairs(packed, shift)
+        return li_flat, ri_flat, totals_h
     stride = 2 * out_cap
     li_parts, ri_parts = [], []
     for i in range(d):

@@ -1,0 +1,59 @@
+"""Projection pushdown: read only the columns a query needs.
+
+The analog of Spark's ColumnPruning + Parquet column projection, which
+the reference inherits for free from its host engine (SURVEY.md §2.2,
+FileSourceScanExec vectorized read). Without it every scan decodes the
+full table width — on real TPC-H schemas that means dictionary-encoding
+6M comment strings to answer a 3-column query. The pass rewrites each
+Scan's `scan_schema` to the subset of columns required by its ancestors
+(projections, predicate references, join keys); the executor then feeds
+the pruned schema straight into the parquet column projection.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from hyperspace_tpu.plan.nodes import Filter, Join, LogicalPlan, Project, Scan, Union
+
+
+def prune_columns(plan: LogicalPlan, needed: set[str] | None = None) -> LogicalPlan:
+    """Rewrite `plan` so every Scan reads only columns in `needed`
+    (lowercase names; None = all columns are required)."""
+    if isinstance(plan, Scan):
+        if needed is None:
+            return plan
+        cols = [c for c in plan.scan_schema.names if c.lower() in needed]
+        if len(cols) == len(plan.scan_schema.names):
+            return plan
+        return dataclasses.replace(plan, scan_schema=plan.scan_schema.select(cols))
+    if isinstance(plan, Project):
+        # Inner projections narrow to what ancestors need (the top-level
+        # call has needed=None, so the user-visible schema never changes);
+        # narrowing keeps Union branches consistently aligned.
+        if needed is None:
+            keep = list(plan.columns)
+        else:
+            keep = [c for c in plan.columns if c.lower() in needed]
+        child_needed = {c.lower() for c in keep}
+        return Project(prune_columns(plan.child, child_needed), keep)
+    if isinstance(plan, Filter):
+        if needed is None:
+            child_needed = None
+        else:
+            child_needed = set(needed) | {c.lower() for c in plan.predicate.references()}
+        return Filter(prune_columns(plan.child, child_needed), plan.predicate)
+    if isinstance(plan, Join):
+        if needed is None:
+            lneed = rneed = None
+        else:
+            lneed = {c.lower() for c in plan.left.schema.names if c.lower() in needed}
+            lneed |= {c.lower() for c in plan.left_on}
+            rneed = {c.lower() for c in plan.right.schema.names if c.lower() in needed}
+            rneed |= {c.lower() for c in plan.right_on}
+        return dataclasses.replace(
+            plan, left=prune_columns(plan.left, lneed), right=prune_columns(plan.right, rneed)
+        )
+    if isinstance(plan, Union):
+        return Union([prune_columns(c, needed) for c in plan.inputs])
+    return plan

@@ -215,7 +215,9 @@ def test_merge_join_sharded_matches_single_device():
 
     mesh = make_mesh()
     rng = np.random.default_rng(7)
-    for L, R in [(64, 96), (1 << 16, 128)]:  # second: disables pack16
+    # Second case: 17+16 index bits > 32 forces the UNPACKED (interleaved)
+    # sharded output path.
+    for L, R in [(64, 96), (1 << 17, 1 << 16)]:
         B = 16
         s = join_ops.sentinel_for(np.int32)
         lk = np.full((B, L), s, np.int32)

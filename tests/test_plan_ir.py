@@ -83,3 +83,29 @@ def test_schema_propagation_and_linearity():
 def test_join_key_arity_checked():
     with pytest.raises(ValueError):
         Join(scan(), scan(), ["a", "b"], ["a"])
+
+
+def test_projection_pushdown_prunes_scan_columns():
+    """prune_columns must narrow Scan schemas to what ancestors need
+    (project cols + predicate refs + join keys) without changing the
+    user-visible output schema."""
+    from hyperspace_tpu.plan.prune import prune_columns
+    from hyperspace_tpu.plan.nodes import Scan, Filter, Project, Join
+    from hyperspace_tpu.plan.expr import col
+    from hyperspace_tpu.schema import Schema, Field
+
+    sch = Schema([Field("a", "int64"), Field("b", "float64"), Field("c", "string"), Field("d", "int64")])
+    scan = Scan(root="/x", format="parquet", scan_schema=sch, files=None, bucket_spec=None)
+    plan = scan.filter(col("b") > 1.0).select("a")
+    pruned = prune_columns(plan)
+    leaf = pruned.child.child
+    assert leaf.scan_schema.names == ["a", "b"]  # predicate ref kept, c/d dropped
+    assert pruned.schema.names == ["a"]
+
+    sch2 = Schema([Field("a", "int64"), Field("x", "string")])
+    scan2 = Scan(root="/y", format="parquet", scan_schema=sch2, files=None, bucket_spec=None)
+    j = scan.select("a", "b").join(scan2.select("a", "x"), ["a"]).select("b")
+    pj = prune_columns(j)
+    leaves = pj.leaves()
+    assert leaves[0].scan_schema.names == ["a", "b"]
+    assert leaves[1].scan_schema.names == ["a"]  # join key only; x dropped
