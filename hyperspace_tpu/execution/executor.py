@@ -30,8 +30,18 @@ from hyperspace_tpu.dataset import list_data_files
 from hyperspace_tpu.ops.filter import apply_filter
 from hyperspace_tpu.ops.hashing import bucket_ids
 from hyperspace_tpu.ops import join as join_ops
-from hyperspace_tpu.plan.expr import BinOp, Col, Expr, Lit, split_conjuncts
-from hyperspace_tpu.plan.nodes import Filter, Join, LogicalPlan, Project, Scan, Union
+from hyperspace_tpu.plan.expr import BinOp, Col, Expr, Lit, evaluate, split_conjuncts
+from hyperspace_tpu.plan.nodes import (
+    Aggregate,
+    Filter,
+    Join,
+    Limit,
+    LogicalPlan,
+    Project,
+    Scan,
+    Sort,
+    Union,
+)
 
 
 @dataclasses.dataclass
@@ -72,17 +82,23 @@ def _bucket_sorted_codes(codes: np.ndarray, side: SideData):
     return codes[perm], perm
 
 
-def _pad_bucket_major(codes: np.ndarray, offsets: np.ndarray) -> np.ndarray:
-    """[n] bucket-grouped codes → [B, Lmax] padded array (pads carry the
-    dtype's max so they sort last), built with one vectorized gather."""
+def _pad_bucket_major(
+    codes: np.ndarray,
+    offsets: np.ndarray,
+    fill=None,
+    width: int | None = None,
+) -> np.ndarray:
+    """[n] bucket-grouped values → [B, L] padded array, built with one
+    vectorized gather. Default fill is the dtype's sort-last sentinel
+    (key codes); value channels pass an explicit fill and width."""
     counts = np.diff(offsets)
     b = len(counts)
-    lmax = max(int(counts.max()) if counts.size else 1, 1)
-    idx = offsets[:-1, None] + np.arange(lmax, dtype=np.int64)[None, :]
-    mask = np.arange(lmax)[None, :] < counts[:, None]
-    sentinel = join_ops.sentinel_for(codes.dtype)
+    lmax = width if width is not None else max(int(counts.max()) if counts.size else 1, 1)
+    sentinel = join_ops.sentinel_for(codes.dtype) if fill is None else fill
     if len(codes) == 0:
         return np.full((b, lmax), sentinel, dtype=codes.dtype)
+    idx = offsets[:-1, None] + np.arange(lmax, dtype=np.int64)[None, :]
+    mask = np.arange(lmax)[None, :] < counts[:, None]
     return np.where(mask, codes[np.minimum(idx, len(codes) - 1)], sentinel)
 
 
@@ -104,6 +120,7 @@ class Executor:
             "join_path": None,
             "join_devices": 1,
             "num_buckets": None,
+            "agg_path": None,
         }
 
     def execute(self, plan: LogicalPlan) -> ColumnTable:
@@ -122,7 +139,33 @@ class Executor:
             return self._join(plan)
         if isinstance(plan, Union):
             return self._union(plan)
+        if isinstance(plan, Aggregate):
+            return self._aggregate(plan)
+        if isinstance(plan, Sort):
+            return self._sort(plan)
+        if isinstance(plan, Limit):
+            t = self._execute(plan.child)
+            return t.take(np.arange(min(plan.n, t.num_rows)))
         raise HyperspaceError(f"cannot execute plan node {type(plan).__name__}")
+
+    # -- aggregate / sort -------------------------------------------------
+    def _aggregate(self, plan: "Aggregate") -> ColumnTable:
+        from hyperspace_tpu.ops.aggregate import aggregate_table
+
+        fused = self._try_fused_join_aggregate(plan)
+        if fused is not None:
+            return fused
+        table = self._execute(plan.child)
+        self.stats["agg_path"] = "segment-reduce"
+        return aggregate_table(table, plan.group_by, plan.aggs, plan.schema)
+
+    def _sort(self, plan: "Sort") -> ColumnTable:
+        from hyperspace_tpu.ops.sortkeys import device_order_perm
+
+        table = self._execute(plan.child)
+        if table.num_rows <= 1:
+            return table
+        return table.take(device_order_perm(table, plan.by))
 
     # -- union (hybrid scan) ----------------------------------------------
     def _union(self, plan: Union) -> ColumnTable:
@@ -214,6 +257,18 @@ class Executor:
 
     # -- join ------------------------------------------------------------
     def _join(self, plan: Join) -> ColumnTable:
+        lside, rside, left_side, right_side = self._join_sides(plan)
+        if left_side is not None:
+            return self._aligned_join(plan, left_side, right_side, lside, rside)
+        return self._partition_join(plan, lside, rside)
+
+    def _join_sides(
+        self, plan: Join
+    ) -> tuple["SideData", "SideData", AlignedSide | None, AlignedSide | None]:
+        """Per-side bucket data for a join — the one place that decides
+        between the zero-exchange aligned path (both sides bucketed with
+        equal counts on the join keys) and the single-partition fallback.
+        Returns the AlignedSides (None, None) on the fallback."""
         left_side = self._aligned_side(plan.left)
         right_side = self._aligned_side(plan.right)
         if (
@@ -226,13 +281,19 @@ class Executor:
             and [c.lower() for c in right_side.scan.bucket_spec[1]] == [c.lower() for c in plan.right_on]
         ):
             self.stats["join_path"] = "zero-exchange-aligned"
-            return self._aligned_join(plan, left_side, right_side)
+            num_buckets = left_side.scan.bucket_spec[0]
+            return (
+                self._side_data(left_side, num_buckets),
+                self._side_data(right_side, num_buckets),
+                left_side,
+                right_side,
+            )
         # General path: single partition (bucket count 1).
         self.stats["join_path"] = "single-partition"
         lt = self._execute(plan.left)
         rt = self._execute(plan.right)
         one = lambda t: SideData(t, np.array([0, t.num_rows], dtype=np.int64), False)  # noqa: E731
-        return self._partition_join(plan, one(lt), one(rt))
+        return one(lt), one(rt), None, None
 
     def _aligned_side(self, plan: LogicalPlan) -> AlignedSide | None:
         node, project = plan, None
@@ -290,13 +351,17 @@ class Executor:
             return SideData(combined.take(order), offsets, False)
         return SideData(base, offsets, sorted_within)
 
-    def _aligned_join(self, plan: Join, left: AlignedSide, right: AlignedSide) -> ColumnTable:
+    def _aligned_join(
+        self,
+        plan: Join,
+        left: AlignedSide,
+        right: AlignedSide,
+        lside: "SideData",
+        rside: "SideData",
+    ) -> ColumnTable:
         """Bucket-aligned zero-exchange SMJ: both sides arrive grouped by
         the same bucket function, so per-bucket merge joins concatenated
         equal the global join."""
-        num_buckets = left.scan.bucket_spec[0]
-        lside = self._side_data(left, num_buckets)
-        rside = self._side_data(right, num_buckets)
         out = self._partition_join(plan, lside, rside)
         cols = None
         if left.project is not None or right.project is not None:
@@ -323,6 +388,164 @@ class Executor:
                 raise HyperspaceError(f"missing bucket file {name} in {scan.root}")
             out.append(by_name[name])
         return out
+
+    # -- fused join + aggregation ----------------------------------------
+    def _try_fused_join_aggregate(self, plan: Aggregate) -> ColumnTable | None:
+        """Aggregate(Join) without materializing the joined pairs
+        (ops/join_agg.py). Applies when every aggregate is sum/count/mean
+        over a single side's numeric expression and the grouping columns
+        (if any) come from one side; min/max and cross-side expressions
+        fall back to the materialized join."""
+        from hyperspace_tpu.ops.aggregate import agg_input, group_ids
+        from hyperspace_tpu.ops.join_agg import fused_join_aggregate
+
+        child = plan.child
+        if isinstance(child, Project):
+            child = child.child
+        if not isinstance(child, Join) or child.how != "inner":
+            return None
+        join = child
+        lnames = {n.lower() for n in join.left.schema.names}
+        rnames = {n.lower() for n in join.right.schema.names}
+
+        def side_of(cols) -> str | None:
+            cl = {c.lower() for c in cols}
+            if cl and cl <= lnames:
+                return "left"
+            if cl and cl <= rnames:
+                return "right"
+            return None
+
+        gside = None
+        if plan.group_by:
+            gside = side_of(plan.group_by)
+            if gside is None:
+                return None
+        spec_sides: list[str | None] = []
+        for a in plan.aggs:
+            if a.fn not in ("sum", "count", "mean"):
+                return None
+            if a.expr is None:
+                spec_sides.append(None)  # count(*)
+                continue
+            refs = a.references()
+            # Constant expressions (sum(lit(2))) and cross-side expressions
+            # have no single owning side — use the materialized join.
+            s = side_of(refs)
+            if s is None:
+                return None
+            sch = join.left.schema if s == "left" else join.right.schema
+            if any(sch.field(r).is_string or sch.field(r).is_vector for r in refs):
+                return None
+            spec_sides.append(s)
+        primary = gside or "left"
+
+        lside, rside, _, _ = self._join_sides(join)
+        data = {"left": lside, "right": rside}
+        self.stats["agg_path"] = "fused-join-agg"
+        self.stats["num_buckets"] = len(data["left"].offsets) - 1
+
+        lkeys = [data["left"].table.schema.field(c).name for c in join.left_on]
+        rkeys = [data["right"].table.schema.field(c).name for c in join.right_on]
+        lc, rc = _factorize_keys([data["left"].table], [data["right"].table], lkeys, rkeys)
+        codes = {}
+        perms = {}
+        codes["left"], perms["left"] = _bucket_sorted_codes(lc[0], data["left"])
+        codes["right"], perms["right"] = _bucket_sorted_codes(rc[0], data["right"])
+        secondary = "right" if primary == "left" else "left"
+        pk = _pad_bucket_major(codes[primary], data[primary].offsets)
+        sk = _pad_bucket_major(codes[secondary], data[secondary].offsets)
+        b, lp = pk.shape
+        ls = sk.shape[1]
+
+        # Group ids on the primary table (original row order) → sorted+padded.
+        gid_orig, k, first_idx = group_ids(data[primary].table, plan.group_by)
+        if k == 0:  # empty primary side
+            if plan.group_by:
+                return ColumnTable.empty(plan.schema)
+            k, gid_orig, first_idx = 1, np.zeros(0, np.int64), np.zeros(0, np.int64)
+
+        def pad_rows(side: str, vals: np.ndarray, fill=0.0) -> np.ndarray:
+            """Per-orig-row values of `side` → bucket-sorted padded [B, L]."""
+            v = np.asarray(vals, np.float64)
+            if perms[side] is not None:
+                v = v[perms[side]]
+            width = lp if side == primary else ls
+            return _pad_bucket_major(v, data[side].offsets, fill=fill, width=width)
+
+        # pad_rows reorders by perm internally — pass the ORIGINAL-order gid;
+        # pads carry group id k (the dead segment).
+        gid_pad = pad_rows(primary, gid_orig, fill=float(k)).astype(np.int32)
+
+        channels: list[tuple] = [("star",)]
+        p_arrays: list[np.ndarray] = []
+        s_arrays: list[np.ndarray] = []
+
+        def add_channel(side: str, padded: np.ndarray) -> int:
+            if side == primary:
+                p_arrays.append(padded)
+                channels.append(("p", len(p_arrays) - 1))
+            else:
+                s_arrays.append(padded)
+                channels.append(("s", len(s_arrays) - 1))
+            return len(channels) - 1
+
+        spec_layout: list[tuple[int | None, int]] = []  # (value ch, count ch; 0=star)
+        for spec, s in zip(plan.aggs, spec_sides):
+            if s is None:  # count(*)
+                spec_layout.append((None, 0))
+                continue
+            tbl = data[s].table
+            # Same null semantics as the plain aggregate path (ops/aggregate).
+            vals, valid, _ = agg_input(tbl, spec)
+            vals = np.asarray(vals, dtype=np.float64)
+            if valid is not None:
+                vals = np.where(valid, vals, 0.0)
+            ind = np.ones(tbl.num_rows, np.float64) if valid is None else valid.astype(np.float64)
+            vi = None
+            if spec.fn in ("sum", "mean"):
+                vi = add_channel(s, pad_rows(s, vals))
+            ci = add_channel(s, pad_rows(s, ind))
+            spec_layout.append((vi, ci))
+
+        pvals = np.stack(p_arrays) if p_arrays else np.zeros((0, b, lp))
+        svals = np.stack(s_arrays) if s_arrays else np.zeros((0, b, ls))
+        out = fused_join_aggregate(pk, sk, pvals, svals, gid_pad, k, tuple(channels))
+        star = out[0]
+
+        keep = star > 0 if plan.group_by else np.ones(k, bool)
+        out_schema = plan.schema
+        cols: dict[str, np.ndarray] = {}
+        dicts: dict[str, np.ndarray] = {}
+        validity: dict[str, np.ndarray] = {}
+        ptable = data[primary].table
+        # first_idx may be empty when the primary side has no rows but a
+        # global (no group_by) aggregate still emits its one k=1 row.
+        kept_first = first_idx[keep[: len(first_idx)]]
+        for c in plan.group_by:
+            f = ptable.schema.field(c)
+            out_f = out_schema.field(c)
+            cols[out_f.name] = ptable.columns[f.name][kept_first]
+            if f.name in ptable.dictionaries:
+                dicts[out_f.name] = ptable.dictionaries[f.name]
+            gv = ptable.valid_mask(c)
+            if gv is not None:
+                validity[out_f.name] = gv[kept_first]
+        for spec, (vi, ci) in zip(plan.aggs, spec_layout):
+            out_f = out_schema.field(spec.alias)
+            cnt = out[ci][keep]
+            if spec.fn == "count":
+                cols[out_f.name] = cnt.astype(np.int64)
+                continue
+            val = out[vi][keep]
+            if spec.fn == "mean":
+                with np.errstate(invalid="ignore", divide="ignore"):
+                    val = val / cnt
+            empty = cnt == 0
+            cols[out_f.name] = np.where(empty, 0, np.where(np.isfinite(val), val, 0)).astype(out_f.device_dtype)
+            if empty.any():
+                validity[out_f.name] = ~empty
+        return ColumnTable(out_schema, cols, dicts, validity)
 
     def _partition_join(self, plan: Join, lside: "SideData", rside: "SideData") -> ColumnTable:
         """Per-bucket merge join over the concatenated bucket-grouped

@@ -32,6 +32,15 @@ def _node_label(plan: LogicalPlan) -> str:
         return f"Join on {list(zip(plan.left_on, plan.right_on))}"
     if isinstance(plan, Union):
         return "HybridScanUnion"
+    from hyperspace_tpu.plan.nodes import Aggregate, Limit, Sort
+
+    if isinstance(plan, Aggregate):
+        aggs = [f"{a.fn}({a.alias})" for a in plan.aggs]
+        return f"Aggregate groupBy={plan.group_by} aggs={aggs}"
+    if isinstance(plan, Sort):
+        return f"Sort by={plan.by}"
+    if isinstance(plan, Limit):
+        return f"Limit {plan.n}"
     return type(plan).__name__
 
 
@@ -117,6 +126,8 @@ def explain_string(
     if mode is None:
         mode = display_mode_from_conf(getattr(session, "conf", None))
 
+    from hyperspace_tpu.plan.prune import prune_columns
+
     was_enabled = session.is_hyperspace_enabled()
     try:
         session.enable_hyperspace()
@@ -125,6 +136,9 @@ def explain_string(
         if not was_enabled:
             session.disable_hyperspace()
 
+    # Diff against the column-pruned baseline: pruning runs on BOTH sides
+    # (it is not an index effect), so highlights show only index rewrites.
+    plan = prune_columns(plan)
     marked_before: set = set()
     marked_after: set = set()
     _mark_diff(plan, with_plan, marked_before, marked_after)

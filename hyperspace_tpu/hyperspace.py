@@ -75,8 +75,14 @@ class HyperspaceSession:
     def optimized_plan(self, plan: LogicalPlan) -> LogicalPlan:
         if not self._enabled:
             return plan
+        from hyperspace_tpu.plan.prune import prune_columns
+
+        # Column pruning FIRST (the analog of Spark running ColumnPruning
+        # before the extraOptimizations batch): a scan narrowed to what the
+        # query needs lets an index cover e.g. Aggregate(Filter(Scan))
+        # shapes whose full source width it could not.
         indexes = self.manager.get_indexes()
-        return apply_rules(plan, indexes, conf=self.conf)
+        return apply_rules(prune_columns(plan), indexes, conf=self.conf)
 
     def run(self, plan: LogicalPlan):
         """Execute a plan (rewriting through indexes when enabled);

@@ -1,0 +1,215 @@
+"""Grouped aggregation on device.
+
+One of the engine-side operators the reference left to Spark
+(SURVEY.md §2.2 — HashAggregateExec inside WholeStageCodegen); the TPU
+build owns it. Group identity is factorized on host (tiny), the
+reduction runs as one jitted segment-reduce on device, and only the
+K-sized per-group results come back — aggregation queries never pay the
+match/row readback that dominates tunneled-TPU transfers.
+
+SQL semantics: null inputs are ignored by sum/min/max/mean and count(col);
+count(*) counts rows; a group whose inputs are all null yields NULL
+(validity mask); null group keys form their own group.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu.execution.table import ColumnTable
+from hyperspace_tpu.plan.expr import Col, evaluate
+from hyperspace_tpu.schema import Schema
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(int(n - 1).bit_length(), 0) if n > 1 else 1
+
+
+@functools.partial(jax.jit, static_argnames=("num_segments", "fns"))
+def _segment_reduce_many(vals, gid, num_segments: int, fns: tuple):
+    """One device program reducing several (value, fn) pairs over shared
+    segment ids. vals: [A, n_pad]; returns [A, num_segments]."""
+    outs = []
+    for i, fn in enumerate(fns):
+        v = vals[i]
+        if fn == "sum":
+            outs.append(jax.ops.segment_sum(v, gid, num_segments))
+        elif fn == "min":
+            outs.append(jax.ops.segment_min(v, gid, num_segments))
+        elif fn == "max":
+            outs.append(jax.ops.segment_max(v, gid, num_segments))
+        else:
+            raise ValueError(fn)
+    return jnp.stack(outs)
+
+
+def group_ids(table: ColumnTable, group_by: list[str]):
+    """Host factorization of the group-key tuples. Returns
+    (gid [n] int64, K, first_idx [K] — first row of each group)."""
+    n = table.num_rows
+    if not group_by:
+        return np.zeros(n, np.int64), 1, np.zeros(1 if n else 0, np.int64)
+    per = []
+    for c in group_by:
+        f = table.schema.field(c)
+        arr = table.columns[f.name]
+        if arr.ndim != 1:
+            raise HyperspaceError(f"cannot group by vector column {c!r}")
+        _, inv = np.unique(arr, return_inverse=True)
+        inv = inv.astype(np.int64) + 1
+        valid = table.valid_mask(c)
+        if valid is not None:
+            inv[~valid] = 0  # SQL: null keys form one group
+        per.append(inv)
+    stacked = np.stack(per, axis=1)
+    _, first_idx, gid = np.unique(
+        stacked, axis=0, return_index=True, return_inverse=True
+    )
+    return gid.reshape(-1).astype(np.int64), len(first_idx), first_idx.astype(np.int64)
+
+
+def agg_input(table: ColumnTable, spec) -> tuple[np.ndarray, np.ndarray | None, bool]:
+    """(values, valid mask or None, is_string_codes) for one AggSpec."""
+    if spec.expr is None:  # count(*)
+        return np.ones(table.num_rows, np.int64), None, False
+    refs = list(spec.expr.references())
+    valid = None
+    for r in refs:
+        v = table.valid_mask(r)
+        if v is not None:
+            valid = v if valid is None else (valid & v)
+    if isinstance(spec.expr, Col):
+        f = table.schema.field(spec.expr.name)
+        if f.is_string:
+            if spec.fn not in ("min", "max", "count"):
+                raise HyperspaceError(f"{spec.fn} over string column {f.name!r}")
+            return table.columns[f.name], valid, True
+        return table.columns[f.name], valid, False
+    for r in refs:
+        if table.schema.field(r).is_string:
+            raise HyperspaceError(f"aggregate expression over string column {r!r}")
+    vals = np.asarray(
+        evaluate(spec.expr, lambda name: table.columns[table.schema.field(name).name], np)
+    )
+    if vals.ndim == 0:  # constant expression, e.g. sum(lit(2))
+        vals = np.full(table.num_rows, vals)
+    return vals, valid, False
+
+
+def aggregate_arrays(
+    inputs: list[tuple[np.ndarray, np.ndarray | None, str]],
+    gid: np.ndarray,
+    num_groups: int,
+):
+    """Device segment-reduce of (values, valid, fn) triples sharing group
+    ids. fn ∈ sum/min/max (count/mean are composed by the caller).
+    Returns (results [A, K] float64-ish np arrays, counts [A, K])."""
+    n = len(gid)
+    n_pad = _pow2(max(n, 1))
+    k_seg = _pow2(num_groups + 1)  # +1 dead segment for pads
+    gid_p = np.full(n_pad, num_groups, np.int32)
+    gid_p[:n] = gid
+    fns: list[str] = []
+    vals_list: list[np.ndarray] = []
+    for vals, valid, fn in inputs:
+        v = np.asarray(vals, dtype=np.float64)
+        if fn == "sum":
+            if valid is not None:
+                v = np.where(valid, v, 0.0)
+        elif fn == "min":
+            v = np.where(valid, v, np.inf) if valid is not None else v
+        elif fn == "max":
+            v = np.where(valid, v, -np.inf) if valid is not None else v
+        vals_list.append(np.pad(v, (0, n_pad - n)) if fn == "sum" else _pad_const(v, n_pad, fn))
+        fns.append(fn)
+        # Every input also gets a non-null count (for mean/null results).
+        cnt = np.ones(n, np.float64) if valid is None else valid.astype(np.float64)
+        vals_list.append(np.pad(cnt, (0, n_pad - n)))
+        fns.append("sum")
+    stacked = np.stack(vals_list)
+    # 53-bit accumulation on the persistent x64 worker thread — the
+    # process-wide flag is never touched (round 1 weakness #8).
+    from hyperspace_tpu.parallel.x64 import run_x64
+
+    out = np.asarray(
+        run_x64(
+            lambda: jax.device_get(
+                _segment_reduce_many(jnp.asarray(stacked), jnp.asarray(gid_p), k_seg, tuple(fns))
+            )
+        )
+    )[:, :num_groups]
+    results = out[0::2]
+    counts = out[1::2]
+    return results, counts
+
+
+def _pad_const(v: np.ndarray, n_pad: int, fn: str) -> np.ndarray:
+    fill = np.inf if fn == "min" else -np.inf
+    out = np.full(n_pad, fill, np.float64)
+    out[: len(v)] = v
+    return out
+
+
+def aggregate_table(
+    table: ColumnTable, group_by: list[str], aggs: list, out_schema: Schema
+) -> ColumnTable:
+    """Execute a grouped aggregation over a materialized table."""
+    gid, k, first_idx = group_ids(table, group_by)
+
+    inputs = []
+    string_dicts: dict[int, np.ndarray] = {}
+    for i, spec in enumerate(aggs):
+        vals, valid, is_str = agg_input(table, spec)
+        if is_str:
+            string_dicts[i] = table.dictionaries[table.schema.field(spec.expr.name).name]
+        fn = {"count": "sum", "mean": "sum"}.get(spec.fn, spec.fn)
+        if spec.fn == "count":
+            vals = np.ones(table.num_rows, np.float64) if valid is None else valid.astype(np.float64)
+            valid = None
+        inputs.append((vals, valid, fn))
+
+    if k == 0:
+        return ColumnTable.empty(out_schema)
+    results, counts = aggregate_arrays(inputs, gid, k)
+
+    cols: dict[str, np.ndarray] = {}
+    dicts: dict[str, np.ndarray] = {}
+    validity: dict[str, np.ndarray] = {}
+    for c in group_by:
+        f = table.schema.field(c)
+        out_f = out_schema.field(c)
+        cols[out_f.name] = table.columns[f.name][first_idx]
+        if f.name in table.dictionaries:
+            dicts[out_f.name] = table.dictionaries[f.name]
+        gv = table.valid_mask(c)
+        if gv is not None:
+            validity[out_f.name] = gv[first_idx]
+    for i, spec in enumerate(aggs):
+        out_f = out_schema.field(spec.alias)
+        res, cnt = results[i], counts[i]
+        if spec.fn == "count":
+            cols[out_f.name] = res.astype(np.int64)
+            continue
+        if spec.fn == "mean":
+            with np.errstate(invalid="ignore", divide="ignore"):
+                vals = res / cnt
+        else:
+            vals = res
+        empty = cnt == 0  # all inputs null ⇒ NULL result
+        if i in string_dicts:
+            codes = np.where(empty, 0, vals).astype(np.int32)
+            cols[out_f.name] = codes
+            dicts[out_f.name] = string_dicts[i]
+        else:
+            dt = out_f.device_dtype
+            safe = np.where(empty, 0, np.where(np.isfinite(vals), vals, 0))
+            cols[out_f.name] = safe.astype(dt)
+        if empty.any():
+            validity[out_f.name] = ~empty
+    return ColumnTable(out_schema, cols, dicts, validity)

@@ -111,6 +111,50 @@ def lexsort_lanes(lanes: list[np.ndarray]) -> np.ndarray:
     return np.lexsort(tuple(reversed(lanes)))
 
 
+def invert_lane(lane: np.ndarray) -> np.ndarray:
+    """Order-reversing bijection on a lane (~x flips both int32 signed
+    order and uint32 unsigned order) — implements DESC sort keys. A
+    flipped validity lane also lands nulls last, matching SQL's
+    nulls-first-ASC / nulls-last-DESC convention."""
+    return ~lane
+
+
+def order_lanes(table, by: list[tuple[str, bool]]) -> list[np.ndarray]:
+    """Lanes for an ORDER BY (column, ascending) list."""
+    out: list[np.ndarray] = []
+    for c, asc in by:
+        lanes = column_lanes(table, c, force_validity=True)
+        if not asc:
+            lanes = [invert_lane(l) for l in lanes]
+        out.extend(lanes)
+    return out
+
+
+def device_order_perm(table, by: list[tuple[str, bool]]) -> np.ndarray:
+    """Stable permutation ordering `table` by the (column, ascending)
+    keys — one device lax.sort over the decomposed lanes."""
+    import jax
+    import jax.numpy as jnp
+
+    n = table.num_rows
+    if n <= 1:
+        return np.arange(n)
+    lanes = order_lanes(table, by)
+    l_pad = 1 << (int(n - 1).bit_length())
+    is_pad = np.zeros((1, l_pad), np.int32)
+    is_pad[0, n:] = 1
+    ops = [jnp.asarray(is_pad)]
+    for l in lanes:
+        buf = np.zeros((1, l_pad), l.dtype)
+        buf[0, :n] = l
+        ops.append(jnp.asarray(buf))
+    iota = np.arange(l_pad, dtype=np.int32)[None, :]
+    ops.append(jnp.asarray(iota))
+    fn = _make_batch_sort(len(ops), 1 + len(lanes))
+    perm = np.asarray(jax.device_get(fn(*ops)))
+    return perm[0, :n]
+
+
 @functools.lru_cache(maxsize=32)
 def _make_batch_sort(num_operands: int, num_keys: int):
     import jax

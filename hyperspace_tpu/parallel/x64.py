@@ -1,0 +1,37 @@
+"""Scoped 64-bit device compute without global flag flips.
+
+Aggregation sums need 53-bit accumulation, but `jax_enable_x64` is
+process-wide poison (round 1 weakness #8) and re-entering the
+`jax.enable_x64(True)` context around every call invalidates the jit
+executable cache — each query would re-lower a multi-second program.
+
+JAX config contexts are THREAD-LOCAL, so all f64 device work runs on one
+dedicated worker thread that enters the context once and never leaves it.
+Every other thread keeps 32-bit-native semantics; the executable cache
+stays warm across queries.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+_pool: ThreadPoolExecutor | None = None
+_pool_lock = threading.Lock()
+
+
+def _enter_x64() -> None:
+    import jax
+
+    ctx = jax.enable_x64(True)
+    ctx.__enter__()  # intentionally never exited: thread-local scope
+
+
+def run_x64(fn, /, *args, **kwargs):
+    """Run `fn` on the persistent x64 worker thread and return its result."""
+    global _pool
+    if _pool is None:
+        with _pool_lock:
+            if _pool is None:
+                _pool = ThreadPoolExecutor(max_workers=1, initializer=_enter_x64)
+    return _pool.submit(fn, *args, **kwargs).result()

@@ -35,6 +35,26 @@ class LogicalPlan:
     def join(self, other: "LogicalPlan", left_on: list[str], right_on: list[str] | None = None) -> "Join":
         return Join(self, other, list(left_on), list(right_on or left_on))
 
+    def aggregate(self, group_by: list[str], aggs: list) -> "Aggregate":
+        """Grouped aggregation. `aggs` entries are AggSpec or
+        (fn, expr|column|None, alias) tuples; fn ∈ sum/count/min/max/mean."""
+        specs = [a if isinstance(a, AggSpec) else AggSpec.of(*a) for a in aggs]
+        return Aggregate(self, list(group_by), specs)
+
+    def sort(self, by: list, ascending: bool | list[bool] = True) -> "Sort":
+        """Order by columns. `by` entries are names or (name, asc) pairs."""
+        keys = []
+        asc_list = ascending if isinstance(ascending, list) else [ascending] * len(by)
+        for b, a in zip(by, asc_list):
+            if isinstance(b, tuple):
+                keys.append((b[0], bool(b[1])))
+            else:
+                keys.append((b, bool(a)))
+        return Sort(self, keys)
+
+    def limit(self, n: int) -> "Limit":
+        return Limit(self, int(n))
+
     # -- interface --------------------------------------------------------
     @property
     def schema(self) -> Schema:
@@ -208,6 +228,142 @@ class Join(LogicalPlan):
         }
 
 
+@dataclasses.dataclass
+class AggSpec:
+    """One aggregation: fn over an expression (None = count(*))."""
+
+    fn: str  # sum | count | min | max | mean
+    expr: Expr | None
+    alias: str
+
+    _FNS = ("sum", "count", "min", "max", "mean")
+
+    def __post_init__(self):
+        if self.fn not in self._FNS:
+            raise ValueError(f"unknown aggregate fn {self.fn!r}")
+        if self.expr is None and self.fn != "count":
+            raise ValueError(f"{self.fn} requires an input expression")
+
+    @staticmethod
+    def of(fn: str, expr=None, alias: str | None = None) -> "AggSpec":
+        from hyperspace_tpu.plan.expr import Col
+
+        if isinstance(expr, str):
+            expr = Col(expr)
+        if alias is None:
+            base = expr.name if isinstance(expr, Col) else ("star" if expr is None else "expr")
+            alias = f"{fn}_{base}" if expr is not None else "count"
+        return AggSpec(fn, expr, alias)
+
+    def references(self) -> set[str]:
+        return self.expr.references() if self.expr is not None else set()
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "fn": self.fn,
+            "expr": self.expr.to_json() if self.expr is not None else None,
+            "alias": self.alias,
+        }
+
+    @staticmethod
+    def from_json(d: dict[str, Any]) -> "AggSpec":
+        e = expr_from_json(d["expr"]) if d.get("expr") is not None else None
+        return AggSpec(d["fn"], e, d["alias"])
+
+
+@dataclasses.dataclass
+class Aggregate(LogicalPlan):
+    """Grouped aggregation — one of the engine-side operators the TPU build
+    owns (SURVEY.md §2.2 lists the WholeStageCodegen'd operators Spark
+    'provided' to the reference). Sorted-key segments post-index make the
+    device reduction cheap; Aggregate(Join) additionally fuses into a
+    run-prefix aggregation that never materializes the joined pairs."""
+
+    child: LogicalPlan
+    group_by: list[str]
+    aggs: list[AggSpec]
+
+    def __post_init__(self):
+        seen: set[str] = set()
+        for name in [*(c.lower() for c in self.group_by), *(a.alias.lower() for a in self.aggs)]:
+            if name in seen:
+                raise ValueError(f"duplicate output column {name!r} in aggregate")
+            seen.add(name)
+
+    @property
+    def schema(self) -> Schema:
+        from hyperspace_tpu.plan.expr import Col
+        from hyperspace_tpu.schema import Field
+
+        child = self.child.schema
+        fields = [child.field(c) for c in self.group_by]
+        for a in self.aggs:
+            if a.fn == "count":
+                dtype = "int64"
+            elif a.fn == "mean":
+                dtype = "float64"
+            elif isinstance(a.expr, Col):
+                src = child.field(a.expr.name)
+                if a.fn in ("min", "max"):
+                    dtype = src.dtype
+                else:  # sum widens integers
+                    dtype = "int64" if src.dtype in ("int32", "int64", "bool", "date") else "float64"
+            else:
+                dtype = "float64"
+            fields.append(Field(a.alias, dtype))
+        return Schema(tuple(fields))
+
+    def children(self) -> list[LogicalPlan]:
+        return [self.child]
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "type": "aggregate",
+            "child": self.child.to_json(),
+            "groupBy": self.group_by,
+            "aggs": [a.to_json() for a in self.aggs],
+        }
+
+
+@dataclasses.dataclass
+class Sort(LogicalPlan):
+    """Total order by (column, ascending) keys — executes as one device
+    lax.sort over order-preserving 32-bit lanes (ops/sortkeys.py)."""
+
+    child: LogicalPlan
+    by: list[tuple[str, bool]]
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+    def children(self) -> list[LogicalPlan]:
+        return [self.child]
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "type": "sort",
+            "child": self.child.to_json(),
+            "by": [[c, bool(a)] for c, a in self.by],
+        }
+
+
+@dataclasses.dataclass
+class Limit(LogicalPlan):
+    child: LogicalPlan
+    n: int
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+    def children(self) -> list[LogicalPlan]:
+        return [self.child]
+
+    def to_json(self) -> dict[str, Any]:
+        return {"type": "limit", "child": self.child.to_json(), "n": self.n}
+
+
 def plan_from_json(d: dict[str, Any]) -> LogicalPlan:
     t = d["type"]
     if t == "scan":
@@ -235,4 +391,14 @@ def plan_from_json(d: dict[str, Any]) -> LogicalPlan:
             list(d["rightOn"]),
             d.get("how", "inner"),
         )
+    if t == "aggregate":
+        return Aggregate(
+            plan_from_json(d["child"]),
+            list(d["groupBy"]),
+            [AggSpec.from_json(a) for a in d["aggs"]],
+        )
+    if t == "sort":
+        return Sort(plan_from_json(d["child"]), [(c, bool(a)) for c, a in d["by"]])
+    if t == "limit":
+        return Limit(plan_from_json(d["child"]), int(d["n"]))
     raise ValueError(f"unknown plan node type {t!r}")

@@ -14,7 +14,17 @@ from __future__ import annotations
 
 import dataclasses
 
-from hyperspace_tpu.plan.nodes import Filter, Join, LogicalPlan, Project, Scan, Union
+from hyperspace_tpu.plan.nodes import (
+    Aggregate,
+    Filter,
+    Join,
+    Limit,
+    LogicalPlan,
+    Project,
+    Scan,
+    Sort,
+    Union,
+)
 
 
 def prune_columns(plan: LogicalPlan, needed: set[str] | None = None) -> LogicalPlan:
@@ -24,6 +34,11 @@ def prune_columns(plan: LogicalPlan, needed: set[str] | None = None) -> LogicalP
         if needed is None:
             return plan
         cols = [c for c in plan.scan_schema.names if c.lower() in needed]
+        if not cols and plan.scan_schema.names:
+            # A zero-column scan would report num_rows == 0; a pure
+            # count(*) needs the row count, so keep one (cheap) column.
+            names = plan.scan_schema.names
+            cols = [next((c for c in names if not plan.scan_schema.field(c).is_string), names[0])]
         if len(cols) == len(plan.scan_schema.names):
             return plan
         return dataclasses.replace(plan, scan_schema=plan.scan_schema.select(cols))
@@ -56,4 +71,25 @@ def prune_columns(plan: LogicalPlan, needed: set[str] | None = None) -> LogicalP
         )
     if isinstance(plan, Union):
         return Union([prune_columns(c, needed) for c in plan.inputs])
+    if isinstance(plan, Aggregate):
+        child_needed = {c.lower() for c in plan.group_by}
+        for a in plan.aggs:
+            child_needed |= {c.lower() for c in a.references()}
+        if not child_needed:
+            # Pure count(*): an empty set would prune every width-defining
+            # node (Scan, Project, Union branches) to zero columns and
+            # collapse num_rows; keep one (cheap) child column instead.
+            names = plan.child.schema.names
+            if names:
+                pick = next((c for c in names if not plan.child.schema.field(c).is_string), names[0])
+                child_needed = {pick.lower()}
+        return dataclasses.replace(plan, child=prune_columns(plan.child, child_needed))
+    if isinstance(plan, Sort):
+        if needed is None:
+            child_needed = None
+        else:
+            child_needed = set(needed) | {c.lower() for c, _ in plan.by}
+        return dataclasses.replace(plan, child=prune_columns(plan.child, child_needed))
+    if isinstance(plan, Limit):
+        return dataclasses.replace(plan, child=prune_columns(plan.child, needed))
     return plan

@@ -78,6 +78,8 @@ def hybrid_scan_for(match: "IndexMatch", source_scan: Scan):
     index's column set so both union inputs line up."""
     from hyperspace_tpu.plan.nodes import Project, Union
 
+    import dataclasses
+
     entry = match.entry
     idx_scan = index_scan_for(entry)
     delta_scan = Scan(
@@ -86,7 +88,18 @@ def hybrid_scan_for(match: "IndexMatch", source_scan: Scan):
         source_scan.scan_schema,
         files=sorted(f.path for f in match.appended),
     )
-    cols = [source_scan.scan_schema.field(c).name for c in entry.derived_dataset.all_columns]
+    # The source scan may be column-pruned (pruning runs before rules);
+    # narrow the index side to the same columns so the union aligns.
+    src_cols = {c.lower() for c in source_scan.scan_schema.names}
+    idx_cols = [
+        c for c in entry.derived_dataset.all_columns
+        if source_scan.scan_schema.names and c.lower() in src_cols
+    ]
+    idx_schema = idx_scan.scan_schema.select(
+        [idx_scan.scan_schema.field(c).name for c in idx_cols]
+    )
+    idx_scan = dataclasses.replace(idx_scan, scan_schema=idx_schema)
+    cols = [source_scan.scan_schema.field(c).name for c in idx_cols]
     return Union([idx_scan, Project(delta_scan, cols)])
 
 

@@ -21,7 +21,7 @@ from __future__ import annotations
 import dataclasses
 
 from hyperspace_tpu.metadata.log_entry import IndexLogEntry
-from hyperspace_tpu.plan.nodes import Filter, LogicalPlan, Project, Scan
+from hyperspace_tpu.plan.nodes import Aggregate, Filter, Limit, LogicalPlan, Project, Scan, Sort
 from hyperspace_tpu.rules.base import Rule, SignatureMatcher, hybrid_scan_for, index_scan_for
 
 
@@ -51,6 +51,8 @@ class FilterIndexRule(Rule):
             return Project(self._rewrite(plan.child, indexes, matcher), plan.columns)
         if isinstance(plan, Filter):
             return Filter(self._rewrite(plan.child, indexes, matcher), plan.predicate)
+        if isinstance(plan, (Aggregate, Sort, Limit)):
+            return dataclasses.replace(plan, child=self._rewrite(plan.child, indexes, matcher))
         if hasattr(plan, "left") and hasattr(plan, "right"):
             new = dataclasses.replace(plan)
             new.left = self._rewrite(plan.left, indexes, matcher)

@@ -24,7 +24,7 @@ shape. What remains:
 from __future__ import annotations
 
 from hyperspace_tpu.metadata.log_entry import IndexLogEntry
-from hyperspace_tpu.plan.nodes import Filter, Join, LogicalPlan, Project, Scan
+from hyperspace_tpu.plan.nodes import Aggregate, Filter, Join, Limit, LogicalPlan, Project, Scan, Sort
 from hyperspace_tpu.rules.base import Rule, SignatureMatcher, hybrid_scan_for, index_scan_for
 from hyperspace_tpu.rules.ranker import JoinIndexRanker
 
@@ -88,6 +88,10 @@ class JoinIndexRule(Rule):
             return Project(self._rewrite(plan.child, indexes, matcher), plan.columns)
         if isinstance(plan, Filter):
             return Filter(self._rewrite(plan.child, indexes, matcher), plan.predicate)
+        if isinstance(plan, (Aggregate, Sort, Limit)):
+            import dataclasses
+
+            return dataclasses.replace(plan, child=self._rewrite(plan.child, indexes, matcher))
         return plan
 
     def _try_rewrite_join(self, plan: Join, indexes, matcher) -> LogicalPlan | None:

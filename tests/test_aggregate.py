@@ -1,0 +1,387 @@
+"""Aggregate / Sort / Limit: device kernels vs pandas ground truth, the
+fused Aggregate(Join) path vs the materialized join, and rewrite rules
+firing underneath aggregation (the engine-side operators the TPU build
+owns, SURVEY.md §2.2)."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import AggSpec, Hyperspace, HyperspaceSession, IndexConfig, col
+from hyperspace_tpu.parallel.mesh import make_mesh
+
+
+@pytest.fixture
+def sales(tmp_path):
+    rng = np.random.default_rng(21)
+    n = 5_000
+    nulls = rng.random(n) < 0.1
+    t = pa.table(
+        {
+            "store": pa.array([f"s{int(i) % 7}" for i in rng.integers(0, 7, n)]),
+            "item": rng.integers(0, 50, n).astype(np.int64),
+            "qty": pa.array(rng.integers(1, 20, n).astype(np.int64), mask=nulls),
+            "price": rng.random(n) * 100,
+        }
+    )
+    root = tmp_path / "sales"
+    root.mkdir()
+    pq.write_table(t, root / "part-0.parquet")
+    return root
+
+
+def _session(tmp_path, **kw):
+    return HyperspaceSession(system_path=str(tmp_path / "idx"), num_buckets=8, **kw)
+
+
+def test_grouped_aggregation_matches_pandas(tmp_path, sales):
+    session = _session(tmp_path)
+    df = session.parquet(sales)
+    q = df.aggregate(
+        ["store"],
+        [
+            AggSpec.of("sum", "qty", "total_qty"),
+            AggSpec.of("count", None, "rows"),
+            AggSpec.of("count", "qty", "qty_rows"),
+            AggSpec.of("mean", "price", "avg_price"),
+            AggSpec.of("min", "price", "min_price"),
+            AggSpec.of("max", "item", "max_item"),
+            AggSpec.of("sum", col("qty") * col("price"), "revenue"),
+        ],
+    )
+    got = session.to_pandas(q).sort_values("store").reset_index(drop=True)
+
+    pdf = pq.read_table(sales).to_pandas()
+    exp = (
+        pdf.groupby("store")
+        .agg(
+            total_qty=("qty", "sum"),
+            rows=("store", "size"),
+            qty_rows=("qty", "count"),
+            avg_price=("price", "mean"),
+            min_price=("price", "min"),
+            max_item=("item", "max"),
+        )
+        .reset_index()
+        .sort_values("store")
+        .reset_index(drop=True)
+    )
+    exp["revenue"] = (
+        (pdf["qty"] * pdf["price"]).groupby(pdf["store"]).sum().sort_index().values
+    )
+    assert list(got["store"]) == list(exp["store"])
+    np.testing.assert_allclose(got["total_qty"].astype(float), exp["total_qty"].astype(float))
+    np.testing.assert_array_equal(got["rows"], exp["rows"])
+    np.testing.assert_array_equal(got["qty_rows"], exp["qty_rows"])
+    np.testing.assert_allclose(got["avg_price"], exp["avg_price"])
+    np.testing.assert_allclose(got["min_price"], exp["min_price"])
+    np.testing.assert_array_equal(got["max_item"], exp["max_item"])
+    np.testing.assert_allclose(got["revenue"], exp["revenue"])
+
+
+def test_global_aggregate_and_string_minmax(tmp_path, sales):
+    session = _session(tmp_path)
+    df = session.parquet(sales)
+    q = df.aggregate(
+        [],
+        [
+            AggSpec.of("count", None, "n"),
+            AggSpec.of("sum", "price", "sum_price"),
+            AggSpec.of("min", "store", "min_store"),
+            AggSpec.of("max", "store", "max_store"),
+        ],
+    )
+    got = session.to_pandas(q)
+    pdf = pq.read_table(sales).to_pandas()
+    assert got["n"][0] == len(pdf)
+    np.testing.assert_allclose(got["sum_price"][0], pdf["price"].sum())
+    assert got["min_store"][0] == pdf["store"].min()
+    assert got["max_store"][0] == pdf["store"].max()
+
+
+def test_null_group_key_and_all_null_group(tmp_path):
+    t = pa.table(
+        {
+            "k": pa.array([1, 1, None, None, 2], type=pa.int64()),
+            "v": pa.array([10.0, None, 5.0, 7.0, None]),
+        }
+    )
+    root = tmp_path / "nulls"
+    root.mkdir()
+    pq.write_table(t, root / "p.parquet")
+    session = _session(tmp_path)
+    df = session.parquet(root)
+    q = df.aggregate(["k"], [AggSpec.of("sum", "v", "sv"), AggSpec.of("count", "v", "cv")])
+    got = session.to_pandas(q)
+    by_k = {row["k"]: row for _, row in got.iterrows()}
+    assert by_k[1]["sv"] == 10.0 and by_k[1]["cv"] == 1
+    # null key forms its own group
+    null_rows = got[got["k"].isna()]
+    assert len(null_rows) == 1 and null_rows["sv"].iloc[0] == 12.0
+    # group 2 has only null inputs -> NULL sum, count 0
+    g2 = got[got["k"] == 2]
+    assert g2["cv"].iloc[0] == 0 and pd.isna(g2["sv"].iloc[0])
+
+
+def test_sort_and_limit(tmp_path, sales):
+    session = _session(tmp_path)
+    df = session.parquet(sales)
+    q = df.select("store", "item", "price").sort([("store", True), ("price", False)]).limit(100)
+    got = session.to_pandas(q)
+    pdf = pq.read_table(sales).to_pandas()
+    exp = (
+        pdf[["store", "item", "price"]]
+        .sort_values(["store", "price"], ascending=[True, False], kind="stable")
+        .head(100)
+        .reset_index(drop=True)
+    )
+    np.testing.assert_array_equal(got["store"], exp["store"])
+    np.testing.assert_allclose(got["price"], exp["price"])
+
+
+def test_sort_desc_nulls_last(tmp_path):
+    t = pa.table({"v": pa.array([3.0, None, 1.0, 2.0, None])})
+    root = tmp_path / "sn"
+    root.mkdir()
+    pq.write_table(t, root / "p.parquet")
+    session = _session(tmp_path)
+    got = session.to_pandas(session.parquet(root).sort([("v", False)]))
+    vals = list(got["v"])
+    assert vals[:3] == [3.0, 2.0, 1.0]
+    assert all(pd.isna(v) for v in vals[3:])
+
+
+@pytest.fixture
+def join_tables(tmp_path):
+    rng = np.random.default_rng(5)
+    n = 8_000
+    fact_root = tmp_path / "fact"
+    fact_root.mkdir()
+    pq.write_table(
+        pa.table(
+            {
+                "k": rng.integers(0, 300, n).astype(np.int64),
+                "amount": rng.random(n) * 50,
+                "units": rng.integers(1, 9, n).astype(np.int64),
+            }
+        ),
+        fact_root / "f.parquet",
+    )
+    dim_root = tmp_path / "dim"
+    dim_root.mkdir()
+    pq.write_table(
+        pa.table(
+            {
+                "k": np.arange(250, dtype=np.int64),  # keys 250..299 unmatched
+                "cat": pa.array([f"c{i % 6}" for i in range(250)]),
+                "weight": np.round(np.random.default_rng(6).random(250), 3),
+            }
+        ),
+        dim_root / "d.parquet",
+    )
+    return fact_root, dim_root
+
+
+def _expected_join_agg(fact_root, dim_root, group, aggs):
+    f = pq.read_table(fact_root).to_pandas()
+    d = pq.read_table(dim_root).to_pandas()
+    j = f.merge(d, on="k")
+    g = j.groupby(group) if group else None
+    return j, g
+
+
+@pytest.mark.parametrize("with_index", [False, True])
+def test_fused_join_aggregate_matches_pandas(tmp_path, join_tables, with_index):
+    fact_root, dim_root = join_tables
+    session = _session(tmp_path, mesh=make_mesh())
+    hs = Hyperspace(session)
+    fact = session.parquet(fact_root)
+    dim = session.parquet(dim_root)
+    if with_index:
+        hs.create_index(fact, IndexConfig("f_k", ["k"], ["amount", "units"]))
+        hs.create_index(dim, IndexConfig("d_k", ["k"], ["cat", "weight"]))
+        session.enable_hyperspace()
+    q = fact.join(dim, ["k"]).aggregate(
+        ["cat"],
+        [
+            AggSpec.of("sum", "amount", "sum_amount"),  # left measure
+            AggSpec.of("sum", "weight", "sum_weight"),  # right measure
+            AggSpec.of("count", None, "pairs"),
+            AggSpec.of("mean", "amount", "avg_amount"),
+            AggSpec.of("sum", col("amount") * col("units"), "revenue"),
+        ],
+    )
+    got = session.to_pandas(q).sort_values("cat").reset_index(drop=True)
+    assert session.last_query_stats["agg_path"] == "fused-join-agg"
+    if with_index:
+        assert session.last_query_stats["join_path"] == "zero-exchange-aligned"
+
+    f = pq.read_table(fact_root).to_pandas()
+    d = pq.read_table(dim_root).to_pandas()
+    j = f.merge(d, on="k")
+    exp = (
+        j.groupby("cat")
+        .agg(
+            sum_amount=("amount", "sum"),
+            sum_weight=("weight", "sum"),
+            pairs=("cat", "size"),
+            avg_amount=("amount", "mean"),
+        )
+        .reset_index()
+        .sort_values("cat")
+        .reset_index(drop=True)
+    )
+    exp["revenue"] = (j["amount"] * j["units"]).groupby(j["cat"]).sum().sort_index().values
+    assert list(got["cat"]) == list(exp["cat"])
+    np.testing.assert_allclose(got["sum_amount"], exp["sum_amount"])
+    np.testing.assert_allclose(got["sum_weight"], exp["sum_weight"])
+    np.testing.assert_array_equal(got["pairs"], exp["pairs"])
+    np.testing.assert_allclose(got["avg_amount"], exp["avg_amount"])
+    np.testing.assert_allclose(got["revenue"], exp["revenue"])
+
+
+def test_fused_join_agg_group_by_left_side(tmp_path, join_tables):
+    fact_root, dim_root = join_tables
+    session = _session(tmp_path)
+    fact = session.parquet(fact_root)
+    dim = session.parquet(dim_root)
+    q = fact.join(dim, ["k"]).aggregate(
+        ["k"], [AggSpec.of("sum", "weight", "w"), AggSpec.of("count", None, "n")]
+    )
+    got = session.to_pandas(q).sort_values("k").reset_index(drop=True)
+    f = pq.read_table(fact_root).to_pandas()
+    d = pq.read_table(dim_root).to_pandas()
+    j = f.merge(d, on="k")
+    exp = (
+        j.groupby("k").agg(w=("weight", "sum"), n=("k", "size")).reset_index()
+    ).sort_values("k").reset_index(drop=True)
+    np.testing.assert_array_equal(got["k"], exp["k"])
+    np.testing.assert_allclose(got["w"], exp["w"])
+    np.testing.assert_array_equal(got["n"], exp["n"])
+
+
+def test_join_agg_minmax_falls_back_to_materialized(tmp_path, join_tables):
+    fact_root, dim_root = join_tables
+    session = _session(tmp_path)
+    fact = session.parquet(fact_root)
+    dim = session.parquet(dim_root)
+    q = fact.join(dim, ["k"]).aggregate(["cat"], [AggSpec.of("max", "amount", "mx")])
+    got = session.to_pandas(q).sort_values("cat").reset_index(drop=True)
+    assert session.last_query_stats["agg_path"] == "segment-reduce"
+    f = pq.read_table(fact_root).to_pandas()
+    d = pq.read_table(dim_root).to_pandas()
+    exp = (
+        f.merge(d, on="k").groupby("cat")["amount"].max().reset_index(name="mx")
+    ).sort_values("cat").reset_index(drop=True)
+    np.testing.assert_allclose(got["mx"], exp["mx"])
+
+
+def test_aggregate_over_index_rewrite_and_explain(tmp_path, sales):
+    """Rules must fire underneath an Aggregate, and explain must render
+    the new nodes."""
+    session = _session(tmp_path)
+    hs = Hyperspace(session)
+    df = session.parquet(sales)
+    hs.create_index(df, IndexConfig("sidx", ["item"], ["qty", "price"]))
+    session.enable_hyperspace()
+    q = df.filter(col("item") == 7).aggregate([], [AggSpec.of("sum", "qty", "sq")])
+    opt = session.optimized_plan(q)
+    assert any(s.bucket_spec is not None for s in opt.leaves()), "rewrite under Aggregate missed"
+    got = session.to_pandas(q)
+    session.disable_hyperspace()
+    exp = session.to_pandas(q)
+    assert got["sq"][0] == exp["sq"][0]
+    text = hs.explain(q)
+    assert "Aggregate" in text
+
+
+def test_aggregate_plan_roundtrips_json(tmp_path, sales):
+    from hyperspace_tpu.plan.nodes import plan_from_json
+
+    session = _session(tmp_path)
+    df = session.parquet(sales)
+    q = df.aggregate(["store"], [AggSpec.of("sum", col("qty") * col("price"), "rev")]).sort(
+        [("rev", False)]
+    ).limit(3)
+    rt = plan_from_json(q.to_json())
+    assert rt.to_json() == q.to_json()
+    got = session.to_pandas(q)
+    got2 = session.to_pandas(rt)
+    pd.testing.assert_frame_equal(got, got2)
+
+
+def test_count_star_only_prunes_to_one_column_not_zero(tmp_path, sales):
+    """count(*) with no group_by references no columns; pruning must keep
+    at least one scan column or num_rows collapses to 0."""
+    session = _session(tmp_path)
+    df = session.parquet(sales)
+    got = session.to_pandas(df.aggregate([], [AggSpec.of("count", None, "n")]))
+    assert got["n"][0] == pq.read_table(sales).num_rows
+
+
+def test_fused_join_agg_empty_primary_side(tmp_path, join_tables):
+    """Global aggregate over a join whose primary (left) side is empty:
+    one row with count 0 and NULL sum, not an IndexError."""
+    _, dim_root = join_tables
+    empty_root = tmp_path / "empty_fact"
+    empty_root.mkdir()
+    pq.write_table(
+        pa.table(
+            {
+                "k": np.zeros(0, np.int64),
+                "amount": np.zeros(0, np.float64),
+            }
+        ),
+        empty_root / "f.parquet",
+    )
+    session = _session(tmp_path)
+    fact = session.parquet(empty_root)
+    dim = session.parquet(dim_root)
+    q = fact.join(dim, ["k"]).aggregate(
+        [], [AggSpec.of("count", None, "n"), AggSpec.of("sum", "amount", "s")]
+    )
+    got = session.to_pandas(q)
+    assert session.last_query_stats["agg_path"] == "fused-join-agg"
+    assert len(got) == 1
+    assert got["n"][0] == 0
+    assert pd.isna(got["s"][0])
+
+    # Grouped variant: no groups at all.
+    q2 = fact.join(dim, ["k"]).aggregate(["k"], [AggSpec.of("count", None, "n")])
+    assert len(session.to_pandas(q2)) == 0
+
+
+def test_count_star_over_projected_table(tmp_path, sales):
+    """Pruning must not collapse a Project to zero columns either."""
+    session = _session(tmp_path)
+    df = session.parquet(sales).select("price")
+    got = session.to_pandas(df.aggregate([], [AggSpec.of("count", None, "n")]))
+    assert got["n"][0] == pq.read_table(sales).num_rows
+
+
+def test_sum_of_constant_expression(tmp_path, join_tables):
+    """sum(lit(2)) == 2 * count(*): constant expressions broadcast instead
+    of crashing, on both the plain and the join paths."""
+    from hyperspace_tpu.plan.expr import lit
+
+    fact_root, dim_root = join_tables
+    session = _session(tmp_path)
+    fact = session.parquet(fact_root)
+    dim = session.parquet(dim_root)
+
+    got = session.to_pandas(
+        fact.aggregate([], [AggSpec.of("sum", lit(2), "s"), AggSpec.of("count", None, "n")])
+    )
+    assert got["s"][0] == 2 * got["n"][0] == 2 * pq.read_table(fact_root).num_rows
+
+    got2 = session.to_pandas(
+        fact.join(dim, ["k"]).aggregate(
+            [], [AggSpec.of("sum", lit(2), "s"), AggSpec.of("count", None, "n")]
+        )
+    )
+    f = pq.read_table(fact_root).to_pandas()
+    d = pq.read_table(dim_root).to_pandas()
+    pairs = len(f.merge(d, on="k"))
+    assert got2["n"][0] == pairs and got2["s"][0] == 2 * pairs
