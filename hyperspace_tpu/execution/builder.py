@@ -296,6 +296,7 @@ class DeviceIndexBuilder:
             if cur:
                 batches.append(cur)
 
+            key_stats: list = [None] * num_buckets
             with ThreadPoolExecutor(max_workers=8) as pool:
                 empty = ColumnTable.empty(sub_schema.select(ordered))
                 for b in range(num_buckets):
@@ -310,9 +311,13 @@ class DeviceIndexBuilder:
                     ]
                     for b, t in zip(ids, tables):
                         bucket_rows[b] = t.num_rows
+                        key_stats[b] = hio.bucket_key_stats(t, indexed_columns[0])
                     for f in futs:
                         f.result()
-            hio.write_manifest(dest, num_buckets, indexed_columns, bucket_rows)
+            hio.write_manifest(
+                dest, num_buckets, indexed_columns, bucket_rows,
+                key_stats if any(s is not None for s in key_stats) else None,
+            )
         finally:
             shutil.rmtree(spill, ignore_errors=True)
         self.last_build_stats = {

@@ -82,6 +82,110 @@ def _bucket_sorted_codes(codes: np.ndarray, side: SideData):
     return codes[perm], perm
 
 
+@dataclasses.dataclass
+class KeyBounds:
+    """Conjunct bounds on one column: lo/hi literal (None = unbounded) and
+    whether each bound is strict (< / >) rather than inclusive."""
+
+    lo: object = None
+    lo_strict: bool = False
+    hi: object = None
+    hi_strict: bool = False
+
+
+_FLIP = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}
+
+
+def key_bounds(predicate: Expr, key: str) -> KeyBounds | None:
+    """Extract literal comparison bounds on `key` from the predicate's
+    conjuncts (key op lit / lit op key; eq pins both ends). Returns None
+    when no conjunct bounds the column. Incomparable literal types are
+    ignored (the residual filter mask still applies them exactly)."""
+    b = KeyBounds()
+    found = False
+    for conj in split_conjuncts(predicate):
+        if not isinstance(conj, BinOp):
+            continue
+        op = conj.op
+        if isinstance(conj.left, Col) and isinstance(conj.right, Lit):
+            name, v = conj.left.name, conj.right.value
+        elif isinstance(conj.right, Col) and isinstance(conj.left, Lit):
+            name, v = conj.right.name, conj.left.value
+            op = _FLIP.get(op, op)
+        else:
+            continue
+        if name.lower() != key.lower() or op not in ("eq", "lt", "le", "gt", "ge") or v is None:
+            continue
+        try:
+            if op in ("gt", "ge", "eq") and (
+                b.lo is None or v > b.lo or (v == b.lo and op == "gt")
+            ):
+                b.lo, b.lo_strict = v, op == "gt"
+                found = True
+            if op in ("lt", "le", "eq") and (
+                b.hi is None or v < b.hi or (v == b.hi and op == "lt")
+            ):
+                b.hi, b.hi_strict = v, op == "lt"
+                found = True
+        except TypeError:
+            continue
+    return b if found else None
+
+
+def _stats_overlap(bounds: KeyBounds, mn, mx) -> bool:
+    """Can any value in [mn, mx] satisfy the bounds?"""
+    try:
+        if bounds.hi is not None and (mn > bounds.hi or (bounds.hi_strict and mn == bounds.hi)):
+            return False
+        if bounds.lo is not None and (mx < bounds.lo or (bounds.lo_strict and mx == bounds.lo)):
+            return False
+    except TypeError:
+        return True  # incomparable stats: keep the file
+    return True
+
+
+def _bounds_domain(field, bounds: KeyBounds):
+    """Conversion putting pruning comparisons in the SAME numeric domain
+    the filter mask uses (ops/filter.py _lower_col_lit's numpy promotion):
+    float32 columns compare weak scalars in float32 (the literal ROUNDS),
+    and int columns compare float literals in float64. Without this,
+    pruning could drop rows the mask would keep. Returns None when raw
+    comparison already matches (ints vs ints, strings)."""
+    dt = field.device_dtype
+    vals = [v for v in (bounds.lo, bounds.hi) if v is not None]
+    if dt.kind == "f":
+        weak = all(
+            type(v) in (int, float, bool) or isinstance(v, (np.bool_, np.float32))
+            for v in vals
+        )
+        return np.float32 if (dt.itemsize <= 4 and weak) else np.float64
+    if dt.kind in "iu" and any(isinstance(v, (float, np.floating)) for v in vals):
+        return np.float64
+    return None
+
+
+def _convert_bounds(field, bounds: KeyBounds) -> tuple[KeyBounds, object]:
+    """(bounds cast into the comparison domain, stat-value converter)."""
+    conv = _bounds_domain(field, bounds)
+    if conv is None:
+        return bounds, lambda v: v
+    try:
+        cast = KeyBounds(
+            conv(bounds.lo) if bounds.lo is not None else None,
+            bounds.lo_strict,
+            conv(bounds.hi) if bounds.hi is not None else None,
+            bounds.hi_strict,
+        )
+    except (TypeError, ValueError, OverflowError):
+        return bounds, lambda v: v
+    def stat_conv(v):
+        try:
+            return conv(v)
+        except (TypeError, ValueError, OverflowError):
+            return v
+    return cast, stat_conv
+
+
 def _pad_bucket_major(
     codes: np.ndarray,
     offsets: np.ndarray,
@@ -117,6 +221,7 @@ class Executor:
         self.stats: dict = {
             "files_read": 0,
             "files_pruned": 0,
+            "rows_pruned": 0,
             "join_path": None,
             "join_devices": 1,
             "num_buckets": None,
@@ -203,6 +308,8 @@ class Executor:
     def _scan(self, scan: Scan, columns: list[str] | None = None) -> ColumnTable:
         files = self._scan_files(scan)
         cols = columns if columns is not None else scan.scan_schema.names
+        if not files:  # everything pruned away
+            return ColumnTable.empty(scan.scan_schema.select(cols))
         if scan.bucket_spec is not None:
             # Index files are immutable per version — cache their decode.
             return self._cached_read(files, cols, scan.scan_schema)
@@ -217,12 +324,18 @@ class Executor:
             if pruned is not None:
                 table = self._cached_read(pruned, child.scan_schema.names, child.scan_schema)
                 return apply_filter(table, plan.predicate, mesh=self.mesh)
+            ranged = self._range_read(child, plan.predicate)
+            if ranged is not None:
+                return apply_filter(ranged, plan.predicate, mesh=self.mesh)
         if isinstance(child, Union):
             # Hybrid scan: prune the bucketed input(s), keep deltas whole.
             new_inputs: list[LogicalPlan] = []
             for inp in child.inputs:
                 if isinstance(inp, Scan) and inp.bucket_spec is not None:
                     pruned = self._prune_bucket_files(inp, plan.predicate)
+                    if pruned is None:
+                        ranged = self._range_prune_list(inp, plan.predicate)
+                        pruned = ranged[0] if ranged is not None else None
                     if pruned is not None:
                         inp = dataclasses.replace(inp, files=pruned)
                 new_inputs.append(inp)
@@ -254,6 +367,83 @@ class Executor:
             self.stats["files_pruned"] += len(files) - len(matches)
             return matches
         return None
+
+    def _range_prune_list(self, scan: Scan, predicate: Expr) -> tuple[list[str], KeyBounds] | None:
+        """File-level range (min/max) pruning: drop bucket files whose
+        manifest key stats cannot overlap the predicate's bounds on the
+        leading indexed column. The analog of FileSourceScanExec's parquet
+        min/max pruning (SURVEY.md §2.2), which the reference inherits
+        from Spark. Comparisons run in the filter mask's own numeric
+        domain so pruning never disagrees with it. Returns None when no
+        literal bounds or no stats exist."""
+        key = scan.bucket_spec[1][0]
+        bounds = key_bounds(predicate, key)
+        if bounds is None:
+            return None
+        files = self._scan_files(scan)
+        stats = hio.file_key_stats(files)
+        if not stats:
+            return None
+        bounds, stat_conv = _convert_bounds(scan.scan_schema.field(key), bounds)
+        kept: list[str] = []
+        for f in files:
+            if f not in stats:
+                kept.append(f)  # no stats recorded: must read it
+                continue
+            s = stats[f]
+            # s is None ⇔ bucket empty or all-null key: no row can satisfy
+            # a literal comparison (3-valued logic), safe to skip.
+            if s is not None and _stats_overlap(bounds, stat_conv(s[0]), stat_conv(s[1])):
+                kept.append(f)
+        self.stats["files_pruned"] += len(files) - len(kept)
+        return kept, bounds
+
+    def _range_read(self, scan: Scan, predicate: Expr) -> ColumnTable | None:
+        """File-level range pruning + within-file searchsorted slicing
+        (each surviving file is key-sorted by construction, so qualifying
+        rows form one contiguous run). Dictionary codes are not
+        value-ordered across files and null prefixes break sortedness —
+        both fall back to reading the file whole (mask handles the rest)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        pruned = self._range_prune_list(scan, predicate)
+        if pruned is None:
+            return None
+        kept, bounds = pruned
+        schema = scan.scan_schema
+        field = schema.field(scan.bucket_spec[1][0])
+        if not kept:
+            return ColumnTable.empty(schema)
+        before = hio.table_cache_stats()["miss_files"]
+        with ThreadPoolExecutor(max_workers=min(8, len(kept))) as pool:
+            tables = list(
+                pool.map(
+                    lambda fp: hio.read_parquet_cached([fp], columns=schema.names, schema=schema),
+                    kept,
+                )
+            )
+        self.stats["files_read"] += hio.table_cache_stats()["miss_files"] - before
+        parts: list[ColumnTable] = []
+        for t in tables:
+            if t.num_rows == 0:
+                continue
+            if not field.is_string and t.valid_mask(field.name) is None:
+                colv = t.columns[field.name]
+                lo_i, hi_i = 0, t.num_rows
+                if bounds.lo is not None:
+                    lo_i = int(np.searchsorted(colv, bounds.lo, side="right" if bounds.lo_strict else "left"))
+                if bounds.hi is not None:
+                    hi_i = int(np.searchsorted(colv, bounds.hi, side="left" if bounds.hi_strict else "right"))
+                if hi_i <= lo_i:
+                    self.stats["rows_pruned"] += t.num_rows
+                    continue
+                if lo_i > 0 or hi_i < t.num_rows:
+                    self.stats["rows_pruned"] += t.num_rows - (hi_i - lo_i)
+                    t = t.take(np.arange(lo_i, hi_i))
+            parts.append(t)
+        if not parts:
+            return ColumnTable.empty(schema)
+        return ColumnTable.concat(parts) if len(parts) > 1 else parts[0]
 
     # -- join ------------------------------------------------------------
     def _join(self, plan: Join) -> ColumnTable:

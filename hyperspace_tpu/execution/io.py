@@ -199,18 +199,72 @@ def bucket_file_name(bucket: int) -> str:
     return f"bucket-{bucket:05d}.parquet"
 
 
+def bucket_of_file_name(name: str) -> int | None:
+    """Inverse of bucket_file_name (None for non-bucket files)."""
+    if name.startswith("bucket-") and name.endswith(".parquet"):
+        try:
+            return int(name[len("bucket-") : -len(".parquet")])
+        except ValueError:
+            return None
+    return None
+
+
+def _json_scalar(v):
+    """numpy scalar → plain JSON-serializable Python value."""
+    return v.item() if hasattr(v, "item") else v
+
+
+def bucket_key_stats(table: ColumnTable, key: str, sel: np.ndarray | None = None):
+    """JSON-serializable [min, max] of `table[key]` over rows `sel` (all
+    rows when None), ignoring nulls; None for empty/all-null/vector. The
+    analog of parquet column-chunk statistics the reference gets from
+    FileSourceScanExec min/max pruning (SURVEY.md §2.2) — persisted in the
+    index manifest so range predicates can skip whole bucket files."""
+    try:
+        f = table.schema.field(key)
+    except Exception:
+        return None
+    if f.is_vector:
+        return None
+    vals = table.columns[f.name]
+    valid = table.valid_mask(f.name)
+    if sel is not None:
+        vals = vals[sel]
+        valid = valid[sel] if valid is not None else None
+    if valid is not None:
+        vals = vals[valid]
+    if len(vals) == 0:
+        return None
+    if f.name in table.dictionaries:
+        # np.min has no ufunc loop for unicode; reduce over the (small)
+        # set of used dictionary values in Python instead.
+        used = np.asarray(table.dictionaries[f.name])[np.unique(vals)].tolist()
+        return [min(used), max(used)]
+    return [_json_scalar(vals.min()), _json_scalar(vals.max())]
+
+
 def write_bucket(dest_dir: Path, bucket: int, table: ColumnTable) -> None:
     dest_dir.mkdir(parents=True, exist_ok=True)
     pq.write_table(table.to_arrow(), dest_dir / bucket_file_name(bucket))
 
 
-def write_manifest(dest_dir: Path, num_buckets: int, indexed_columns: list[str], bucket_rows: list[int]) -> None:
+def write_manifest(
+    dest_dir: Path,
+    num_buckets: int,
+    indexed_columns: list[str],
+    bucket_rows: list[int],
+    key_stats: list | None = None,
+) -> None:
     dest_dir.mkdir(parents=True, exist_ok=True)
     manifest = {
         "numBuckets": num_buckets,
         "indexedColumns": indexed_columns,
         "bucketRows": bucket_rows,
     }
+    if key_stats is not None:
+        # Per-bucket [min, max] of the first indexed column (None when the
+        # bucket is empty or all-null) — enables file-level range pruning.
+        manifest["keyStats"] = key_stats
     (dest_dir / MANIFEST_NAME).write_text(json.dumps(manifest, indent=2))
 
 
@@ -219,6 +273,45 @@ def read_manifest(version_dir: Path) -> dict | None:
     if not p.exists():
         return None
     return json.loads(p.read_text())
+
+
+_manifest_cache: "dict[str, tuple[int, dict | None]]" = {}
+_manifest_lock = threading.Lock()
+
+
+def file_key_stats(files: list[str]) -> dict[str, list | None]:
+    """Per-file [min, max] of the leading indexed column, looked up in each
+    file's version-dir manifest (cached, mtime-validated). Files whose dir
+    has no manifest or whose manifest has no keyStats are absent from the
+    result; a present-but-None value means the bucket is empty/all-null."""
+    import os
+
+    out: dict[str, list | None] = {}
+    by_dir: dict[Path, list[str]] = {}
+    for f in files:
+        by_dir.setdefault(Path(f).parent, []).append(f)
+    for d, fs in by_dir.items():
+        mp = d / MANIFEST_NAME
+        try:
+            mt = os.stat(mp).st_mtime_ns
+        except OSError:
+            continue
+        with _manifest_lock:
+            cached = _manifest_cache.get(str(mp))
+        if cached is None or cached[0] != mt:
+            m = read_manifest(d)
+            with _manifest_lock:
+                _manifest_cache[str(mp)] = (mt, m)
+        else:
+            m = cached[1]
+        if not m or "keyStats" not in m:
+            continue
+        ks = m["keyStats"]
+        for f in fs:
+            b = bucket_of_file_name(Path(f).name)
+            if b is not None and b < len(ks):
+                out[f] = ks[b]
+    return out
 
 
 def carve_and_write(
@@ -242,13 +335,17 @@ def carve_and_write(
     dest.mkdir(parents=True, exist_ok=True)
     starts = np.searchsorted(sorted_partition, np.arange(num_partitions + 1))
     rows = [int(starts[p + 1] - starts[p]) for p in range(num_partitions)]
+    key_stats: list = [None] * num_partitions
 
     def write_one(p: int) -> None:
         lo, hi = int(starts[p]), int(starts[p + 1])
         sel = np.arange(lo, hi) if order is None else order[lo:hi]
+        if indexed_columns:
+            key_stats[p] = bucket_key_stats(table, indexed_columns[0], sel)
         write_bucket(dest, p, table.take(sel))
 
     with ThreadPoolExecutor(max_workers=min(8, max(1, num_partitions))) as ex:
         list(ex.map(write_one, range(num_partitions)))
-    write_manifest(dest, num_partitions, indexed_columns, rows)
+    has_stats = any(s is not None for s in key_stats)
+    write_manifest(dest, num_partitions, indexed_columns, rows, key_stats if has_stats else None)
     return rows
