@@ -279,31 +279,37 @@ _manifest_cache: "dict[str, tuple[int, dict | None]]" = {}
 _manifest_lock = threading.Lock()
 
 
+def read_manifest_cached(version_dir: Path) -> dict | None:
+    """read_manifest through an mtime-validated cache (manifests are
+    immutable per version, but refresh can rewrite a dir's manifest)."""
+    import os
+
+    mp = Path(version_dir) / MANIFEST_NAME
+    try:
+        mt = os.stat(mp).st_mtime_ns
+    except OSError:
+        return None
+    with _manifest_lock:
+        cached = _manifest_cache.get(str(mp))
+    if cached is not None and cached[0] == mt:
+        return cached[1]
+    m = read_manifest(version_dir)
+    with _manifest_lock:
+        _manifest_cache[str(mp)] = (mt, m)
+    return m
+
+
 def file_key_stats(files: list[str]) -> dict[str, list | None]:
     """Per-file [min, max] of the leading indexed column, looked up in each
     file's version-dir manifest (cached, mtime-validated). Files whose dir
     has no manifest or whose manifest has no keyStats are absent from the
     result; a present-but-None value means the bucket is empty/all-null."""
-    import os
-
     out: dict[str, list | None] = {}
     by_dir: dict[Path, list[str]] = {}
     for f in files:
         by_dir.setdefault(Path(f).parent, []).append(f)
     for d, fs in by_dir.items():
-        mp = d / MANIFEST_NAME
-        try:
-            mt = os.stat(mp).st_mtime_ns
-        except OSError:
-            continue
-        with _manifest_lock:
-            cached = _manifest_cache.get(str(mp))
-        if cached is None or cached[0] != mt:
-            m = read_manifest(d)
-            with _manifest_lock:
-                _manifest_cache[str(mp)] = (mt, m)
-        else:
-            m = cached[1]
+        m = read_manifest_cached(d)
         if not m or "keyStats" not in m:
             continue
         ks = m["keyStats"]

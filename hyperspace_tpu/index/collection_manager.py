@@ -78,16 +78,38 @@ class IndexCollectionManager:
 
     def refresh(self, name: str, mode: str = "full") -> None:
         lm, dm, path = self._managers(name)
-        if mode == "full":
-            RefreshAction(lm, dm, path, self.conf, self.writer_factory()).run()
-        elif mode == "incremental":
-            RefreshIncrementalAction(lm, dm, path, self.conf, self.writer_factory()).run()
-        else:
+        if mode not in ("full", "incremental"):
             raise HyperspaceError(f"unknown refresh mode {mode!r} (full|incremental)")
+        if self._is_vector(lm):
+            from hyperspace_tpu.vector.lifecycle import (
+                VectorRefreshAction,
+                VectorRefreshIncrementalAction,
+            )
+
+            action = VectorRefreshAction if mode == "full" else VectorRefreshIncrementalAction
+            action(lm, dm, path, self.conf).run()
+        elif mode == "full":
+            RefreshAction(lm, dm, path, self.conf, self.writer_factory()).run()
+        else:
+            RefreshIncrementalAction(lm, dm, path, self.conf, self.writer_factory()).run()
 
     def optimize(self, name: str) -> None:
         lm, dm, _ = self._managers(name)
-        OptimizeAction(lm, dm, self.writer_factory()).run()
+        if self._is_vector(lm):
+            from hyperspace_tpu.vector.lifecycle import VectorOptimizeAction
+
+            VectorOptimizeAction(lm, dm).run()
+        else:
+            OptimizeAction(lm, dm, self.writer_factory()).run()
+
+    @staticmethod
+    def _is_vector(lm) -> bool:
+        entry = lm.get_latest_log()
+        return (
+            entry is not None
+            and entry.derived_dataset is not None
+            and entry.derived_dataset.kind == "VectorIndex"
+        )
 
     def cancel(self, name: str) -> None:
         lm, _, _ = self._managers(name)

@@ -141,7 +141,15 @@ class VectorCreateAction(CreateActionBase):
         base_cfg = IndexConfig(config.index_name, [config.embedding_column], config.included_columns)
         super().__init__(plan, base_cfg, log_manager, data_manager, index_path, conf, None)
         self.vconfig = config
-        self.builder = builder or VectorIndexBuilder()
+        self._builder = builder
+
+    @property
+    def builder(self) -> VectorIndexBuilder:
+        # Lazy: actions that never build (incremental refresh assigns to
+        # existing centroids via write_partitions) skip construction.
+        if self._builder is None:
+            self._builder = VectorIndexBuilder()
+        return self._builder
 
     def _num_partitions(self) -> int:
         if self.vconfig.num_partitions is not None:

@@ -30,7 +30,6 @@ from hyperspace_tpu.metadata.log_entry import IndexLogEntry
 from hyperspace_tpu.ops.topk import topk
 from hyperspace_tpu.plan.nodes import LogicalPlan, Scan
 from hyperspace_tpu.schema import Schema
-from hyperspace_tpu.vector.index import CENTROIDS_NAME
 
 
 @dataclasses.dataclass
@@ -163,8 +162,13 @@ def ann_search(
             f"metric {dd.metric!r}; omit metric or disable hyperspace for an "
             "exact search with the requested metric"
         )
-    version_dir = Path(entry.content.root) / entry.content.directories[-1]
-    centroids = np.load(version_dir / CENTROIDS_NAME)
+    # Incremental refresh keeps several version dirs live: partition p is
+    # the union of p's files across dirs (the covering index's hybrid
+    # layout). Centroids come from the newest dir carrying a copy.
+    from hyperspace_tpu.vector.lifecycle import load_centroids
+
+    dirs = [Path(entry.content.root) / d for d in entry.content.directories]
+    centroids = load_centroids(entry)
     num_partitions = dd.num_partitions
     nprobe = num_partitions if nprobe is None else min(nprobe, num_partitions)
 
@@ -176,23 +180,15 @@ def ann_search(
     cscores = _device_scores(dd.metric, qv, centroids)
     _, probe = topk(cscores, nprobe)  # [q, nprobe]
 
-    # Stage 2: candidate geometry from the manifest — no payload IO yet.
+    # Stage 2: candidate geometry from the manifests — no payload IO yet.
+    # One rows[(dir, p)] map per query batch; stages 3 and 4 reuse it so
+    # the stat/manifest lookups run once per (dir, partition).
     needed = sorted(set(int(p) for p in probe.reshape(-1)))
     schema = Schema.from_json(dd.schema)
-    manifest = hio.read_manifest(version_dir)
-    if manifest is not None:
-        all_rows = manifest["bucketRows"]
-        sizes = np.array([all_rows[p] for p in needed], dtype=np.int64)
-    else:  # manifest missing: fall back to parquet metadata
-        import pyarrow.parquet as pq
-
-        sizes = np.array(
-            [
-                pq.read_metadata(version_dir / hio.bucket_file_name(p)).num_rows
-                for p in needed
-            ],
-            dtype=np.int64,
-        )
+    rows_map = {(d, p): _partition_rows(d, p) for p in needed for d in dirs}
+    sizes = np.array(
+        [sum(rows_map[(d, p)] for d in dirs) for p in needed], dtype=np.int64
+    )
     offsets = np.concatenate([[0], np.cumsum(sizes)])
     cand_part = np.repeat(np.array(needed, dtype=np.int32), sizes)
 
@@ -204,9 +200,13 @@ def ann_search(
     import jax.numpy as jnp
 
     emb_name = schema.field(dd.embedding_column).name
-    emb_dev = jnp.concatenate(
-        [_partition_device_emb(version_dir, p, schema, emb_name) for p in needed]
-    )
+    emb_parts = [
+        _partition_device_emb(d, p, schema, emb_name)
+        for p in needed
+        for d in dirs
+        if rows_map[(d, p)] > 0
+    ]
+    emb_dev = jnp.concatenate(emb_parts) if emb_parts else jnp.zeros((0, dd.dim), jnp.float32)
     scores = _device_scores(dd.metric, qv, emb_dev)  # [q, m] on device
     probed_mask = np.zeros((len(qv), num_partitions), dtype=bool)
     probed_mask[np.arange(len(qv))[:, None], probe] = True
@@ -226,13 +226,38 @@ def ann_search(
     group_order = np.argsort(owner, kind="stable")
     grouped: list[ColumnTable] = []
     for o in np.unique(owner):
-        part_table = _read_partition(version_dir, needed[int(o)], schema)
+        part_table = _read_partition_multi(dirs, needed[int(o)], schema, rows_map)
         grouped.append(part_table.take(local[owner == o]))
     regrouped = ColumnTable.concat(grouped)
     inverse = np.empty(len(flat), dtype=np.int64)
     inverse[group_order] = np.arange(len(flat))
     rows = regrouped.take(inverse)
     return _result_with_query_ids(rows, vals)
+
+
+def _partition_rows(version_dir: Path, p: int) -> int:
+    """Row count of partition p in one version dir (0 when the dir has no
+    file for it), from the dir's manifest or the parquet footer."""
+    path = version_dir / hio.bucket_file_name(p)
+    if not path.exists():
+        return 0
+    manifest = hio.read_manifest_cached(version_dir)
+    if manifest is not None and p < len(manifest.get("bucketRows", [])):
+        return int(manifest["bucketRows"][p])
+    import pyarrow.parquet as pq
+
+    return int(pq.read_metadata(path).num_rows)
+
+
+def _read_partition_multi(dirs: list[Path], p: int, schema: Schema, rows_map: dict) -> ColumnTable:
+    """Partition p's payload rows concatenated across version dirs, in the
+    SAME dir order the embedding concat uses (offsets stay aligned)."""
+    parts = [
+        _read_partition(d, p, schema) for d in dirs if rows_map[(d, p)] > 0
+    ]
+    if not parts:
+        return ColumnTable.empty(schema)
+    return ColumnTable.concat(parts) if len(parts) > 1 else parts[0]
 
 
 # Per-process partition read cache: (path, mtime_ns) → ColumnTable. The
