@@ -227,6 +227,10 @@ class Executor:
             "num_buckets": None,
             "agg_path": None,
         }
+        # Executed physical plan, built as the query runs (the analog of
+        # the reference diffing executedPlans, PlanAnalyzer.scala:163-178).
+        self.physical_plan = None
+        self._cur_phys = None
 
     def execute(self, plan: LogicalPlan) -> ColumnTable:
         from hyperspace_tpu.plan.prune import prune_columns
@@ -234,24 +238,66 @@ class Executor:
         return self._execute(prune_columns(plan))
 
     def _execute(self, plan: LogicalPlan) -> ColumnTable:
+        from hyperspace_tpu.execution.physical import PhysicalNode
+
+        node = PhysicalNode(op=type(plan).__name__)
+        parent, self._cur_phys = self._cur_phys, node
+        if parent is not None:
+            parent.children.append(node)
+        else:
+            self.physical_plan = node
+        files_before = self.stats["files_read"]
+        try:
+            result = self._dispatch(plan)
+        finally:
+            self._cur_phys = parent
+        # Physical file IO attributed to THIS operator = its frame's delta
+        # minus what child frames already claimed.
+        subtree = self.stats["files_read"] - files_before
+        node._subtree_files = subtree
+        own = subtree - sum(getattr(c, "_subtree_files", 0) for c in node.children)
+        if own > 0:
+            node.detail.setdefault("files", own)
+        node.rows_out = result.num_rows
+        return result
+
+    def _dispatch(self, plan: LogicalPlan) -> ColumnTable:
         if isinstance(plan, Scan):
+            # Labeled here, not in _scan: _scan also runs as a subroutine
+            # of other operators (hybrid delta reads) whose node must not
+            # be renamed.
+            if plan.bucket_spec is not None:
+                self._phys("IndexScan", buckets=plan.bucket_spec[0])
+            else:
+                self._phys("TableScan")
             return self._scan(plan)
         if isinstance(plan, Filter):
             return self._filter(plan)
         if isinstance(plan, Project):
+            self._cur_phys.detail["columns"] = list(plan.columns)
             return self._execute(plan.child).select(plan.columns)
         if isinstance(plan, Join):
             return self._join(plan)
         if isinstance(plan, Union):
+            self._cur_phys.op = "HybridScanUnion"
             return self._union(plan)
         if isinstance(plan, Aggregate):
             return self._aggregate(plan)
         if isinstance(plan, Sort):
             return self._sort(plan)
         if isinstance(plan, Limit):
+            self._cur_phys.detail["n"] = plan.n
             t = self._execute(plan.child)
             return t.take(np.arange(min(plan.n, t.num_rows)))
         raise HyperspaceError(f"cannot execute plan node {type(plan).__name__}")
+
+    def _phys(self, op: str | None = None, **detail) -> None:
+        """Annotate the operator currently executing."""
+        if self._cur_phys is None:
+            return
+        if op is not None:
+            self._cur_phys.op = op
+        self._cur_phys.detail.update(detail)
 
     # -- aggregate / sort -------------------------------------------------
     def _aggregate(self, plan: "Aggregate") -> ColumnTable:
@@ -259,15 +305,22 @@ class Executor:
 
         fused = self._try_fused_join_aggregate(plan)
         if fused is not None:
+            self._phys(
+                "FusedJoinAggregate",
+                join_path=self.stats["join_path"],
+                buckets=self.stats["num_buckets"],
+            )
             return fused
         table = self._execute(plan.child)
         self.stats["agg_path"] = "segment-reduce"
+        self._phys("SegmentReduceAggregate", groups=len(plan.group_by), aggs=len(plan.aggs))
         return aggregate_table(table, plan.group_by, plan.aggs, plan.schema)
 
     def _sort(self, plan: "Sort") -> ColumnTable:
         from hyperspace_tpu.ops.sortkeys import device_order_perm
 
         table = self._execute(plan.child)
+        self._phys("DeviceSort", keys=[c for c, _ in plan.by])
         if table.num_rows <= 1:
             return table
         return table.take(device_order_perm(table, plan.by))
@@ -319,13 +372,27 @@ class Executor:
     # -- filter (with index bucket pruning) ------------------------------
     def _filter(self, plan: Filter) -> ColumnTable:
         child = plan.child
+        # Per-OPERATOR pruning evidence: deltas of the query-cumulative
+        # counters from this frame's start.
+        fp0, rp0 = self.stats["files_pruned"], self.stats["rows_pruned"]
         if isinstance(child, Scan) and child.bucket_spec is not None:
             pruned = self._prune_bucket_files(child, plan.predicate)
             if pruned is not None:
+                self._phys(
+                    "IndexPointLookup",
+                    files_pruned=self.stats["files_pruned"] - fp0,
+                    kernel="bucket-hash-prune + fused-xla-mask",
+                )
                 table = self._cached_read(pruned, child.scan_schema.names, child.scan_schema)
                 return apply_filter(table, plan.predicate, mesh=self.mesh)
             ranged = self._range_read(child, plan.predicate)
             if ranged is not None:
+                self._phys(
+                    "IndexRangeScan",
+                    files_pruned=self.stats["files_pruned"] - fp0,
+                    rows_pruned=self.stats["rows_pruned"] - rp0,
+                    kernel="minmax-prune + searchsorted-slice + fused-xla-mask",
+                )
                 return apply_filter(ranged, plan.predicate, mesh=self.mesh)
         if isinstance(child, Union):
             # Hybrid scan: prune the bucketed input(s), keep deltas whole.
@@ -339,7 +406,13 @@ class Executor:
                     if pruned is not None:
                         inp = dataclasses.replace(inp, files=pruned)
                 new_inputs.append(inp)
+            self._phys(
+                "HybridScanFilter",
+                files_pruned=self.stats["files_pruned"] - fp0,
+                kernel="bucket/minmax-prune + fused-xla-mask",
+            )
             return apply_filter(self._union(Union(new_inputs)), plan.predicate, mesh=self.mesh)
+        self._phys(kernel="fused-xla-mask")
         return apply_filter(self._execute(child), plan.predicate, mesh=self.mesh)
 
     def _prune_bucket_files(self, scan: Scan, predicate: Expr) -> list[str] | None:
@@ -448,9 +521,22 @@ class Executor:
     # -- join ------------------------------------------------------------
     def _join(self, plan: Join) -> ColumnTable:
         lside, rside, left_side, right_side = self._join_sides(plan)
+        # Path from THIS frame's decision, not the global stat — a nested
+        # join executed inside _join_sides overwrites the latter. buckets/
+        # devices are read after _partition_join, which sets them for the
+        # kernel that just ran (this join's own).
+        path = "zero-exchange-aligned" if left_side is not None else "single-partition"
         if left_side is not None:
-            return self._aligned_join(plan, left_side, right_side, lside, rside)
-        return self._partition_join(plan, lside, rside)
+            out = self._aligned_join(plan, left_side, right_side, lside, rside)
+        else:
+            out = self._partition_join(plan, lside, rside)
+        self._phys(
+            "SortMergeJoin",
+            path=path,
+            buckets=self.stats["num_buckets"],
+            devices=self.stats["join_devices"],
+        )
+        return out
 
     def _join_sides(
         self, plan: Join

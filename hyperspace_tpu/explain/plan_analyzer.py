@@ -61,25 +61,30 @@ def pretty_plan(plan: LogicalPlan, indent: int = 0) -> str:
     )
 
 
-def _mark_diff(
-    a: LogicalPlan, b: LogicalPlan, marked_a: set, marked_b: set, path: tuple = ()
+def _mark_diff_trees(
+    a, b, marked_a: set, marked_b: set, label, children, path: tuple = ()
 ) -> None:
     """Queue-style pairwise walk (PlanAnalyzer.scala:56-101): nodes whose
     labels match recurse into their children; any mismatch marks BOTH
-    whole subtrees (by occurrence path) as differing."""
+    whole subtrees (by occurrence path) as differing. Shared by the
+    logical and executed-physical diffs via (label, children) accessors."""
 
-    def mark_subtree(p: LogicalPlan, acc: set, at: tuple) -> None:
+    def mark_subtree(p, acc: set, at: tuple) -> None:
         acc.add(at)
-        for i, c in enumerate(p.children()):
+        for i, c in enumerate(children(p)):
             mark_subtree(c, acc, at + (i,))
 
-    ca, cb = a.children(), b.children()
-    if _node_label(a) != _node_label(b) or len(ca) != len(cb):
+    ca, cb = children(a), children(b)
+    if label(a) != label(b) or len(ca) != len(cb):
         mark_subtree(a, marked_a, path)
         mark_subtree(b, marked_b, path)
         return
     for i, (x, y) in enumerate(zip(ca, cb)):
-        _mark_diff(x, y, marked_a, marked_b, path + (i,))
+        _mark_diff_trees(x, y, marked_a, marked_b, label, children, path + (i,))
+
+
+def _mark_diff(a: LogicalPlan, b: LogicalPlan, marked_a: set, marked_b: set) -> None:
+    _mark_diff_trees(a, b, marked_a, marked_b, _node_label, lambda p: p.children())
 
 
 def _render_highlighted(plan: LogicalPlan, marked: set, mode) -> str:
@@ -113,6 +118,83 @@ def _used_indexes(plan: LogicalPlan, session) -> list[str]:
         if str(entry.content.root) in roots:
             used.append(entry.name)
     return used
+
+
+def _physical_counts(root) -> Counter:
+    c: Counter = Counter()
+    for n in root.walk():
+        c[n.op] += 1
+    return c
+
+
+def _render_physical(root, marked: set, mode, path: tuple = (), indent: int = 0) -> list:
+    line = "  " * indent + root.label()
+    out = [mode.highlight(line) if path in marked else line]
+    for i, c in enumerate(root.children):
+        out.extend(_render_physical(c, marked, mode, path + (i,), indent + 1))
+    return out
+
+
+def explain_executed(plan: LogicalPlan, session, mode=None) -> str:
+    """EXECUTE the query twice (rules off / on) and diff the physical
+    plans that actually ran — files read, kernels chosen, bucket/device
+    counts, rows per operator. The analog of the reference diffing
+    executedPlans (PlanAnalyzer.scala:163-178) with per-operator stats
+    (PhysicalOperatorAnalyzer.scala:39-56); here the evidence is
+    measured, not estimated, because the executor IS the physical layer.
+    Note: this runs the query (twice); use explain() for a no-IO diff."""
+    from hyperspace_tpu.explain.display_mode import display_mode_from_conf
+
+    if mode is None:
+        mode = display_mode_from_conf(getattr(session, "conf", None))
+
+    was_enabled = session.is_hyperspace_enabled()
+    try:
+        session.disable_hyperspace()
+        session.run(plan)
+        phys_without = session.last_physical_plan
+        stats_without = session.last_query_stats
+        session.enable_hyperspace()
+        session.run(plan)
+        phys_with = session.last_physical_plan
+        stats_with = session.last_query_stats
+    finally:
+        session._enabled = was_enabled
+
+    marked_before: set = set()
+    marked_after: set = set()
+    _mark_diff_trees(
+        phys_without, phys_with, marked_before, marked_after,
+        lambda n: n.label(), lambda n: n.children,
+    )
+
+    out = []
+    out.append("=" * 64)
+    out.append("Executed plan with indexes:")
+    out.extend(_render_physical(phys_with, marked_after, mode))
+    out.append("=" * 64)
+    out.append("Executed plan without indexes:")
+    out.extend(_render_physical(phys_without, marked_before, mode))
+    out.append("=" * 64)
+    out.append("Physical operator stats:")
+    cb, ca = _physical_counts(phys_without), _physical_counts(phys_with)
+    for op in sorted(set(cb) | set(ca)):
+        out.append(f"  {op}: {cb.get(op, 0)} -> {ca.get(op, 0)}")
+    out.append(
+        f"  files read: {stats_without['files_read']} -> {stats_with['files_read']}"
+    )
+    out.append(
+        f"  files pruned: {stats_without['files_pruned']} -> {stats_with['files_pruned']}"
+    )
+    out.append(
+        f"  rows pruned: {stats_without['rows_pruned']} -> {stats_with['rows_pruned']}"
+    )
+    if stats_with.get("join_path"):
+        out.append(
+            f"  join path: {stats_without.get('join_path')} -> {stats_with['join_path']} "
+            f"({stats_with.get('join_devices', 1)} device(s))"
+        )
+    return mode.finalize("\n".join(out))
 
 
 def explain_string(

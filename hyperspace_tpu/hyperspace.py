@@ -35,8 +35,10 @@ class HyperspaceSession:
         self.mesh = mesh
         self._enabled = False
         self._manager: CachingIndexCollectionManager | None = None
-        # Executed-plan evidence of the most recent run() (Executor.stats).
+        # Executed-plan evidence of the most recent run(): Executor.stats
+        # and the executed PhysicalNode tree.
         self.last_query_stats: dict = {}
+        self.last_physical_plan = None
 
     # -- rule toggle (package.scala:46-70) --------------------------------
     def enable_hyperspace(self) -> "HyperspaceSession":
@@ -84,14 +86,25 @@ class HyperspaceSession:
         indexes = self.manager.get_indexes()
         return apply_rules(prune_columns(plan), indexes, conf=self.conf)
 
-    def run(self, plan: LogicalPlan):
+    def run(self, plan: LogicalPlan, profile_dir: str | Path | None = None):
         """Execute a plan (rewriting through indexes when enabled);
-        returns a ColumnTable."""
+        returns a ColumnTable. With `profile_dir`, the execution runs
+        under jax.profiler.trace and writes an xplane artifact there
+        (SURVEY.md §5: the TPU profiling story) — open with TensorBoard
+        or xprof."""
         from hyperspace_tpu.execution.executor import Executor
 
         executor = Executor(mesh=self.mesh)
-        result = executor.execute(self.optimized_plan(plan))
+        optimized = self.optimized_plan(plan)
+        if profile_dir is not None:
+            import jax
+
+            with jax.profiler.trace(str(profile_dir)):
+                result = executor.execute(optimized)
+        else:
+            result = executor.execute(optimized)
         self.last_query_stats = executor.stats
+        self.last_physical_plan = executor.physical_plan
         return result
 
     def to_pandas(self, plan: LogicalPlan):
@@ -145,7 +158,12 @@ class Hyperspace:
     def indexes(self):
         return self.session.manager.indexes()
 
-    def explain(self, plan: LogicalPlan, verbose: bool = False) -> str:
-        from hyperspace_tpu.explain.plan_analyzer import explain_string
+    def explain(self, plan: LogicalPlan, verbose: bool = False, physical: bool = False) -> str:
+        """Rules-off/on plan diff. physical=True EXECUTES both variants
+        and diffs the physical plans that actually ran (files read,
+        kernels, bucket/device counts, rows per operator)."""
+        from hyperspace_tpu.explain.plan_analyzer import explain_executed, explain_string
 
+        if physical:
+            return explain_executed(plan, self.session)
         return explain_string(plan, self.session, verbose=verbose)
