@@ -27,6 +27,12 @@ INDEX_HYBRID_SCAN_MAX_APPENDED_RATIO = "hyperspace.index.hybridscan.maxAppendedR
 # from the budget).
 INDEX_BUILD_MEMORY_BUDGET = "hyperspace.index.build.memoryBudgetBytes"
 INDEX_BUILD_CHUNK_BYTES = "hyperspace.index.build.chunkBytes"
+# Materialized-join execution venue: "auto" picks the host-native merge
+# kernel when measured device->host bandwidth is below joinVenueMinMbps
+# (the match pairs land on host either way; on tunneled devices the
+# readback dominates), else the device kernel. "device"/"host" force it.
+JOIN_VENUE = "hyperspace.join.venue"
+JOIN_VENUE_MIN_MBPS = "hyperspace.join.venueMinMbps"
 
 # Directory-layout constants (reference index/IndexConstants.scala:38-39).
 HYPERSPACE_LOG_DIR = "_hyperspace_log"
@@ -37,6 +43,8 @@ DEFAULT_NUM_BUCKETS = 8
 DEFAULT_CACHE_EXPIRY_SECONDS = 300.0
 DEFAULT_HYBRID_SCAN_MAX_APPENDED_RATIO = 0.3
 DEFAULT_BUILD_MEMORY_BUDGET = 4 << 30
+DEFAULT_JOIN_VENUE = "auto"
+DEFAULT_JOIN_VENUE_MIN_MBPS = 200.0
 
 
 @dataclasses.dataclass
@@ -50,6 +58,8 @@ class HyperspaceConf:
     hybrid_scan_max_appended_ratio: float = DEFAULT_HYBRID_SCAN_MAX_APPENDED_RATIO
     build_memory_budget_bytes: int = DEFAULT_BUILD_MEMORY_BUDGET
     build_chunk_bytes: int = 0  # 0 = derived from the budget
+    join_venue: str = DEFAULT_JOIN_VENUE
+    join_venue_min_mbps: float = DEFAULT_JOIN_VENUE_MIN_MBPS
     overrides: dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def __post_init__(self):
@@ -72,6 +82,10 @@ class HyperspaceConf:
             self.build_memory_budget_bytes = int(value)
         elif key == INDEX_BUILD_CHUNK_BYTES:
             self.build_chunk_bytes = int(value)
+        elif key == JOIN_VENUE:
+            self.join_venue = str(value)
+        elif key == JOIN_VENUE_MIN_MBPS:
+            self.join_venue_min_mbps = float(value)
 
     def get(self, key: str, default: Any = None) -> Any:
         if key in self.overrides:
@@ -90,4 +104,8 @@ class HyperspaceConf:
             return self.build_memory_budget_bytes
         if key == INDEX_BUILD_CHUNK_BYTES:
             return self.build_chunk_bytes
+        if key == JOIN_VENUE:
+            return self.join_venue
+        if key == JOIN_VENUE_MIN_MBPS:
+            return self.join_venue_min_mbps
         return default

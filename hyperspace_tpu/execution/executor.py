@@ -216,13 +216,15 @@ class Executor:
     `stats` records what physically ran (files read, kernels, devices) —
     the executed-plan evidence explain consumes."""
 
-    def __init__(self, mesh=None):
+    def __init__(self, mesh=None, conf=None):
         self.mesh = mesh
+        self.conf = conf
         self.stats: dict = {
             "files_read": 0,
             "files_pruned": 0,
             "rows_pruned": 0,
             "join_path": None,
+            "join_kernel": None,
             "join_devices": 1,
             "num_buckets": None,
             "agg_path": None,
@@ -290,6 +292,29 @@ class Executor:
             t = self._execute(plan.child)
             return t.take(np.arange(min(plan.n, t.num_rows)))
         raise HyperspaceError(f"cannot execute plan node {type(plan).__name__}")
+
+    def _join_venue(self) -> str:
+        """auto: host when the measured device→host link is slower than
+        the configured floor (tunneled deployments) AND the native library
+        built; the pairs land on host either way."""
+        venue = self.conf.join_venue if self.conf is not None else "auto"
+        if venue in ("device", "host"):
+            return venue
+        if venue != "auto":
+            raise HyperspaceError(
+                f"unknown hyperspace.join.venue={venue!r} (auto|device|host)"
+            )
+        from hyperspace_tpu import native
+
+        # Auto with a mesh keeps the distributed device kernel (the
+        # query-plane sharding is the point); forced "host" above still
+        # wins — the host kernel is bucket-parallel too.
+        if self.mesh is not None or not native.available():
+            return "device"
+        from hyperspace_tpu.parallel.bandwidth import d2h_mb_per_s
+
+        floor = self.conf.join_venue_min_mbps if self.conf is not None else 200.0
+        return "host" if d2h_mb_per_s() < floor else "device"
 
     def _phys(self, op: str | None = None, **detail) -> None:
         """Annotate the operator currently executing."""
@@ -533,6 +558,7 @@ class Executor:
         self._phys(
             "SortMergeJoin",
             path=path,
+            kernel=self.stats["join_kernel"],
             buckets=self.stats["num_buckets"],
             devices=self.stats["join_devices"],
         )
@@ -838,23 +864,41 @@ class Executor:
 
         lcodes, lperm = _bucket_sorted_codes(lcodes, lside)
         rcodes, rperm = _bucket_sorted_codes(rcodes, rside)
-        lk = _pad_bucket_major(lcodes, lside.offsets)
-        rk = _pad_bucket_major(rcodes, rside.offsets)
-        b = lk.shape[0]
-
-        if self.mesh is not None:
-            from hyperspace_tpu.parallel.mesh import mesh_for_parallelism, mesh_size
-
-            jmesh = mesh_for_parallelism(self.mesh, b)
-            li_flat, ri_flat, totals = join_ops.merge_join_sharded(lk, rk, jmesh)
-            self.stats["join_devices"] = mesh_size(jmesh)
-        else:
-            li_flat, ri_flat, totals = join_ops.merge_join(lk, rk)
+        b = len(lside.offsets) - 1
         self.stats["num_buckets"] = b
 
-        # Local (within-bucket) match indices → global row indices.
-        lidx = np.repeat(lside.offsets[:-1], totals) + li_flat
-        ridx = np.repeat(rside.offsets[:-1], totals) + ri_flat
+        host_res = None
+        if (
+            lcodes.dtype == np.int32
+            and rcodes.dtype == np.int32
+            and self._join_venue() == "host"
+        ):
+            from hyperspace_tpu import native
+
+            host_res = native.merge_join_sorted(
+                lcodes, lside.offsets, rcodes, rside.offsets
+            )
+        if host_res is not None:
+            # Host venue: exact bucket-parallel C++ merge over the already
+            # host-resident sorted runs — no device round-trip (the match
+            # pairs land on host either way; see parallel/bandwidth.py).
+            lidx, ridx, totals = host_res
+            self.stats["join_kernel"] = "host-native-merge"
+        else:
+            lk = _pad_bucket_major(lcodes, lside.offsets)
+            rk = _pad_bucket_major(rcodes, rside.offsets)
+            if self.mesh is not None:
+                from hyperspace_tpu.parallel.mesh import mesh_for_parallelism, mesh_size
+
+                jmesh = mesh_for_parallelism(self.mesh, b)
+                li_flat, ri_flat, totals = join_ops.merge_join_sharded(lk, rk, jmesh)
+                self.stats["join_devices"] = mesh_size(jmesh)
+            else:
+                li_flat, ri_flat, totals = join_ops.merge_join(lk, rk)
+            self.stats["join_kernel"] = "device-searchsorted"
+            # Local (within-bucket) match indices → global row indices.
+            lidx = np.repeat(lside.offsets[:-1], totals) + li_flat
+            ridx = np.repeat(rside.offsets[:-1], totals) + ri_flat
         if lperm is not None:
             lidx = lperm[lidx]
         if rperm is not None:

@@ -94,7 +94,7 @@ class HyperspaceSession:
         or xprof."""
         from hyperspace_tpu.execution.executor import Executor
 
-        executor = Executor(mesh=self.mesh)
+        executor = Executor(mesh=self.mesh, conf=self.conf)
         optimized = self.optimized_plan(plan)
         if profile_dir is not None:
             import jax

@@ -83,6 +83,8 @@ def _load() -> ctypes.CDLL | None:
         ctypes.c_void_p, ctypes.c_void_p, i64p, ctypes.c_int64, ctypes.c_int64,
     ]
     lib.hs_combine.argtypes = [u32p, u32p, ctypes.c_int64]
+    lib.hs_mj_count.argtypes = [i32p, i64p, i32p, i64p, ctypes.c_int64, i64p]
+    lib.hs_mj_fill.argtypes = [i32p, i64p, i32p, i64p, i64p, ctypes.c_int64, i64p, i64p]
     _lib = lib
     return _lib
 
@@ -143,6 +145,32 @@ def take_rows(arr: np.ndarray, idx: np.ndarray) -> np.ndarray | None:
         idx, len(idx), row_bytes,
     )
     return out
+
+
+def merge_join_sorted(
+    lk: np.ndarray, lofs: np.ndarray, rk: np.ndarray, rofs: np.ndarray
+) -> tuple[np.ndarray, np.ndarray, np.ndarray] | None:
+    """Exact bucket-parallel merge join over within-bucket-sorted int32
+    codes. Returns (li, ri, totals): GLOBAL row indices (int64) in
+    bucket-major match order, and per-bucket match counts. None when the
+    library is unavailable (caller uses the device path)."""
+    lib = _load()
+    if lib is None:
+        return None
+    lk = np.ascontiguousarray(lk, dtype=np.int32)
+    rk = np.ascontiguousarray(rk, dtype=np.int32)
+    lofs = np.ascontiguousarray(lofs, dtype=np.int64)
+    rofs = np.ascontiguousarray(rofs, dtype=np.int64)
+    nb = len(lofs) - 1
+    counts = np.zeros(nb, dtype=np.int64)
+    lib.hs_mj_count(lk, lofs, rk, rofs, nb, counts)
+    oofs = np.zeros(nb + 1, dtype=np.int64)
+    np.cumsum(counts, out=oofs[1:])
+    total = int(oofs[-1])
+    li = np.empty(total, dtype=np.int64)
+    ri = np.empty(total, dtype=np.int64)
+    lib.hs_mj_fill(lk, lofs, rk, rofs, oofs, nb, li, ri)
+    return li, ri, counts
 
 
 def combine(acc: np.ndarray, h: np.ndarray) -> np.ndarray | None:

@@ -1,0 +1,32 @@
+"""Device→host transfer probe.
+
+On a directly-attached TPU, PCIe readback runs at GB/s; through a
+tunneled/remote device it can be tens of MB/s with ~100ms per-transfer
+latency — 100x slower than host memory. Operators whose OUTPUT must land
+on host (a materialized join's match pairs) pick their execution venue
+by this number: below the threshold, computing on host beats shipping
+results off the device. Probed once per process with a 4 MB transfer.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+
+@functools.lru_cache(maxsize=1)
+def d2h_mb_per_s() -> float:
+    """Measured device→host bandwidth (MB/s), probed once."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    try:
+        x = jnp.arange(1 << 20, dtype=jnp.uint32)  # 4 MB
+        x.block_until_ready()
+        t0 = time.perf_counter()
+        np.asarray(jax.device_get(x))
+        dt = time.perf_counter() - t0
+        return 4.0 / max(dt, 1e-9)
+    except Exception:
+        return float("inf")  # probe failure: assume fast, keep device path
