@@ -33,6 +33,9 @@ INDEX_BUILD_CHUNK_BYTES = "hyperspace.index.build.chunkBytes"
 # readback dominates), else the device kernel. "device"/"host" force it.
 JOIN_VENUE = "hyperspace.join.venue"
 JOIN_VENUE_MIN_MBPS = "hyperspace.join.venueMinMbps"
+# Build sort venue: same auto/device/host scheme for the bucketize+sort
+# permutation (its only output lands on host).
+BUILD_VENUE = "hyperspace.build.venue"
 
 # Directory-layout constants (reference index/IndexConstants.scala:38-39).
 HYPERSPACE_LOG_DIR = "_hyperspace_log"
@@ -60,6 +63,7 @@ class HyperspaceConf:
     build_chunk_bytes: int = 0  # 0 = derived from the budget
     join_venue: str = DEFAULT_JOIN_VENUE
     join_venue_min_mbps: float = DEFAULT_JOIN_VENUE_MIN_MBPS
+    build_venue: str = DEFAULT_JOIN_VENUE
     overrides: dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def __post_init__(self):
@@ -86,6 +90,8 @@ class HyperspaceConf:
             self.join_venue = str(value)
         elif key == JOIN_VENUE_MIN_MBPS:
             self.join_venue_min_mbps = float(value)
+        elif key == BUILD_VENUE:
+            self.build_venue = str(value)
 
     def get(self, key: str, default: Any = None) -> Any:
         if key in self.overrides:
@@ -108,4 +114,6 @@ class HyperspaceConf:
             return self.join_venue
         if key == JOIN_VENUE_MIN_MBPS:
             return self.join_venue_min_mbps
+        if key == BUILD_VENUE:
+            return self.build_venue
         return default

@@ -81,6 +81,20 @@ def _pad_to(arr: np.ndarray, n: int, fill=0) -> np.ndarray:
     return np.concatenate([arr, pad])
 
 
+def _host_sort_perms(tables, indexed_columns: list[str]) -> list[np.ndarray]:
+    """Per-table stable key-sort permutations via the native kernel (the
+    streaming build's host sort venue; same order as device_sort_perms)."""
+    from hyperspace_tpu import native
+    from hyperspace_tpu.ops.sortkeys import key_lanes, lanes_as_unsigned
+
+    perms = []
+    for t in tables:
+        perm = np.arange(t.num_rows, dtype=np.int64)
+        native.sort_range(perm, lanes_as_unsigned(key_lanes(t, indexed_columns)))
+        perms.append(perm)
+    return perms
+
+
 class DeviceIndexBuilder:
     """IndexWriter over a device mesh (defaults to all local devices).
 
@@ -104,6 +118,8 @@ class DeviceIndexBuilder:
         capacity_factor: float = 2.0,
         memory_budget_bytes: int | None = None,
         chunk_bytes: int | None = None,
+        venue: str = "auto",
+        venue_min_mbps: float = 200.0,
     ):
         self._mesh = mesh
         self.capacity_factor = capacity_factor
@@ -111,8 +127,26 @@ class DeviceIndexBuilder:
             memory_budget_bytes = DEFAULT_BUILD_MEMORY_BUDGET
         self.memory_budget_bytes = memory_budget_bytes
         self.chunk_bytes = chunk_bytes or max(16 << 20, memory_budget_bytes // 8)
+        self.venue = venue
+        self.venue_min_mbps = venue_min_mbps
         self.last_build_stats: dict = {}
         enable_compile_cache()
+
+    def _sort_venue(self, mesh) -> str:
+        """Where the bucketize+sort permutation is computed. The sort's
+        only output is a row-id permutation that must land on host; on a
+        slow device→host link (tunneled TPU) the readback dominates, so
+        auto picks the threaded C++ counting-sort + per-bucket key sort
+        when a single device would run the exchange anyway. A real
+        multi-device mesh keeps the device all_to_all path in auto mode
+        (the distributed exchange is the point); a forced venue wins."""
+        from hyperspace_tpu.parallel.bandwidth import pick_venue
+
+        return pick_venue(
+            self.venue, self.venue_min_mbps,
+            prefer_device=mesh_size(mesh) > 1,
+            what="hyperspace.build.venue",
+        )
 
     def _mesh_for(self, num_buckets: int) -> Mesh:
         # Shrink to the largest device count dividing num_buckets
@@ -170,18 +204,35 @@ class DeviceIndexBuilder:
         key_names = [table.schema.field(c).name for c in indexed_columns]
         lanes = key_lanes(table, indexed_columns)
 
-        # Pad rows to a multiple of the mesh size; rows past n are pads
-        # (the device derives validity from the global row id).
-        n_pad = max(d, math.ceil(max(n, 1) / d) * d)
-        bucket_p = _pad_to(bucket, n_pad)
-        lanes_p = [_pad_to(l, n_pad) for l in lanes]
+        sort_fn = None
+        if self._sort_venue(mesh) == "host":
+            # Host venue: C++ counting-sort by bucket now; each bucket's
+            # key sort runs INSIDE its carve task (sort_fn) so sorting
+            # pipelines with the parquet encode of other buckets — no
+            # device round-trip (the permutation is the sort's only
+            # output and it must land on host).
+            from hyperspace_tpu import native
+            from hyperspace_tpu.ops.sortkeys import lanes_as_unsigned
 
-        # Device: the exchange (Spark-shuffle analog, single all_to_all)
-        # fused with the per-shard lex sort by (bucket, key lanes); ONE
-        # int32-per-row readback (the permutation).
-        order, bucket_rows = bucketize_perm(
-            mesh, lanes_p, bucket_p, n, num_buckets, self.capacity_factor
-        )
+            order, bucket_rows = native.bucket_perm(bucket, num_buckets)
+            lanes_u = lanes_as_unsigned(lanes)
+
+            def sort_fn(p: int, sel: np.ndarray) -> np.ndarray:
+                native.sort_range(sel, lanes_u)
+                return sel
+        else:
+            # Pad rows to a multiple of the mesh size; rows past n are pads
+            # (the device derives validity from the global row id).
+            n_pad = max(d, math.ceil(max(n, 1) / d) * d)
+            bucket_p = _pad_to(bucket, n_pad)
+            lanes_p = [_pad_to(l, n_pad) for l in lanes]
+
+            # Device: the exchange (Spark-shuffle analog, single all_to_all)
+            # fused with the per-shard lex sort by (bucket, key lanes); ONE
+            # int32-per-row readback (the permutation).
+            order, bucket_rows = bucketize_perm(
+                mesh, lanes_p, bucket_p, n, num_buckets, self.capacity_factor
+            )
         if len(order) != n:
             raise HyperspaceError(
                 f"row count changed through exchange: {n} → {len(order)}"
@@ -192,15 +243,16 @@ class DeviceIndexBuilder:
 
         # Host: carve into per-bucket files, gathering each bucket's rows
         # by its slice of the permutation INSIDE the write threads (the
-        # gather overlaps the parquet encode of other buckets).
+        # gather overlaps the parquet encode — and, host venue, the key
+        # sort — of other buckets). Devices own contiguous bucket ranges
+        # in mesh order and each shard is bucket-sorted, so the compacted
+        # global bucket array is sorted.
         field_names = [f.name for f in table.schema.fields]
         payload_names = [c for c in field_names if c not in key_names]
-        ordered = key_names + payload_names
-        # Devices own contiguous bucket ranges in mesh order and each shard
-        # is bucket-sorted, so the compacted global bucket array is sorted.
         hio.carve_and_write(
-            Path(dest_path), table.select(ordered), compact_bucket, num_buckets,
-            indexed_columns, order=order,
+            Path(dest_path), table.select(key_names + payload_names),
+            compact_bucket, num_buckets, indexed_columns,
+            order=order, sort_fn=sort_fn,
         )
 
     # -- streaming out-of-core build -------------------------------------
@@ -297,6 +349,7 @@ class DeviceIndexBuilder:
                 batches.append(cur)
 
             key_stats: list = [None] * num_buckets
+            sort_venue = self._sort_venue(self._mesh_for(num_buckets))
             with ThreadPoolExecutor(max_workers=8) as pool:
                 empty = ColumnTable.empty(sub_schema.select(ordered))
                 for b in range(num_buckets):
@@ -304,7 +357,10 @@ class DeviceIndexBuilder:
                         hio.write_bucket(dest, b, empty)
                 for ids in batches:
                     tables = list(pool.map(lambda b: hio.read_parquet([spill_files[b]]), ids))
-                    perms = device_sort_perms(tables, indexed_columns)
+                    if sort_venue == "host":
+                        perms = _host_sort_perms(tables, indexed_columns)
+                    else:
+                        perms = device_sort_perms(tables, indexed_columns)
                     futs = [
                         pool.submit(hio.write_bucket, dest, b, t.take(p))
                         for b, t, p in zip(ids, tables, perms)

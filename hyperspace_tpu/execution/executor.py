@@ -297,24 +297,17 @@ class Executor:
         """auto: host when the measured device→host link is slower than
         the configured floor (tunneled deployments) AND the native library
         built; the pairs land on host either way."""
-        venue = self.conf.join_venue if self.conf is not None else "auto"
-        if venue in ("device", "host"):
-            return venue
-        if venue != "auto":
-            raise HyperspaceError(
-                f"unknown hyperspace.join.venue={venue!r} (auto|device|host)"
-            )
-        from hyperspace_tpu import native
+        from hyperspace_tpu.parallel.bandwidth import pick_venue
 
         # Auto with a mesh keeps the distributed device kernel (the
-        # query-plane sharding is the point); forced "host" above still
-        # wins — the host kernel is bucket-parallel too.
-        if self.mesh is not None or not native.available():
-            return "device"
-        from hyperspace_tpu.parallel.bandwidth import d2h_mb_per_s
-
-        floor = self.conf.join_venue_min_mbps if self.conf is not None else 200.0
-        return "host" if d2h_mb_per_s() < floor else "device"
+        # query-plane sharding is the point); a forced "host" wins — the
+        # host kernel is bucket-parallel too.
+        return pick_venue(
+            self.conf.join_venue if self.conf is not None else "auto",
+            self.conf.join_venue_min_mbps if self.conf is not None else 200.0,
+            prefer_device=self.mesh is not None,
+            what="hyperspace.join.venue",
+        )
 
     def _phys(self, op: str | None = None, **detail) -> None:
         """Annotate the operator currently executing."""

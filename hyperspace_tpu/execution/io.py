@@ -245,7 +245,14 @@ def bucket_key_stats(table: ColumnTable, key: str, sel: np.ndarray | None = None
 
 def write_bucket(dest_dir: Path, bucket: int, table: ColumnTable) -> None:
     dest_dir.mkdir(parents=True, exist_ok=True)
-    pq.write_table(table.to_arrow(), dest_dir / bucket_file_name(bucket))
+    # Dictionary-encode ONLY string columns: for numeric index data,
+    # parquet dictionary encoding costs ~6x encode time AND grows the
+    # files (high-cardinality keys, float payloads); for low-cardinality
+    # strings it still wins.
+    dict_cols = [f.name for f in table.schema.fields if f.is_string]
+    pq.write_table(
+        table.to_arrow(), dest_dir / bucket_file_name(bucket), use_dictionary=dict_cols
+    )
 
 
 def write_manifest(
@@ -327,14 +334,18 @@ def carve_and_write(
     num_partitions: int,
     indexed_columns: list[str],
     order: "np.ndarray | None" = None,
+    sort_fn=None,
 ) -> list[int]:
     """Carve `table` into one parquet file per partition + manifest.
 
     `sorted_partition` is the non-decreasing partition id per carved row;
     `order` (optional) maps carved row i to `table` row order[i] (identity
-    when the table is already in carved order). Parquet encode releases
-    the GIL, so buckets are written concurrently. Returns per-partition
-    row counts (also persisted in the manifest)."""
+    when the table is already in carved order). `sort_fn(p, sel)` (optional)
+    finalizes partition p's selection inside its write task — the host
+    build venue passes the per-bucket native key sort here so sorting
+    PIPELINES with the parquet encode of other buckets. Encode and sort
+    both release the GIL, so buckets run concurrently. Returns
+    per-partition row counts (also persisted in the manifest)."""
     from concurrent.futures import ThreadPoolExecutor
 
     dest = Path(dest)
@@ -346,11 +357,13 @@ def carve_and_write(
     def write_one(p: int) -> None:
         lo, hi = int(starts[p]), int(starts[p + 1])
         sel = np.arange(lo, hi) if order is None else order[lo:hi]
+        if sort_fn is not None:
+            sel = sort_fn(p, sel)
         if indexed_columns:
             key_stats[p] = bucket_key_stats(table, indexed_columns[0], sel)
         write_bucket(dest, p, table.take(sel))
 
-    with ThreadPoolExecutor(max_workers=min(8, max(1, num_partitions))) as ex:
+    with ThreadPoolExecutor(max_workers=min(16, max(1, num_partitions))) as ex:
         list(ex.map(write_one, range(num_partitions)))
     has_stats = any(s is not None for s in key_stats)
     write_manifest(dest, num_partitions, indexed_columns, rows, key_stats if has_stats else None)

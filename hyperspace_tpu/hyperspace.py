@@ -63,6 +63,8 @@ class HyperspaceSession:
                     mesh=self.mesh,
                     memory_budget_bytes=self.conf.build_memory_budget_bytes,
                     chunk_bytes=self.conf.build_chunk_bytes or None,
+                    venue=self.conf.build_venue,
+                    venue_min_mbps=self.conf.join_venue_min_mbps,
                 )
 
             self._manager = CachingIndexCollectionManager(self.conf, writer_factory)

@@ -85,6 +85,8 @@ def _load() -> ctypes.CDLL | None:
     lib.hs_combine.argtypes = [u32p, u32p, ctypes.c_int64]
     lib.hs_mj_count.argtypes = [i32p, i64p, i32p, i64p, ctypes.c_int64, i64p]
     lib.hs_mj_fill.argtypes = [i32p, i64p, i32p, i64p, i64p, ctypes.c_int64, i64p, i64p]
+    lib.hs_bucket_perm.argtypes = [i32p, ctypes.c_int64, ctypes.c_int64, i64p, i64p]
+    lib.hs_sort_range.argtypes = [i64p, ctypes.c_int64, u32p, ctypes.c_int64, ctypes.c_int64]
     _lib = lib
     return _lib
 
@@ -145,6 +147,39 @@ def take_rows(arr: np.ndarray, idx: np.ndarray) -> np.ndarray | None:
         idx, len(idx), row_bytes,
     )
     return out
+
+
+def bucket_perm(
+    bucket: np.ndarray, num_buckets: int
+) -> tuple[np.ndarray, np.ndarray] | None:
+    """Stable counting sort of row ids by bucket. Returns (perm int64,
+    per-bucket counts int64), or None when the library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    bucket = np.ascontiguousarray(bucket, dtype=np.int32)
+    perm = np.empty(len(bucket), dtype=np.int64)
+    counts = np.zeros(num_buckets, dtype=np.int64)
+    lib.hs_bucket_perm(bucket, len(bucket), num_buckets, perm, counts)
+    return perm, counts
+
+
+def sort_range(perm_slice: np.ndarray, lanes_u32: np.ndarray) -> bool:
+    """In-place key sort of one bucket's contiguous permutation slice by
+    the [L, n] unsigned lanes (GIL released — pipelines with encode)."""
+    lib = _load()
+    if lib is None:
+        return False
+    assert perm_slice.flags.c_contiguous and perm_slice.dtype == np.int64
+    num_lanes = lanes_u32.shape[0] if lanes_u32.ndim == 2 else 0
+    lib.hs_sort_range(
+        perm_slice,
+        len(perm_slice),
+        lanes_u32 if num_lanes else np.zeros((1, 1), np.uint32),
+        lanes_u32.shape[1] if num_lanes else 0,
+        num_lanes,
+    )
+    return True
 
 
 def merge_join_sorted(

@@ -16,6 +16,7 @@
 // entry point has a numpy fallback, so the library is an accelerator, never
 // a dependency.
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <functional>
@@ -178,6 +179,51 @@ void hs_take_rows(const uint8_t* src, uint8_t* dst, const int64_t* idx,
 void hs_combine(uint32_t* acc, const uint32_t* h, int64_t n) {
   parallel_for(n, 1 << 16, [&](int64_t lo, int64_t hi) {
     for (int64_t i = lo; i < hi; ++i) acc[i] = mix32(acc[i] * 31u + h[i]);
+  });
+}
+
+// ---- bucket-grouped key sort ----------------------------------------------
+// The host venue of the build's bucketize+sort, split in two so the Python
+// side can PIPELINE each bucket's key sort with its parquet encode:
+//
+//   1. hs_bucket_perm: stable counting sort of row ids by bucket;
+//   2. hs_sort_range: sort one bucket's slice of the permutation by the
+//      order-preserving uint32 key lanes (original index as the final
+//      tiebreak — deterministic, equal to the device path's stable
+//      lexicographic order). lanes is [num_lanes, n] row-major.
+
+void hs_bucket_perm(const int32_t* bucket, int64_t n, int64_t num_buckets,
+                    int64_t* perm, int64_t* counts) {
+  for (int64_t b = 0; b < num_buckets; ++b) counts[b] = 0;
+  for (int64_t i = 0; i < n; ++i) ++counts[bucket[i]];
+  std::vector<int64_t> cur(num_buckets, 0);
+  for (int64_t b = 1; b < num_buckets; ++b) cur[b] = cur[b - 1] + counts[b - 1];
+  for (int64_t i = 0; i < n; ++i) perm[cur[bucket[i]]++] = i;
+}
+
+void hs_sort_range(int64_t* perm, int64_t count, const uint32_t* lanes,
+                   int64_t n, int64_t num_lanes) {
+  if (num_lanes <= 2) {
+    // Fast path (int32/int64/float keys = 1-2 lanes): pack into one u64
+    // so the slice sorts contiguous 16-byte (key, idx) pairs instead of
+    // gather-loading lanes in the comparator.
+    std::vector<std::pair<uint64_t, int64_t>> buf(count);
+    for (int64_t p = 0; p < count; ++p) {
+      int64_t i = perm[p];
+      uint64_t k = num_lanes ? (static_cast<uint64_t>(lanes[i]) << 32) : 0;
+      if (num_lanes == 2) k |= lanes[n + i];
+      buf[p] = {k, i};
+    }
+    std::sort(buf.begin(), buf.end());
+    for (int64_t p = 0; p < count; ++p) perm[p] = buf[p].second;
+    return;
+  }
+  std::sort(perm, perm + count, [&](int64_t a, int64_t c) {
+    for (int64_t l = 0; l < num_lanes; ++l) {
+      uint32_t x = lanes[l * n + a], y = lanes[l * n + c];
+      if (x != y) return x < y;
+    }
+    return a < c;
   });
 }
 

@@ -14,6 +14,32 @@ import functools
 import time
 
 
+def pick_venue(requested: str, floor_mbps: float, prefer_device: bool, what: str) -> str:
+    """Shared auto/device/host venue selection (join merge, build sort).
+
+    `requested` other than auto forces the venue — forcing "host" without
+    the native library is an error, not a silent device fallback.
+    `prefer_device` wins the auto case (e.g. a real multi-device mesh,
+    where the distributed kernel is the point)."""
+    from hyperspace_tpu import native
+    from hyperspace_tpu.exceptions import HyperspaceError
+
+    if requested == "host":
+        if not native.available():
+            raise HyperspaceError(
+                f"{what}=host requires the native library (g++ build failed "
+                "or unavailable); use auto or device"
+            )
+        return "host"
+    if requested == "device":
+        return "device"
+    if requested != "auto":
+        raise HyperspaceError(f"unknown {what}={requested!r} (auto|device|host)")
+    if prefer_device or not native.available():
+        return "device"
+    return "host" if d2h_mb_per_s() < floor_mbps else "device"
+
+
 @functools.lru_cache(maxsize=1)
 def d2h_mb_per_s() -> float:
     """Measured device→host bandwidth (MB/s), probed once."""

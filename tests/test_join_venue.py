@@ -169,3 +169,43 @@ def test_forced_host_venue_wins_over_mesh(joined):
     got = session.to_pandas(fs.join(ds, ["k"]))
     assert session.last_query_stats["join_kernel"] == "host-native-merge"
     assert len(got) == len(f.merge(d, on="k"))
+
+
+@needs_native
+def test_build_venue_host_produces_identical_index(tmp_path):
+    """Host and device build venues must write byte-identical bucket
+    files and manifests (null/string/float32/int64 keys covered)."""
+    import json
+
+    from hyperspace_tpu.config import BUILD_VENUE
+
+    rng = np.random.default_rng(0)
+    n = 20_000
+    df = pd.DataFrame(
+        {
+            "k": rng.integers(0, 5_000, n).astype(np.int64),
+            "s": rng.choice(["aa", "bb", None, "cc"], n),
+            "v": rng.normal(size=n).astype(np.float32),
+            "d": rng.normal(size=n),
+        }
+    )
+    (tmp_path / "src").mkdir()
+    pq.write_table(pa.Table.from_pandas(df, preserve_index=False), tmp_path / "src" / "p.parquet")
+
+    dirs = {}
+    for venue in ("device", "host"):
+        session = HyperspaceSession(system_path=str(tmp_path / f"idx_{venue}"), num_buckets=8)
+        session.conf.set(BUILD_VENUE, venue)
+        hs = Hyperspace(session)
+        scan = session.parquet(tmp_path / "src")
+        hs.create_index(scan, IndexConfig("ix", ["k", "s"], ["v", "d"]))
+        dirs[venue] = tmp_path / f"idx_{venue}" / "ix" / "v__=0"
+    for b in range(8):
+        f = f"bucket-{b:05d}.parquet"
+        pd.testing.assert_frame_equal(
+            pq.read_table(dirs["device"] / f).to_pandas(),
+            pq.read_table(dirs["host"] / f).to_pandas(),
+        )
+    m1 = json.loads((dirs["device"] / "_index_manifest.json").read_text())
+    m2 = json.loads((dirs["host"] / "_index_manifest.json").read_text())
+    assert m1 == m2
