@@ -28,7 +28,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from hyperspace_tpu.config import DEFAULT_BUILD_MEMORY_BUDGET
-from hyperspace_tpu.dataset import list_data_files
+from hyperspace_tpu.dataset import format_suffix, list_data_files
 from hyperspace_tpu.exceptions import HyperspaceError
 from hyperspace_tpu.execution import io as hio
 from hyperspace_tpu.execution.table import ColumnTable
@@ -166,16 +166,33 @@ class DeviceIndexBuilder:
     ) -> None:
         if not isinstance(plan, Scan):
             raise HyperspaceError("index builds materialize scan-only plans")
-        files = plan.files if plan.files is not None else [fi.path for fi in list_data_files(plan.root)]
-        footers = hio.read_footers(files)
-        est = hio.estimate_uncompressed_bytes(files, columns, footers=footers)
-        if est > self.memory_budget_bytes:
-            self._write_streaming(
-                files, plan.scan_schema, columns, indexed_columns, num_buckets,
-                dest_path, est, footers=footers,
-            )
-            return
-        table = hio.read_parquet(files, columns=columns, schema=plan.schema)
+        if plan.files is not None:
+            files = list(plan.files)
+        else:
+            files = [fi.path for fi in list_data_files(plan.root, suffix=format_suffix(plan.format))]
+        if plan.format == "parquet":
+            footers = hio.read_footers(files)
+            est = hio.estimate_uncompressed_bytes(files, columns, footers=footers)
+            if est > self.memory_budget_bytes:
+                self._write_streaming(
+                    files, plan.scan_schema, columns, indexed_columns, num_buckets,
+                    dest_path, est, footers=footers,
+                )
+                return
+        else:
+            # Non-parquet sources have no row-group chunking; a rough
+            # on-disk-size inflate guards the in-memory path.
+            import os
+
+            est = sum(os.stat(f).st_size for f in files) * 4
+            if est > self.memory_budget_bytes:
+                raise HyperspaceError(
+                    f"{plan.format} source (~{est >> 20} MiB decoded estimate) exceeds "
+                    "the build memory budget; the streaming out-of-core build supports "
+                    "parquet sources only — raise hyperspace.index.build.memoryBudgetBytes "
+                    "or convert the source to parquet"
+                )
+        table = hio.read_table_files(files, plan.format, columns=columns, schema=plan.schema)
         self.write_table(table, indexed_columns, num_buckets, dest_path)
         self.last_build_stats = {"path": "in-memory", "bytes_estimate": est, "rows": table.num_rows}
 

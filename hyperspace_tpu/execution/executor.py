@@ -26,7 +26,7 @@ from hyperspace_tpu.exceptions import HyperspaceError
 from hyperspace_tpu.execution import io as hio
 from hyperspace_tpu.execution.builder import compute_row_hashes, hash_scalar_key
 from hyperspace_tpu.execution.table import ColumnTable
-from hyperspace_tpu.dataset import list_data_files
+from hyperspace_tpu.dataset import format_suffix, list_data_files
 from hyperspace_tpu.ops.filter import apply_filter
 from hyperspace_tpu.ops.hashing import bucket_ids
 from hyperspace_tpu.ops import join as join_ops
@@ -366,7 +366,7 @@ class Executor:
     def _scan_files(self, scan: Scan) -> list[str]:
         if scan.files is not None:
             return list(scan.files)
-        return [fi.path for fi in list_data_files(scan.root)]
+        return [fi.path for fi in list_data_files(scan.root, suffix=format_suffix(scan.format))]
 
     def _cached_read(self, files: list[str], columns, schema) -> ColumnTable:
         """Index-file read through the decoded-table cache; files_read
@@ -385,7 +385,7 @@ class Executor:
             # Index files are immutable per version — cache their decode.
             return self._cached_read(files, cols, scan.scan_schema)
         self.stats["files_read"] += len(files)
-        return hio.read_parquet(files, columns=cols, schema=scan.scan_schema)
+        return hio.read_table_files(files, scan.format, columns=cols, schema=scan.scan_schema)
 
     # -- filter (with index bucket pruning) ------------------------------
     def _filter(self, plan: Filter) -> ColumnTable:

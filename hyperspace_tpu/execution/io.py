@@ -105,16 +105,86 @@ def read_parquet_cached(files: list[str], columns: list[str] | None = None, sche
 
 
 def read_parquet(files: list[str], columns: list[str] | None = None, schema: Schema | None = None) -> ColumnTable:
+    return read_table_files(files, "parquet", columns=columns, schema=schema)
+
+
+_ARROW_TYPES = {
+    "int32": pa.int32(),
+    "int64": pa.int64(),
+    "float32": pa.float32(),
+    "float64": pa.float64(),
+    "bool": pa.bool_(),
+    "string": pa.string(),
+    "date": pa.date32(),
+    "timestamp": pa.timestamp("us"),
+}
+
+
+def _arrow_types_for(schema: Schema | None) -> dict | None:
+    """name → arrow type for the registered schema's scalar fields —
+    pins CSV/JSON decode to the PLANNED types instead of re-inferring
+    per file (per-file inference can diverge across files and from the
+    registration-time schema)."""
+    if schema is None:
+        return None
+    out = {}
+    for f in schema.fields:
+        t = _ARROW_TYPES.get(f.dtype)
+        if t is not None:
+            out[f.name] = t
+    return out or None
+
+
+def _read_one_file(path: str, fmt: str, columns: list[str] | None, schema: Schema | None):
+    """One file of any supported source format → pyarrow Table. The
+    reference gates sources to the same four formats
+    (index/serde/LogicalPlanSerDeUtils.scala:225-245)."""
+    if fmt == "parquet":
+        return pq.read_table(path, columns=columns)
+    if fmt == "orc":
+        from pyarrow import orc
+
+        return orc.ORCFile(path).read(columns=columns)
+    if fmt == "csv":
+        from pyarrow import csv as pcsv
+
+        opts = pcsv.ConvertOptions(
+            include_columns=columns if columns is not None else None,
+            column_types=_arrow_types_for(schema),
+        )
+        return pcsv.read_csv(path, convert_options=opts)
+    if fmt == "json":
+        from pyarrow import json as pjson
+
+        types = _arrow_types_for(schema)
+        parse = None
+        if types is not None and schema is not None and len(types) == len(schema.fields):
+            parse = pjson.ParseOptions(
+                explicit_schema=pa.schema([(f.name, types[f.name]) for f in schema.fields])
+            )
+        t = pjson.read_json(path, parse_options=parse)
+        return t.select(columns) if columns is not None else t
+    raise HyperspaceError(f"unsupported source format {fmt!r} (parquet|orc|csv|json)")
+
+
+def read_table_files(
+    files: list[str],
+    fmt: str = "parquet",
+    columns: list[str] | None = None,
+    schema: Schema | None = None,
+) -> ColumnTable:
+    """Format-aware multi-file read into a ColumnTable (decode released
+    from the GIL and overlapped across files). `schema` is the registered
+    dataset schema; CSV/JSON decode is pinned to it."""
     if not files:
         raise HyperspaceError("no files to read")
     if len(files) == 1:
-        tables = [pq.read_table(files[0], columns=columns)]
+        tables = [_read_one_file(files[0], fmt, columns, schema)]
     else:
-        # Parquet decode releases the GIL; overlap files.
         from concurrent.futures import ThreadPoolExecutor
 
         with ThreadPoolExecutor(max_workers=min(8, len(files))) as ex:
-            tables = list(ex.map(lambda f: pq.read_table(f, columns=columns), files))
+            tables = list(ex.map(lambda f: _read_one_file(f, fmt, columns, schema), files))
     table = pa.concat_tables(tables, promote_options="default") if len(tables) > 1 else tables[0]
     if schema is not None and columns is not None:
         schema = schema.select(columns)

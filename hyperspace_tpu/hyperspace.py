@@ -76,6 +76,16 @@ class HyperspaceSession:
         DataFrame-equivalent; LogicalPlan carries the fluent API)."""
         return Dataset.parquet(root).scan()
 
+    def orc(self, root: str | Path) -> Scan:
+        return Dataset.orc(root).scan()
+
+    def csv(self, root: str | Path) -> Scan:
+        return Dataset.csv(root).scan()
+
+    def json(self, root: str | Path) -> Scan:
+        """Register a line-delimited JSON dataset."""
+        return Dataset.json(root).scan()
+
     def optimized_plan(self, plan: LogicalPlan) -> LogicalPlan:
         if not self._enabled:
             return plan

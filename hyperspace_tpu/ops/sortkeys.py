@@ -102,6 +102,19 @@ def key_lanes(table, key_columns: list[str], force_validity: bool = False) -> li
     return out
 
 
+def lanes_as_unsigned(lanes: list[np.ndarray]) -> np.ndarray:
+    """[L, n] uint32 matrix whose unsigned lexicographic order equals the
+    lanes' mixed signed/unsigned order (signed lanes get the sign bit
+    flipped) — the layout the native host sort kernel consumes."""
+    out = np.empty((len(lanes), len(lanes[0]) if lanes else 0), dtype=np.uint32)
+    for i, l in enumerate(lanes):
+        if l.dtype == np.uint32:
+            out[i] = l
+        else:
+            out[i] = l.astype(np.int32).view(np.uint32) ^ np.uint32(0x80000000)
+    return out
+
+
 def lexsort_lanes(lanes: list[np.ndarray]) -> np.ndarray:
     """Host (numpy) stable argsort by the lanes — the reference ordering
     the device sort must reproduce. np.lexsort keys are LAST-significant

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import hashlib
 
-from hyperspace_tpu.dataset import list_data_files
+from hyperspace_tpu.dataset import format_suffix, list_data_files
 from hyperspace_tpu.exceptions import HyperspaceError
 from hyperspace_tpu.metadata.log_entry import Fingerprint
 from hyperspace_tpu.plan.nodes import LogicalPlan, Scan
@@ -29,7 +29,7 @@ def collect_leaf_files(leaf: Scan) -> list:
             st = os.stat(path)
             out.append(FileInfo(path, st.st_size, st.st_mtime_ns))
         return out
-    return list_data_files(leaf.root)
+    return list_data_files(leaf.root, suffix=format_suffix(leaf.format))
 
 
 def fingerprint_files(files) -> str:

@@ -92,14 +92,14 @@ class VectorIndexBuilder:
         metric: str,
     ) -> np.ndarray:
         """Build partitions under dest_path; returns the centroids."""
-        from hyperspace_tpu.dataset import list_data_files
+        from hyperspace_tpu.dataset import format_suffix, list_data_files
 
         if not isinstance(plan, Scan):
             raise HyperspaceError("vector index builds materialize scan-only plans")
         files = plan.files if plan.files is not None else [
-            fi.path for fi in list_data_files(plan.root)
+            fi.path for fi in list_data_files(plan.root, suffix=format_suffix(plan.format))
         ]
-        table = hio.read_parquet(files, columns=columns, schema=plan.schema)
+        table = hio.read_table_files(files, plan.format, columns=columns, schema=plan.schema)
         if table.num_rows == 0:
             raise HyperspaceError("cannot build a vector index over an empty source")
         emb_field = table.schema.field(embedding_column)

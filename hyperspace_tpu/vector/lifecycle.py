@@ -229,12 +229,12 @@ class VectorOptimizeAction(Action):
 def write_partitions(plan, dd, centroids: np.ndarray, dest: Path, schema) -> None:
     """Assign `plan`'s rows to EXISTING centroids and carve one parquet
     per partition into `dest` (+ a centroids copy)."""
-    from hyperspace_tpu.dataset import list_data_files
+    from hyperspace_tpu.dataset import format_suffix, list_data_files
 
     files = plan.files if plan.files is not None else [
-        fi.path for fi in list_data_files(plan.root)
+        fi.path for fi in list_data_files(plan.root, suffix=format_suffix(plan.format))
     ]
-    table = hio.read_parquet(files, columns=dd.all_columns, schema=schema)
+    table = hio.read_table_files(files, plan.format, columns=dd.all_columns, schema=schema)
     emb = table.columns[table.schema.field(dd.embedding_column).name]
     if dd.metric == "cos":
         emb = emb / np.maximum(np.linalg.norm(emb, axis=1, keepdims=True), 1e-12)
