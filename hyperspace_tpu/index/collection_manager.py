@@ -36,10 +36,18 @@ from hyperspace_tpu import states
 class IndexCollectionManager:
     """Concrete manager: one log/data manager pair per index directory."""
 
-    def __init__(self, conf: HyperspaceConf, writer_factory=None):
+    def __init__(
+        self,
+        conf: HyperspaceConf,
+        writer_factory=None,
+        log_manager_factory=None,
+        data_manager_factory=None,
+    ):
         self.conf = conf
         self.path_resolver = PathResolver(conf)
-        # The writer seam (DI for tests; analog of index/factories.scala).
+        # The DI seams (analog of index/factories.scala:22-52): the writer
+        # builds index data; the log/data manager factories let tests
+        # inject protocol mocks/fakes per index path.
         if writer_factory is None:
             def writer_factory():
                 from hyperspace_tpu.execution.builder import DeviceIndexBuilder
@@ -47,11 +55,17 @@ class IndexCollectionManager:
                 return DeviceIndexBuilder()
 
         self.writer_factory = writer_factory
+        self.log_manager_factory = log_manager_factory or IndexLogManager
+        self.data_manager_factory = data_manager_factory or IndexDataManager
 
     # -- manager wiring --------------------------------------------------
     def _managers(self, name: str) -> tuple[IndexLogManager, IndexDataManager, Path]:
         index_path = self.path_resolver.get_index_path(name)
-        return IndexLogManager(index_path), IndexDataManager(index_path), index_path
+        return (
+            self.log_manager_factory(index_path),
+            self.data_manager_factory(index_path),
+            index_path,
+        )
 
     # -- IndexManager interface ------------------------------------------
     def create(self, plan: LogicalPlan, config: IndexConfig) -> None:
@@ -122,7 +136,7 @@ class IndexCollectionManager:
         latest log (IndexCollectionManager.scala:87-105)."""
         out = []
         for d in self.path_resolver.list_index_paths():
-            entry = IndexLogManager(d).get_latest_log()
+            entry = self.log_manager_factory(d).get_latest_log()
             if entry is not None and entry.state in states_filter:
                 out.append(entry)
         return out
@@ -162,8 +176,8 @@ class CachingIndexCollectionManager(IndexCollectionManager):
     every mutating API clears the cache first
     (CachingIndexCollectionManager.scala:60-98)."""
 
-    def __init__(self, conf: HyperspaceConf, writer_factory=None):
-        super().__init__(conf, writer_factory)
+    def __init__(self, conf: HyperspaceConf, writer_factory=None, **factories):
+        super().__init__(conf, writer_factory, **factories)
         self._cache = CreationTimeBasedCache(conf.cache_expiry_seconds)
 
     def clear_cache(self) -> None:

@@ -1,0 +1,207 @@
+"""Protocol-level action tests against FAKE log/data managers injected
+through the collection manager's factory seam — the analog of the
+reference's mock-based state-machine tests (ActionTest.scala:139-166
+verifies the exact writeLog(0, CREATING) → writeLog(1, ACTIVE) →
+latestStable swap sequence through mock(classOf[IndexLogManager]);
+factories.scala:22-52 is the DI seam they inject through)."""
+
+import pytest
+
+from hyperspace_tpu.actions import states
+from hyperspace_tpu.actions.base import Action
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu.index.collection_manager import IndexCollectionManager
+from hyperspace_tpu.metadata.log_entry import (
+    Content,
+    CoveringIndex,
+    Fingerprint,
+    IndexLogEntry,
+    Source,
+)
+
+
+def _entry(state=states.ACTIVE, name="idx"):
+    e = IndexLogEntry(
+        name=name,
+        derived_dataset=CoveringIndex(
+            indexed_columns=["k"], included_columns=["v"],
+            schema=[{"name": "k", "dtype": "int64", "nullable": False},
+                    {"name": "v", "dtype": "float64", "nullable": False}],
+            num_buckets=4,
+        ),
+        content=Content(root="/idx", directories=["v__=0"]),
+        source=Source(plan={"type": "scan", "root": "/src", "format": "parquet",
+                            "schema": [{"name": "k", "dtype": "int64", "nullable": False},
+                                       {"name": "v", "dtype": "float64", "nullable": False}]},
+                      fingerprint=Fingerprint(kind="fileBased", value="f0"),
+                      files=[]),
+    )
+    e.state = state
+    return e
+
+
+class FakeLogManager:
+    """In-memory log manager recording every protocol call in order."""
+
+    def __init__(self, path=None, latest=None):
+        self.path = path
+        self.calls: list[tuple] = []
+        self.logs: dict[int, IndexLogEntry] = {}
+        if latest is not None:
+            self.logs[0] = latest
+        self.stable_id: int | None = 0 if latest is not None else None
+        self.fail_write_ids: set[int] = set()
+
+    def get_latest_id(self):
+        return max(self.logs) if self.logs else None
+
+    def get_latest_log(self):
+        lid = self.get_latest_id()
+        return self.logs.get(lid) if lid is not None else None
+
+    def get_latest_stable_log(self):
+        return self.logs.get(self.stable_id) if self.stable_id is not None else None
+
+    def write_log(self, id, entry):
+        self.calls.append(("write_log", id, entry.state))
+        if id in self.fail_write_ids or id in self.logs:
+            return False
+        self.logs[id] = entry
+        return True
+
+    def delete_latest_stable_log(self):
+        self.calls.append(("delete_latest_stable",))
+        self.stable_id = None
+
+    def create_latest_stable_log(self, id):
+        self.calls.append(("create_latest_stable", id))
+        self.stable_id = id
+
+
+class FakeDataManager:
+    def __init__(self, path=None):
+        self.path = path
+        self.deleted: list[int] = []
+
+    def get_latest_version_id(self):
+        return 0
+
+    def get_path(self, version):
+        return f"/idx/v__={version}"
+
+    def get_version_ids(self):
+        return [0]
+
+    def delete(self, version):
+        self.deleted.append(version)
+
+
+class NoopAction(Action):
+    transient_state = states.CREATING
+    final_state = states.ACTIVE
+
+    def build_log_entry(self):
+        return _entry()
+
+
+def test_run_commits_exact_two_phase_sequence():
+    """Empty log: run() must write id 0 transient, id 1 final, then swap
+    latestStable to 1 — the exact ActionTest.scala:139-166 sequence."""
+    lm = FakeLogManager()
+    NoopAction(lm).run()
+    assert lm.calls == [
+        ("write_log", 0, states.CREATING),
+        ("write_log", 1, states.ACTIVE),
+        ("delete_latest_stable",),
+        ("create_latest_stable", 1),
+    ]
+
+
+def test_run_on_existing_log_advances_base_id_by_two():
+    lm = FakeLogManager(latest=_entry(states.ACTIVE))
+    NoopAction(lm).run()
+    assert [c for c in lm.calls if c[0] == "write_log"] == [
+        ("write_log", 1, states.CREATING),
+        ("write_log", 2, states.ACTIVE),
+    ]
+    assert lm.stable_id == 2
+
+
+def test_losing_cas_aborts_with_no_final_write():
+    """A concurrent writer winning the transient CAS must abort the action
+    before op()/end() — single-writer optimistic concurrency."""
+    lm = FakeLogManager()
+    lm.fail_write_ids = {0}
+    with pytest.raises(HyperspaceError, match="Could not acquire proper state"):
+        NoopAction(lm).run()
+    assert lm.calls == [("write_log", 0, states.CREATING)]
+    assert lm.stable_id is None
+
+
+def test_op_failure_leaves_transient_state_no_stable_swap():
+    class ExplodingAction(NoopAction):
+        def op(self):
+            raise RuntimeError("mid-flight crash")
+
+    lm = FakeLogManager()
+    with pytest.raises(RuntimeError):
+        ExplodingAction(lm).run()
+    # Transient entry committed, final never written, stable untouched.
+    assert lm.calls == [("write_log", 0, states.CREATING)]
+    assert lm.get_latest_log().state == states.CREATING
+
+
+def test_collection_manager_factory_seam_injects_fakes(tmp_path):
+    """delete() through the manager must use ONLY the injected fakes —
+    the factory seam the reference's IndexCollectionManagerTest uses."""
+    created: dict = {}
+
+    def log_factory(path):
+        fake = FakeLogManager(path, latest=_entry(states.ACTIVE))
+        created["log"] = fake
+        return fake
+
+    def data_factory(path):
+        created["data"] = FakeDataManager(path)
+        return created["data"]
+
+    conf = HyperspaceConf(system_path=str(tmp_path / "sys"))
+    mgr = IndexCollectionManager(
+        conf, log_manager_factory=log_factory, data_manager_factory=data_factory
+    )
+    mgr.delete("idx")
+    assert created["log"].get_latest_log().state == states.DELETED
+    assert [c for c in created["log"].calls if c[0] == "write_log"] == [
+        ("write_log", 1, states.DELETING),
+        ("write_log", 2, states.DELETED),
+    ]
+
+
+def test_vacuum_fans_out_per_version_delete(tmp_path):
+    """VacuumAction deletes every data version (VacuumActionTest.scala:50
+    verifies the per-version delete fan-out through a mock data manager)."""
+    class MultiVersionData(FakeDataManager):
+        def get_version_ids(self):
+            return [0, 1, 2]
+
+        def get_latest_version_id(self):
+            return 2
+
+    created: dict = {}
+
+    def log_factory(path):
+        created["log"] = FakeLogManager(path, latest=_entry(states.DELETED))
+        return created["log"]
+
+    def data_factory(path):
+        created["data"] = MultiVersionData(path)
+        return created["data"]
+
+    conf = HyperspaceConf(system_path=str(tmp_path / "sys"))
+    mgr = IndexCollectionManager(
+        conf, log_manager_factory=log_factory, data_manager_factory=data_factory
+    )
+    mgr.vacuum("idx")
+    assert sorted(created["data"].deleted) == [0, 1, 2]
+    assert created["log"].get_latest_log().state == states.DOESNOTEXIST
