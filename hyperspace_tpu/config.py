@@ -36,6 +36,8 @@ JOIN_VENUE_MIN_MBPS = "hyperspace.join.venueMinMbps"
 # Build sort venue: same auto/device/host scheme for the bucketize+sort
 # permutation (its only output lands on host).
 BUILD_VENUE = "hyperspace.build.venue"
+AGG_VENUE = "hyperspace.agg.venue"
+SORT_VENUE = "hyperspace.sort.venue"
 
 # Directory-layout constants (reference index/IndexConstants.scala:38-39).
 HYPERSPACE_LOG_DIR = "_hyperspace_log"
@@ -64,6 +66,8 @@ class HyperspaceConf:
     join_venue: str = DEFAULT_JOIN_VENUE
     join_venue_min_mbps: float = DEFAULT_JOIN_VENUE_MIN_MBPS
     build_venue: str = DEFAULT_JOIN_VENUE
+    agg_venue: str = DEFAULT_JOIN_VENUE
+    sort_venue: str = DEFAULT_JOIN_VENUE
     overrides: dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def __post_init__(self):
@@ -92,6 +96,10 @@ class HyperspaceConf:
             self.join_venue_min_mbps = float(value)
         elif key == BUILD_VENUE:
             self.build_venue = str(value)
+        elif key == AGG_VENUE:
+            self.agg_venue = str(value)
+        elif key == SORT_VENUE:
+            self.sort_venue = str(value)
 
     def get(self, key: str, default: Any = None) -> Any:
         if key in self.overrides:
@@ -116,4 +124,8 @@ class HyperspaceConf:
             return self.join_venue_min_mbps
         if key == BUILD_VENUE:
             return self.build_venue
+        if key == AGG_VENUE:
+            return self.agg_venue
+        if key == SORT_VENUE:
+            return self.sort_venue
         return default

@@ -297,16 +297,11 @@ class Executor:
         """auto: host when the measured device→host link is slower than
         the configured floor (tunneled deployments) AND the native library
         built; the pairs land on host either way."""
-        from hyperspace_tpu.parallel.bandwidth import pick_venue
-
         # Auto with a mesh keeps the distributed device kernel (the
         # query-plane sharding is the point); a forced "host" wins — the
         # host kernel is bucket-parallel too.
-        return pick_venue(
-            self.conf.join_venue if self.conf is not None else "auto",
-            self.conf.join_venue_min_mbps if self.conf is not None else 200.0,
-            prefer_device=self.mesh is not None,
-            what="hyperspace.join.venue",
+        return self._venue(
+            "join_venue", "hyperspace.join.venue", self.mesh is not None, needs_native=True
         )
 
     def _phys(self, op: str | None = None, **detail) -> None:
@@ -321,26 +316,68 @@ class Executor:
     def _aggregate(self, plan: "Aggregate") -> ColumnTable:
         from hyperspace_tpu.ops.aggregate import aggregate_table
 
-        fused = self._try_fused_join_aggregate(plan)
-        if fused is not None:
-            self._phys(
-                "FusedJoinAggregate",
-                join_path=self.stats["join_path"],
-                buckets=self.stats["num_buckets"],
-            )
-            return fused
+        venue = self._agg_venue()
+        if self._join_venue() == "device":
+            # Fuse Aggregate(Join) whenever the JOIN would run on device:
+            # the run-prefix kernel reduces to [K] there, avoiding the
+            # match-pair readback the materialized device join pays. With
+            # the host join venue the pairs are host-merged cheaply and
+            # the host reduce takes over instead.
+            fused = self._try_fused_join_aggregate(plan)
+            if fused is not None:
+                self._phys(
+                    "FusedJoinAggregate",
+                    join_path=self.stats["join_path"],
+                    buckets=self.stats["num_buckets"],
+                )
+                return fused
         table = self._execute(plan.child)
-        self.stats["agg_path"] = "segment-reduce"
-        self._phys("SegmentReduceAggregate", groups=len(plan.group_by), aggs=len(plan.aggs))
-        return aggregate_table(table, plan.group_by, plan.aggs, plan.schema)
+        self.stats["agg_path"] = f"segment-reduce-{venue}"
+        self._phys(
+            "SegmentReduceAggregate",
+            venue=venue,
+            groups=len(plan.group_by),
+            aggs=len(plan.aggs),
+        )
+        return aggregate_table(table, plan.group_by, plan.aggs, plan.schema, venue=venue)
+
+    def _venue(self, conf_attr: str, what: str, prefer_device: bool, needs_native: bool) -> str:
+        """One pick_venue wrapper: conf defaults and the shared link floor
+        live here instead of at every venue-choosing call site."""
+        from hyperspace_tpu.parallel.bandwidth import pick_venue
+
+        return pick_venue(
+            getattr(self.conf, conf_attr) if self.conf is not None else "auto",
+            self.conf.join_venue_min_mbps if self.conf is not None else 200.0,
+            prefer_device=prefer_device,
+            what=what,
+            needs_native=needs_native,
+        )
+
+    def _agg_venue(self) -> str:
+        """Where the segment reduce runs. The inputs are host-resident and
+        the [A, K] result is tiny, so below the link floor the numpy
+        bincount/reduceat path beats uploading every channel (and avoids
+        emulated f64 on chips without native double support)."""
+        return self._venue("agg_venue", "hyperspace.agg.venue", False, needs_native=False)
 
     def _sort(self, plan: "Sort") -> ColumnTable:
-        from hyperspace_tpu.ops.sortkeys import device_order_perm
+        from hyperspace_tpu.ops.sortkeys import (
+            device_order_perm,
+            lexsort_lanes,
+            order_lanes,
+        )
 
         table = self._execute(plan.child)
-        self._phys("DeviceSort", keys=[c for c, _ in plan.by])
+        venue = self._venue("sort_venue", "hyperspace.sort.venue", False, needs_native=False)
+        self._phys(f"{venue.capitalize()}Sort", keys=[c for c, _ in plan.by])
         if table.num_rows <= 1:
             return table
+        if venue == "host":
+            # ORDER BY output must land on host; below the link floor a
+            # numpy lexsort beats the device round-trip (latency-bound
+            # for the typical small post-aggregation result).
+            return table.take(lexsort_lanes(order_lanes(table, plan.by)))
         return table.take(device_order_perm(table, plan.by))
 
     # -- union (hybrid scan) ----------------------------------------------

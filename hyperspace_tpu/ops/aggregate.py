@@ -49,29 +49,90 @@ def _segment_reduce_many(vals, gid, num_segments: int, fns: tuple):
     return jnp.stack(outs)
 
 
+def _dense_codes(arr: np.ndarray, valid) -> tuple[np.ndarray, int] | None:
+    """O(n) factorization for integer columns whose value range is small
+    relative to n (join keys, dict codes, dates): rank via a presence
+    table instead of np.unique's O(n log n) argsort. Returns
+    (codes [n] int64 with 0 reserved for nulls, cardinality incl. the
+    null slot) in VALUE-sorted code order, or None when out of range."""
+    if not np.issubdtype(arr.dtype, np.integer) or len(arr) == 0:
+        return None
+    vv = arr if valid is None else arr[valid]
+    if len(vv) == 0:
+        return np.zeros(len(arr), np.int64), 1
+    lo, hi = int(vv.min()), int(vv.max())
+    span = hi - lo + 1
+    if span > max(4 * len(arr), 1 << 16):
+        return None
+    offs = arr.astype(np.int64) - lo
+    if valid is not None:
+        offs = np.where(valid, offs, 0)
+    present = np.zeros(span, dtype=bool)
+    present[offs[valid] if valid is not None else offs] = True
+    ids = np.cumsum(present, dtype=np.int64)  # 1-based rank among present
+    codes = ids[offs]
+    if valid is not None:
+        codes[~valid] = 0
+    return codes, int(present.sum()) + 1
+
+
+def _column_codes(table: ColumnTable, c: str) -> tuple[np.ndarray, int]:
+    """(codes [n] int64 with 0 = null, cardinality) for one group column,
+    codes in value-sorted order."""
+    f = table.schema.field(c)
+    arr = table.columns[f.name]
+    if arr.ndim != 1:
+        raise HyperspaceError(f"cannot group by vector column {c!r}")
+    valid = table.valid_mask(c)
+    dense = _dense_codes(arr, valid)
+    if dense is not None:
+        return dense
+    _, inv = np.unique(arr, return_inverse=True)
+    inv = inv.astype(np.int64) + 1
+    card = int(inv.max()) + 1 if len(inv) else 1
+    if valid is not None:
+        inv[~valid] = 0
+    return inv, card
+
+
+def _compress(codes: np.ndarray) -> tuple[np.ndarray, int, np.ndarray]:
+    """Combined codes → (gid [n] in [0, K), K, first_idx [K]) with gid
+    order following code order."""
+    dense = _dense_codes(codes, None)
+    if dense is not None:
+        gid = dense[0] - 1  # no nulls at this stage; drop the reserved 0
+        k = dense[1] - 1
+    else:
+        uniq, gid = np.unique(codes, return_inverse=True)
+        gid = gid.reshape(-1).astype(np.int64)
+        k = len(uniq)
+    # Any representative row per group works (the key values are equal);
+    # a vectorized last-write gives one without a sort.
+    rep = np.empty(k, dtype=np.int64)
+    rep[gid] = np.arange(len(gid), dtype=np.int64)
+    return gid, k, rep
+
+
 def group_ids(table: ColumnTable, group_by: list[str]):
     """Host factorization of the group-key tuples. Returns
-    (gid [n] int64, K, first_idx [K] — first row of each group)."""
+    (gid [n] int64, K, first_idx [K] — a representative row per group).
+    O(n) for integer/dict/date keys of reasonable range (the common
+    case: join keys, flags); np.unique fallback otherwise."""
     n = table.num_rows
     if not group_by:
         return np.zeros(n, np.int64), 1, np.zeros(1 if n else 0, np.int64)
-    per = []
-    for c in group_by:
-        f = table.schema.field(c)
-        arr = table.columns[f.name]
-        if arr.ndim != 1:
-            raise HyperspaceError(f"cannot group by vector column {c!r}")
-        _, inv = np.unique(arr, return_inverse=True)
-        inv = inv.astype(np.int64) + 1
-        valid = table.valid_mask(c)
-        if valid is not None:
-            inv[~valid] = 0  # SQL: null keys form one group
-        per.append(inv)
-    stacked = np.stack(per, axis=1)
-    _, first_idx, gid = np.unique(
-        stacked, axis=0, return_index=True, return_inverse=True
-    )
-    return gid.reshape(-1).astype(np.int64), len(first_idx), first_idx.astype(np.int64)
+    codes0, card0 = _column_codes(table, group_by[0])
+    combined = codes0
+    total = card0
+    for c in group_by[1:]:
+        codes, card = _column_codes(table, c)
+        if total * card >= np.iinfo(np.int64).max:
+            raise HyperspaceError(
+                f"group-by key cardinalities overflow the int64 code space"
+            )
+        combined = combined * np.int64(card) + codes
+        total *= card
+    return _compress(combined)
 
 
 def agg_input(table: ColumnTable, spec) -> tuple[np.ndarray, np.ndarray | None, bool]:
@@ -102,14 +163,69 @@ def agg_input(table: ColumnTable, spec) -> tuple[np.ndarray, np.ndarray | None, 
     return vals, valid, False
 
 
-def aggregate_arrays(
+def aggregate_arrays_host(
     inputs: list[tuple[np.ndarray, np.ndarray | None, str]],
     gid: np.ndarray,
     num_groups: int,
 ):
-    """Device segment-reduce of (values, valid, fn) triples sharing group
+    """Host (numpy) venue of the segment reduce: bincount sums and
+    sorted-reduceat min/max in exact float64. The inputs are host-resident
+    and the [A, K] result is tiny, so on slow-transfer deployments (or
+    chips without native f64) this beats uploading every channel to the
+    device; semantics are pinned identical to aggregate_arrays."""
+    n = len(gid)
+    order = None
+    group_rows = np.bincount(gid, minlength=num_groups).astype(np.int64)
+    results: list[np.ndarray] = []
+    counts: list[np.ndarray] = []
+    for vals, valid, fn in inputs:
+        v = np.asarray(vals, dtype=np.float64)
+        if fn == "sum":
+            if valid is not None:
+                v = np.where(valid, v, 0.0)
+            res = np.bincount(gid, weights=v, minlength=num_groups)
+        else:
+            identity = np.inf if fn == "min" else -np.inf
+            if order is None:
+                order = np.argsort(gid, kind="stable")
+                starts = np.searchsorted(gid[order], np.arange(num_groups))
+            sv = v[order]
+            if valid is not None:
+                sv = np.where(valid[order], sv, identity)
+            if n == 0:
+                res = np.full(num_groups, identity)
+            else:
+                op = np.minimum if fn == "min" else np.maximum
+                # reduceat returns sv[start] for EMPTY segments (start ==
+                # next start) and rejects start == n — clamp, then reset
+                # empty groups to the identity.
+                res = op.reduceat(sv, np.minimum(starts, n - 1))
+                res[group_rows == 0] = identity
+        cnt = (
+            group_rows.astype(np.float64)
+            if valid is None
+            else np.bincount(gid, weights=valid.astype(np.float64), minlength=num_groups)
+        )
+        results.append(res)
+        counts.append(cnt)
+    a = max(len(inputs), 1)
+    return (
+        np.stack(results) if results else np.zeros((a, num_groups)),
+        np.stack(counts) if counts else np.zeros((a, num_groups)),
+    )
+
+
+def aggregate_arrays(
+    inputs: list[tuple[np.ndarray, np.ndarray | None, str]],
+    gid: np.ndarray,
+    num_groups: int,
+    venue: str = "device",
+):
+    """Segment-reduce of (values, valid, fn) triples sharing group
     ids. fn ∈ sum/min/max (count/mean are composed by the caller).
     Returns (results [A, K] float64-ish np arrays, counts [A, K])."""
+    if venue == "host":
+        return aggregate_arrays_host(inputs, gid, num_groups)
     n = len(gid)
     n_pad = _pow2(max(n, 1))
     k_seg = _pow2(num_groups + 1)  # +1 dead segment for pads
@@ -157,7 +273,8 @@ def _pad_const(v: np.ndarray, n_pad: int, fn: str) -> np.ndarray:
 
 
 def aggregate_table(
-    table: ColumnTable, group_by: list[str], aggs: list, out_schema: Schema
+    table: ColumnTable, group_by: list[str], aggs: list, out_schema: Schema,
+    venue: str = "device",
 ) -> ColumnTable:
     """Execute a grouped aggregation over a materialized table."""
     gid, k, first_idx = group_ids(table, group_by)
@@ -176,7 +293,7 @@ def aggregate_table(
 
     if k == 0:
         return ColumnTable.empty(out_schema)
-    results, counts = aggregate_arrays(inputs, gid, k)
+    results, counts = aggregate_arrays(inputs, gid, k, venue=venue)
 
     cols: dict[str, np.ndarray] = {}
     dicts: dict[str, np.ndarray] = {}

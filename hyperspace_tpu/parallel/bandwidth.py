@@ -14,18 +14,26 @@ import functools
 import time
 
 
-def pick_venue(requested: str, floor_mbps: float, prefer_device: bool, what: str) -> str:
-    """Shared auto/device/host venue selection (join merge, build sort).
+def pick_venue(
+    requested: str,
+    floor_mbps: float,
+    prefer_device: bool,
+    what: str,
+    needs_native: bool = True,
+) -> str:
+    """Shared auto/device/host venue selection (join merge, build sort,
+    aggregation reduce).
 
     `requested` other than auto forces the venue — forcing "host" without
-    the native library is an error, not a silent device fallback.
-    `prefer_device` wins the auto case (e.g. a real multi-device mesh,
-    where the distributed kernel is the point)."""
+    the native library (when the host path needs it) is an error, not a
+    silent device fallback. `prefer_device` wins the auto case (e.g. a
+    real multi-device mesh, where the distributed kernel is the point).
+    `needs_native=False` marks host paths implemented in pure numpy."""
     from hyperspace_tpu import native
     from hyperspace_tpu.exceptions import HyperspaceError
 
     if requested == "host":
-        if not native.available():
+        if needs_native and not native.available():
             raise HyperspaceError(
                 f"{what}=host requires the native library (g++ build failed "
                 "or unavailable); use auto or device"
@@ -35,7 +43,7 @@ def pick_venue(requested: str, floor_mbps: float, prefer_device: bool, what: str
         return "device"
     if requested != "auto":
         raise HyperspaceError(f"unknown {what}={requested!r} (auto|device|host)")
-    if prefer_device or not native.available():
+    if prefer_device or (needs_native and not native.available()):
         return "device"
     return "host" if d2h_mb_per_s() < floor_mbps else "device"
 

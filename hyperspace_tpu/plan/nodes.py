@@ -333,6 +333,10 @@ class Sort(LogicalPlan):
     child: LogicalPlan
     by: list[tuple[str, bool]]
 
+    def __post_init__(self):
+        if not self.by:
+            raise ValueError("sort requires at least one order-by key")
+
     @property
     def schema(self) -> Schema:
         return self.child.schema

@@ -101,7 +101,10 @@ def test_global_aggregate_and_string_minmax(tmp_path, sales):
     assert got["max_store"][0] == pdf["store"].max()
 
 
-def test_null_group_key_and_all_null_group(tmp_path):
+@pytest.mark.parametrize("venue", ["device", "host"])
+def test_null_group_key_and_all_null_group(tmp_path, venue):
+    from hyperspace_tpu.config import AGG_VENUE
+
     t = pa.table(
         {
             "k": pa.array([1, 1, None, None, 2], type=pa.int64()),
@@ -112,6 +115,7 @@ def test_null_group_key_and_all_null_group(tmp_path):
     root.mkdir()
     pq.write_table(t, root / "p.parquet")
     session = _session(tmp_path)
+    session.conf.set(AGG_VENUE, venue)
     df = session.parquet(root)
     q = df.aggregate(["k"], [AggSpec.of("sum", "v", "sv"), AggSpec.of("count", "v", "cv")])
     got = session.to_pandas(q)
@@ -269,7 +273,7 @@ def test_join_agg_minmax_falls_back_to_materialized(tmp_path, join_tables):
     dim = session.parquet(dim_root)
     q = fact.join(dim, ["k"]).aggregate(["cat"], [AggSpec.of("max", "amount", "mx")])
     got = session.to_pandas(q).sort_values("cat").reset_index(drop=True)
-    assert session.last_query_stats["agg_path"] == "segment-reduce"
+    assert session.last_query_stats["agg_path"] == "segment-reduce-device"
     f = pq.read_table(fact_root).to_pandas()
     d = pq.read_table(dim_root).to_pandas()
     exp = (
@@ -385,3 +389,62 @@ def test_sum_of_constant_expression(tmp_path, join_tables):
     d = pq.read_table(dim_root).to_pandas()
     pairs = len(f.merge(d, on="k"))
     assert got2["n"][0] == pairs and got2["s"][0] == 2 * pairs
+
+
+def test_agg_host_venue_matches_device(tmp_path, sales):
+    """The numpy host reduce must match the device segment-reduce on all
+    fns incl. null inputs and string (dict-code) min/max."""
+    from hyperspace_tpu.config import AGG_VENUE
+
+    q_args = (
+        ["item"],
+        [
+            AggSpec.of("sum", "qty", "s"),
+            AggSpec.of("count", None, "n"),
+            AggSpec.of("count", "qty", "nq"),
+            AggSpec.of("mean", "price", "m"),
+            AggSpec.of("min", "qty", "mn"),
+            AggSpec.of("max", "price", "mx"),
+            AggSpec.of("min", "store", "smn"),
+            AggSpec.of("max", "store", "smx"),
+        ],
+    )
+    outs = {}
+    for venue in ("device", "host"):
+        session = _session(tmp_path, **{})
+        session.conf.set(AGG_VENUE, venue)
+        df = session.parquet(sales)
+        outs[venue] = (
+            session.to_pandas(df.aggregate(*q_args)).sort_values("item").reset_index(drop=True)
+        )
+        assert session.last_query_stats["agg_path"] == f"segment-reduce-{venue}"
+    d, h = outs["device"], outs["host"]
+    assert list(d["item"]) == list(h["item"])
+    for c in ("s", "n", "nq", "m", "mn", "mx"):
+        np.testing.assert_allclose(d[c].astype(float), h[c].astype(float), rtol=1e-12)
+    assert list(d["smn"]) == list(h["smn"])
+    assert list(d["smx"]) == list(h["smx"])
+
+
+def test_sort_host_venue_matches_device(tmp_path, sales):
+    from hyperspace_tpu.config import SORT_VENUE
+
+    outs = {}
+    for venue in ("device", "host"):
+        session = _session(tmp_path)
+        session.conf.set(SORT_VENUE, venue)
+        df = session.parquet(sales)
+        q = df.select("store", "item", "price").sort([("store", True), ("price", False)]).limit(50)
+        outs[venue] = session.to_pandas(q)
+    pd.testing.assert_frame_equal(outs["device"], outs["host"])
+
+
+def test_sort_requires_keys():
+    from hyperspace_tpu.plan.nodes import Scan, Sort
+    from hyperspace_tpu.schema import Field, Schema
+
+    scan = Scan("/x", "parquet", Schema.of(Field("a", "int64")))
+    with pytest.raises(ValueError, match="at least one"):
+        Sort(scan, [])
+    with pytest.raises(ValueError, match="at least one"):
+        scan.sort([])
