@@ -333,13 +333,21 @@ class Executor:
                 return fused
         table = self._execute(plan.child)
         self.stats["agg_path"] = f"segment-reduce-{venue}"
+        mesh = self.mesh if venue == "device" else None
+        if mesh is not None:
+            from hyperspace_tpu.parallel.mesh import mesh_size
+
+            self.stats["agg_devices"] = mesh_size(mesh)
         self._phys(
             "SegmentReduceAggregate",
             venue=venue,
             groups=len(plan.group_by),
             aggs=len(plan.aggs),
+            devices=self.stats.get("agg_devices", 1),
         )
-        return aggregate_table(table, plan.group_by, plan.aggs, plan.schema, venue=venue)
+        return aggregate_table(
+            table, plan.group_by, plan.aggs, plan.schema, venue=venue, mesh=mesh
+        )
 
     def _venue(self, conf_attr: str, what: str, prefer_device: bool, needs_native: bool) -> str:
         """One pick_venue wrapper: conf defaults and the shared link floor

@@ -49,6 +49,41 @@ def _segment_reduce_many(vals, gid, num_segments: int, fns: tuple):
     return jnp.stack(outs)
 
 
+@functools.lru_cache(maxsize=32)
+def _make_sharded_segment_reduce(mesh, axes: tuple, num_segments: int, fns: tuple):
+    """Mesh-distributed segment reduce: the row dimension shards across
+    devices, each shard reduces locally, and ONE collective per channel
+    (psum for sums, pmin/pmax for extrema) combines the [A, K] partials —
+    the distributed HashAggregate the reference gets from Spark's partial
+    + final aggregation (SURVEY.md §2.2), expressed as XLA collectives
+    over ICI."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(None, axes), P(axes)),
+        out_specs=P(None, None),
+        check_vma=False,
+    )
+    def fn(vals, gid):
+        local = _segment_reduce_many.__wrapped__(vals, gid, num_segments, fns)
+        outs = []
+        for i, f in enumerate(fns):
+            if f == "sum":
+                outs.append(jax.lax.psum(local[i], axes))
+            elif f == "min":
+                outs.append(jax.lax.pmin(local[i], axes))
+            elif f == "max":
+                outs.append(jax.lax.pmax(local[i], axes))
+            else:
+                raise ValueError(f)
+        return jnp.stack(outs)
+
+    return jax.jit(fn)
+
+
 def _dense_codes(arr: np.ndarray, valid) -> tuple[np.ndarray, int] | None:
     """O(n) factorization for integer columns whose value range is small
     relative to n (join keys, dict codes, dates): rank via a presence
@@ -220,14 +255,22 @@ def aggregate_arrays(
     gid: np.ndarray,
     num_groups: int,
     venue: str = "device",
+    mesh=None,
 ):
     """Segment-reduce of (values, valid, fn) triples sharing group
     ids. fn ∈ sum/min/max (count/mean are composed by the caller).
-    Returns (results [A, K] float64-ish np arrays, counts [A, K])."""
+    Returns (results [A, K] float64-ish np arrays, counts [A, K]).
+    With a multi-device mesh the row dimension shards across devices
+    (partial reduce + one collective per channel)."""
     if venue == "host":
         return aggregate_arrays_host(inputs, gid, num_groups)
+    from hyperspace_tpu.parallel.mesh import mesh_axes, mesh_size
+
+    d = mesh_size(mesh) if mesh is not None else 1
     n = len(gid)
     n_pad = _pow2(max(n, 1))
+    if d > 1 and n_pad % d:
+        n_pad = ((n_pad + d - 1) // d) * d
     k_seg = _pow2(num_groups + 1)  # +1 dead segment for pads
     gid_p = np.full(n_pad, num_groups, np.int32)
     gid_p[:n] = gid
@@ -253,11 +296,13 @@ def aggregate_arrays(
     # process-wide flag is never touched (round 1 weakness #8).
     from hyperspace_tpu.parallel.x64 import run_x64
 
+    if d > 1:
+        reduce_fn = _make_sharded_segment_reduce(mesh, mesh_axes(mesh), k_seg, tuple(fns))
+    else:
+        reduce_fn = functools.partial(_segment_reduce_many, num_segments=k_seg, fns=tuple(fns))
     out = np.asarray(
         run_x64(
-            lambda: jax.device_get(
-                _segment_reduce_many(jnp.asarray(stacked), jnp.asarray(gid_p), k_seg, tuple(fns))
-            )
+            lambda: jax.device_get(reduce_fn(jnp.asarray(stacked), jnp.asarray(gid_p)))
         )
     )[:, :num_groups]
     results = out[0::2]
@@ -275,6 +320,7 @@ def _pad_const(v: np.ndarray, n_pad: int, fn: str) -> np.ndarray:
 def aggregate_table(
     table: ColumnTable, group_by: list[str], aggs: list, out_schema: Schema,
     venue: str = "device",
+    mesh=None,
 ) -> ColumnTable:
     """Execute a grouped aggregation over a materialized table."""
     gid, k, first_idx = group_ids(table, group_by)
@@ -293,7 +339,7 @@ def aggregate_table(
 
     if k == 0:
         return ColumnTable.empty(out_schema)
-    results, counts = aggregate_arrays(inputs, gid, k, venue=venue)
+    results, counts = aggregate_arrays(inputs, gid, k, venue=venue, mesh=mesh)
 
     cols: dict[str, np.ndarray] = {}
     dicts: dict[str, np.ndarray] = {}

@@ -448,3 +448,32 @@ def test_sort_requires_keys():
         Sort(scan, [])
     with pytest.raises(ValueError, match="at least one"):
         scan.sort([])
+
+
+def test_mesh_sharded_aggregation_matches_single_device(tmp_path, sales):
+    """With a multi-device mesh, the device segment-reduce shards the row
+    dimension and combines [A, K] partials with one collective per
+    channel; results must equal the single-device reduce."""
+    from hyperspace_tpu.config import AGG_VENUE
+
+    q_args = (
+        ["item"],
+        [
+            AggSpec.of("sum", "qty", "s"),
+            AggSpec.of("count", None, "n"),
+            AggSpec.of("mean", "price", "m"),
+            AggSpec.of("min", "price", "mn"),
+            AggSpec.of("max", "qty", "mx"),
+        ],
+    )
+    outs = {}
+    for name, mesh in (("single", None), ("mesh", make_mesh())):
+        session = _session(tmp_path, mesh=mesh)
+        session.conf.set(AGG_VENUE, "device")
+        df = session.parquet(sales)
+        outs[name] = (
+            session.to_pandas(df.aggregate(*q_args)).sort_values("item").reset_index(drop=True)
+        )
+        if name == "mesh":
+            assert session.last_query_stats.get("agg_devices", 1) > 1
+    pd.testing.assert_frame_equal(outs["single"], outs["mesh"])
