@@ -27,10 +27,10 @@ from hyperspace_tpu.execution import io as hio
 from hyperspace_tpu.execution.builder import compute_row_hashes, hash_scalar_key
 from hyperspace_tpu.execution.table import ColumnTable
 from hyperspace_tpu.dataset import format_suffix, list_data_files
-from hyperspace_tpu.ops.filter import apply_filter
+from hyperspace_tpu.ops.filter import apply_filter, eval_predicate_mask
 from hyperspace_tpu.ops.hashing import bucket_ids
 from hyperspace_tpu.ops import join as join_ops
-from hyperspace_tpu.plan.expr import BinOp, Col, Expr, Lit, evaluate, split_conjuncts
+from hyperspace_tpu.plan.expr import And, BinOp, Col, Expr, Lit, evaluate, split_conjuncts
 from hyperspace_tpu.plan.nodes import (
     Aggregate,
     Filter,
@@ -51,6 +51,10 @@ class AlignedSide:
     # Hybrid scan: an unbucketed delta scan whose rows are bucketized
     # on the fly and merged into the index buckets before the SMJ.
     delta: Scan | None = None
+    # Side-local filter (JoinIndexRule keeps linear sides with filters):
+    # applied per bucket BEFORE the merge, preserving bucket grouping and
+    # within-bucket sort order (a filtered subsequence stays sorted).
+    predicate: Expr | None = None
 
 
 @dataclasses.dataclass
@@ -61,6 +65,21 @@ class SideData:
     table: ColumnTable
     offsets: np.ndarray  # [B+1] int64
     sorted_within: bool  # buckets key-sorted (index files are)?
+
+
+def _filter_side(side: SideData, predicate, mesh) -> SideData:
+    """Apply a side-local filter to bucket-grouped data, recomputing the
+    bucket offsets over the surviving rows (grouping and within-bucket
+    order are preserved — a filtered subsequence stays sorted)."""
+    t = side.table
+    if t.num_rows == 0:
+        return side
+    mask = eval_predicate_mask(t, predicate, mesh=mesh)
+    counts = np.diff(side.offsets)
+    bucket_of = np.repeat(np.arange(len(counts), dtype=np.int64), counts)
+    new_counts = np.bincount(bucket_of[mask], minlength=len(counts))
+    offsets = np.concatenate([[0], np.cumsum(new_counts)]).astype(np.int64)
+    return SideData(t.filter_mask(mask), offsets, side.sorted_within)
 
 
 def _bucket_sorted_codes(codes: np.ndarray, side: SideData):
@@ -636,10 +655,17 @@ class Executor:
         return one(lt), one(rt), None, None
 
     def _aligned_side(self, plan: LogicalPlan) -> AlignedSide | None:
-        node, project = plan, None
-        if isinstance(node, Project):
-            project = node.columns
-            node = node.child
+        node, project, predicate = plan, None, None
+        # Linear chain the join rule preserves: Project / Filter over the
+        # (possibly hybrid) index scan, in any order.
+        while isinstance(node, (Project, Filter)):
+            if isinstance(node, Project):
+                if project is None:  # outermost projection defines output
+                    project = node.columns
+                node = node.child
+            else:
+                predicate = node.predicate if predicate is None else And(predicate, node.predicate)
+                node = node.child
         if isinstance(node, Union) and len(node.inputs) == 2:
             base, delta = node.inputs
             if isinstance(delta, Project) and isinstance(delta.child, Scan):
@@ -650,10 +676,10 @@ class Executor:
                 and isinstance(delta, Scan)
                 and delta.bucket_spec is None
             ):
-                return AlignedSide(base, project, delta=delta)
+                return AlignedSide(base, project, delta=delta, predicate=predicate)
             return None
         if isinstance(node, Scan):
-            return AlignedSide(node, project)
+            return AlignedSide(node, project, predicate=predicate)
         return None
 
     def _side_data(self, side: AlignedSide, num_buckets: int) -> "SideData":
@@ -688,8 +714,12 @@ class Executor:
             order = np.argsort(all_bucket, kind="stable")
             counts2 = np.bincount(all_bucket, minlength=num_buckets)
             offsets = np.concatenate([[0], np.cumsum(counts2)]).astype(np.int64)
-            return SideData(combined.take(order), offsets, False)
-        return SideData(base, offsets, sorted_within)
+            out = SideData(combined.take(order), offsets, False)
+        else:
+            out = SideData(base, offsets, sorted_within)
+        if side.predicate is not None:
+            out = _filter_side(out, side.predicate, self.mesh)
+        return out
 
     def _aligned_join(
         self,

@@ -11,7 +11,7 @@ import pyarrow as pa
 import pyarrow.parquet as pq
 import pytest
 
-from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig
+from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col, lit
 from hyperspace_tpu.config import JOIN_VENUE
 from hyperspace_tpu import native
 
@@ -209,3 +209,27 @@ def test_build_venue_host_produces_identical_index(tmp_path):
     m1 = json.loads((dirs["device"] / "_index_manifest.json").read_text())
     m2 = json.loads((dirs["host"] / "_index_manifest.json").read_text())
     assert m1 == m2
+
+
+@pytest.mark.parametrize("venue", ["device", "host"])
+def test_filtered_sides_keep_zero_exchange_join(joined, venue):
+    """JoinIndexRule keeps linear sides with filters; the executor must
+    apply side-local predicates per bucket and STILL take the
+    bucket-aligned zero-exchange path (round-1 weak #7: such shapes
+    silently fell back to the single-partition join)."""
+    if venue == "host" and not native.available():
+        pytest.skip("native library not built")
+    session, fs, ds, f, d = joined
+    session.conf.set(JOIN_VENUE, venue)
+    q = fs.filter(col("a") > lit(0.0)).join(ds.filter(col("b") < lit(0.5)), ["k"])
+    got = session.to_pandas(q).sort_values(["k", "a"]).reset_index(drop=True)
+    assert session.last_query_stats["join_path"] == "zero-exchange-aligned"
+    exp = (
+        f[f.a > 0.0]
+        .merge(d[d.b < 0.5], on="k")
+        .sort_values(["k", "a"])
+        .reset_index(drop=True)
+    )
+    assert len(got) == len(exp)
+    np.testing.assert_allclose(got["a"], exp["a"])
+    np.testing.assert_allclose(got["b"], exp["b"])
