@@ -115,6 +115,28 @@ class KeyBounds:
 _FLIP = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}
 
 
+def _conjunct_col_lit(conj) -> tuple[str, str, object] | None:
+    """Destructure one conjunct as (column, op, literal), normalizing
+    `lit op col` by flipping the comparison. NaN literals are rejected
+    (they defeat ordered-bound reasoning: every comparison is False, but
+    searchsorted treats NaN as largest). Returns None otherwise."""
+    if not isinstance(conj, BinOp):
+        return None
+    op = conj.op
+    if isinstance(conj.left, Col) and isinstance(conj.right, Lit):
+        name, v = conj.left.name, conj.right.value
+    elif isinstance(conj.right, Col) and isinstance(conj.left, Lit):
+        name, v = conj.right.name, conj.left.value
+        op = _FLIP.get(op, op)
+    else:
+        return None
+    if v is None:
+        return None
+    if isinstance(v, (float, np.floating)) and np.isnan(v):
+        return None
+    return name, op, v
+
+
 def key_bounds(predicate: Expr, key: str) -> KeyBounds | None:
     """Extract literal comparison bounds on `key` from the predicate's
     conjuncts (key op lit / lit op key; eq pins both ends). Returns None
@@ -123,17 +145,11 @@ def key_bounds(predicate: Expr, key: str) -> KeyBounds | None:
     b = KeyBounds()
     found = False
     for conj in split_conjuncts(predicate):
-        if not isinstance(conj, BinOp):
+        dec = _conjunct_col_lit(conj)
+        if dec is None:
             continue
-        op = conj.op
-        if isinstance(conj.left, Col) and isinstance(conj.right, Lit):
-            name, v = conj.left.name, conj.right.value
-        elif isinstance(conj.right, Col) and isinstance(conj.left, Lit):
-            name, v = conj.right.name, conj.left.value
-            op = _FLIP.get(op, op)
-        else:
-            continue
-        if name.lower() != key.lower() or op not in ("eq", "lt", "le", "gt", "ge") or v is None:
+        name, op, v = dec
+        if name.lower() != key.lower() or op not in ("eq", "lt", "le", "gt", "ge"):
             continue
         try:
             if op in ("gt", "ge", "eq") and (
@@ -149,6 +165,23 @@ def key_bounds(predicate: Expr, key: str) -> KeyBounds | None:
         except TypeError:
             continue
     return b if found else None
+
+
+def predicate_all_key_bounds(predicate: Expr, key: str) -> bool:
+    """True iff EVERY conjunct is a comparable literal bound on `key`
+    (eq/lt/le/gt/ge) — i.e. an exact searchsorted slice on the sorted key
+    fully implements the predicate and the residual mask is redundant."""
+    key = key.lower()
+    for conj in split_conjuncts(predicate):
+        dec = _conjunct_col_lit(conj)
+        if dec is None:
+            return False
+        name, op, v = dec
+        if name.lower() != key or op not in ("eq", "lt", "le", "gt", "ge"):
+            return False
+        if not isinstance(v, (int, float, bool, np.number)):
+            return False
+    return True
 
 
 def _stats_overlap(bounds: KeyBounds, mn, mx) -> bool:
@@ -469,13 +502,25 @@ class Executor:
                 return apply_filter(table, plan.predicate, mesh=self.mesh)
             ranged = self._range_read(child, plan.predicate)
             if ranged is not None:
+                table, exact = ranged
+                if exact and predicate_all_key_bounds(plan.predicate, child.bucket_spec[1][0]):
+                    # The slice IS the predicate: every conjunct bounds the
+                    # sorted key, so the residual mask would be all-true —
+                    # skip its evaluation (and the device round-trip).
+                    self._phys(
+                        "IndexRangeScan",
+                        files_pruned=self.stats["files_pruned"] - fp0,
+                        rows_pruned=self.stats["rows_pruned"] - rp0,
+                        kernel="minmax-prune + searchsorted-slice (exact, mask skipped)",
+                    )
+                    return table
                 self._phys(
                     "IndexRangeScan",
                     files_pruned=self.stats["files_pruned"] - fp0,
                     rows_pruned=self.stats["rows_pruned"] - rp0,
                     kernel="minmax-prune + searchsorted-slice + fused-xla-mask",
                 )
-                return apply_filter(ranged, plan.predicate, mesh=self.mesh)
+                return apply_filter(table, plan.predicate, mesh=self.mesh)
         if isinstance(child, Union):
             # Hybrid scan: prune the bucketed input(s), keep deltas whole.
             new_inputs: list[LogicalPlan] = []
@@ -484,7 +529,7 @@ class Executor:
                     pruned = self._prune_bucket_files(inp, plan.predicate)
                     if pruned is None:
                         ranged = self._range_prune_list(inp, plan.predicate)
-                        pruned = ranged[0] if ranged is not None else None
+                        pruned = ranged[0] if ranged is not None else None  # (kept, bounds, stats)
                     if pruned is not None:
                         inp = dataclasses.replace(inp, files=pruned)
                 new_inputs.append(inp)
@@ -523,7 +568,9 @@ class Executor:
             return matches
         return None
 
-    def _range_prune_list(self, scan: Scan, predicate: Expr) -> tuple[list[str], KeyBounds] | None:
+    def _range_prune_list(
+        self, scan: Scan, predicate: Expr
+    ) -> tuple[list[str], KeyBounds, dict] | None:
         """File-level range (min/max) pruning: drop bucket files whose
         manifest key stats cannot overlap the predicate's bounds on the
         leading indexed column. The analog of FileSourceScanExec's parquet
@@ -551,24 +598,27 @@ class Executor:
             if s is not None and _stats_overlap(bounds, stat_conv(s[0]), stat_conv(s[1])):
                 kept.append(f)
         self.stats["files_pruned"] += len(files) - len(kept)
-        return kept, bounds
+        return kept, bounds, stats
 
-    def _range_read(self, scan: Scan, predicate: Expr) -> ColumnTable | None:
+    def _range_read(self, scan: Scan, predicate: Expr) -> tuple[ColumnTable, bool] | None:
         """File-level range pruning + within-file searchsorted slicing
         (each surviving file is key-sorted by construction, so qualifying
         rows form one contiguous run). Dictionary codes are not
         value-ordered across files and null prefixes break sortedness —
-        both fall back to reading the file whole (mask handles the rest)."""
+        both fall back to reading the file whole (mask handles the rest).
+        Returns (table, exact): exact ⇔ every row returned provably
+        satisfies the key bounds (all parts sliced on a sorted, null-free,
+        stats-backed key)."""
         from concurrent.futures import ThreadPoolExecutor
 
         pruned = self._range_prune_list(scan, predicate)
         if pruned is None:
             return None
-        kept, bounds = pruned
+        kept, bounds, stats_files = pruned
         schema = scan.scan_schema
         field = schema.field(scan.bucket_spec[1][0])
         if not kept:
-            return ColumnTable.empty(schema)
+            return ColumnTable.empty(schema), True
         before = hio.table_cache_stats()["miss_files"]
         with ThreadPoolExecutor(max_workers=min(8, len(kept))) as pool:
             tables = list(
@@ -579,10 +629,19 @@ class Executor:
             )
         self.stats["files_read"] += hio.table_cache_stats()["miss_files"] - before
         parts: list[ColumnTable] = []
-        for t in tables:
+        # Float keys can hold NaN VALUES (sorted last by the build); a
+        # lower-bound-only slice would include them while the mask drops
+        # them — never claim exactness for float key columns.
+        exact = field.device_dtype.kind != "f"
+        for fp, t in zip(kept, tables):
             if t.num_rows == 0:
                 continue
-            if not field.is_string and t.valid_mask(field.name) is None:
+            sliceable = (
+                not field.is_string
+                and t.valid_mask(field.name) is None
+                and fp in stats_files  # stats-backed ⇒ written key-sorted
+            )
+            if sliceable:
                 colv = t.columns[field.name]
                 lo_i, hi_i = 0, t.num_rows
                 if bounds.lo is not None:
@@ -595,10 +654,13 @@ class Executor:
                 if lo_i > 0 or hi_i < t.num_rows:
                     self.stats["rows_pruned"] += t.num_rows - (hi_i - lo_i)
                     t = t.take(np.arange(lo_i, hi_i))
+            else:
+                exact = False
             parts.append(t)
         if not parts:
-            return ColumnTable.empty(schema)
-        return ColumnTable.concat(parts) if len(parts) > 1 else parts[0]
+            return ColumnTable.empty(schema), True
+        out = ColumnTable.concat(parts) if len(parts) > 1 else parts[0]
+        return out, exact
 
     # -- join ------------------------------------------------------------
     def _join(self, plan: Join) -> ColumnTable:

@@ -223,3 +223,61 @@ def test_range_pruning_in_hybrid_scan(tmp_path):
     exp2 = both[(both.k >= lo) & (both.k < hi)]
     assert sorted(got2["k"]) == sorted(exp2["k"])
     np.testing.assert_allclose(sorted(got2["v"]), sorted(exp2["v"]))
+
+
+def test_exact_slice_skips_residual_mask(indexed):
+    """A predicate made ONLY of key bounds is fully implemented by the
+    slice — the physical plan records the skipped mask and results stay
+    identical to the raw scan."""
+    session, scan, df = indexed
+    lo, hi = 30_000, 31_000
+    q = scan.filter((col("k") >= lit(lo)) & (col("k") < lit(hi)))
+    got = session.to_pandas(q)
+    phys = session.last_physical_plan
+    node = next(n for n in phys.walk() if n.op == "IndexRangeScan")
+    assert "mask skipped" in node.detail["kernel"]
+    exp = df[(df.k >= lo) & (df.k < hi)]
+    assert len(got) == len(exp)
+    np.testing.assert_allclose(sorted(got["v"]), sorted(exp["v"]))
+
+    # A residual conjunct on another column keeps the mask.
+    q2 = scan.filter((col("k") >= lit(lo)) & (col("k") < lit(hi)) & (col("v") > lit(0.0)))
+    got2 = session.to_pandas(q2)
+    node2 = next(n for n in session.last_physical_plan.walk() if n.op == "IndexRangeScan")
+    assert "fused-xla-mask" in node2.detail["kernel"]
+    exp2 = exp[exp.v > 0.0]
+    assert len(got2) == len(exp2)
+
+
+def test_nan_bound_returns_no_rows(indexed):
+    """NaN comparisons are False for every row; the range path must not
+    treat NaN as an orderable bound (searchsorted sorts NaN last, which
+    would return EVERY row as an 'exact' slice)."""
+    session, scan, df = indexed
+    q = scan.filter(col("k") <= lit(float("nan")))
+    session.disable_hyperspace()
+    assert len(session.to_pandas(q)) == 0
+    session.enable_hyperspace()
+    assert len(session.to_pandas(q)) == 0
+
+
+def test_float_key_with_nan_values_not_overincluded(tmp_path):
+    """A float key column holding NaN VALUES: a lower-bound-only slice
+    includes the trailing NaN run, so the mask must still run (exactness
+    is never claimed for float keys)."""
+    df = pd.DataFrame(
+        {
+            "k": np.array([1.0, 2.0, 3.0, np.nan, np.nan], dtype=np.float64),
+            "v": np.arange(5, dtype=np.float64),
+        }
+    )
+    root = tmp_path / "nan_src"
+    root.mkdir()
+    pq.write_table(pa.Table.from_pandas(df, preserve_index=False), root / "p.parquet")
+    session = HyperspaceSession(system_path=str(tmp_path / "idx"), num_buckets=1)
+    hs = Hyperspace(session)
+    scan = session.parquet(root)
+    hs.create_index(scan, IndexConfig("nk", ["k"], ["v"]))
+    session.enable_hyperspace()
+    got = session.to_pandas(scan.filter(col("k") >= lit(2.0)))
+    assert sorted(got["k"]) == [2.0, 3.0]  # NaN rows dropped by the mask
