@@ -158,6 +158,7 @@ def explain_executed(plan: LogicalPlan, session, mode=None) -> str:
         session.run(plan)
         phys_with = session.last_physical_plan
         stats_with = session.last_query_stats
+        rewritten = session.optimized_plan(plan)
     finally:
         session._enabled = was_enabled
 
@@ -175,6 +176,10 @@ def explain_executed(plan: LogicalPlan, session, mode=None) -> str:
     out.append("=" * 64)
     out.append("Executed plan without indexes:")
     out.extend(_render_physical(phys_without, marked_before, mode))
+    out.append("=" * 64)
+    out.append("Indexes used:")
+    for name in _used_indexes(rewritten, session):
+        out.append(f"  {name}")
     out.append("=" * 64)
     out.append("Physical operator stats:")
     cb, ca = _physical_counts(phys_without), _physical_counts(phys_with)

@@ -76,6 +76,7 @@ def test_explain_physical_diffs_executed_plans(session, hs, sample_parquet):
     assert "Executed plan with indexes:" in out
     assert "IndexPointLookup" in out
     assert "TableScan" in out  # the without-index side
+    assert "Indexes used:" in out and "e_key" in out
     assert "files read:" in out and "files pruned:" in out
     # Aggregate evidence shows up too.
     out2 = hs.explain(
