@@ -47,7 +47,7 @@ def main(sf: float = 1.0):
     import numpy as np
 
     from benchmarks.datagen import cached_tpch
-    from hyperspace_tpu import AggSpec, Hyperspace, HyperspaceSession, IndexConfig, col, lit
+    from hyperspace_tpu import AggSpec, Hyperspace, HyperspaceSession, IndexConfig, col, lit, when
 
     tmp = Path(tempfile.mkdtemp(prefix="hs_tpchq_"))
     results = []
@@ -66,7 +66,7 @@ def main(sf: float = 1.0):
         ))
         hs.create_index(li, IndexConfig(
             "li_orderkey", ["l_orderkey"],
-            ["l_extendedprice", "l_discount", "l_shipdate", "l_shipmode"],
+            ["l_extendedprice", "l_discount", "l_shipdate", "l_shipmode", "l_receiptdate"],
         ))
         hs.create_index(orders, IndexConfig(
             "o_orderkey", ["o_orderkey"], ["o_orderdate", "o_shippriority", "o_orderpriority"],
@@ -109,10 +109,42 @@ def main(sf: float = 1.0):
                     .aggregate(["o_orderkey"], [AggSpec.of("sum", rev, "revenue")])
                     .sort([("revenue", False), ("o_orderkey", True)])
                     .limit(10),
-            # Q12-shaped: line counts per ship mode for one year of orders.
+            # Q12: shipping-mode priority counts — conditional aggregates
+            # (CASE WHEN o_orderpriority in high) over the join, filtered
+            # to two ship modes and one receipt year.
             "q12": orders.select("o_orderkey", "o_orderpriority")
-                    .join(li.select("l_orderkey", "l_shipmode"), ["o_orderkey"], ["l_orderkey"])
-                    .aggregate(["l_shipmode"], [AggSpec.of("count", None, "line_count")])
+                    .join(
+                        li.select("l_orderkey", "l_shipmode", "l_receiptdate"),
+                        ["o_orderkey"], ["l_orderkey"],
+                    )
+                    .filter(
+                        ((col("l_shipmode") == lit("MAIL")) | (col("l_shipmode") == lit("SHIP")))
+                        & (col("l_receiptdate") >= lit(days("1994-01-01")))
+                        & (col("l_receiptdate") < lit(days("1995-01-01")))
+                    )
+                    .aggregate(
+                        ["l_shipmode"],
+                        [
+                            AggSpec.of(
+                                "sum",
+                                when(
+                                    (col("o_orderpriority") == lit("1-URGENT"))
+                                    | (col("o_orderpriority") == lit("2-HIGH")),
+                                    1.0,
+                                ).otherwise(0.0),
+                                "high_line_count",
+                            ),
+                            AggSpec.of(
+                                "sum",
+                                when(
+                                    (col("o_orderpriority") == lit("1-URGENT"))
+                                    | (col("o_orderpriority") == lit("2-HIGH")),
+                                    0.0,
+                                ).otherwise(1.0),
+                                "low_line_count",
+                            ),
+                        ],
+                    )
                     .sort(["l_shipmode"]),
         }
 

@@ -38,6 +38,7 @@ JOIN_VENUE_MIN_MBPS = "hyperspace.join.venueMinMbps"
 BUILD_VENUE = "hyperspace.build.venue"
 AGG_VENUE = "hyperspace.agg.venue"
 SORT_VENUE = "hyperspace.sort.venue"
+FILTER_VENUE = "hyperspace.filter.venue"
 
 # Directory-layout constants (reference index/IndexConstants.scala:38-39).
 HYPERSPACE_LOG_DIR = "_hyperspace_log"
@@ -68,6 +69,7 @@ class HyperspaceConf:
     build_venue: str = DEFAULT_JOIN_VENUE
     agg_venue: str = DEFAULT_JOIN_VENUE
     sort_venue: str = DEFAULT_JOIN_VENUE
+    filter_venue: str = DEFAULT_JOIN_VENUE
     overrides: dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def __post_init__(self):
@@ -100,6 +102,8 @@ class HyperspaceConf:
             self.agg_venue = str(value)
         elif key == SORT_VENUE:
             self.sort_venue = str(value)
+        elif key == FILTER_VENUE:
+            self.filter_venue = str(value)
 
     def get(self, key: str, default: Any = None) -> Any:
         if key in self.overrides:
@@ -128,4 +132,6 @@ class HyperspaceConf:
             return self.agg_venue
         if key == SORT_VENUE:
             return self.sort_venue
+        if key == FILTER_VENUE:
+            return self.filter_venue
         return default

@@ -67,14 +67,14 @@ class SideData:
     sorted_within: bool  # buckets key-sorted (index files are)?
 
 
-def _filter_side(side: SideData, predicate, mesh) -> SideData:
+def _filter_side(side: SideData, predicate, mesh, venue: str = "auto") -> SideData:
     """Apply a side-local filter to bucket-grouped data, recomputing the
     bucket offsets over the surviving rows (grouping and within-bucket
     order are preserved — a filtered subsequence stays sorted)."""
     t = side.table
     if t.num_rows == 0:
         return side
-    mask = eval_predicate_mask(t, predicate, mesh=mesh)
+    mask = eval_predicate_mask(t, predicate, mesh=mesh, venue=venue)
     counts = np.diff(side.offsets)
     bucket_of = np.repeat(np.arange(len(counts), dtype=np.int64), counts)
     new_counts = np.bincount(bucket_of[mask], minlength=len(counts))
@@ -288,8 +288,9 @@ class Executor:
 
     def execute(self, plan: LogicalPlan) -> ColumnTable:
         from hyperspace_tpu.plan.prune import prune_columns
+        from hyperspace_tpu.plan.pushdown import push_down_filters
 
-        return self._execute(prune_columns(plan))
+        return self._execute(prune_columns(push_down_filters(plan)))
 
     def _execute(self, plan: LogicalPlan) -> ColumnTable:
         from hyperspace_tpu.execution.physical import PhysicalNode
@@ -414,6 +415,12 @@ class Executor:
             needs_native=needs_native,
         )
 
+    def _filter_venue(self) -> str:
+        """Mask venue: host numpy below the link floor (the mask and the
+        columns are host-resident); device (mesh-sharded) otherwise."""
+        return self._venue("filter_venue", "hyperspace.filter.venue",
+                           self.mesh is not None, needs_native=False)
+
     def _agg_venue(self) -> str:
         """Where the segment reduce runs. The inputs are host-resident and
         the [A, K] result is tiny, so below the link floor the numpy
@@ -490,16 +497,18 @@ class Executor:
         # Per-OPERATOR pruning evidence: deltas of the query-cumulative
         # counters from this frame's start.
         fp0, rp0 = self.stats["files_pruned"], self.stats["rows_pruned"]
+        mask_venue = self._filter_venue()
+        mask_kernel = "host-mask" if mask_venue == "host" else "fused-xla-mask"
         if isinstance(child, Scan) and child.bucket_spec is not None:
             pruned = self._prune_bucket_files(child, plan.predicate)
             if pruned is not None:
                 self._phys(
                     "IndexPointLookup",
                     files_pruned=self.stats["files_pruned"] - fp0,
-                    kernel="bucket-hash-prune + fused-xla-mask",
+                    kernel=f"bucket-hash-prune + {mask_kernel}",
                 )
                 table = self._cached_read(pruned, child.scan_schema.names, child.scan_schema)
-                return apply_filter(table, plan.predicate, mesh=self.mesh)
+                return apply_filter(table, plan.predicate, mesh=self.mesh, venue=mask_venue)
             ranged = self._range_read(child, plan.predicate)
             if ranged is not None:
                 table, exact = ranged
@@ -518,9 +527,9 @@ class Executor:
                     "IndexRangeScan",
                     files_pruned=self.stats["files_pruned"] - fp0,
                     rows_pruned=self.stats["rows_pruned"] - rp0,
-                    kernel="minmax-prune + searchsorted-slice + fused-xla-mask",
+                    kernel=f"minmax-prune + searchsorted-slice + {mask_kernel}",
                 )
-                return apply_filter(table, plan.predicate, mesh=self.mesh)
+                return apply_filter(table, plan.predicate, mesh=self.mesh, venue=mask_venue)
         if isinstance(child, Union):
             # Hybrid scan: prune the bucketed input(s), keep deltas whole.
             new_inputs: list[LogicalPlan] = []
@@ -536,11 +545,14 @@ class Executor:
             self._phys(
                 "HybridScanFilter",
                 files_pruned=self.stats["files_pruned"] - fp0,
-                kernel="bucket/minmax-prune + fused-xla-mask",
+                kernel=f"bucket/minmax-prune + {mask_kernel}",
             )
-            return apply_filter(self._union(Union(new_inputs)), plan.predicate, mesh=self.mesh)
-        self._phys(kernel="fused-xla-mask")
-        return apply_filter(self._execute(child), plan.predicate, mesh=self.mesh)
+            return apply_filter(
+                self._union(Union(new_inputs)), plan.predicate,
+                mesh=self.mesh, venue=mask_venue,
+            )
+        self._phys(kernel=mask_kernel)
+        return apply_filter(self._execute(child), plan.predicate, mesh=self.mesh, venue=mask_venue)
 
     def _prune_bucket_files(self, scan: Scan, predicate: Expr) -> list[str] | None:
         """If the predicate pins every bucket column with an equality
@@ -780,7 +792,7 @@ class Executor:
         else:
             out = SideData(base, offsets, sorted_within)
         if side.predicate is not None:
-            out = _filter_side(out, side.predicate, self.mesh)
+            out = _filter_side(out, side.predicate, self.mesh, self._filter_venue())
         return out
 
     def _aligned_join(
@@ -853,6 +865,8 @@ class Executor:
             gside = side_of(plan.group_by)
             if gside is None:
                 return None
+        from hyperspace_tpu.plan.expr import Case
+
         spec_sides: list[str | None] = []
         for a in plan.aggs:
             if a.fn not in ("sum", "count", "mean"):
@@ -867,7 +881,11 @@ class Executor:
             if s is None:
                 return None
             sch = join.left.schema if s == "left" else join.right.schema
-            if any(sch.field(r).is_string or sch.field(r).is_vector for r in refs):
+            if any(sch.field(r).is_vector for r in refs):
+                return None
+            # Case conditions handle strings via the predicate machinery;
+            # any other string reference cannot feed a numeric channel.
+            if not isinstance(a.expr, Case) and any(sch.field(r).is_string for r in refs):
                 return None
             spec_sides.append(s)
         primary = gside or "left"

@@ -214,6 +214,7 @@ def explain_string(
         mode = display_mode_from_conf(getattr(session, "conf", None))
 
     from hyperspace_tpu.plan.prune import prune_columns
+    from hyperspace_tpu.plan.pushdown import push_down_filters
 
     was_enabled = session.is_hyperspace_enabled()
     try:
@@ -223,9 +224,10 @@ def explain_string(
         if not was_enabled:
             session.disable_hyperspace()
 
-    # Diff against the column-pruned baseline: pruning runs on BOTH sides
-    # (it is not an index effect), so highlights show only index rewrites.
-    plan = prune_columns(plan)
+    # Diff against the pushed-down, column-pruned baseline: those passes
+    # run on BOTH sides (they are not index effects), so highlights show
+    # only index rewrites.
+    plan = prune_columns(push_down_filters(plan))
     marked_before: set = set()
     marked_after: set = set()
     _mark_diff(plan, with_plan, marked_before, marked_after)

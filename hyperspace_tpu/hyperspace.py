@@ -90,13 +90,15 @@ class HyperspaceSession:
         if not self._enabled:
             return plan
         from hyperspace_tpu.plan.prune import prune_columns
+        from hyperspace_tpu.plan.pushdown import push_down_filters
 
-        # Column pruning FIRST (the analog of Spark running ColumnPruning
-        # before the extraOptimizations batch): a scan narrowed to what the
-        # query needs lets an index cover e.g. Aggregate(Filter(Scan))
-        # shapes whose full source width it could not.
+        # Predicate pushdown + column pruning FIRST (the analog of Spark
+        # running PushDownPredicate/ColumnPruning before the
+        # extraOptimizations batch): side-local filters reach the join
+        # sides (where the index rules cover them) and scans narrow to
+        # what the query needs.
         indexes = self.manager.get_indexes()
-        return apply_rules(prune_columns(plan), indexes, conf=self.conf)
+        return apply_rules(prune_columns(push_down_filters(plan)), indexes, conf=self.conf)
 
     def run(self, plan: LogicalPlan, profile_dir: str | Path | None = None):
         """Execute a plan (rewriting through indexes when enabled);

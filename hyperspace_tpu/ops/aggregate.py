@@ -170,31 +170,94 @@ def group_ids(table: ColumnTable, group_by: list[str]):
     return _compress(combined)
 
 
+def _case_input(table: ColumnTable, e) -> tuple[np.ndarray, np.ndarray | None]:
+    """CASE WHEN inside an aggregate: conditions evaluate with FULL
+    predicate semantics (string literals, 3-valued nulls — a null
+    condition does not take its branch) via the filter mask machinery;
+    value legs are numeric. Validity follows the branch actually taken."""
+    from hyperspace_tpu.ops.filter import eval_predicate_mask
+
+    out, valid = _expr_input(table, e.default)
+    out = _full(np.asarray(out, dtype=np.float64), table.num_rows)
+    for cond, val in reversed(e.branches):
+        m = eval_predicate_mask(table, cond)
+        v, vvalid = _expr_input(table, val)
+        v = _full(np.asarray(v, dtype=np.float64), table.num_rows)
+        out = np.where(m, v, out)
+        if valid is not None or vvalid is not None:
+            va = np.ones(table.num_rows, bool) if valid is None else valid
+            vb = np.ones(table.num_rows, bool) if vvalid is None else vvalid
+            valid = np.where(m, vb, va)
+    return out, valid
+
+
+def _full(vals: np.ndarray, n: int) -> np.ndarray:
+    return np.full(n, vals) if vals.ndim == 0 else vals
+
+
+def _expr_input(table: ColumnTable, e) -> tuple[np.ndarray, np.ndarray | None]:
+    """Recursive (values, validity) for an aggregate expression. Case
+    nodes keep their branch-following validity ANYWHERE in the tree (a
+    null condition takes the ELSE leg, it does not poison the row);
+    everything else ANDs the validity of what it actually reads. Values
+    may be 0-d (literals) until the caller broadcasts."""
+    from hyperspace_tpu.plan.expr import Case, Lit as _Lit
+
+    if isinstance(e, Case):
+        return _case_input(table, e)
+    if isinstance(e, Col):
+        f = table.schema.field(e.name)
+        if f.is_string:
+            raise HyperspaceError(f"aggregate expression over string column {f.name!r}")
+        return table.columns[f.name], table.valid_mask(e.name)
+    if isinstance(e, _Lit):
+        return np.asarray(e.value), None
+    from hyperspace_tpu.plan.expr import BinOp as _BinOp
+
+    if isinstance(e, _BinOp):
+        a, av = _expr_input(table, e.left)
+        b, bv = _expr_input(table, e.right)
+        vals = np.asarray(
+            evaluate(
+                _BinOp(e.op, Col("__a__"), Col("__b__")),
+                lambda name: a if name == "__a__" else b,
+                np,
+            )
+        )
+        if av is None:
+            valid = bv
+        elif bv is None:
+            valid = av
+        else:
+            valid = av & bv
+        return vals, valid
+    raise HyperspaceError(f"cannot aggregate over expression {type(e).__name__}")
+
+
+def _numeric_input(table: ColumnTable, e) -> tuple[np.ndarray, np.ndarray | None]:
+    """Full-length numeric (values, validity) for an aggregate expression."""
+    vals, valid = _expr_input(table, e)
+    return _full(vals, table.num_rows), valid
+
+
 def agg_input(table: ColumnTable, spec) -> tuple[np.ndarray, np.ndarray | None, bool]:
     """(values, valid mask or None, is_string_codes) for one AggSpec."""
+    from hyperspace_tpu.plan.expr import Case
+
     if spec.expr is None:  # count(*)
         return np.ones(table.num_rows, np.int64), None, False
-    refs = list(spec.expr.references())
-    valid = None
-    for r in refs:
-        v = table.valid_mask(r)
-        if v is not None:
-            valid = v if valid is None else (valid & v)
+    if isinstance(spec.expr, Case):
+        vals, valid = _case_input(table, spec.expr)
+        return vals, valid, False
     if isinstance(spec.expr, Col):
         f = table.schema.field(spec.expr.name)
+        valid = table.valid_mask(spec.expr.name)
         if f.is_string:
             if spec.fn not in ("min", "max", "count"):
                 raise HyperspaceError(f"{spec.fn} over string column {f.name!r}")
             return table.columns[f.name], valid, True
         return table.columns[f.name], valid, False
-    for r in refs:
-        if table.schema.field(r).is_string:
-            raise HyperspaceError(f"aggregate expression over string column {r!r}")
-    vals = np.asarray(
-        evaluate(spec.expr, lambda name: table.columns[table.schema.field(name).name], np)
-    )
-    if vals.ndim == 0:  # constant expression, e.g. sum(lit(2))
-        vals = np.full(table.num_rows, vals)
+    vals, valid = _numeric_input(table, spec.expr)
     return vals, valid, False
 
 

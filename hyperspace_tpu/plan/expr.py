@@ -153,6 +153,47 @@ class Not(Expr):
         return self.child.references()
 
 
+@dataclasses.dataclass(eq=False, repr=True)
+class Case(Expr):
+    """SQL CASE WHEN: ordered (condition, value) branches + default.
+    Conditions use full predicate semantics (3-valued logic; a null
+    condition does not take its branch); usable inside aggregate
+    expressions (the common TPC-H conditional-aggregate shape)."""
+
+    branches: list[tuple[Expr, Expr]]
+    default: Expr
+
+    def to_json(self):
+        return {
+            "type": "case",
+            "branches": [[c.to_json(), v.to_json()] for c, v in self.branches],
+            "default": self.default.to_json(),
+        }
+
+    def references(self):
+        out: set[str] = self.default.references()
+        for c, v in self.branches:
+            out |= c.references() | v.references()
+        return out
+
+
+class CaseBuilder:
+    """`when(cond, value).when(...).otherwise(default)` sugar."""
+
+    def __init__(self, branches):
+        self._branches = branches
+
+    def when(self, cond: Expr, value) -> "CaseBuilder":
+        return CaseBuilder(self._branches + [(cond, _wrap(value))])
+
+    def otherwise(self, default) -> Case:
+        return Case(self._branches, _wrap(default))
+
+
+def when(cond: Expr, value) -> CaseBuilder:
+    return CaseBuilder([(cond, _wrap(value))])
+
+
 def col(name: str) -> Col:
     return Col(name)
 
@@ -179,6 +220,11 @@ def expr_from_json(d: dict[str, Any]) -> Expr:
         return Or(expr_from_json(d["left"]), expr_from_json(d["right"]))
     if t == "not":
         return Not(expr_from_json(d["child"]))
+    if t == "case":
+        return Case(
+            [(expr_from_json(c), expr_from_json(v)) for c, v in d["branches"]],
+            expr_from_json(d["default"]),
+        )
     raise ValueError(f"unknown expr type {t!r}")
 
 
@@ -222,4 +268,11 @@ def evaluate(e: Expr, resolve: Callable[[str], Any], xp) -> Any:
         return xp.logical_or(evaluate(e.left, resolve, xp), evaluate(e.right, resolve, xp))
     if isinstance(e, Not):
         return xp.logical_not(evaluate(e.child, resolve, xp))
+    if isinstance(e, Case):
+        out = evaluate(e.default, resolve, xp)
+        for cond, val in reversed(e.branches):
+            out = xp.where(
+                evaluate(cond, resolve, xp), evaluate(val, resolve, xp), out
+            )
+        return out
     raise ValueError(f"cannot evaluate {e!r}")

@@ -1,0 +1,73 @@
+"""Predicate pushdown: move filters below inner joins.
+
+The analog of Spark's PushDownPredicate, which runs before the
+reference's rewrite rules (the Hyperspace rules see plans Catalyst has
+already normalized). Side-local conjuncts of a filter above an inner
+equi-join filter that side BEFORE the join — the executor's
+bucket-aligned path then applies them per bucket and the merge works
+over the (much smaller) surviving rows; conjuncts touching both sides
+stay above as a residual filter. Semantics-preserving for inner joins.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+from hyperspace_tpu.plan.expr import And, Expr, split_conjuncts
+from hyperspace_tpu.plan.nodes import Filter, Join, LogicalPlan
+
+
+def _conjoin(conjuncts: list[Expr]) -> Expr:
+    return functools.reduce(And, conjuncts)
+
+
+def push_down_filters(plan: LogicalPlan) -> LogicalPlan:
+    """Rewrite Filter(Join) shapes so side-local conjuncts run on their
+    side; applied recursively over the whole plan."""
+    if isinstance(plan, Filter):
+        child = push_down_filters(plan.child)
+        if isinstance(child, Join) and child.how == "inner":
+            lnames = {n.lower() for n in child.left.schema.names}
+            rnames = {n.lower() for n in child.right.schema.names}
+            left_c: list[Expr] = []
+            right_c: list[Expr] = []
+            residual: list[Expr] = []
+            for conj in split_conjuncts(plan.predicate):
+                refs = {r.lower() for r in conj.references()}
+                if refs and refs <= lnames:
+                    left_c.append(conj)
+                elif refs and refs <= rnames:
+                    right_c.append(conj)
+                else:
+                    residual.append(conj)
+            if left_c or right_c:
+                new_left = (
+                    push_down_filters(Filter(child.left, _conjoin(left_c)))
+                    if left_c
+                    else child.left
+                )
+                new_right = (
+                    push_down_filters(Filter(child.right, _conjoin(right_c)))
+                    if right_c
+                    else child.right
+                )
+                out: LogicalPlan = Join(
+                    new_left, new_right, child.left_on, child.right_on, child.how
+                )
+                return Filter(out, _conjoin(residual)) if residual else out
+        return Filter(child, plan.predicate)
+    kids = plan.children()
+    if not kids:
+        return plan
+    from hyperspace_tpu.plan.nodes import Union
+
+    if isinstance(plan, Union):
+        return Union([push_down_filters(c) for c in plan.inputs])
+    if isinstance(plan, Join):
+        return dataclasses.replace(
+            plan,
+            left=push_down_filters(plan.left),
+            right=push_down_filters(plan.right),
+        )
+    return dataclasses.replace(plan, child=push_down_filters(plan.child))

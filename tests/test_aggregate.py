@@ -477,3 +477,99 @@ def test_mesh_sharded_aggregation_matches_single_device(tmp_path, sales):
         if name == "mesh":
             assert session.last_query_stats.get("agg_devices", 1) > 1
     pd.testing.assert_frame_equal(outs["single"], outs["mesh"])
+
+
+@pytest.mark.parametrize("venue", ["device", "host"])
+def test_case_when_conditional_aggregate(tmp_path, sales, venue):
+    """SQL CASE WHEN inside aggregates (the TPC-H Q12/Q14 shape):
+    string-literal conditions with 3-valued nulls, numeric value legs,
+    identical across venues and vs pandas."""
+    from hyperspace_tpu import when
+    from hyperspace_tpu.config import AGG_VENUE
+    from hyperspace_tpu.plan.expr import lit as L
+
+    session = _session(tmp_path)
+    session.conf.set(AGG_VENUE, venue)
+    df = session.parquet(sales)
+    is_s1 = (col("store") == L("s1")) | (col("store") == L("s2"))
+    expr = when(is_s1, col("price")).otherwise(0.0)
+    flag = when(col("qty") > L(10), 1.0).otherwise(0.0)  # qty has nulls
+    q = df.aggregate(
+        ["item"],
+        [
+            AggSpec.of("sum", expr, "s12_price"),
+            AggSpec.of("sum", flag, "big_qty"),
+        ],
+    ).sort(["item"])
+    got = session.to_pandas(q)
+
+    pdf = pq.read_table(sales).to_pandas()
+    exp_price = np.where(pdf.store.isin(["s1", "s2"]), pdf.price, 0.0)
+    # null qty: condition is NULL -> branch not taken -> 0.0 (default leg)
+    exp_flag = np.where(pdf.qty.fillna(-1) > 10, 1.0, 0.0)
+    exp = (
+        pd.DataFrame({"item": pdf.item, "p": exp_price, "f": exp_flag})
+        .groupby("item")
+        .sum()
+        .reset_index()
+        .sort_values("item")
+        .reset_index(drop=True)
+    )
+    np.testing.assert_array_equal(got["item"], exp["item"])
+    np.testing.assert_allclose(got["s12_price"], exp["p"])
+    np.testing.assert_allclose(got["big_qty"], exp["f"])
+
+
+def test_case_when_json_roundtrip():
+    from hyperspace_tpu import when
+    from hyperspace_tpu.plan.expr import expr_from_json, lit as L
+
+    e = when(col("a") > L(1), col("b") * L(2.0)).when(col("a") < L(0), 0.0).otherwise(col("b"))
+    j = e.to_json()
+    e2 = expr_from_json(j)
+    assert e2.to_json() == j
+    assert e.references() == {"a", "b"}
+
+
+def test_nested_case_in_arithmetic_aggregate(tmp_path, sales):
+    """Case nested inside arithmetic keeps branch-following validity: a
+    null condition takes the ELSE leg instead of poisoning the row, and
+    string-literal conditions work at any depth."""
+    from hyperspace_tpu import when
+    from hyperspace_tpu.plan.expr import lit as L
+
+    session = _session(tmp_path)
+    df = session.parquet(sales)
+    expr = when(col("qty") > L(10), 1.0).otherwise(2.0) * col("price")
+    sexpr = when(col("store") == L("s1"), 1.0).otherwise(0.0) * col("price")
+    got = session.to_pandas(
+        df.aggregate([], [AggSpec.of("sum", expr, "s"), AggSpec.of("sum", sexpr, "sp")])
+    )
+    pdf = pq.read_table(sales).to_pandas()
+    exp = (np.where(pdf.qty.fillna(-1) > 10, 1.0, 2.0) * pdf.price).sum()
+    exp_sp = np.where(pdf.store == "s1", pdf.price, 0.0).sum()
+    np.testing.assert_allclose(got["s"][0], exp)
+    np.testing.assert_allclose(got["sp"][0], exp_sp)
+
+
+def test_case_aggregate_takes_fused_join_path(tmp_path, join_tables):
+    """A Case spec with string-literal conditions stays eligible for the
+    fused Aggregate(Join) kernel (the TPC-H Q12 shape)."""
+    from hyperspace_tpu import when
+    from hyperspace_tpu.config import AGG_VENUE
+    from hyperspace_tpu.plan.expr import lit as L
+
+    fact_root, dim_root = join_tables
+    session = _session(tmp_path)
+    session.conf.set(AGG_VENUE, "device")
+    fact = session.parquet(fact_root)
+    dim = session.parquet(dim_root)
+    q = fact.join(dim, ["k"]).aggregate(
+        [], [AggSpec.of("sum", when(col("cat") == L("c1"), 1.0).otherwise(0.0), "c1s")]
+    )
+    got = session.to_pandas(q)
+    assert session.last_query_stats["agg_path"] == "fused-join-agg"
+    f = pq.read_table(fact_root).to_pandas()
+    d = pq.read_table(dim_root).to_pandas()
+    j = f.merge(d, on="k")
+    np.testing.assert_allclose(got["c1s"][0], float((j.cat == "c1").sum()))

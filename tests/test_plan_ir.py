@@ -109,3 +109,41 @@ def test_projection_pushdown_prunes_scan_columns():
     leaves = pj.leaves()
     assert leaves[0].scan_schema.names == ["a", "b"]
     assert leaves[1].scan_schema.names == ["a"]  # join key only; x dropped
+
+
+class TestPushdown:
+    def _scans(self):
+        from hyperspace_tpu.plan.nodes import Scan
+        from hyperspace_tpu.schema import Field, Schema
+
+        l = Scan("/l", "parquet", Schema.of(Field("k", "int64"), Field("a", "float64")))
+        r = Scan("/r", "parquet", Schema.of(Field("k2", "int64"), Field("b", "float64")))
+        return l, r
+
+    def test_side_local_conjuncts_push_below_inner_join(self):
+        from hyperspace_tpu.plan.expr import col, lit
+        from hyperspace_tpu.plan.nodes import Filter, Join
+        from hyperspace_tpu.plan.pushdown import push_down_filters
+
+        l, r = self._scans()
+        q = l.join(r, ["k"], ["k2"]).filter(
+            (col("a") > lit(1.0)) & (col("b") < lit(0.0)) & (col("a") + col("b") > lit(0.0))
+        )
+        out = push_down_filters(q)
+        # Mixed conjunct stays above; side-local ones moved into the sides.
+        assert isinstance(out, Filter)
+        assert out.predicate.references() == {"a", "b"}
+        join = out.child
+        assert isinstance(join, Join)
+        assert isinstance(join.left, Filter) and join.left.predicate.references() == {"a"}
+        assert isinstance(join.right, Filter) and join.right.predicate.references() == {"b"}
+
+    def test_fully_local_filter_leaves_no_residual(self):
+        from hyperspace_tpu.plan.expr import col, lit
+        from hyperspace_tpu.plan.nodes import Filter, Join
+        from hyperspace_tpu.plan.pushdown import push_down_filters
+
+        l, r = self._scans()
+        out = push_down_filters(l.join(r, ["k"], ["k2"]).filter(col("a") > lit(0.0)))
+        assert isinstance(out, Join)
+        assert isinstance(out.left, Filter)
