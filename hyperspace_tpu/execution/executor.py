@@ -342,6 +342,8 @@ class Executor:
             return self._sort(plan)
         if isinstance(plan, Limit):
             self._cur_phys.detail["n"] = plan.n
+            if isinstance(plan.child, Sort):
+                return self._top_n(plan.child, plan.n)
             t = self._execute(plan.child)
             return t.take(np.arange(min(plan.n, t.num_rows)))
         raise HyperspaceError(f"cannot execute plan node {type(plan).__name__}")
@@ -428,18 +430,65 @@ class Executor:
         emulated f64 on chips without native double support)."""
         return self._venue("agg_venue", "hyperspace.agg.venue", False, needs_native=False)
 
+    def _top_n(self, sort_plan: "Sort", n: int) -> ColumnTable:
+        """ORDER BY ... LIMIT n as an O(rows) selection: np.partition on
+        the first sort column finds the n-th threshold, only the (ties-
+        inclusive) candidate set gets the full lexicographic sort. The
+        TopK analog of Spark's TakeOrderedAndProject."""
+        from hyperspace_tpu.ops.sortkeys import column_lanes, lanes_as_unsigned
+
+        table = self._execute(sort_plan.child)
+        rows = table.num_rows
+        if n <= 0:
+            return table.take(np.arange(0))
+        if rows <= max(2 * n, 1024):
+            # Full sort (venue-aware via _sort's own machinery).
+            self._phys("TopN", n=n, kernel="full-sort")
+            full = self._sorted_table(table, sort_plan)
+            return full.take(np.arange(min(n, full.num_rows)))
+        # Pack the FIRST sort column's lanes into one u64 selection key
+        # (DESC via the same lane inversion the full sort uses). A
+        # constant validity lane is dropped so both 32-bit words carry
+        # real key entropy (else a low-entropy hi word degenerates the
+        # selection to ~all rows).
+        c0, asc0 = sort_plan.by[0]
+        has_nulls = table.valid_mask(c0) is not None
+        lanes = column_lanes(table, c0, force_validity=has_nulls)
+        if not asc0:
+            lanes = [~l for l in lanes]
+        lu = lanes_as_unsigned(lanes[:2])
+        kpack = (lu[0].astype(np.uint64) << np.uint64(32)) | (
+            lu[1].astype(np.uint64) if lu.shape[0] > 1 else np.uint64(0)
+        )
+        thr = np.partition(kpack, n - 1)[n - 1]
+        # The selection key may be a PREFIX of the first column's order
+        # (extra lanes unseen) — prefix-ties stay in, and every true
+        # top-n row provably has prefix <= thr; the exact sort of the
+        # candidate set settles the rest.
+        cand = np.flatnonzero(kpack <= thr)
+        sub = table.take(cand)
+        self._phys("TopN", n=n, kernel="partition-select + sort", candidates=len(cand))
+        full = self._sorted_table(sub, sort_plan)
+        return full.take(np.arange(min(n, full.num_rows)))
+
     def _sort(self, plan: "Sort") -> ColumnTable:
+        table = self._execute(plan.child)
+        venue = self._venue("sort_venue", "hyperspace.sort.venue", False, needs_native=False)
+        self._phys(f"{venue.capitalize()}Sort", keys=[c for c, _ in plan.by])
+        return self._sorted_table(table, plan, venue)
+
+    def _sorted_table(self, table: ColumnTable, plan: "Sort", venue: str | None = None) -> ColumnTable:
+        """Venue-aware total order of an already-materialized table."""
         from hyperspace_tpu.ops.sortkeys import (
             device_order_perm,
             lexsort_lanes,
             order_lanes,
         )
 
-        table = self._execute(plan.child)
-        venue = self._venue("sort_venue", "hyperspace.sort.venue", False, needs_native=False)
-        self._phys(f"{venue.capitalize()}Sort", keys=[c for c, _ in plan.by])
         if table.num_rows <= 1:
             return table
+        if venue is None:
+            venue = self._venue("sort_venue", "hyperspace.sort.venue", False, needs_native=False)
         if venue == "host":
             # ORDER BY output must land on host; below the link floor a
             # numpy lexsort beats the device round-trip (latency-bound

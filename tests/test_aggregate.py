@@ -573,3 +573,29 @@ def test_case_aggregate_takes_fused_join_path(tmp_path, join_tables):
     d = pq.read_table(dim_root).to_pandas()
     j = f.merge(d, on="k")
     np.testing.assert_allclose(got["c1s"][0], float((j.cat == "c1").sum()))
+
+
+def test_top_n_matches_full_sort(tmp_path):
+    """ORDER BY + LIMIT takes the partition-select path and must equal
+    the full sort exactly, incl. duplicate first keys and DESC order."""
+    rng = np.random.default_rng(8)
+    n = 60_000
+    df_ = pd.DataFrame(
+        {
+            "r": np.round(rng.random(n), 3),  # many exact duplicates
+            "id": rng.permutation(n).astype(np.int64),
+        }
+    )
+    root = tmp_path / "top"
+    root.mkdir()
+    pq.write_table(pa.Table.from_pandas(df_, preserve_index=False), root / "p.parquet")
+    session = _session(tmp_path)
+    scan = session.parquet(root)
+    got = session.to_pandas(scan.sort([("r", False), ("id", True)]).limit(25))
+    node = next(n_ for n_ in session.last_physical_plan.walk() if n_.op == "TopN")
+    assert "partition-select" in node.detail["kernel"]
+    exp = df_.sort_values(["r", "id"], ascending=[False, True]).head(25).reset_index(drop=True)
+    np.testing.assert_allclose(got["r"], exp["r"])
+    np.testing.assert_array_equal(got["id"], exp["id"])
+    # limit 0 edge
+    assert len(session.to_pandas(scan.sort(["r"]).limit(0))) == 0
