@@ -306,11 +306,7 @@ def aggregate_arrays_host(
         )
         results.append(res)
         counts.append(cnt)
-    a = max(len(inputs), 1)
-    return (
-        np.stack(results) if results else np.zeros((a, num_groups)),
-        np.stack(counts) if counts else np.zeros((a, num_groups)),
-    )
+    return np.stack(results), np.stack(counts)
 
 
 def aggregate_arrays(
@@ -325,6 +321,8 @@ def aggregate_arrays(
     Returns (results [A, K] float64-ish np arrays, counts [A, K]).
     With a multi-device mesh the row dimension shards across devices
     (partial reduce + one collective per channel)."""
+    if not inputs:  # DISTINCT: group keys only, nothing to reduce
+        return np.zeros((0, num_groups)), np.zeros((0, num_groups))
     if venue == "host":
         return aggregate_arrays_host(inputs, gid, num_groups)
     from hyperspace_tpu.parallel.mesh import mesh_axes, mesh_size

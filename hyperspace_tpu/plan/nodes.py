@@ -55,6 +55,18 @@ class LogicalPlan:
     def limit(self, n: int) -> "Limit":
         return Limit(self, int(n))
 
+    def distinct(self) -> "Aggregate":
+        """Distinct rows = group by every column with no aggregates.
+        Vector (embedding) columns have no grouping semantics — select
+        the scalar columns first."""
+        vec = [f.name for f in self.schema.fields if f.is_vector]
+        if vec:
+            raise ValueError(
+                f"distinct() is not defined over vector columns {vec}; "
+                "select the scalar columns first"
+            )
+        return Aggregate(self, list(self.schema.names), [])
+
     # -- interface --------------------------------------------------------
     @property
     def schema(self) -> Schema:

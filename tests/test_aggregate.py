@@ -599,3 +599,25 @@ def test_top_n_matches_full_sort(tmp_path):
     np.testing.assert_array_equal(got["id"], exp["id"])
     # limit 0 edge
     assert len(session.to_pandas(scan.sort(["r"]).limit(0))) == 0
+
+
+@pytest.mark.parametrize("venue", ["device", "host"])
+def test_distinct(tmp_path, venue):
+    from hyperspace_tpu.config import AGG_VENUE
+
+    df_ = pd.DataFrame(
+        {
+            "a": [1, 1, 2, 2, 2, None],
+            "b": ["x", "x", "y", "y", "z", None],
+        }
+    )
+    root = tmp_path / "d"
+    root.mkdir()
+    pq.write_table(pa.Table.from_pandas(df_, preserve_index=False), root / "p.parquet")
+    session = _session(tmp_path)
+    session.conf.set(AGG_VENUE, venue)
+    got = session.to_pandas(session.parquet(root).distinct())
+    assert len(got) == 4
+    tuples = {(None if pd.isna(a) else int(a), None if (b is None or (isinstance(b, float) and pd.isna(b))) else b)
+              for a, b in zip(got["a"], got["b"])}
+    assert tuples == {(1, "x"), (2, "y"), (2, "z"), (None, None)}
