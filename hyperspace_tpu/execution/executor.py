@@ -372,20 +372,18 @@ class Executor:
         from hyperspace_tpu.ops.aggregate import aggregate_table
 
         venue = self._agg_venue()
-        if self._join_venue() == "device":
-            # Fuse Aggregate(Join) whenever the JOIN would run on device:
-            # the run-prefix kernel reduces to [K] there, avoiding the
-            # match-pair readback the materialized device join pays. With
-            # the host join venue the pairs are host-merged cheaply and
-            # the host reduce takes over instead.
-            fused = self._try_fused_join_aggregate(plan)
-            if fused is not None:
-                self._phys(
-                    "FusedJoinAggregate",
-                    join_path=self.stats["join_path"],
-                    buckets=self.stats["num_buckets"],
-                )
-                return fused
+        # Fuse Aggregate(Join) on both venues: the device run-prefix
+        # kernel avoids the match-pair readback; the host C++
+        # merge+accumulate avoids materializing the pairs at all.
+        fused = self._try_fused_join_aggregate(plan)
+        if fused is not None:
+            self._phys(
+                "FusedJoinAggregate",
+                join_path=self.stats["join_path"],
+                kernel=self.stats["join_kernel"],
+                buckets=self.stats["num_buckets"],
+            )
+            return fused
         table = self._execute(plan.child)
         self.stats["agg_path"] = f"segment-reduce-{venue}"
         mesh = self.mesh if venue == "device" else None
@@ -890,7 +888,6 @@ class Executor:
         (if any) come from one side; min/max and cross-side expressions
         fall back to the materialized join."""
         from hyperspace_tpu.ops.aggregate import agg_input, group_ids
-        from hyperspace_tpu.ops.join_agg import fused_join_aggregate
 
         child = plan.child
         if isinstance(child, Project):
@@ -952,64 +949,44 @@ class Executor:
         codes["left"], perms["left"] = _bucket_sorted_codes(lc[0], data["left"])
         codes["right"], perms["right"] = _bucket_sorted_codes(rc[0], data["right"])
         secondary = "right" if primary == "left" else "left"
-        pk = _pad_bucket_major(codes[primary], data[primary].offsets)
-        sk = _pad_bucket_major(codes[secondary], data[secondary].offsets)
-        b, lp = pk.shape
-        ls = sk.shape[1]
 
-        # Group ids on the primary table (original row order) → sorted+padded.
+        # Group ids on the primary table (original row order).
         gid_orig, k, first_idx = group_ids(data[primary].table, plan.group_by)
         if k == 0:  # empty primary side
             if plan.group_by:
                 return ColumnTable.empty(plan.schema)
             k, gid_orig, first_idx = 1, np.zeros(0, np.int64), np.zeros(0, np.int64)
 
-        def pad_rows(side: str, vals: np.ndarray, fill=0.0) -> np.ndarray:
-            """Per-orig-row values of `side` → bucket-sorted padded [B, L]."""
-            v = np.asarray(vals, np.float64)
-            if perms[side] is not None:
-                v = v[perms[side]]
-            width = lp if side == primary else ls
-            return _pad_bucket_major(v, data[side].offsets, fill=fill, width=width)
-
-        # pad_rows reorders by perm internally — pass the ORIGINAL-order gid;
-        # pads carry group id k (the dead segment).
-        gid_pad = pad_rows(primary, gid_orig, fill=float(k)).astype(np.int32)
-
-        channels: list[tuple] = [("star",)]
-        p_arrays: list[np.ndarray] = []
-        s_arrays: list[np.ndarray] = []
-
-        def add_channel(side: str, padded: np.ndarray) -> int:
-            if side == primary:
-                p_arrays.append(padded)
-                channels.append(("p", len(p_arrays) - 1))
-            else:
-                s_arrays.append(padded)
-                channels.append(("s", len(s_arrays) - 1))
-            return len(channels) - 1
-
-        spec_layout: list[tuple[int | None, int]] = []  # (value ch, count ch; 0=star)
-        for spec, s in zip(plan.aggs, spec_sides):
-            if s is None:  # count(*)
-                spec_layout.append((None, 0))
-                continue
-            tbl = data[s].table
-            # Same null semantics as the plain aggregate path (ops/aggregate).
+        def spec_input(side: str, spec):
+            """(masked values, indicator) per original row of `side` with
+            the plain aggregate path's null semantics (ops/aggregate)."""
+            tbl = data[side].table
             vals, valid, _ = agg_input(tbl, spec)
             vals = np.asarray(vals, dtype=np.float64)
             if valid is not None:
                 vals = np.where(valid, vals, 0.0)
             ind = np.ones(tbl.num_rows, np.float64) if valid is None else valid.astype(np.float64)
-            vi = None
-            if spec.fn in ("sum", "mean"):
-                vi = add_channel(s, pad_rows(s, vals))
-            ci = add_channel(s, pad_rows(s, ind))
-            spec_layout.append((vi, ci))
+            return vals, ind
 
-        pvals = np.stack(p_arrays) if p_arrays else np.zeros((0, b, lp))
-        svals = np.stack(s_arrays) if s_arrays else np.zeros((0, b, ls))
-        out = fused_join_aggregate(pk, sk, pvals, svals, gid_pad, k, tuple(channels))
+        host_res = None
+        if (
+            self._join_venue() == "host"
+            and codes[primary].dtype == np.int32
+            and codes[secondary].dtype == np.int32
+        ):
+            host_res = self._host_fused_channels(
+                plan, data, codes, perms, primary, secondary, spec_sides,
+                gid_orig, k, spec_input,
+            )
+        if host_res is not None:
+            self.stats["join_kernel"] = "host-native-merge-accumulate"
+            out, spec_layout = host_res
+        else:
+            self.stats["join_kernel"] = "device-run-prefix"
+            out, spec_layout = self._device_fused_channels(
+                plan, data, codes, perms, primary, secondary, spec_sides,
+                gid_orig, k, spec_input,
+            )
         star = out[0]
 
         keep = star > 0 if plan.group_by else np.ones(k, bool)
@@ -1045,6 +1022,141 @@ class Executor:
             if empty.any():
                 validity[out_f.name] = ~empty
         return ColumnTable(out_schema, cols, dicts, validity)
+
+    def _device_fused_channels(
+        self, plan, data, codes, perms, primary, secondary, spec_sides, gid_orig, k, spec_input
+    ):
+        """Device venue: the run-prefix kernel over bucket-major padded
+        channels (ops/join_agg.py)."""
+        from hyperspace_tpu.ops.join_agg import fused_join_aggregate
+
+        pk = _pad_bucket_major(codes[primary], data[primary].offsets)
+        sk = _pad_bucket_major(codes[secondary], data[secondary].offsets)
+        b, lp = pk.shape
+        ls = sk.shape[1]
+
+        def pad_rows(side: str, vals: np.ndarray, fill=0.0) -> np.ndarray:
+            """Per-orig-row values of `side` → bucket-sorted padded [B, L]."""
+            v = np.asarray(vals, np.float64)
+            if perms[side] is not None:
+                v = v[perms[side]]
+            width = lp if side == primary else ls
+            return _pad_bucket_major(v, data[side].offsets, fill=fill, width=width)
+
+        # pad_rows reorders by perm internally — pass the ORIGINAL-order gid;
+        # pads carry group id k (the dead segment).
+        gid_pad = pad_rows(primary, gid_orig, fill=float(k)).astype(np.int32)
+
+        channels: list[tuple] = [("star",)]
+        p_arrays: list[np.ndarray] = []
+        s_arrays: list[np.ndarray] = []
+
+        def add_channel(side: str, padded: np.ndarray) -> int:
+            if side == primary:
+                p_arrays.append(padded)
+                channels.append(("p", len(p_arrays) - 1))
+            else:
+                s_arrays.append(padded)
+                channels.append(("s", len(s_arrays) - 1))
+            return len(channels) - 1
+
+        spec_layout: list[tuple[int | None, int]] = []  # (value ch, count ch; 0=star)
+        for spec, s in zip(plan.aggs, spec_sides):
+            if s is None:  # count(*)
+                spec_layout.append((None, 0))
+                continue
+            vals, ind = spec_input(s, spec)
+            vi = None
+            if spec.fn in ("sum", "mean"):
+                vi = add_channel(s, pad_rows(s, vals))
+            ci = add_channel(s, pad_rows(s, ind))
+            spec_layout.append((vi, ci))
+
+        pvals = np.stack(p_arrays) if p_arrays else np.zeros((0, b, lp))
+        svals = np.stack(s_arrays) if s_arrays else np.zeros((0, b, ls))
+        out = fused_join_aggregate(pk, sk, pvals, svals, gid_pad, k, tuple(channels))
+        return out, spec_layout
+
+    def _host_fused_channels(
+        self, plan, data, codes, perms, primary, secondary, spec_sides, gid_orig, k, spec_input
+    ):
+        """Host venue: one C++ merge+accumulate pass computes per-primary-
+        row channel sums and match counts (no pair materialization), then
+        per-group bincounts produce the same [K] channel layout the device
+        kernel emits. Returns None when the native library is missing."""
+        from hyperspace_tpu import native
+
+        if not native.available():
+            return None
+        tbl_s = data[secondary].table
+        sec_arrays: list[np.ndarray] = []  # SORTED secondary order
+        parts: list[tuple] = []
+
+        def sec_sorted(a: np.ndarray) -> np.ndarray:
+            return a[perms[secondary]] if perms[secondary] is not None else a
+
+        for spec, s in zip(plan.aggs, spec_sides):
+            if s is None:
+                parts.append(("star",))
+                continue
+            vals, ind = spec_input(s, spec)
+            if s == secondary:
+                vi = None
+                if spec.fn in ("sum", "mean"):
+                    sec_arrays.append(sec_sorted(vals))
+                    vi = len(sec_arrays) - 1
+                sec_arrays.append(sec_sorted(ind))
+                parts.append(("sec", vi, len(sec_arrays) - 1))
+            else:
+                parts.append(("pri", vals if spec.fn in ("sum", "mean") else None, ind))
+
+        rvals = (
+            np.stack(sec_arrays) if sec_arrays else np.zeros((0, tbl_s.num_rows))
+        )
+        res = native.merge_join_accumulate(
+            codes[primary], data[primary].offsets,
+            codes[secondary], data[secondary].offsets, rvals,
+        )
+        if res is None:
+            return None
+        acc_sorted, match_sorted = res
+        n_l = data[primary].table.num_rows
+        pperm = perms[primary]
+        if pperm is not None:
+            matches = np.empty(n_l)
+            matches[pperm] = match_sorted
+            acc = np.empty_like(acc_sorted)
+            acc[:, pperm] = acc_sorted
+        else:
+            matches, acc = match_sorted, acc_sorted
+
+        def greduce(w: np.ndarray) -> np.ndarray:
+            if n_l == 0:
+                return np.zeros(k)
+            return np.bincount(gid_orig, weights=w, minlength=k)
+
+        out: list[np.ndarray] = [greduce(matches)]  # star = pairs per group
+        spec_layout: list[tuple[int | None, int]] = []
+        for part in parts:
+            if part[0] == "star":
+                spec_layout.append((None, 0))
+            elif part[0] == "sec":
+                _, vi, ci = part
+                v_idx = None
+                if vi is not None:
+                    out.append(greduce(acc[vi]))
+                    v_idx = len(out) - 1
+                out.append(greduce(acc[ci]))
+                spec_layout.append((v_idx, len(out) - 1))
+            else:
+                _, vals, ind = part
+                v_idx = None
+                if vals is not None:
+                    out.append(greduce(vals * matches))
+                    v_idx = len(out) - 1
+                out.append(greduce(ind * matches))
+                spec_layout.append((v_idx, len(out) - 1))
+        return out, spec_layout
 
     def _partition_join(self, plan: Join, lside: "SideData", rside: "SideData") -> ColumnTable:
         """Per-bucket merge join over the concatenated bucket-grouped

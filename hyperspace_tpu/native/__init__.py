@@ -85,6 +85,11 @@ def _load() -> ctypes.CDLL | None:
     lib.hs_combine.argtypes = [u32p, u32p, ctypes.c_int64]
     lib.hs_mj_count.argtypes = [i32p, i64p, i32p, i64p, ctypes.c_int64, i64p]
     lib.hs_mj_fill.argtypes = [i32p, i64p, i32p, i64p, i64p, ctypes.c_int64, i64p, i64p]
+    f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+    lib.hs_mj_accum.argtypes = [
+        i32p, i64p, i32p, i64p, ctypes.c_int64,
+        f64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, f64p, f64p,
+    ]
     lib.hs_bucket_perm.argtypes = [i32p, ctypes.c_int64, ctypes.c_int64, i64p, i64p]
     lib.hs_sort_range.argtypes = [i64p, ctypes.c_int64, u32p, ctypes.c_int64, ctypes.c_int64]
     _lib = lib
@@ -180,6 +185,34 @@ def sort_range(perm_slice: np.ndarray, lanes_u32: np.ndarray) -> bool:
         num_lanes,
     )
     return True
+
+
+def merge_join_accumulate(
+    lk: np.ndarray, lofs: np.ndarray, rk: np.ndarray, rofs: np.ndarray,
+    rvals: np.ndarray,
+) -> tuple[np.ndarray, np.ndarray] | None:
+    """Fused merge + accumulate over within-bucket-sorted int32 codes:
+    per SORTED-primary-row channel sums of the matching secondary rows
+    plus the per-row match count — Aggregate(Join) without materializing
+    pairs. rvals is [A, n_r] float64; returns (out [A, n_l], counts
+    [n_l]); None when the library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    lk = np.ascontiguousarray(lk, dtype=np.int32)
+    rk = np.ascontiguousarray(rk, dtype=np.int32)
+    lofs = np.ascontiguousarray(lofs, dtype=np.int64)
+    rofs = np.ascontiguousarray(rofs, dtype=np.int64)
+    rvals = np.ascontiguousarray(rvals, dtype=np.float64)
+    a_r = rvals.shape[0]
+    n_r, n_l = len(rk), len(lk)
+    out = np.zeros((a_r, n_l), dtype=np.float64)
+    counts = np.zeros(n_l, dtype=np.float64)
+    lib.hs_mj_accum(
+        lk, lofs, rk, rofs, len(lofs) - 1,
+        rvals if a_r else np.zeros((1, 1)), a_r, n_r, n_l, out, counts,
+    )
+    return out, counts
 
 
 def merge_join_sorted(

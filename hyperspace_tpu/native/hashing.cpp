@@ -265,6 +265,47 @@ void hs_mj_count(const int32_t* lk, const int64_t* lofs, const int32_t* rk,
   });
 }
 
+// Fused merge + accumulate (the host venue of Aggregate(Join)): instead
+// of materializing match pairs, each equal-key run accumulates the
+// secondary side's channel sums onto every primary row of the run, plus
+// the per-primary-row match count. out is [a_r, n_l] row-major (indexed
+// by SORTED primary position); counts is [n_l].
+void hs_mj_accum(const int32_t* lk, const int64_t* lofs, const int32_t* rk,
+                 const int64_t* rofs, int64_t nb, const double* rvals,
+                 int64_t a_r, int64_t n_r, int64_t n_l, double* out,
+                 double* counts) {
+  parallel_for(nb, 1, [&](int64_t blo, int64_t bhi) {
+    for (int64_t b = blo; b < bhi; ++b) {
+      int64_t i = lofs[b], il = lofs[b + 1];
+      int64_t j = rofs[b], jl = rofs[b + 1];
+      while (i < il && j < jl) {
+        int32_t a = lk[i], v = rk[j];
+        if (a < v) {
+          ++i;
+        } else if (a > v) {
+          ++j;
+        } else {
+          int64_t i2 = i + 1;
+          while (i2 < il && lk[i2] == a) ++i2;
+          int64_t j2 = j + 1;
+          while (j2 < jl && rk[j2] == a) ++j2;
+          double m = static_cast<double>(j2 - j);
+          for (int64_t x = i; x < i2; ++x) counts[x] = m;
+          for (int64_t c = 0; c < a_r; ++c) {
+            double s = 0.0;
+            const double* rv = rvals + c * n_r;
+            for (int64_t y = j; y < j2; ++y) s += rv[y];
+            double* ov = out + c * n_l;
+            for (int64_t x = i; x < i2; ++x) ov[x] = s;
+          }
+          i = i2;
+          j = j2;
+        }
+      }
+    }
+  });
+}
+
 // Pass 2: fill GLOBAL row indices; bucket b's matches occupy
 // [oofs[b], oofs[b+1]) (oofs = prefix sum of pass-1 counts).
 void hs_mj_fill(const int32_t* lk, const int64_t* lofs, const int32_t* rk,

@@ -621,3 +621,57 @@ def test_distinct(tmp_path, venue):
     tuples = {(None if pd.isna(a) else int(a), None if (b is None or (isinstance(b, float) and pd.isna(b))) else b)
               for a, b in zip(got["a"], got["b"])}
     assert tuples == {(1, "x"), (2, "y"), (2, "z"), (None, None)}
+
+
+@pytest.mark.parametrize("with_index", [False, True])
+def test_host_fused_join_aggregate_matches_device(tmp_path, join_tables, with_index):
+    """The host C++ merge+accumulate fused path must match the device
+    run-prefix kernel and pandas, with and without aligned indexes
+    (covering both the sorted and permuted code layouts)."""
+    from hyperspace_tpu import native
+    from hyperspace_tpu.config import JOIN_VENUE
+
+    if not native.available():
+        pytest.skip("native library not built")
+    fact_root, dim_root = join_tables
+    outs = {}
+    for venue in ("device", "host"):
+        session = _session(tmp_path / venue)
+        session.conf.set(JOIN_VENUE, venue)
+        hs = Hyperspace(session)
+        fact = session.parquet(fact_root)
+        dim = session.parquet(dim_root)
+        if with_index:
+            hs.create_index(fact, IndexConfig("f_k", ["k"], ["amount", "units"]))
+            hs.create_index(dim, IndexConfig("d_k", ["k"], ["cat", "weight"]))
+            session.enable_hyperspace()
+        q = fact.join(dim, ["k"]).aggregate(
+            ["cat"],
+            [
+                AggSpec.of("sum", "amount", "sa"),     # secondary-side measure
+                AggSpec.of("sum", "weight", "sw"),     # primary(group)-side measure
+                AggSpec.of("count", None, "n"),
+                AggSpec.of("mean", "amount", "ma"),
+            ],
+        )
+        outs[venue] = session.to_pandas(q).sort_values("cat").reset_index(drop=True)
+        assert session.last_query_stats["agg_path"] == "fused-join-agg"
+        if venue == "host":
+            assert session.last_query_stats["join_kernel"] == "host-native-merge-accumulate"
+    d, h = outs["device"], outs["host"]
+    assert list(d["cat"]) == list(h["cat"])
+    for c in ("sa", "sw", "n", "ma"):
+        np.testing.assert_allclose(d[c].astype(float), h[c].astype(float), rtol=1e-9)
+
+    f = pq.read_table(fact_root).to_pandas()
+    dd = pq.read_table(dim_root).to_pandas()
+    j = f.merge(dd, on="k")
+    exp = (
+        j.groupby("cat")
+        .agg(sa=("amount", "sum"), sw=("weight", "sum"), n=("cat", "size"), ma=("amount", "mean"))
+        .reset_index().sort_values("cat").reset_index(drop=True)
+    )
+    np.testing.assert_allclose(h["sa"], exp["sa"])
+    np.testing.assert_allclose(h["sw"], exp["sw"])
+    np.testing.assert_array_equal(h["n"], exp["n"])
+    np.testing.assert_allclose(h["ma"], exp["ma"])
