@@ -28,14 +28,32 @@ def pick_venue(
     the native library (when the host path needs it) is an error, not a
     silent device fallback. `prefer_device` wins the auto case (e.g. a
     real multi-device mesh, where the distributed kernel is the point).
-    `needs_native=False` marks host paths implemented in pure numpy."""
+    `needs_native=False` marks host paths implemented in pure numpy.
+
+    The HYPERSPACE_VENUE env var overrides every auto decision at once
+    (explicit per-operator conf still wins) — the testing/ops escape
+    hatch for exercising one venue across a whole run."""
+    import os
+
     from hyperspace_tpu import native
     from hyperspace_tpu.exceptions import HyperspaceError
 
+    forced_by_env = False
+    if requested == "auto":
+        env = os.environ.get("HYPERSPACE_VENUE", "")
+        if env:
+            if env not in ("device", "host"):
+                raise HyperspaceError(
+                    f"unknown HYPERSPACE_VENUE={env!r} (device|host)"
+                )
+            requested = env
+            forced_by_env = True
+
     if requested == "host":
         if needs_native and not native.available():
+            origin = "HYPERSPACE_VENUE" if forced_by_env else what
             raise HyperspaceError(
-                f"{what}=host requires the native library (g++ build failed "
+                f"{origin}=host requires the native library (g++ build failed "
                 "or unavailable); use auto or device"
             )
         return "host"

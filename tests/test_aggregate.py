@@ -273,7 +273,7 @@ def test_join_agg_minmax_falls_back_to_materialized(tmp_path, join_tables):
     dim = session.parquet(dim_root)
     q = fact.join(dim, ["k"]).aggregate(["cat"], [AggSpec.of("max", "amount", "mx")])
     got = session.to_pandas(q).sort_values("cat").reset_index(drop=True)
-    assert session.last_query_stats["agg_path"] == "segment-reduce-device"
+    assert session.last_query_stats["agg_path"].startswith("segment-reduce")  # not fused
     f = pq.read_table(fact_root).to_pandas()
     d = pq.read_table(dim_root).to_pandas()
     exp = (

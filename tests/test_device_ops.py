@@ -240,7 +240,9 @@ def test_merge_join_sharded_matches_single_device():
 def test_e2e_join_distributed_on_mesh(tmp_path):
     """Full query path with a session mesh: the rewritten join must run
     bucket-sharded over all 8 virtual devices and match the un-indexed
-    result row-for-row."""
+    result row-for-row (the device kernel is the subject — pinned
+    explicitly so a HYPERSPACE_VENUE=host sweep does not reroute it)."""
+    from hyperspace_tpu.config import JOIN_VENUE
     import pyarrow as pa
     import pyarrow.parquet as pq
 
@@ -270,6 +272,7 @@ def test_e2e_join_distributed_on_mesh(tmp_path):
     session = HyperspaceSession(
         system_path=str(tmp_path / "idx"), num_buckets=16, mesh=make_mesh()
     )
+    session.conf.set(JOIN_VENUE, "device")
     hs = Hyperspace(session)
     fact = session.parquet(fact_root)
     dim = session.parquet(dim_root)

@@ -233,3 +233,23 @@ def test_filtered_sides_keep_zero_exchange_join(joined, venue):
     assert len(got) == len(exp)
     np.testing.assert_allclose(got["a"], exp["a"])
     np.testing.assert_allclose(got["b"], exp["b"])
+
+
+def test_env_venue_override_precedence(joined, monkeypatch):
+    """HYPERSPACE_VENUE overrides auto decisions; explicit per-operator
+    conf still wins; invalid values raise."""
+    from hyperspace_tpu.exceptions import HyperspaceError
+    from hyperspace_tpu.parallel.bandwidth import pick_venue
+
+    monkeypatch.setenv("HYPERSPACE_VENUE", "device")
+    assert pick_venue("auto", 200.0, False, "x", needs_native=False) == "device"
+    # Explicit request wins over the env var.
+    assert pick_venue("host", 200.0, False, "x", needs_native=False) == "host"
+    monkeypatch.setenv("HYPERSPACE_VENUE", "hOst")
+    with pytest.raises(HyperspaceError, match="HYPERSPACE_VENUE"):
+        pick_venue("auto", 200.0, False, "x", needs_native=False)
+    # End-to-end: forced device via env on an auto session.
+    monkeypatch.setenv("HYPERSPACE_VENUE", "device")
+    session, fs, ds, f, d = joined
+    session.to_pandas(fs.join(ds, ["k"]))
+    assert session.last_query_stats["join_kernel"] == "device-searchsorted"

@@ -244,7 +244,7 @@ def test_exact_slice_skips_residual_mask(indexed):
     q2 = scan.filter((col("k") >= lit(lo)) & (col("k") < lit(hi)) & (col("v") > lit(0.0)))
     got2 = session.to_pandas(q2)
     node2 = next(n for n in session.last_physical_plan.walk() if n.op == "IndexRangeScan")
-    assert "fused-xla-mask" in node2.detail["kernel"]
+    assert "-mask" in node2.detail["kernel"]  # mask ran (either venue)
     exp2 = exp[exp.v > 0.0]
     assert len(got2) == len(exp2)
 
