@@ -887,7 +887,7 @@ class Executor:
         over a single side's numeric expression and the grouping columns
         (if any) come from one side; min/max and cross-side expressions
         fall back to the materialized join."""
-        from hyperspace_tpu.ops.aggregate import agg_input, group_ids
+        from hyperspace_tpu.ops.aggregate import agg_input, finalize_agg_values, group_ids
 
         child = plan.child
         if isinstance(child, Project):
@@ -1018,7 +1018,7 @@ class Executor:
                 with np.errstate(invalid="ignore", divide="ignore"):
                     val = val / cnt
             empty = cnt == 0
-            cols[out_f.name] = np.where(empty, 0, np.where(np.isfinite(val), val, 0)).astype(out_f.device_dtype)
+            cols[out_f.name] = finalize_agg_values(val, empty, out_f.device_dtype)
             if empty.any():
                 validity[out_f.name] = ~empty
         return ColumnTable(out_schema, cols, dicts, validity)

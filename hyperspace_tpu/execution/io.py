@@ -73,6 +73,14 @@ def _table_nbytes(t: ColumnTable) -> int:
     return total
 
 
+def _freeze_table(t: ColumnTable) -> None:
+    """Mark a table's arrays read-only before it enters the cache: the
+    SAME object is returned to every caller, so an accidental in-place
+    write must raise instead of corrupting every later query."""
+    for arr in (*t.columns.values(), *t.validity.values(), *t.dictionaries.values()):
+        arr.flags.writeable = False
+
+
 def read_parquet_cached(files: list[str], columns: list[str] | None = None, schema: Schema | None = None) -> ColumnTable:
     """read_parquet through the mtime-validated decoded-table cache."""
     import os
@@ -92,6 +100,7 @@ def read_parquet_cached(files: list[str], columns: list[str] | None = None, sche
         _cache_stats["misses"] += 1
         _cache_stats["miss_files"] += len(files)
     table = read_parquet(files, columns=columns, schema=schema)
+    _freeze_table(table)
     nb = _table_nbytes(table)
     global _cache_bytes
     with _cache_lock:

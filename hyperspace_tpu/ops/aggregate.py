@@ -290,15 +290,16 @@ def aggregate_arrays_host(
             sv = v[order]
             if valid is not None:
                 sv = np.where(valid[order], sv, identity)
-            if n == 0:
-                res = np.full(num_groups, identity)
-            else:
+            nonempty = group_rows > 0
+            res = np.full(num_groups, identity)
+            if n and nonempty.any():
                 op = np.minimum if fn == "min" else np.maximum
-                # reduceat returns sv[start] for EMPTY segments (start ==
-                # next start) and rejects start == n — clamp, then reset
-                # empty groups to the identity.
-                res = op.reduceat(sv, np.minimum(starts, n - 1))
-                res[group_rows == 0] = identity
+                # reduceat only over NON-EMPTY groups: an empty group's
+                # start equals the next group's, so including it (or
+                # clamping start == n) would shrink a neighbour's segment.
+                # A non-empty group's segment runs to the next listed
+                # start, which is exactly its true end.
+                res[nonempty] = op.reduceat(sv, starts[nonempty])
         cnt = (
             group_rows.astype(np.float64)
             if valid is None
@@ -378,6 +379,20 @@ def _pad_const(v: np.ndarray, n_pad: int, fn: str) -> np.ndarray:
     return out
 
 
+def finalize_agg_values(vals: np.ndarray, empty: np.ndarray, dtype) -> np.ndarray:
+    """Per-group aggregate values → output column. Float outputs keep
+    legitimately non-finite results (NaN inputs, overflowing sums —
+    Spark/the reference return NaN/Infinity here); only empty (all-NULL)
+    groups are zero-backed, and their validity mask marks them NULL.
+    Integer outputs coerce non-finite before the cast (undefined
+    otherwise; such values only arise for empty groups anyway)."""
+    if np.dtype(dtype).kind == "f":
+        safe = np.where(empty, 0, vals)
+    else:
+        safe = np.where(empty, 0, np.where(np.isfinite(vals), vals, 0))
+    return safe.astype(dtype)
+
+
 def aggregate_table(
     table: ColumnTable, group_by: list[str], aggs: list, out_schema: Schema,
     venue: str = "device",
@@ -431,9 +446,7 @@ def aggregate_table(
             cols[out_f.name] = codes
             dicts[out_f.name] = string_dicts[i]
         else:
-            dt = out_f.device_dtype
-            safe = np.where(empty, 0, np.where(np.isfinite(vals), vals, 0))
-            cols[out_f.name] = safe.astype(dt)
+            cols[out_f.name] = finalize_agg_values(vals, empty, out_f.device_dtype)
         if empty.any():
             validity[out_f.name] = ~empty
     return ColumnTable(out_schema, cols, dicts, validity)

@@ -171,8 +171,28 @@ def _fused_join(lk, rk, cap: int, m_pad: int, shift: int | None):
 
 # Speculative (cap, m_pad) per key-array shape: repeated queries over the
 # same index sync ONCE instead of twice (each device_get round-trip costs
-# ~0.3-1s of latency on tunneled TPUs).
+# ~0.3-1s of latency on tunneled TPUs). Bounded + lock-guarded: one entry
+# per distinct shape accrues for the process lifetime otherwise, and
+# concurrent executors share it.
+import threading
+
 _cap_cache: dict[tuple, tuple[int, int]] = {}
+_cap_lock = threading.Lock()
+_CAP_CACHE_MAX = 256
+
+
+def _cap_get(key):
+    with _cap_lock:
+        return _cap_cache.get(key)
+
+
+def _cap_set(key, value) -> None:
+    with _cap_lock:
+        if key in _cap_cache:
+            _cap_cache.pop(key)
+        elif len(_cap_cache) >= _CAP_CACHE_MAX:
+            _cap_cache.pop(next(iter(_cap_cache)))  # oldest insertion
+        _cap_cache[key] = value
 
 
 def merge_join(lkeys_np: np.ndarray, rkeys_np: np.ndarray):
@@ -187,7 +207,7 @@ def merge_join(lkeys_np: np.ndarray, rkeys_np: np.ndarray):
     shift = pack_shift(lkeys_np.shape[1], rkeys_np.shape[1])
     shape_key = (lkeys_np.shape, rkeys_np.shape, str(lkeys_np.dtype))
 
-    guess = _cap_cache.get(shape_key)
+    guess = _cap_get(shape_key)
     if guess is not None:
         cap, m_pad = guess
         a, b, totals, overflow = _fused_join(lk, rk, cap, m_pad, shift)
@@ -214,7 +234,7 @@ def merge_join(lkeys_np: np.ndarray, rkeys_np: np.ndarray):
     li, ri, _valid = join_expand(start, cum, totals, cap)
     total = int(totals_h.sum())
     m_pad = next_pow2(max(total, 1))
-    _cap_cache[shape_key] = (cap, m_pad)
+    _cap_set(shape_key, (cap, m_pad))
     if shift is not None:
         packed = np.asarray(jax.device_get(_compact_pairs(li, ri, totals, m_pad, shift)))[:total]
         li_flat, ri_flat = _unpack_pairs(packed, shift)

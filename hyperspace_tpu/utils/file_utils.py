@@ -26,8 +26,11 @@ def ensure_dir(path: str | os.PathLike) -> None:
 def atomic_write(path: str | os.PathLike, data: bytes) -> bool:
     """Atomically create `path` with `data`.
 
-    Returns True on success, False if `path` already exists (i.e. a
-    concurrent writer won the race). Never overwrites an existing file.
+    Returns True on success, False when the CAS is lost: `path` already
+    exists (a concurrent writer won), or — on the degraded no-hardlink
+    fallback only — a concurrent writer holds the lock lease (including a
+    writer that crashed less than _LOCK_STALE_S ago; callers treat any
+    False as contention and may retry). Never overwrites an existing file.
     """
     path = Path(path)
     ensure_dir(path.parent)
@@ -44,22 +47,133 @@ def atomic_write(path: str | os.PathLike, data: bytes) -> bool:
             return False
         except OSError:
             # Filesystem without hard links (FUSE/SMB/some overlays). The
-            # tmp file already holds the full fsynced payload; make it
-            # visible with rename guarded by an existence check. The
-            # check→rename window is a narrow race on this degraded path,
-            # but content is never torn (rename is atomic).
-            if path.exists():
-                return False
-            try:
-                os.rename(tmp, path)
-                return True
-            except OSError:
-                return False
+            # tmp file already holds the full fsynced payload; serialize
+            # the visibility rename behind an O_EXCL lock file so two
+            # writers can never both pass the existence check (content is
+            # never torn either way — rename is atomic).
+            return _locked_rename(tmp, path)
     finally:
         try:
             os.unlink(tmp)
         except OSError:
             pass
+
+
+# Lease duration for the no-hardlink lock-file fallback. A crashed
+# writer's lock older than this is presumed dead and reaped. Staleness is
+# judged from an epoch the CREATOR wrote into the lock file (never from
+# filesystem mtime — network filesystems stamp mtime with the SERVER's
+# clock), so single-winner correctness assumes inter-writer clock skew
+# below this bound — the standard lease-lock assumption.
+_LOCK_STALE_S = 30.0
+
+
+def _read_lock_text(p: Path) -> str | None:
+    try:
+        with open(p, "r") as f:
+            return f.read()
+    except OSError:
+        return None
+
+
+def _lock_epoch(text: str | None) -> float | None:
+    """Creator epoch out of a lock token ('<epoch>:<uuid>'); None means
+    unreadable/torn — treated as stale, which is safe because a mis-stolen
+    live lock is detected by token mismatch and by the holder's pre-commit
+    re-verification."""
+    if not text or ":" not in text:
+        return None
+    try:
+        return float(text.split(":", 1)[0])
+    except ValueError:
+        return None
+
+
+def _try_reap(lock: Path, nonce: str) -> bool:
+    """Clear `lock` if stale. True ⇒ cleared (caller may retry the
+    acquire); False ⇒ a live contender holds it (CAS lost). The claim is
+    atomic — rename to a unique name, exactly one reaper wins — and
+    verified: stealing a lock instance OTHER than the one judged stale is
+    detected by content mismatch and the stolen token is reinstalled."""
+    import time
+
+    text = _read_lock_text(lock)
+    if text is None:
+        return True  # vanished underneath us — retry the acquire
+    ep = _lock_epoch(text)
+    if ep is not None and time.time() - ep <= _LOCK_STALE_S:
+        return False
+    reaped = lock.with_name(f"{lock.name}.reap-{nonce}")
+    try:
+        os.rename(lock, reaped)
+    except OSError:
+        return False  # another reaper won
+    stolen = _read_lock_text(reaped)
+    try:
+        os.unlink(reaped)
+    except OSError:
+        pass
+    if stolen != text:
+        # Between our read and the rename the stale lock was replaced by a
+        # NEW (live) instance — reinstall its token so later writers still
+        # see a held lease; its holder aborts via pre-commit verification
+        # only if this reinstall loses a further race.
+        try:
+            fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            try:
+                os.write(fd, (stolen or "").encode())
+            finally:
+                os.close(fd)
+        except OSError:
+            pass
+        return False
+    return True
+
+
+def _locked_rename(tmp: str, path: Path) -> bool:
+    """Compare-and-swap via an O_EXCL lock file (the no-hardlink fallback
+    for atomic_write): only the lock holder may check-and-rename. The
+    holder re-reads its own token immediately before committing, so a
+    writer whose lease was (wrongly) reaped aborts instead of producing a
+    second winner."""
+    import time
+    import uuid
+
+    lock = path.with_name(path.name + ".lock")
+    token = f"{time.time():.6f}:{uuid.uuid4().hex}"
+    for attempt in range(3):
+        try:
+            fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            if not _try_reap(lock, f"{os.getpid()}-{attempt}"):
+                return False
+            continue
+        except OSError:
+            return False
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(token)
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError:
+            pass
+        try:
+            if path.exists():
+                return False
+            if _read_lock_text(lock) != token:
+                return False  # our lease was stolen — do not double-commit
+            try:
+                os.rename(tmp, path)
+                return True
+            except OSError:
+                return False
+        finally:
+            if _read_lock_text(lock) == token:
+                try:
+                    os.unlink(lock)
+                except OSError:
+                    pass
+    return False
 
 
 def write_json(path: str | os.PathLike, obj: Any, *, overwrite: bool = True) -> bool:

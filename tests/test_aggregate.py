@@ -675,3 +675,64 @@ def test_host_fused_join_aggregate_matches_device(tmp_path, join_tables, with_in
     np.testing.assert_allclose(h["sw"], exp["sw"])
     np.testing.assert_array_equal(h["n"], exp["n"])
     np.testing.assert_allclose(h["ma"], exp["ma"])
+
+
+@pytest.mark.parametrize("venue", ["device", "host"])
+def test_non_finite_float_aggregates_pass_through(tmp_path, venue):
+    """sum/min/max results that are legitimately NaN or inf (NaN/inf VALUES
+    in a float column) come back as NaN/inf with the row still valid —
+    not silently zeroed (round-2 advisor, medium). Matches Spark/numpy."""
+    from hyperspace_tpu.config import AGG_VENUE
+
+    t = pa.table(
+        {
+            "g": pa.array([0, 0, 1, 1, 2, 3], type=pa.int64()),
+            "x": pa.array([1.0, np.nan, np.inf, 2.0, 3.0, -np.inf]),
+        }
+    )
+    root = tmp_path / f"nf_{venue}"
+    root.mkdir()
+    pq.write_table(t, root / "p.parquet")
+    session = _session(tmp_path)
+    session.conf.set(AGG_VENUE, venue)
+    df = session.parquet(root)
+    q = df.aggregate(
+        ["g"],
+        [
+            AggSpec.of("sum", "x", "s"),
+            AggSpec.of("min", "x", "mn"),
+            AggSpec.of("max", "x", "mx"),
+        ],
+    )
+    got = session.to_pandas(q).sort_values("g").reset_index(drop=True)
+    exp = (
+        t.to_pandas()
+        .groupby("g")
+        .agg(s=("x", "sum"), mn=("x", "min"), mx=("x", "max"))
+        .reset_index()
+    )
+    # pandas .sum skips NaN; SQL SUM over a NaN VALUE is NaN — pin SQL/
+    # numpy semantics explicitly per group.
+    assert np.isnan(got.loc[0, "s"]) and np.isnan(got.loc[0, "mn"]) and np.isnan(got.loc[0, "mx"])
+    assert got.loc[1, "s"] == np.inf and got.loc[1, "mn"] == 2.0 and got.loc[1, "mx"] == np.inf
+    assert got.loc[2, "s"] == 3.0
+    assert got.loc[3, "s"] == -np.inf and got.loc[3, "mn"] == -np.inf
+    assert not got[["s", "mn", "mx"]].isna().drop(index=0).any().any()
+    np.testing.assert_array_equal(got["g"], exp["g"])
+
+
+def test_host_reduceat_with_trailing_empty_groups():
+    """aggregate_arrays_host called with num_groups > max(gid)+1: trailing
+    empty groups must not corrupt the LAST non-empty group's min/max
+    (round-2 advisor: clamped reduceat starts shrank the prior segment)."""
+    from hyperspace_tpu.ops.aggregate import aggregate_arrays_host
+
+    vals = np.array([5.0, 1.0, 9.0])
+    gid = np.array([0, 0, 1])
+    res, cnt = aggregate_arrays_host(
+        [(vals, None, "min"), (vals, None, "max")], gid, num_groups=4
+    )
+    np.testing.assert_array_equal(res[0][:2], [1.0, 9.0])  # min includes sv[n-1]
+    np.testing.assert_array_equal(res[1][:2], [5.0, 9.0])
+    assert np.isinf(res[0][2]) and np.isinf(res[0][3])  # empty -> identity
+    np.testing.assert_array_equal(cnt[0], [2, 1, 0, 0])

@@ -140,3 +140,83 @@ def test_concurrent_actions_second_aborts(tmp_path):
         t.join()
     assert len(errors) == 1 and "Could not acquire proper state" in errors[0]
     assert lm.get_latest_log().state == states.ACTIVE
+
+
+def test_concurrent_writers_one_wins_without_hardlinks(tmp_path, monkeypatch):
+    """The no-hardlink degraded path (O_EXCL lock file) admits exactly one
+    winner under contention — the former check-then-rename fallback had a
+    window where two writers could both pass the existence check."""
+    import threading
+
+    from hyperspace_tpu.utils.file_utils import atomic_write
+
+    def no_link(src, dst, **kw):
+        raise OSError("hard links unsupported")
+
+    monkeypatch.setattr("os.link", no_link)
+
+    target = tmp_path / "nolink" / "0"
+    n_threads = 8
+    barrier = threading.Barrier(n_threads)
+    results = [None] * n_threads
+
+    def contend(i):
+        barrier.wait()
+        results[i] = atomic_write(target, f"writer-{i}".encode())
+
+    threads = [threading.Thread(target=contend, args=(i,)) for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sum(1 for r in results if r) == 1, results
+    winner = results.index(True)
+    assert target.read_bytes() == f"writer-{winner}".encode()
+    # Late writer after the winner: lock is free again, but the CAS fails.
+    assert atomic_write(target, b"late") is False
+    assert not target.with_name("0.lock").exists()
+
+
+def test_cached_index_tables_are_frozen(tmp_path):
+    """Tables handed out by the decoded-table cache are read-only: an
+    accidental in-place write raises instead of corrupting every later
+    query that shares the cache entry."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from hyperspace_tpu.execution import io as hio
+
+    p = tmp_path / "frozen.parquet"
+    pq.write_table(pa.table({"k": [1, 2, 3], "s": ["a", "b", None]}), p)
+    t = hio.read_parquet_cached([str(p)])
+    import numpy as np
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        t.columns["k"][0] = 99
+    with _pytest.raises(ValueError):
+        t.validity["s"][0] = False
+    # A second read returns the same (uncorrupted) object.
+    assert hio.read_parquet_cached([str(p)]).columns["k"][0] == 1
+
+
+def test_stale_lock_is_reaped_and_write_retried(tmp_path, monkeypatch):
+    """A crashed writer's leaked lock does not wedge the no-hardlink path:
+    the next writer claims the stale lock atomically and wins the CAS."""
+    import os
+
+    from hyperspace_tpu.utils.file_utils import atomic_write
+
+    monkeypatch.setattr("os.link", lambda *a, **k: (_ for _ in ()).throw(OSError()))
+
+    target = tmp_path / "staledir" / "0"
+    target.parent.mkdir()
+    lock = target.with_name("0.lock")
+    # A real (token-bearing) lock whose creator epoch is ancient — mtime is
+    # deliberately FRESH to prove staleness comes from the token, not the
+    # filesystem clock.
+    lock.write_text("1000000000.000000:deadbeef")
+
+    assert atomic_write(target, b"payload") is True
+    assert target.read_bytes() == b"payload"
+    assert not lock.exists()
