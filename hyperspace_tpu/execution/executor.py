@@ -855,7 +855,11 @@ class Executor:
         equal the global join."""
         out = self._partition_join(plan, lside, rside)
         cols = None
-        if left.project is not None or right.project is not None:
+        if plan.how in ("semi", "anti"):
+            # Left-only output; the right side contributes no columns.
+            if left.project is not None:
+                cols = list(left.project)
+        elif left.project is not None or right.project is not None:
             keep = list(left.project if left.project is not None else left.scan.scan_schema.names)
             rkeys = {k.lower() for k in plan.right_on}
             for c in right.project if right.project is not None else right.scan.scan_schema.names:
@@ -1162,7 +1166,78 @@ class Executor:
         """Per-bucket merge join over the concatenated bucket-grouped
         layout: everything host-side is vectorized (pad-gather in, one
         repeat+add to globalize match indices, ONE native gather per
-        column out) — no per-bucket Python loop (round 1 weakness #4)."""
+        column out) — no per-bucket Python loop (round 1 weakness #4).
+        Non-inner join types derive from the same match pairs: outer
+        variants append the unmatched side's rows null-extended, semi/anti
+        keep left rows by match flag (the join-type surface Spark's
+        SortMergeJoinExec serves over the reference's rewritten bucketed
+        relations, JoinIndexRule.scala:124-153)."""
+        lt, rt = lside.table, rside.table
+        how = plan.how
+
+        if how in ("semi", "anti"):
+            # Existence is a membership probe, not a join: never expand the
+            # match pairs (a hot key repeated k×k ways would materialize k²
+            # pairs only to collapse into |L| bits).
+            matched = self._semi_match_mask(plan, lside, rside)
+            out = lt.filter_mask(matched if how == "semi" else ~matched)
+            return ColumnTable(plan.schema, out.columns, out.dictionaries, out.validity)
+
+        lidx, ridx = self._match_pairs(plan, lside, rside)
+
+        inner = self._gather_pairs(plan, lt, rt, lidx, ridx)
+        if how == "inner":
+            return inner
+        parts = [inner]
+        if how in ("left", "full"):
+            lmask = np.zeros(lt.num_rows, dtype=bool)
+            lmask[lidx] = True
+            parts.append(self._left_unmatched(plan, lt, rt, ~lmask))
+        if how in ("right", "full"):
+            rmask = np.zeros(rt.num_rows, dtype=bool)
+            rmask[ridx] = True
+            parts.append(self._right_unmatched(plan, lt, rt, ~rmask))
+        parts = [p for p in parts if p.num_rows > 0]
+        if not parts:
+            return inner
+        # Concat builds from plan.schema, so any extra physical columns a
+        # wide index scan carried along are dropped here; the outer-join
+        # output is exactly the declared join schema.
+        return ColumnTable.concat(parts) if len(parts) > 1 else parts[0]
+
+    def _semi_match_mask(self, plan: Join, lside: "SideData", rside: "SideData") -> np.ndarray:
+        """Per-left-row existence of an equi-match in the right side:
+        one sorted membership probe over (bucket, key-code) composites —
+        O((n+m) log m) on host, no pair expansion, no device round-trip
+        (the result is |L| bits the mask filter consumes on host anyway).
+        Null-keyed rows carry side-distinct negative codes and never
+        match (SQL: NULL = NULL is not true), so anti keeps them."""
+        lt, rt = lside.table, rside.table
+        lkeys = [lt.schema.field(c).name for c in plan.left_on]
+        rkeys = [rt.schema.field(c).name for c in plan.right_on]
+        lc, rc = _factorize_keys([lt], [rt], lkeys, rkeys)
+        lcodes = lc[0].astype(np.int64)
+        rcodes = rc[0].astype(np.int64)
+        b = len(lside.offsets) - 1
+        self.stats["num_buckets"] = b
+        self.stats["join_kernel"] = "host-membership-probe"
+        counts_l = np.diff(lside.offsets)
+        counts_r = np.diff(rside.offsets)
+        bucket_l = np.repeat(np.arange(b, dtype=np.int64), counts_l)
+        bucket_r = np.repeat(np.arange(b, dtype=np.int64), counts_r)
+        # Composite (bucket, code) key: codes span int32 (±2^31), buckets
+        # are small — the shifted sum is collision-free in int64.
+        comp_l = (bucket_l << np.int64(33)) + lcodes
+        comp_r = np.sort((bucket_r << np.int64(33)) + rcodes)
+        pos = np.searchsorted(comp_r, comp_l)
+        matched = np.zeros(lt.num_rows, dtype=bool)
+        in_range = pos < len(comp_r)
+        matched[in_range] = comp_r[pos[in_range]] == comp_l[in_range]
+        return matched
+
+    def _match_pairs(self, plan: Join, lside: "SideData", rside: "SideData"):
+        """(lidx, ridx) global match row indices of the equi-join, from the
+        venue-selected merge kernel over bucket-sorted key codes."""
         lt, rt = lside.table, rside.table
         lkeys = [lt.schema.field(c).name for c in plan.left_on]
         rkeys = [rt.schema.field(c).name for c in plan.right_on]
@@ -1212,8 +1287,13 @@ class Executor:
             lidx = lperm[lidx]
         if rperm is not None:
             ridx = rperm[ridx]
+        return lidx, ridx
 
-        rkeys_low = {k.lower() for k in rkeys}
+    def _gather_pairs(
+        self, plan: Join, lt: ColumnTable, rt: ColumnTable, lidx, ridx
+    ) -> ColumnTable:
+        """Materialize matched rows: left columns + right non-key columns."""
+        rkeys_low = {rt.schema.field(c).name.lower() for c in plan.right_on}
         lgather = lt.take(lidx)
         cols = dict(lgather.columns)
         dicts = dict(lgather.dictionaries)
@@ -1224,6 +1304,83 @@ class Executor:
         dicts.update(rgather.dictionaries)
         val.update(rgather.validity)
         return ColumnTable(plan.schema, cols, dicts, val)
+
+    def _left_unmatched(self, plan: Join, lt: ColumnTable, rt: ColumnTable, mask) -> ColumnTable:
+        """Unmatched left rows, right-side fields null-extended."""
+        sub = lt.filter_mask(mask)
+        lnames = {x.lower() for x in plan.left.schema.names}
+        cols: dict = {}
+        dicts: dict = {}
+        val: dict = {}
+        for f in plan.schema.fields:
+            if f.name.lower() in lnames:
+                _copy_field(f, sub, f.name, cols, dicts, val)
+            else:
+                _null_field(f, sub.num_rows, rt, cols, dicts, val)
+        return ColumnTable(plan.schema, cols, dicts, val)
+
+    def _right_unmatched(self, plan: Join, lt: ColumnTable, rt: ColumnTable, mask) -> ColumnTable:
+        """Unmatched right rows: key columns coalesce to the RIGHT key's
+        values (under the left-named output column), right non-key fields
+        carry their values, left-only fields are null-extended."""
+        sub = rt.filter_mask(mask)
+        key_src = {l.lower(): r for l, r in zip(plan.left_on, plan.right_on)}
+        rnames = {x.lower() for x in plan.right.schema.names}
+        cols: dict = {}
+        dicts: dict = {}
+        val: dict = {}
+        for f in plan.schema.fields:
+            low = f.name.lower()
+            if low in key_src:
+                _copy_field(f, sub, key_src[low], cols, dicts, val)
+            elif low in rnames:
+                _copy_field(f, sub, f.name, cols, dicts, val)
+            else:
+                _null_field(f, sub.num_rows, lt, cols, dicts, val)
+        return ColumnTable(plan.schema, cols, dicts, val)
+
+
+def _copy_field(out_f, src: ColumnTable, src_name: str, cols, dicts, val) -> None:
+    """Copy src column `src_name` into output field `out_f` (dtype-cast
+    for numeric mismatches — outer-join key coalescing may source the
+    left-named key column from the right side)."""
+    sf = src.schema.field(src_name)
+    arr = src.columns[sf.name]
+    if sf.name in src.dictionaries:
+        dicts[out_f.name] = src.dictionaries[sf.name]
+        cols[out_f.name] = arr
+    else:
+        want = np.dtype(out_f.device_dtype)
+        cols[out_f.name] = arr if arr.ndim > 1 or arr.dtype == want else arr.astype(want)
+    v = src.validity.get(sf.name)
+    if v is not None:
+        val[out_f.name] = v
+
+
+def _null_field(out_f, n: int, dict_src: ColumnTable | None, cols, dicts, val) -> None:
+    """All-null column for output field `out_f` (outer-join null
+    extension). String fields reuse `dict_src`'s dictionary for that
+    field when available, so concat with the matched part needs no
+    dictionary merge."""
+    if out_f.is_vector:
+        raise HyperspaceError(
+            f"outer join cannot null-extend vector column {out_f.name!r}"
+        )
+    if out_f.is_string:
+        d = None
+        if dict_src is not None:
+            try:
+                sf = dict_src.schema.field(out_f.name)
+                d = dict_src.dictionaries.get(sf.name)
+            except Exception:
+                d = None
+        if d is None or len(d) == 0:
+            d = np.array([""], dtype=object)
+        cols[out_f.name] = np.zeros(n, dtype=np.int32)
+        dicts[out_f.name] = d
+    else:
+        cols[out_f.name] = np.zeros(n, dtype=out_f.device_dtype)
+    val[out_f.name] = np.zeros(n, dtype=bool)
 
 
 def _key_null_mask(table: ColumnTable, keys: list[str]) -> np.ndarray | None:

@@ -32,8 +32,14 @@ class LogicalPlan:
     def select(self, *columns: str) -> "Project":
         return Project(self, list(columns))
 
-    def join(self, other: "LogicalPlan", left_on: list[str], right_on: list[str] | None = None) -> "Join":
-        return Join(self, other, list(left_on), list(right_on or left_on))
+    def join(
+        self,
+        other: "LogicalPlan",
+        left_on: list[str],
+        right_on: list[str] | None = None,
+        how: str = "inner",
+    ) -> "Join":
+        return Join(self, other, list(left_on), list(right_on or left_on), how)
 
     def aggregate(self, group_by: list[str], aggs: list) -> "Aggregate":
         """Grouped aggregation. `aggs` entries are AggSpec or
@@ -190,10 +196,17 @@ class Union(LogicalPlan):
         return {"type": "union", "inputs": [c.to_json() for c in self.inputs]}
 
 
+JOIN_TYPES = ("inner", "left", "right", "full", "semi", "anti")
+
+
 @dataclasses.dataclass
 class Join(LogicalPlan):
-    """Inner equi-join on key column lists (reference matches CNF of EqualTo,
-    JoinIndexRule.scala:179-185; we make the equi-join structural)."""
+    """Equi-join on key column lists (reference matches CNF of EqualTo,
+    JoinIndexRule.scala:179-185; we make the equi-join structural). `how`
+    covers the join types Spark's SortMergeJoinExec serves over the
+    reference's rewritten bucketed relations (JoinIndexRule.scala:124-153
+    swaps only the relations inside whatever join node it matched):
+    inner / left / right / full outer, plus (left) semi and anti."""
 
     left: LogicalPlan
     right: LogicalPlan
@@ -204,14 +217,18 @@ class Join(LogicalPlan):
     def __post_init__(self):
         if len(self.left_on) != len(self.right_on):
             raise ValueError("join key lists must have equal length")
-        if self.how != "inner":
-            raise ValueError("only inner equi-joins are supported")
+        if self.how not in JOIN_TYPES:
+            raise ValueError(f"unknown join type {self.how!r}; one of {JOIN_TYPES}")
 
     @property
     def schema(self) -> Schema:
-        """Join key columns appear once (values are equal by definition);
-        a non-key name collision is ambiguous and rejected."""
+        """Join key columns appear once (equal for matches; outer joins
+        coalesce the surviving side's key into the left-named column); a
+        non-key name collision is ambiguous and rejected. Semi/anti
+        produce the left side's schema only."""
         lf = self.left.schema.fields
+        if self.how in ("semi", "anti"):
+            return Schema(tuple(lf))
         left_names = {f.name.lower() for f in lf}
         keys = {k.lower() for k in self.right_on}
         rf = []
@@ -224,7 +241,7 @@ class Join(LogicalPlan):
                     f"ambiguous non-key column {f.name!r} appears on both join sides"
                 )
             rf.append(f)
-        return Schema(lf + tuple(rf))
+        return Schema(tuple(lf) + tuple(rf))
 
     def children(self) -> list[LogicalPlan]:
         return [self.left, self.right]

@@ -27,7 +27,14 @@ def push_down_filters(plan: LogicalPlan) -> LogicalPlan:
     side; applied recursively over the whole plan."""
     if isinstance(plan, Filter):
         child = push_down_filters(plan.child)
-        if isinstance(child, Join) and child.how == "inner":
+        if isinstance(child, Join):
+            # Which sides accept a pushed filter without changing the join
+            # semantics: the null-EXTENDED side of an outer join cannot (a
+            # pushed filter would drop rows before null extension instead
+            # of nulling their columns after); semi/anti output left rows
+            # verbatim, so left pushes are safe there too.
+            push_left = child.how in ("inner", "left", "semi", "anti")
+            push_right = child.how in ("inner", "right")
             lnames = {n.lower() for n in child.left.schema.names}
             rnames = {n.lower() for n in child.right.schema.names}
             left_c: list[Expr] = []
@@ -35,9 +42,9 @@ def push_down_filters(plan: LogicalPlan) -> LogicalPlan:
             residual: list[Expr] = []
             for conj in split_conjuncts(plan.predicate):
                 refs = {r.lower() for r in conj.references()}
-                if refs and refs <= lnames:
+                if push_left and refs and refs <= lnames:
                     left_c.append(conj)
-                elif refs and refs <= rnames:
+                elif push_right and refs and refs <= rnames:
                     right_c.append(conj)
                 else:
                     residual.append(conj)

@@ -101,7 +101,17 @@ def _try_reap(lock: Path, nonce: str) -> bool:
     if text is None:
         return True  # vanished underneath us — retry the acquire
     ep = _lock_epoch(text)
-    if ep is not None and time.time() - ep <= _LOCK_STALE_S:
+    if ep is None:
+        # Token missing/torn: the holder may be BETWEEN its O_EXCL create
+        # and its token write — judge by file age instead (the only case
+        # where mtime, with its server-clock caveat, is consulted), so a
+        # live-but-not-yet-written lease is not reaped.
+        try:
+            if time.time() - os.stat(lock).st_mtime <= _LOCK_STALE_S:
+                return False
+        except OSError:
+            return True  # vanished — retry the acquire
+    elif time.time() - ep <= _LOCK_STALE_S:
         return False
     reaped = lock.with_name(f"{lock.name}.reap-{nonce}")
     try:
@@ -135,7 +145,10 @@ def _locked_rename(tmp: str, path: Path) -> bool:
     for atomic_write): only the lock holder may check-and-rename. The
     holder re-reads its own token immediately before committing, so a
     writer whose lease was (wrongly) reaped aborts instead of producing a
-    second winner."""
+    second winner. Residual lease-lock hazard (inherent to leases): a
+    holder paused for longer than _LOCK_STALE_S between that check and
+    its rename can still commit over a successor's write — bounded-pause
+    is assumed alongside bounded clock skew."""
     import time
     import uuid
 
@@ -145,7 +158,9 @@ def _locked_rename(tmp: str, path: Path) -> bool:
         try:
             fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
         except FileExistsError:
-            if not _try_reap(lock, f"{os.getpid()}-{attempt}"):
+            # uuid nonce: concurrent reapers (even same-pid threads) must
+            # never collide on the claim name.
+            if not _try_reap(lock, f"{os.getpid()}-{uuid.uuid4().hex[:8]}-{attempt}"):
                 return False
             continue
         except OSError:

@@ -1,0 +1,173 @@
+"""Outer / semi / anti join types: equality vs SQL semantics computed in
+pandas (with null keys handled the SQL way — NULL never matches, unlike
+pandas' NaN-joins-NaN), on both venues, rewritten (bucket-aligned index
+path) and raw. The reference inherits these join types from Spark's
+SortMergeJoinExec over its rewritten bucketed relations — the rewrite
+swaps only the relations inside whatever join node it matched
+(JoinIndexRule.scala:124-153)."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig
+from hyperspace_tpu import native
+from hyperspace_tpu.config import JOIN_VENUE
+
+HOWS = ["inner", "left", "right", "full", "semi", "anti"]
+
+
+def _frames():
+    rng = np.random.default_rng(7)
+    n_l, n_r = 3_000, 800
+    lk = rng.integers(0, 400, n_l).astype(np.float64)
+    lk[rng.random(n_l) < 0.05] = np.nan  # null keys
+    rk = rng.integers(300, 600, n_r).astype(np.float64)  # partial overlap
+    rk[rng.random(n_r) < 0.05] = np.nan
+    l = pd.DataFrame(
+        {
+            "k": pd.array(np.where(np.isnan(lk), None, lk), dtype="Int64"),
+            "lv": rng.integers(0, 100, n_l).astype(np.int64),
+            "ls": [f"L{int(i) % 11}" for i in rng.integers(0, 11, n_l)],
+        }
+    )
+    r = pd.DataFrame(
+        {
+            "k2": pd.array(np.where(np.isnan(rk), None, rk), dtype="Int64"),
+            "rv": rng.normal(size=n_r),
+            "rs": [f"R{int(i) % 5}" for i in rng.integers(0, 5, n_r)],
+        }
+    )
+    return l, r
+
+
+def sql_join(l: pd.DataFrame, r: pd.DataFrame, how: str) -> pd.DataFrame:
+    """SQL-semantics expected output (columns k, lv, ls[, rv, rs]):
+    NULL keys never match; outer variants null-extend; the key column
+    coalesces (right-unmatched rows carry the right key)."""
+    ld = l[l.k.notna()]
+    rd = r[r.k2.notna()]
+    if how == "semi":
+        return l[l.k.isin(set(rd.k2))]
+    if how == "anti":
+        return l[~l.k.isin(set(rd.k2))]
+    inner = ld.merge(rd, left_on="k", right_on="k2", how="inner").drop(columns=["k2"])
+    parts = [inner]
+    if how in ("left", "full"):
+        un = l[~l.k.isin(set(rd.k2))].copy()
+        un["rv"] = np.nan
+        un["rs"] = None
+        parts.append(un)
+    if how in ("right", "full"):
+        un = r[~r.k2.isin(set(ld.k))].copy()
+        un = un.rename(columns={"k2": "k"})
+        un["lv"] = None
+        un["ls"] = None
+        parts.append(un)
+    return pd.concat(parts, ignore_index=True)[["k", "lv", "ls", "rv", "rs"]]
+
+
+def norm_rows(df: pd.DataFrame, cols: list[str]) -> list[str]:
+    """Order-independent, null-normalized row multiset for comparison."""
+    rows = []
+    for t in df[cols].itertuples(index=False, name=None):
+        row = []
+        for v in t:
+            if v is None or v is pd.NA or (isinstance(v, float) and np.isnan(v)):
+                row.append(None)
+            elif isinstance(v, (bool, np.bool_)):
+                row.append(bool(v))
+            elif isinstance(v, (int, np.integer, float, np.floating)):
+                row.append(round(float(v), 9))
+            else:
+                row.append(str(v))
+        rows.append(repr(tuple(row)))
+    return sorted(rows)
+
+
+@pytest.fixture(scope="module")
+def setup(tmp_path_factory):
+    tmp_path = tmp_path_factory.mktemp("join_types")
+    l, r = _frames()
+    (tmp_path / "l").mkdir()
+    (tmp_path / "r").mkdir()
+    pq.write_table(pa.Table.from_pandas(l, preserve_index=False), tmp_path / "l" / "p.parquet")
+    pq.write_table(pa.Table.from_pandas(r, preserve_index=False), tmp_path / "r" / "p.parquet")
+    session = HyperspaceSession(system_path=str(tmp_path / "idx"), num_buckets=4)
+    hs = Hyperspace(session)
+    ls, rs = session.parquet(tmp_path / "l"), session.parquet(tmp_path / "r")
+    hs.create_index(ls, IndexConfig("jt_l", ["k"], ["lv", "ls"]))
+    hs.create_index(rs, IndexConfig("jt_r", ["k2"], ["rv", "rs"]))
+    return session, ls, rs, l, r
+
+
+@pytest.mark.parametrize("how", HOWS)
+@pytest.mark.parametrize("venue", ["device", "host"])
+@pytest.mark.parametrize("indexed", [False, True])
+def test_join_types_match_sql_semantics(setup, how, venue, indexed):
+    session, ls, rs, l, r = setup
+    if venue == "host" and not native.available():
+        pytest.skip("native library not built")
+    if indexed:
+        session.enable_hyperspace()
+    else:
+        session.disable_hyperspace()
+    session.conf.set(JOIN_VENUE, venue)
+    q = ls.join(rs, ["k"], ["k2"], how=how)
+    got = session.to_pandas(q)
+    exp = sql_join(l, r, how)
+    out_cols = ["k", "lv", "ls"] if how in ("semi", "anti") else ["k", "lv", "ls", "rv", "rs"]
+    assert list(got.columns) == out_cols
+    assert norm_rows(got, out_cols) == norm_rows(exp, out_cols)
+    if indexed:
+        assert session.last_query_stats["join_path"] == "zero-exchange-aligned"
+        assert session.last_query_stats["num_buckets"] == 4
+
+
+@pytest.mark.parametrize("how", ["left", "semi", "anti", "full"])
+def test_join_types_with_side_filter_and_pushdown(setup, how):
+    """Filter above the join on LEFT columns: pushed below for left/semi/
+    anti (semantics-preserving), kept residual for full — identical
+    results either way vs filtering the SQL-expected frame."""
+    from hyperspace_tpu import col
+
+    session, ls, rs, l, r = setup
+    session.enable_hyperspace()
+    session.conf.set(JOIN_VENUE, "device")
+    q = ls.join(rs, ["k"], ["k2"], how=how).filter(col("lv") < 50)
+    got = session.to_pandas(q)
+    exp = sql_join(l, r, how)
+    exp = exp[exp.lv.notna() & (exp.lv < 50)]
+    out_cols = ["k", "lv", "ls"] if how in ("semi", "anti") else ["k", "lv", "ls", "rv", "rs"]
+    assert norm_rows(got, out_cols) == norm_rows(exp, out_cols)
+
+
+def test_right_unmatched_coalesces_key_from_right(setup):
+    """Full join rows unmatched on the left carry the RIGHT key value in
+    the (left-named) key column."""
+    session, ls, rs, l, r = setup
+    session.disable_hyperspace()
+    session.conf.set(JOIN_VENUE, "device")
+    got = session.to_pandas(ls.join(rs, ["k"], ["k2"], how="full"))
+    rd_only = set(r[r.k2.notna()].k2) - set(l[l.k.notna()].k)
+    got_keys = set(got[got.lv.isna()].k.dropna())
+    assert rd_only <= got_keys
+
+
+def test_unknown_join_type_rejected():
+    from hyperspace_tpu.plan.nodes import Join, Scan
+    from hyperspace_tpu.schema import Field, Schema
+
+    s = Scan("/tmp/x", "parquet", Schema((Field("k", "int64"),)))
+    with pytest.raises(ValueError, match="unknown join type"):
+        Join(s, s, ["k"], ["k"], "cross")
+
+
+def test_semi_anti_schema_is_left_only(setup):
+    _, ls, rs, _, _ = setup
+    semi = ls.join(rs, ["k"], ["k2"], how="semi")
+    assert [f.name for f in semi.schema.fields] == ["k", "lv", "ls"]
+    full = ls.join(rs, ["k"], ["k2"], how="full")
+    assert [f.name for f in full.schema.fields] == ["k", "lv", "ls", "rv", "rs"]
