@@ -1,16 +1,23 @@
 """A slice of real TPC-H queries through the full stack.
 
 Q1 (pricing summary), Q6 (forecast revenue), a Q3-shaped join-aggregate
-(top unshipped orders over orders x lineitem), and a Q12-shaped
-join-count — expressed in the plan IR, executed with and without
-indexes, with results REQUIRED identical both ways (and sanity-checked
-against pandas). Prints one JSON line per query plus the geomean.
+(top unshipped orders over orders x lineitem), Q12 with its REAL
+predicates (l_shipmode IN ('MAIL','SHIP'), the commit/receipt date
+comparisons), Q13 (customer LEFT JOIN orders with the NOT LIKE comment
+exclusion, double aggregation), and Q14 (promo revenue share,
+p_type LIKE 'PROMO%' inside the conditional aggregate) — expressed in
+the plan IR with no CASE-WHEN workarounds, executed with and without
+indexes, with results REQUIRED identical both ways. Prints one JSON line
+per query plus the geomean.
 
 Index design per query (what a Hyperspace user would build):
 - Q1/Q6 filter on l_shipdate -> covering index keyed on l_shipdate
   (range pruning + searchsorted slicing serve the date window);
 - Q3/Q12 join on the orderkey -> both sides bucketed on it with equal
-  counts (zero-exchange SMJ; the aggregation fuses over it).
+  counts (zero-exchange SMJ; the aggregation fuses over it);
+- Q13 join on custkey -> customer + orders bucketed on it (the LEFT
+  join runs zero-exchange too);
+- Q14 join on partkey -> lineitem + part bucketed on it.
 """
 
 from __future__ import annotations
@@ -52,11 +59,15 @@ def main(sf: float = 1.0):
     tmp = Path(tempfile.mkdtemp(prefix="hs_tpchq_"))
     results = []
     try:
-        li_root, o_root = cached_tpch(sf=sf)
+        li_root, o_root, p_root, c_root = cached_tpch(
+            sf=sf, tables=("lineitem", "orders", "part", "customer")
+        )
         session = HyperspaceSession(system_path=str(tmp / "indexes"), num_buckets=64)
         hs = Hyperspace(session)
         li = session.parquet(li_root)
         orders = session.parquet(o_root)
+        part = session.parquet(p_root)
+        customer = session.parquet(c_root)
 
         t0 = time.perf_counter()
         hs.create_index(li, IndexConfig(
@@ -66,10 +77,20 @@ def main(sf: float = 1.0):
         ))
         hs.create_index(li, IndexConfig(
             "li_orderkey", ["l_orderkey"],
-            ["l_extendedprice", "l_discount", "l_shipdate", "l_shipmode", "l_receiptdate"],
+            ["l_extendedprice", "l_discount", "l_shipdate", "l_shipmode",
+             "l_commitdate", "l_receiptdate"],
         ))
         hs.create_index(orders, IndexConfig(
             "o_orderkey", ["o_orderkey"], ["o_orderdate", "o_shippriority", "o_orderpriority"],
+        ))
+        hs.create_index(li, IndexConfig(
+            "li_partkey", ["l_partkey"],
+            ["l_shipdate", "l_extendedprice", "l_discount"],
+        ))
+        hs.create_index(part, IndexConfig("p_partkey", ["p_partkey"], ["p_type"]))
+        hs.create_index(customer, IndexConfig("c_custkey", ["c_custkey"], []))
+        hs.create_index(orders, IndexConfig(
+            "o_custkey", ["o_custkey"], ["o_orderkey", "o_comment"],
         ))
         log(f"index builds (sf={sf:g}): {time.perf_counter() - t0:.2f}s")
 
@@ -109,16 +130,22 @@ def main(sf: float = 1.0):
                     .aggregate(["o_orderkey"], [AggSpec.of("sum", rev, "revenue")])
                     .sort([("revenue", False), ("o_orderkey", True)])
                     .limit(10),
-            # Q12: shipping-mode priority counts — conditional aggregates
-            # (CASE WHEN o_orderpriority in high) over the join, filtered
-            # to two ship modes and one receipt year.
+            # Q12: shipping-mode priority counts — the REAL predicate text:
+            # l_shipmode IN ('MAIL','SHIP'), commit/receipt date column
+            # comparisons, one receipt year; the conditional aggregate IS
+            # the real query's CASE WHEN.
             "q12": orders.select("o_orderkey", "o_orderpriority")
                     .join(
-                        li.select("l_orderkey", "l_shipmode", "l_receiptdate"),
+                        li.select(
+                            "l_orderkey", "l_shipmode", "l_shipdate",
+                            "l_commitdate", "l_receiptdate",
+                        ),
                         ["o_orderkey"], ["l_orderkey"],
                     )
                     .filter(
-                        ((col("l_shipmode") == lit("MAIL")) | (col("l_shipmode") == lit("SHIP")))
+                        col("l_shipmode").isin(["MAIL", "SHIP"])
+                        & (col("l_commitdate") < col("l_receiptdate"))
+                        & (col("l_shipdate") < col("l_commitdate"))
                         & (col("l_receiptdate") >= lit(days("1994-01-01")))
                         & (col("l_receiptdate") < lit(days("1995-01-01")))
                     )
@@ -128,8 +155,7 @@ def main(sf: float = 1.0):
                             AggSpec.of(
                                 "sum",
                                 when(
-                                    (col("o_orderpriority") == lit("1-URGENT"))
-                                    | (col("o_orderpriority") == lit("2-HIGH")),
+                                    col("o_orderpriority").isin(["1-URGENT", "2-HIGH"]),
                                     1.0,
                                 ).otherwise(0.0),
                                 "high_line_count",
@@ -137,8 +163,7 @@ def main(sf: float = 1.0):
                             AggSpec.of(
                                 "sum",
                                 when(
-                                    (col("o_orderpriority") == lit("1-URGENT"))
-                                    | (col("o_orderpriority") == lit("2-HIGH")),
+                                    col("o_orderpriority").isin(["1-URGENT", "2-HIGH"]),
                                     0.0,
                                 ).otherwise(1.0),
                                 "low_line_count",
@@ -146,6 +171,37 @@ def main(sf: float = 1.0):
                         ],
                     )
                     .sort(["l_shipmode"]),
+            # Q13: customer distribution — LEFT OUTER JOIN with the comment
+            # exclusion in the join condition, then the count-of-counts.
+            "q13": customer.select("c_custkey")
+                    .join(
+                        orders.select("o_custkey", "o_orderkey", "o_comment")
+                              .filter(~col("o_comment").like("%special%requests%")),
+                        ["c_custkey"], ["o_custkey"],
+                        how="left",
+                    )
+                    .aggregate(["c_custkey"], [AggSpec.of("count", "o_orderkey", "c_count")])
+                    .aggregate(["c_count"], [AggSpec.of("count", None, "custdist")])
+                    .sort([("custdist", False), ("c_count", False)]),
+            # Q14: promo revenue share — p_type LIKE 'PROMO%' inside the
+            # conditional aggregate, one shipdate month.
+            "q14": li.select("l_partkey", "l_shipdate", "l_extendedprice", "l_discount")
+                    .filter(
+                        (col("l_shipdate") >= lit(days("1995-09-01")))
+                        & (col("l_shipdate") < lit(days("1995-10-01")))
+                    )
+                    .join(part.select("p_partkey", "p_type"), ["l_partkey"], ["p_partkey"])
+                    .aggregate(
+                        [],
+                        [
+                            AggSpec.of(
+                                "sum",
+                                when(col("p_type").like("PROMO%"), rev).otherwise(0.0),
+                                "promo_revenue",
+                            ),
+                            AggSpec.of("sum", rev, "total_revenue"),
+                        ],
+                    ),
         }
 
         speedups = []

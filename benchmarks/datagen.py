@@ -82,6 +82,16 @@ _EPOCH_1992 = 8035  # days from 1970-01-01 to 1992-01-01
 _DATE_SPAN = 2525  # order dates span 1992-01-01 .. 1998-12-01 (TPC-H 4.2.3)
 
 
+def _write_parts(t: "pa.Table", root: Path, files: int) -> None:
+    """Chunked parquet write shared by every TPC-H table generator."""
+    root.mkdir(parents=True, exist_ok=True)
+    per = (t.num_rows + files - 1) // files
+    for i in range(files):
+        part = t.slice(i * per, per)
+        if part.num_rows:
+            pq.write_table(part, root / f"part-{i}.parquet", row_group_size=262_144)
+
+
 def gen_tpch_lineitem(
     root: Path, sf: float = 1.0, seed: int = 42, files: int = 8
 ) -> int:
@@ -133,12 +143,7 @@ def gen_tpch_lineitem(
             "l_comment": pa.array(comments.astype(object)),
         }
     )
-    root.mkdir(parents=True, exist_ok=True)
-    per = (m + files - 1) // files
-    for i in range(files):
-        part = t.slice(i * per, per)
-        if part.num_rows:
-            pq.write_table(part, root / f"part-{i}.parquet", row_group_size=262_144)
+    _write_parts(t, root, files)
     return t.nbytes
 
 
@@ -159,37 +164,128 @@ def gen_tpch_orders(root: Path, sf: float = 1.0, seed: int = 43, files: int = 4)
                 np.char.add("Clerk#", rng.integers(1, 1001, n).astype("U6")).astype(object)
             ),
             "o_shippriority": np.zeros(n, dtype=np.int32),
+            # ~1.2% of comments match Q13's '%special%requests%' exclusion.
             "o_comment": pa.array(
-                _ORDERPRIORITY[rng.integers(0, 5, n)].astype(str).astype(object)
+                np.where(
+                    rng.random(n) < 0.012,
+                    "the special packages wake furiously among the requests",
+                    np.char.add(
+                        _ORDERPRIORITY[rng.integers(0, 5, n)].astype(str),
+                        " instructions sleep quickly",
+                    ).astype(object),
+                ).astype(object)
             ),
         }
     )
-    root.mkdir(parents=True, exist_ok=True)
-    per = (n + files - 1) // files
-    for i in range(files):
-        part = t.slice(i * per, per)
-        if part.num_rows:
-            pq.write_table(part, root / f"part-{i}.parquet", row_group_size=262_144)
+    _write_parts(t, root, files)
     return t.nbytes
 
 
-def cached_tpch(sf: float = 1.0, cache_root: Path | None = None) -> tuple[Path, Path]:
-    """Generate (or reuse) the TPC-H tables under a cache dir keyed by
-    scale factor; bench reruns skip the ~20s generation."""
+TPCH_SF1_PART_ROWS = 200_000
+TPCH_SF1_CUSTOMER_ROWS = 150_000
+
+_P_TYPE_1 = np.array(["STANDARD", "SMALL", "MEDIUM", "LARGE", "ECONOMY", "PROMO"], dtype=object)
+_P_TYPE_2 = np.array(["ANODIZED", "BURNISHED", "PLATED", "POLISHED", "BRUSHED"], dtype=object)
+_P_TYPE_3 = np.array(["TIN", "NICKEL", "BRASS", "STEEL", "COPPER"], dtype=object)
+_SEGMENTS = np.array(
+    ["AUTOMOBILE", "BUILDING", "FURNITURE", "MACHINERY", "HOUSEHOLD"], dtype=object
+)
+
+
+def gen_tpch_part(root: Path, sf: float = 1.0, seed: int = 44, files: int = 2) -> int:
+    """TPC-H part (SF1 = 200k rows): p_type is the three-word TPC-H shape
+    ('PROMO BURNISHED COPPER'), so Q14's `like 'PROMO%'` is faithful."""
+    n = int(TPCH_SF1_PART_ROWS * sf)
+    rng = np.random.default_rng(seed)
+    ptype = np.char.add(
+        np.char.add(
+            np.char.add(_P_TYPE_1[rng.integers(0, 6, n)].astype(str), " "),
+            np.char.add(_P_TYPE_2[rng.integers(0, 5, n)].astype(str), " "),
+        ),
+        _P_TYPE_3[rng.integers(0, 5, n)].astype(str),
+    )
+    t = pa.table(
+        {
+            "p_partkey": np.arange(n, dtype=np.int64),
+            "p_name": pa.array(
+                np.char.add("part ", rng.integers(0, 100_000, n).astype("U6")).astype(object)
+            ),
+            "p_brand": pa.array(
+                np.char.add("Brand#", rng.integers(11, 56, n).astype("U2")).astype(object)
+            ),
+            "p_type": pa.array(ptype.astype(object)),
+            "p_size": rng.integers(1, 51, n).astype(np.int32),
+            "p_container": pa.array(
+                np.char.add("JUMBO ", _P_TYPE_3[rng.integers(0, 5, n)].astype(str)).astype(object)
+            ),
+            "p_retailprice": np.round(900 + rng.random(n) * 1000, 2),
+        }
+    )
+    _write_parts(t, root, files)
+    return t.nbytes
+
+
+def gen_tpch_customer(root: Path, sf: float = 1.0, seed: int = 45, files: int = 2) -> int:
+    """TPC-H customer (SF1 = 150k rows). c_custkey aligns with orders'
+    o_custkey domain; ~1% of Q13-facing comments would match
+    '%special%requests%' via the ORDERS comment (this table carries the
+    phone/segment columns Q22-style queries read)."""
+    n = int(TPCH_SF1_CUSTOMER_ROWS * sf)
+    rng = np.random.default_rng(seed)
+    t = pa.table(
+        {
+            "c_custkey": np.arange(n, dtype=np.int64),
+            "c_name": pa.array(
+                np.char.add("Customer#", np.arange(n).astype("U9")).astype(object)
+            ),
+            "c_phone": pa.array(
+                np.char.add(
+                    np.char.add(rng.integers(10, 35, n).astype("U2"), "-555-"),
+                    rng.integers(1000, 10000, n).astype("U4"),
+                ).astype(object)
+            ),
+            "c_acctbal": np.round(rng.random(n) * 10_000 - 1_000, 2),
+            "c_mktsegment": pa.array(_SEGMENTS[rng.integers(0, 5, n)]),
+            "c_nationkey": rng.integers(0, 25, n).astype(np.int32),
+        }
+    )
+    _write_parts(t, root, files)
+    return t.nbytes
+
+
+_TPCH_GENS = {
+    "lineitem": gen_tpch_lineitem,
+    "orders": gen_tpch_orders,
+    "part": gen_tpch_part,
+    "customer": gen_tpch_customer,
+}
+
+
+def cached_tpch(
+    sf: float = 1.0,
+    cache_root: Path | None = None,
+    tables: tuple[str, ...] = ("lineitem", "orders"),
+) -> tuple[Path, ...]:
+    """Generate (or reuse) the requested TPC-H tables under a cache dir
+    keyed by scale factor; bench reruns skip the ~20s generation.
+    Returns one root per requested table, in order."""
     import tempfile
 
     import shutil
 
-    base = cache_root or Path(tempfile.gettempdir()) / f"hs_tpch_sf{sf:g}"
-    li, orders = base / "lineitem", base / "orders"
+    # v2: orders comments + part/customer tables added in round 3.
+    base = cache_root or Path(tempfile.gettempdir()) / f"hs_tpch_v2_sf{sf:g}"
+    roots = []
     # A _COMPLETE marker written AFTER generation guards against reusing a
     # partial dataset from an interrupted run.
-    for root, gen in ((li, gen_tpch_lineitem), (orders, gen_tpch_orders)):
+    for name in tables:
+        root = base / name
         if not (root / "_COMPLETE").exists():
             shutil.rmtree(root, ignore_errors=True)
-            gen(root, sf)
+            _TPCH_GENS[name](root, sf)
             (root / "_COMPLETE").touch()
-    return li, orders
+        roots.append(root)
+    return tuple(roots)
 
 
 def gen_embeddings(root: Path, n: int, dim: int, clusters: int, seed: int = 7) -> np.ndarray:

@@ -11,7 +11,7 @@ sort, gather/filter and merge-join kernels); the host plane is pure Python.
 
 from hyperspace_tpu.exceptions import HyperspaceError
 from hyperspace_tpu.index.index_config import IndexConfig
-from hyperspace_tpu.plan.expr import col, lit, when
+from hyperspace_tpu.plan.expr import col, date_lit, day, lit, month, when, year
 from hyperspace_tpu.plan.nodes import AggSpec
 from hyperspace_tpu.schema import Field, Schema
 

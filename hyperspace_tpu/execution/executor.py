@@ -137,33 +137,112 @@ def _conjunct_col_lit(conj) -> tuple[str, str, object] | None:
     return name, op, v
 
 
+def _like_prefix(pattern: str) -> str | None:
+    """The literal prefix of a prefix-shaped LIKE pattern ('PROMO%'), or
+    None when the pattern isn't prefix-shaped."""
+    if pattern.endswith("%") and len(pattern) > 1:
+        body = pattern[:-1]
+        if "%" not in body and "_" not in body:
+            return body
+    return None
+
+
+def _prefix_upper(prefix: str) -> str | None:
+    """Smallest string ABOVE every string with `prefix` (exclusive upper
+    bound for prefix matching); None when the last char can't increment."""
+    last = ord(prefix[-1])
+    if last >= 0x10FFFF:
+        return None
+    return prefix[:-1] + chr(last + 1)
+
+
+def _conjunct_bound_ops(conj, key: str) -> list[tuple[str, object]] | None:
+    """One conjunct → literal (op, value) bounds it implies on `key`:
+    plain comparisons pass through; IN gives its min/max envelope; a
+    prefix LIKE gives [prefix, next-prefix). The residual filter mask
+    still applies the exact predicate — bounds only need to be a valid
+    superset."""
+    from hyperspace_tpu.plan.expr import InList, Like
+
+    if isinstance(conj, InList) and isinstance(conj.child, Col):
+        if conj.child.name.lower() != key:
+            return None
+        vals = conj.values
+        if any(isinstance(v, (float, np.floating)) and np.isnan(v) for v in vals):
+            return None
+        try:
+            return [("ge", min(vals)), ("le", max(vals))]
+        except TypeError:
+            return None
+    if isinstance(conj, Like) and isinstance(conj.child, Col):
+        if conj.child.name.lower() != key:
+            return None
+        prefix = _like_prefix(conj.pattern)
+        if prefix is None:
+            if "%" not in conj.pattern and "_" not in conj.pattern:
+                return [("eq", conj.pattern)]  # wildcard-free LIKE = equality
+            return None
+        out: list[tuple[str, object]] = [("ge", prefix)]
+        upper = _prefix_upper(prefix)
+        if upper is not None:
+            out.append(("lt", upper))
+        return out
+    if isinstance(conj, BinOp) and conj.is_comparison:
+        from hyperspace_tpu.ops.filter import _translate_date_part_cmp
+        from hyperspace_tpu.plan.expr import DatePart
+
+        l, r, op = conj.left, conj.right, conj.op
+        if isinstance(r, DatePart) and isinstance(l, Lit):
+            l, r, op = r, l, _FLIP.get(op, op)
+        if isinstance(l, DatePart) and isinstance(r, Lit):
+            # year(d) OP lit → the same day-range tree the filter layer
+            # lowers to; recurse so the range feeds pruning too.
+            t = _translate_date_part_cmp(op, l, r.value)
+            if t is None:
+                return None
+            out: list[tuple[str, object]] = []
+            for sub in split_conjuncts(t):
+                pairs = _conjunct_bound_ops(sub, key)
+                if pairs is None:
+                    return None  # ne-shaped (an OR): not a conjunct bound
+                out.extend(pairs)
+            return out
+    dec = _conjunct_col_lit(conj)
+    if dec is None:
+        return None
+    name, op, v = dec
+    if name.lower() != key or op not in ("eq", "lt", "le", "gt", "ge"):
+        return None
+    return [(op, v)]
+
+
 def key_bounds(predicate: Expr, key: str) -> KeyBounds | None:
     """Extract literal comparison bounds on `key` from the predicate's
-    conjuncts (key op lit / lit op key; eq pins both ends). Returns None
-    when no conjunct bounds the column. Incomparable literal types are
-    ignored (the residual filter mask still applies them exactly)."""
+    conjuncts (key op lit / lit op key; eq pins both ends; IN gives its
+    envelope; prefix LIKE gives a string range). Returns None when no
+    conjunct bounds the column. Incomparable literal types are ignored
+    (the residual filter mask still applies them exactly)."""
+    key = key.lower()
     b = KeyBounds()
     found = False
     for conj in split_conjuncts(predicate):
-        dec = _conjunct_col_lit(conj)
-        if dec is None:
+        pairs = _conjunct_bound_ops(conj, key)
+        if pairs is None:
             continue
-        name, op, v = dec
-        if name.lower() != key.lower() or op not in ("eq", "lt", "le", "gt", "ge"):
-            continue
-        try:
-            if op in ("gt", "ge", "eq") and (
-                b.lo is None or v > b.lo or (v == b.lo and op == "gt")
-            ):
-                b.lo, b.lo_strict = v, op == "gt"
-                found = True
-            if op in ("lt", "le", "eq") and (
-                b.hi is None or v < b.hi or (v == b.hi and op == "lt")
-            ):
-                b.hi, b.hi_strict = v, op == "lt"
-                found = True
-        except TypeError:
-            continue
+        for op, v in pairs:
+            try:
+                if op in ("gt", "ge", "eq") and (
+                    b.lo is None or v > b.lo or (v == b.lo and op == "gt")
+                ):
+                    b.lo, b.lo_strict = v, op == "gt"
+                    found = True
+                if op in ("lt", "le", "eq") and (
+                    b.hi is None or v < b.hi or (v == b.hi and op == "lt")
+                ):
+                    b.hi, b.hi_strict = v, op == "lt"
+                    found = True
+            except TypeError:
+                continue
     return b if found else None
 
 
@@ -601,27 +680,53 @@ class Executor:
         self._phys(kernel=mask_kernel)
         return apply_filter(self._execute(child), plan.predicate, mesh=self.mesh, venue=mask_venue)
 
+    # Bucket pruning reads at most this many point combinations; above it
+    # the (still-correct) range/mask machinery takes over.
+    _MAX_POINT_COMBOS = 64
+
     def _prune_bucket_files(self, scan: Scan, predicate: Expr) -> list[str] | None:
-        """If the predicate pins every bucket column with an equality
-        literal, return only the owning bucket's file."""
+        """If the predicate pins every bucket column with equality
+        literals — single (eq) or multi-point (IN) — return only the
+        owning buckets' files. The analog of partition pruning the
+        reference cannot do (FilterIndexRule keeps a full scan,
+        FilterIndexRule.scala:114-120); IN on the bucket column divides
+        IO by numBuckets/|IN| instead of 1."""
+        import itertools
+        import math
+
+        from hyperspace_tpu.plan.expr import InList
+
         num_buckets, bucket_cols = scan.bucket_spec
-        eq_lits: dict[str, object] = {}
+        cand: dict[str, list] = {}
         for conj in split_conjuncts(predicate):
+            got: tuple[str, list] | None = None
             if isinstance(conj, BinOp) and conj.op == "eq":
                 if isinstance(conj.left, Col) and isinstance(conj.right, Lit):
-                    eq_lits[conj.left.name.lower()] = conj.right.value
+                    got = (conj.left.name.lower(), [conj.right.value])
                 elif isinstance(conj.right, Col) and isinstance(conj.left, Lit):
-                    eq_lits[conj.right.name.lower()] = conj.left.value
+                    got = (conj.right.name.lower(), [conj.left.value])
+            elif isinstance(conj, InList) and isinstance(conj.child, Col):
+                got = (conj.child.name.lower(), list(conj.values))
+            if got is not None:
+                name, vals = got
+                # Conjunctive constraints: any one conjunct's list is a
+                # valid superset of the reachable values — keep the
+                # smallest.
+                if name not in cand or len(vals) < len(cand[name]):
+                    cand[name] = vals
         try:
-            values = [eq_lits[c.lower()] for c in bucket_cols]
+            lists = [cand[c.lower()] for c in bucket_cols]
         except KeyError:
             return None
+        if math.prod(len(l) for l in lists) > self._MAX_POINT_COMBOS:
+            return None
         fields = [scan.scan_schema.field(c) for c in bucket_cols]
-        h = hash_scalar_key(values, fields)
-        b = int(bucket_ids(h, num_buckets, np)[0])
+        names = set()
+        for combo in itertools.product(*lists):
+            h = hash_scalar_key(list(combo), fields)
+            names.add(hio.bucket_file_name(int(bucket_ids(h, num_buckets, np)[0])))
         files = self._scan_files(scan)
-        name = hio.bucket_file_name(b)
-        matches = [f for f in files if Path(f).name == name]
+        matches = [f for f in files if Path(f).name in names]
         if matches:
             self.stats["files_pruned"] += len(files) - len(matches)
             return matches

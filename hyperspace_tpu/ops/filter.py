@@ -27,7 +27,21 @@ import jax
 import jax.numpy as jnp
 
 from hyperspace_tpu.execution.table import ColumnTable
-from hyperspace_tpu.plan.expr import And, BinOp, Col, Expr, Lit, Not, Or, evaluate
+from hyperspace_tpu.plan.expr import (
+    And,
+    BinOp,
+    Col,
+    DatePart,
+    Expr,
+    InList,
+    IsNull,
+    Like,
+    Lit,
+    Not,
+    Or,
+    Substr,
+    evaluate,
+)
 
 # Virtual-column name pieces for the 64-bit pair lowering. "\x00" cannot
 # appear in a real column name, so these never collide with the schema.
@@ -66,17 +80,133 @@ def _null_expr(table: ColumnTable, names: list[str]) -> Expr | None:
     return out
 
 
+def _or_chain(parts: list[Expr]) -> Expr:
+    import functools
+
+    return functools.reduce(Or, parts)
+
+
+def _codes_runs_expr(col: Col, codes: "np.ndarray") -> Expr:
+    """Matched dictionary codes (sorted int array) → the equivalent
+    predicate in the code domain: an OR of contiguous code ranges. A
+    prefix LIKE over a SORTED dictionary is always ONE range; arbitrary
+    patterns decompose into few runs. All leaves are int comparisons —
+    device-lowerable, null-aware via the normal _Cmp3 machinery."""
+    if len(codes) == 0:
+        # No dictionary value matches: always-false but still UNKNOWN for
+        # null inputs (-1 is never a real code).
+        return BinOp("eq", col, Lit(np.int32(-1)))
+    codes = np.asarray(codes, dtype=np.int64)
+    breaks = np.flatnonzero(np.diff(codes) > 1)
+    starts = np.concatenate([[0], breaks + 1])
+    ends = np.concatenate([breaks, [len(codes) - 1]])
+    parts: list[Expr] = []
+    for s, t in zip(starts, ends):
+        a, b = int(codes[s]), int(codes[t])
+        if a == b:
+            parts.append(BinOp("eq", col, Lit(np.int32(a))))
+        else:
+            parts.append(
+                And(BinOp("ge", col, Lit(np.int32(a))), BinOp("le", col, Lit(np.int32(b))))
+            )
+    return _or_chain(parts)
+
+
+def like_regex(pattern: str):
+    """Compiled regex for a SQL LIKE pattern (% = any run, _ = one char)."""
+    import re
+
+    out = []
+    for ch in pattern:
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+    return re.compile("".join(out), re.DOTALL)
+
+
+def _like_codes(table: ColumnTable, colname: str, pattern: str) -> "np.ndarray":
+    f = table.schema.field(colname)
+    if not f.is_string:
+        from hyperspace_tpu.exceptions import HyperspaceError
+
+        raise HyperspaceError(f"LIKE requires a string column, got {colname!r}")
+    rx = like_regex(pattern)
+    d = table.dictionaries[f.name]
+    return np.flatnonzero([rx.fullmatch(str(s)) is not None for s in d])
+
+
+def _substr_values(table: ColumnTable, sub: Substr) -> tuple[str, "np.ndarray"]:
+    """(column name, per-dictionary-entry substring values)."""
+    from hyperspace_tpu.exceptions import HyperspaceError
+
+    if not isinstance(sub.child, Col):
+        raise HyperspaceError("SUBSTRING applies to a column")
+    f = table.schema.field(sub.child.name)
+    if not f.is_string:
+        raise HyperspaceError(f"SUBSTRING requires a string column, got {sub.child.name!r}")
+    lo = sub.start - 1
+    d = table.dictionaries[f.name]
+    return f.name, np.array([str(s)[lo : lo + sub.length] for s in d], dtype=object)
+
+
+_NP_CMP = {"eq": "__eq__", "ne": "__ne__", "lt": "__lt__", "le": "__le__", "gt": "__gt__", "ge": "__ge__"}
+
+
 def translate_predicate(table: ColumnTable, e: Expr) -> Expr:
     """Rewrite string-column comparisons against literals into the code
-    domain of `table`'s dictionaries (order-preserving). Pure — returns a
-    new tree, never mutates the plan's predicate."""
+    domain of `table`'s dictionaries (order-preserving), and desugar the
+    SQL predicate extensions — IN, LIKE, SUBSTRING comparisons, date-part
+    comparisons — into plain comparison trees the device lowering and the
+    host fallback both evaluate. Pure — returns a new tree, never mutates
+    the plan's predicate."""
     if isinstance(e, BinOp) and e.is_comparison:
         l, r = e.left, e.right
+        if isinstance(r, (Substr, DatePart)) and isinstance(l, Lit):
+            l, r = r, l
+            e = BinOp(_FLIP[e.op], l, r)
+        if isinstance(l, Substr) and isinstance(r, Lit):
+            name, vals = _substr_values(table, l)
+            cmp = getattr(vals.astype(str), _NP_CMP[e.op])
+            codes = np.flatnonzero(cmp(str(r.value)))
+            return _codes_runs_expr(Col(name), codes)
+        if isinstance(l, DatePart) and isinstance(r, Lit):
+            t = _translate_date_part_cmp(e.op, l, r.value)
+            if t is not None:
+                return t
+            return e  # month/day shapes: host evaluation
         if isinstance(l, Col) and isinstance(r, Lit) and table.schema.field(l.name).is_string:
             return BinOp(e.op, l, Lit(table.translate_literal(l.name, r.value, e.op)))
         if isinstance(r, Col) and isinstance(l, Lit) and table.schema.field(r.name).is_string:
             return translate_predicate(table, BinOp(_FLIP[e.op], r, l))
         return e
+    if isinstance(e, InList):
+        child = e.child
+        if isinstance(child, Substr):
+            name, vals = _substr_values(table, child)
+            want = {str(v) for v in e.values}
+            codes = np.flatnonzero([v in want for v in vals])
+            return _codes_runs_expr(Col(name), codes)
+        if isinstance(child, Col):
+            if table.schema.field(child.name).is_string:
+                codes = []
+                d = table.dictionaries[table.schema.field(child.name).name]
+                for v in e.values:
+                    pos = int(np.searchsorted(d, v))
+                    if pos < len(d) and d[pos] == v:
+                        codes.append(pos)
+                return _codes_runs_expr(child, np.sort(np.unique(codes)) if codes else np.array([]))
+            return _or_chain([BinOp("eq", child, Lit(v)) for v in e.values])
+        return e  # DatePart / arithmetic probes: host evaluation
+    if isinstance(e, Like):
+        from hyperspace_tpu.exceptions import HyperspaceError
+
+        if not isinstance(e.child, Col):
+            raise HyperspaceError("LIKE applies to a column")
+        f = table.schema.field(e.child.name)
+        return _codes_runs_expr(Col(f.name), _like_codes(table, e.child.name, e.pattern))
     if isinstance(e, And):
         return And(translate_predicate(table, e.left), translate_predicate(table, e.right))
     if isinstance(e, Or):
@@ -84,6 +214,40 @@ def translate_predicate(table: ColumnTable, e: Expr) -> Expr:
     if isinstance(e, Not):
         return Not(translate_predicate(table, e.child))
     return e
+
+
+def _days(y: int, m: int, d: int) -> int:
+    import datetime
+
+    return (datetime.date(y, m, d) - datetime.date(1970, 1, 1)).days
+
+
+def _translate_date_part_cmp(op: str, dp: DatePart, value) -> Expr | None:
+    """year(col) OP literal → the equivalent day-range comparison on the
+    raw date column (device-lowerable; feeds min/max range pruning).
+    month/day parts are not interval-shaped over days — return None."""
+    if dp.part != "year" or not isinstance(dp.child, Col):
+        return None
+    if isinstance(value, (bool, np.bool_)) or not isinstance(value, (int, np.integer)):
+        return None
+    col = dp.child
+    y = int(value)
+    if y < 1 or y > 9998:  # keep datetime.date in range
+        return None
+    first, next_first = _days(y, 1, 1), _days(y + 1, 1, 1)
+    if op == "eq":
+        return And(BinOp("ge", col, Lit(first)), BinOp("lt", col, Lit(next_first)))
+    if op == "ne":
+        return Or(BinOp("lt", col, Lit(first)), BinOp("ge", col, Lit(next_first)))
+    if op == "lt":
+        return BinOp("lt", col, Lit(first))
+    if op == "le":
+        return BinOp("lt", col, Lit(next_first))
+    if op == "ge":
+        return BinOp("ge", col, Lit(first))
+    if op == "gt":
+        return BinOp("ge", col, Lit(next_first))
+    return None
 
 
 # -- 64-bit pair lowering ----------------------------------------------------
@@ -314,6 +478,11 @@ def _lower(table: ColumnTable, e: Expr) -> Expr:
         return Or(_lower(table, e.left), _lower(table, e.right))
     if isinstance(e, Not):
         return Not(_lower(table, e.child))
+    if isinstance(e, IsNull):
+        # IS NULL is never UNKNOWN: it evaluates the validity lanes
+        # directly (true where any referenced column is null).
+        nul = _null_expr(table, sorted(e.references()))
+        return _Cmp3(nul if nul is not None else Lit(np.bool_(False)), None)
     if isinstance(e, BinOp) and e.is_comparison:
         l, r = e.left, e.right
         if isinstance(l, Lit) and isinstance(r, Col):
@@ -472,6 +641,9 @@ def _host_mask(table: ColumnTable, predicate: Expr) -> np.ndarray:
         if isinstance(e, Not):
             t, f = tri(e.child)
             return f, t
+        if isinstance(e, IsNull):
+            known = known_mask(e.child)
+            return ~known, known  # IS NULL is never UNKNOWN
         # Leaf comparison/expression: any null input makes it unknown.
         with np.errstate(all="ignore"):
             v = np.broadcast_to(np.asarray(evaluate(e, resolve, np), dtype=bool), (n_rows,))

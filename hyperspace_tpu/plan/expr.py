@@ -64,6 +64,27 @@ class Expr:
     def __invert__(self):
         return Not(self)
 
+    # -- SQL predicate sugar ----------------------------------------------
+    def is_null(self) -> "IsNull":
+        return IsNull(self)
+
+    def is_not_null(self) -> "Not":
+        return Not(IsNull(self))
+
+    def isin(self, values) -> "InList":
+        return InList(self, list(values))
+
+    def like(self, pattern: str) -> "Like":
+        return Like(self, pattern)
+
+    def between(self, lo, hi) -> "And":
+        """SQL BETWEEN sugar: inclusive on both ends."""
+        return And(BinOp("ge", self, _wrap(lo)), BinOp("le", self, _wrap(hi)))
+
+    def substr(self, start: int, length: int) -> "Substr":
+        """SQL SUBSTRING (1-based start), usable inside comparisons / IN."""
+        return Substr(self, int(start), int(length))
+
     def __hash__(self):
         return hash(repr(self))
 
@@ -177,6 +198,129 @@ class Case(Expr):
         return out
 
 
+@dataclasses.dataclass(eq=False, repr=True)
+class IsNull(Expr):
+    """SQL IS NULL. Never UNKNOWN (the point of the operator); IS NOT
+    NULL is Not(IsNull(...)). For a compound child, null iff any input
+    column is null (matching the engine's expression null semantics)."""
+
+    child: Expr
+
+    def to_json(self):
+        return {"type": "isnull", "child": self.child.to_json()}
+
+    def references(self):
+        return self.child.references()
+
+
+@dataclasses.dataclass(eq=False, repr=True)
+class InList(Expr):
+    """SQL IN over a literal list. 3-valued: a null probe is UNKNOWN.
+    Desugars (at translation time) to an OR of equalities in the physical
+    code domain — which also feeds multi-point bucket pruning and
+    min/max envelope pruning on indexed columns."""
+
+    child: Expr
+    values: list
+
+    def __post_init__(self):
+        if not self.values:
+            raise ValueError("IN requires a non-empty value list")
+        if any(v is None for v in self.values):
+            raise ValueError("IN list literals must be non-null")
+
+    def to_json(self):
+        return {"type": "in", "child": self.child.to_json(), "values": list(self.values)}
+
+    def references(self):
+        return self.child.references()
+
+
+@dataclasses.dataclass(eq=False, repr=True)
+class Like(Expr):
+    """SQL LIKE (% = any run, _ = any one char), case-sensitive, against
+    a string column. Evaluates over the (small, sorted) dictionary and
+    desugars to code-range / code-equality tests — a prefix pattern
+    becomes ONE contiguous code range."""
+
+    child: Expr
+    pattern: str
+
+    def to_json(self):
+        return {"type": "like", "child": self.child.to_json(), "pattern": self.pattern}
+
+    def references(self):
+        return self.child.references()
+
+
+@dataclasses.dataclass(eq=False, repr=True)
+class Substr(Expr):
+    """SQL SUBSTRING(col, start, length), 1-based, over a string column;
+    valid inside comparisons against string literals and IN lists
+    (TPC-H Q22's substring(c_phone, 1, 2) shape)."""
+
+    child: Expr
+    start: int
+    length: int
+
+    def __post_init__(self):
+        if self.start < 1:
+            raise ValueError("SUBSTRING start is 1-based and must be >= 1")
+        if self.length < 0:
+            raise ValueError("SUBSTRING length must be >= 0")
+
+    def to_json(self):
+        return {
+            "type": "substr",
+            "child": self.child.to_json(),
+            "start": self.start,
+            "length": self.length,
+        }
+
+    def references(self):
+        return self.child.references()
+
+
+@dataclasses.dataclass(eq=False, repr=True)
+class DatePart(Expr):
+    """Extract year/month/day from a date column (int32 days since
+    epoch). Comparisons against literals translate to equivalent day
+    ranges, so they lower to the device and drive range pruning."""
+
+    part: str  # year | month | day
+    child: Expr
+
+    def __post_init__(self):
+        if self.part not in ("year", "month", "day"):
+            raise ValueError(f"unknown date part {self.part!r}")
+
+    def to_json(self):
+        return {"type": "datepart", "part": self.part, "child": self.child.to_json()}
+
+    def references(self):
+        return self.child.references()
+
+
+def year(e) -> DatePart:
+    return DatePart("year", _wrap(e))
+
+
+def month(e) -> DatePart:
+    return DatePart("month", _wrap(e))
+
+
+def day(e) -> DatePart:
+    return DatePart("day", _wrap(e))
+
+
+def date_lit(iso: str) -> Lit:
+    """A date literal from ISO text, as the engine's physical day count."""
+    import datetime
+
+    d = datetime.date.fromisoformat(iso)
+    return Lit((d - datetime.date(1970, 1, 1)).days)
+
+
 class CaseBuilder:
     """`when(cond, value).when(...).otherwise(default)` sugar."""
 
@@ -225,6 +369,16 @@ def expr_from_json(d: dict[str, Any]) -> Expr:
             [(expr_from_json(c), expr_from_json(v)) for c, v in d["branches"]],
             expr_from_json(d["default"]),
         )
+    if t == "isnull":
+        return IsNull(expr_from_json(d["child"]))
+    if t == "in":
+        return InList(expr_from_json(d["child"]), list(d["values"]))
+    if t == "like":
+        return Like(expr_from_json(d["child"]), d["pattern"])
+    if t == "substr":
+        return Substr(expr_from_json(d["child"]), int(d["start"]), int(d["length"]))
+    if t == "datepart":
+        return DatePart(d["part"], expr_from_json(d["child"]))
     raise ValueError(f"unknown expr type {t!r}")
 
 
@@ -275,4 +429,30 @@ def evaluate(e: Expr, resolve: Callable[[str], Any], xp) -> Any:
                 evaluate(cond, resolve, xp), evaluate(val, resolve, xp), out
             )
         return out
+    if isinstance(e, DatePart):
+        return eval_date_part(e.part, evaluate(e.child, resolve, xp), xp)
+    if isinstance(e, InList):
+        v = evaluate(e.child, resolve, xp)
+        out = None
+        for lv in e.values:
+            m = v == lv
+            out = m if out is None else xp.logical_or(out, m)
+        return out
     raise ValueError(f"cannot evaluate {e!r}")
+
+
+def eval_date_part(part: str, days, xp) -> Any:
+    """year/month/day from days-since-epoch. numpy calendar conversion on
+    host; the device path never reaches here (comparisons are translated
+    to day ranges first)."""
+    import numpy as _np
+
+    if xp is not _np:
+        raise ValueError("date part extraction evaluates on host only")
+    d64 = _np.asarray(days).astype("datetime64[D]")
+    if part == "year":
+        return d64.astype("datetime64[Y]").astype(_np.int64) + 1970
+    if part == "month":
+        m = d64.astype("datetime64[M]").astype(_np.int64)
+        return m % 12 + 1
+    return (d64 - d64.astype("datetime64[M]")).astype(_np.int64) + 1
