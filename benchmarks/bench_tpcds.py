@@ -1,0 +1,72 @@
+"""TPC-DS slice benchmark: nine real star-join queries (q3, q7, q27,
+q42, q43, q48, q52, q55, q96) with and without indexes, results REQUIRED
+identical both ways. Prints one JSON line with the geomean speedup —
+the artifact building toward BASELINE config 3 (SF1000 99-query
+geomean)."""
+
+from __future__ import annotations
+
+import json
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+from benchmarks.harness import assert_same_results, log, timed as _timed
+
+
+def main(sf: float = 1.0):
+    import numpy as np
+
+    from benchmarks.tpcds import cached_tpcds, tpcds_indexes, tpcds_queries
+    from hyperspace_tpu import Hyperspace, HyperspaceSession
+
+    tmp = Path(tempfile.mkdtemp(prefix="hs_tpcds_"))
+    results = []
+    try:
+        roots = cached_tpcds(sf=sf)
+        session = HyperspaceSession(system_path=str(tmp / "indexes"), num_buckets=64)
+        hs = Hyperspace(session)
+        scans = {name: session.parquet(root) for name, root in roots.items()}
+
+        t0 = time.perf_counter()
+        tpcds_indexes(hs, scans)
+        log(f"tpcds index builds (sf={sf:g}): {time.perf_counter() - t0:.2f}s")
+
+        queries = tpcds_queries(scans)
+        speedups = []
+        for name, plan in queries.items():
+            session.disable_hyperspace()
+            t_raw, r_raw = _timed(lambda p=plan: session.run(p))
+            session.enable_hyperspace()
+            t_idx, r_idx = _timed(lambda p=plan: session.run(p))
+            stats = dict(session.last_query_stats)
+
+            assert_same_results(name, r_raw, r_idx)
+
+            sp = t_raw / t_idx
+            speedups.append(sp)
+            log(
+                f"{name}: raw {t_raw:.3f}s  indexed {t_idx:.3f}s  {sp:.2f}x  "
+                f"(rows={r_idx.num_rows}, join={stats['join_path']}, "
+                f"agg={stats['agg_path']})"
+            )
+            results.append({"query": name, "speedup": round(sp, 3)})
+
+        geo = float(np.exp(np.mean(np.log(speedups))))
+        print(json.dumps({
+            "metric": "tpcds_slice_geomean_speedup",
+            "value": round(geo, 3),
+            "unit": "x",
+            "vs_baseline": round(geo, 3),
+            "queries": results,
+        }))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main(float(sys.argv[1]) if len(sys.argv) > 1 else 1.0)

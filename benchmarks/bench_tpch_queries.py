@@ -31,8 +31,8 @@ import time
 from pathlib import Path
 
 
-def log(*a):
-    print(*a, file=sys.stderr, flush=True)
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+from benchmarks.harness import assert_same_results, log, timed as _timed  # noqa: E402
 
 
 def days(iso: str) -> int:
@@ -40,17 +40,7 @@ def days(iso: str) -> int:
     return (d - datetime.date(1970, 1, 1)).days
 
 
-def _timed(fn, warmup=1, reps=2):
-    for _ in range(warmup):
-        out = fn()
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = fn()
-    return (time.perf_counter() - t0) / reps, out
-
-
 def main(sf: float = 1.0):
-    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
     import numpy as np
 
     from benchmarks.datagen import cached_tpch
@@ -212,15 +202,7 @@ def main(sf: float = 1.0):
             t_idx, r_idx = _timed(lambda p=plan: session.run(p))
             stats = dict(session.last_query_stats)
 
-            a, b = r_raw.decode(), r_idx.decode()
-            assert set(a) == set(b), (name, set(a), set(b))
-            for c in a:
-                av, bv = np.asarray(a[c]), np.asarray(b[c])
-                assert len(av) == len(bv), (name, c, len(av), len(bv))
-                if av.dtype.kind in "fc":
-                    np.testing.assert_allclose(av, bv, rtol=1e-9, err_msg=f"{name}.{c}")
-                else:
-                    assert (av == bv).all(), (name, c)
+            assert_same_results(name, r_raw, r_idx)
 
             sp = t_raw / t_idx
             speedups.append(sp)

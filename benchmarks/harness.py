@@ -1,0 +1,37 @@
+"""Shared benchmark harness: timing protocol and the raw-vs-indexed
+result-equality gate every query bench applies (the analog of the
+reference's verifyIndexUsage equality assertion,
+E2EHyperspaceRulesTests.scala:324-340)."""
+
+from __future__ import annotations
+
+import sys
+import time
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def timed(fn, warmup=1, reps=2):
+    for _ in range(warmup):
+        out = fn()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn()
+    return (time.perf_counter() - t0) / reps, out
+
+
+def assert_same_results(name: str, raw, indexed) -> None:
+    """Decoded result dicts must be identical (float columns to 1e-9)."""
+    import numpy as np
+
+    a, b = raw.decode(), indexed.decode()
+    assert set(a) == set(b), (name, set(a), set(b))
+    for c in a:
+        av, bv = np.asarray(a[c]), np.asarray(b[c])
+        assert len(av) == len(bv), (name, c, len(av), len(bv))
+        if av.dtype.kind in "fc":
+            np.testing.assert_allclose(av, bv, rtol=1e-9, err_msg=f"{name}.{c}")
+        else:
+            assert (av == bv).all(), (name, c)

@@ -11,11 +11,18 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-from benchmarks import bench_ann, bench_hybrid, bench_join, bench_refresh, bench_tpch_queries  # noqa: E402
+from benchmarks import (  # noqa: E402
+    bench_ann,
+    bench_hybrid,
+    bench_join,
+    bench_refresh,
+    bench_tpcds,
+    bench_tpch_queries,
+)
 
 
 def main():
-    for mod in (bench_join, bench_tpch_queries, bench_hybrid, bench_refresh, bench_ann):
+    for mod in (bench_join, bench_tpch_queries, bench_tpcds, bench_hybrid, bench_refresh, bench_ann):
         print(f"=== {mod.__name__} ===", file=sys.stderr, flush=True)
         mod.main()
 

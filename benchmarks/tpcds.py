@@ -1,0 +1,522 @@
+"""TPC-DS star-schema slice: datagen + nine real queries in the plan IR.
+
+Tables follow the TPC-DS schema (store_sales fact + date_dim / item /
+store / customer_demographics / household_demographics / time_dim /
+customer_address dimensions) with dsdgen-style surrogate keys (date_dim
+julian numbering, cd demographics as a cycling cartesian product) and
+synthetic value distributions. SF1 store_sales = 2,879,987 rows.
+
+The queries are TPC-DS q3, q7, q27 (flat group-by; no ROLLUP in the IR),
+q42, q43, q48, q52, q55 and q96 — the star-join + filter + group-by +
+ORDER/LIMIT subset the engine expresses today (windowed/correlated
+queries are out of scope this round). Each is written with the most
+selective dimension join innermost so the index rewrite turns it into a
+bucket-aligned zero-exchange SMJ; remaining dimensions chain above it.
+The reference claims serde coverage of all TPC-DS queries
+(index/serde/package.scala:47-50); BASELINE config 3 is the SF1000
+99-query geomean this slice builds toward.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+SS_SF1_ROWS = 2_879_987
+ITEM_SF1_ROWS = 18_000
+CUSTOMER_SF1_ROWS = 100_000
+CA_SF1_ROWS = 50_000
+CD_ROWS = 1_920_800  # fixed cartesian size in TPC-DS
+HD_ROWS = 7_200
+DD_ROWS = 73_049  # 1900-01-02 .. 2100-01-01
+DD_SK0 = 2_415_022  # julian day number of the first date_dim row
+STORE_ROWS = 12
+
+_CATEGORIES = np.array(
+    ["Books", "Children", "Electronics", "Home", "Jewelry",
+     "Men", "Music", "Shoes", "Sports", "Women"], dtype=object
+)
+_GENDER = np.array(["M", "F"], dtype=object)
+_MARITAL = np.array(["M", "S", "D", "W", "U"], dtype=object)
+_EDUCATION = np.array(
+    ["Primary", "Secondary", "College", "2 yr Degree", "4 yr Degree",
+     "Advanced Degree", "Unknown"], dtype=object
+)
+_BUY_POTENTIAL = np.array(
+    [">10000", "5001-10000", "1001-5000", "501-1000", "0-500", "Unknown"], dtype=object
+)
+_STATES = np.array(
+    ["TX", "OH", "OR", "CA", "WA", "NM", "KY", "VA", "FL", "GA", "MI", "IL"], dtype=object
+)
+_STORE_NAMES = np.array(
+    ["ought", "able", "pri", "ese", "anti", "cally", "ation", "eing",
+     "ought", "able", "ese", "bar"], dtype=object
+)
+
+
+def _parts(t: pa.Table, root: Path, files: int) -> int:
+    from benchmarks.datagen import _write_parts
+
+    _write_parts(t, root, files)
+    return t.nbytes
+
+
+def gen_date_dim(root: Path) -> int:
+    """Deterministic calendar: one row per day 1900-01-02..2100-01-01,
+    julian d_date_sk numbering as dsdgen emits."""
+    days = np.arange(DD_ROWS, dtype=np.int64)
+    d64 = np.datetime64("1900-01-02") + days
+    years = d64.astype("datetime64[Y]").astype(np.int64) + 1970
+    months0 = d64.astype("datetime64[M]").astype(np.int64)
+    moy = months0 % 12 + 1
+    dom = (d64 - d64.astype("datetime64[M]")).astype(np.int64) + 1
+    dow = (d64.astype("datetime64[D]").astype(np.int64) + 4) % 7  # 0=Sunday
+    names = np.array(
+        ["Sunday", "Monday", "Tuesday", "Wednesday", "Thursday", "Friday", "Saturday"],
+        dtype=object,
+    )
+    t = pa.table(
+        {
+            "d_date_sk": DD_SK0 + days,
+            "d_date": pa.array(
+                (d64 - np.datetime64("1970-01-01")).astype(np.int32), type=pa.date32()
+            ),
+            "d_year": years.astype(np.int32),
+            "d_moy": moy.astype(np.int32),
+            "d_dom": dom.astype(np.int32),
+            "d_qoy": ((moy - 1) // 3 + 1).astype(np.int32),
+            "d_day_name": pa.array(names[dow]),
+        }
+    )
+    return _parts(t, root, 1)
+
+
+def item_rows(sf: float) -> int:
+    """item scales sublinearly in TPC-DS; pinned to the SF1 size above
+    SF1 (good enough for this slice) and proportionally below."""
+    return max(int(ITEM_SF1_ROWS * min(sf, 1.0)), 100)
+
+
+def gen_item(root: Path, sf: float = 1.0, seed: int = 61) -> int:
+    n = item_rows(sf)
+    rng = np.random.default_rng(seed)
+    manufact = rng.integers(1, 1001, n).astype(np.int32)
+    brand_id = (manufact * 1000 + rng.integers(1, 1000, n)).astype(np.int32)
+    cat_id = rng.integers(1, 11, n).astype(np.int32)
+    t = pa.table(
+        {
+            "i_item_sk": np.arange(1, n + 1, dtype=np.int64),
+            "i_item_id": pa.array(
+                np.char.add("AAAAAAAA", np.arange(n).astype("U8")).astype(object)
+            ),
+            "i_brand_id": brand_id,
+            "i_brand": pa.array(
+                np.char.add("brandbrand#", brand_id.astype("U8")).astype(object)
+            ),
+            "i_manufact_id": manufact,
+            "i_manager_id": rng.integers(1, 101, n).astype(np.int32),
+            "i_category_id": cat_id,
+            "i_category": pa.array(_CATEGORIES[cat_id - 1]),
+            "i_class": pa.array(
+                np.char.add("class", rng.integers(1, 17, n).astype("U2")).astype(object)
+            ),
+            "i_current_price": np.round(rng.random(n) * 99 + 1, 2),
+        }
+    )
+    return _parts(t, root, 1)
+
+
+def gen_store(root: Path) -> int:
+    n = STORE_ROWS
+    t = pa.table(
+        {
+            "s_store_sk": np.arange(1, n + 1, dtype=np.int64),
+            "s_store_id": pa.array(
+                np.char.add("AAAAAAAA", np.arange(n).astype("U2")).astype(object)
+            ),
+            "s_store_name": pa.array(_STORE_NAMES[:n]),
+            "s_state": pa.array(_STATES[:n]),
+            "s_zip": pa.array(
+                np.char.add("55", (np.arange(n) * 137 % 1000).astype("U3")).astype(object)
+            ),
+            "s_gmt_offset": np.full(n, -5.0),
+        }
+    )
+    return _parts(t, root, 1)
+
+
+def cd_rows(sf: float) -> int:
+    """customer_demographics is fixed-size in TPC-DS; scaled down below
+    SF1 (keeping full field-cycle coverage) so tiny test runs stay fast."""
+    return CD_ROWS if sf >= 1 else max(int(CD_ROWS * sf), 11_200)
+
+
+def gen_customer_demographics(root: Path, sf: float = 1.0) -> int:
+    """The dsdgen cartesian: demographics fields CYCLE with fixed periods
+    so any (gender, marital, education) combo is a fixed 1/70 of keys."""
+    n = cd_rows(sf)
+    i = np.arange(n, dtype=np.int64)
+    t = pa.table(
+        {
+            "cd_demo_sk": i + 1,
+            "cd_gender": pa.array(_GENDER[i % 2]),
+            "cd_marital_status": pa.array(_MARITAL[(i // 2) % 5]),
+            "cd_education_status": pa.array(_EDUCATION[(i // 10) % 7]),
+            "cd_purchase_estimate": ((i // 70) % 20 * 500 + 500).astype(np.int32),
+            "cd_credit_rating": pa.array(
+                np.array(["Good", "High Risk", "Low Risk", "Unknown"], dtype=object)[
+                    (i // 1400) % 4
+                ]
+            ),
+            "cd_dep_count": ((i // 5600) % 7).astype(np.int32),
+        }
+    )
+    return _parts(t, root, 2)
+
+
+def gen_household_demographics(root: Path) -> int:
+    n = HD_ROWS
+    i = np.arange(n, dtype=np.int64)
+    t = pa.table(
+        {
+            "hd_demo_sk": i + 1,
+            "hd_buy_potential": pa.array(_BUY_POTENTIAL[i % 6]),
+            "hd_dep_count": ((i // 6) % 10).astype(np.int32),
+            "hd_vehicle_count": ((i // 60) % 5).astype(np.int32),
+        }
+    )
+    return _parts(t, root, 1)
+
+
+def gen_time_dim(root: Path) -> int:
+    i = np.arange(86_400, dtype=np.int64)
+    t = pa.table(
+        {
+            "t_time_sk": i,
+            "t_hour": (i // 3600).astype(np.int32),
+            "t_minute": (i % 3600 // 60).astype(np.int32),
+            "t_second": (i % 60).astype(np.int32),
+        }
+    )
+    return _parts(t, root, 1)
+
+
+def gen_customer_address(root: Path, sf: float = 1.0, seed: int = 62) -> int:
+    n = max(int(CA_SF1_ROWS * max(sf, 0.02)), 100)
+    rng = np.random.default_rng(seed)
+    t = pa.table(
+        {
+            "ca_address_sk": np.arange(1, n + 1, dtype=np.int64),
+            "ca_state": pa.array(_STATES[rng.integers(0, len(_STATES), n)]),
+            "ca_zip": pa.array(rng.integers(10000, 99999, n).astype("U5").astype(object)),
+            "ca_country": pa.array(np.full(n, "United States", dtype=object)),
+        }
+    )
+    return _parts(t, root, 1)
+
+
+def gen_store_sales(root: Path, sf: float = 1.0, seed: int = 60, files: int = 8,
+                    n_items: int | None = None, n_ca: int | None = None) -> int:
+    """The fact table. Sold dates concentrate in 1998-2002 (the years the
+    published queries probe), store hours 08:00-21:00."""
+    n = int(SS_SF1_ROWS * sf)
+    rng = np.random.default_rng(seed)
+    # d_date_sk for 1998-01-01..2002-12-31 in julian numbering.
+    lo = DD_SK0 + int((np.datetime64("1998-01-01") - np.datetime64("1900-01-02")) // np.timedelta64(1, "D"))
+    hi = DD_SK0 + int((np.datetime64("2002-12-31") - np.datetime64("1900-01-02")) // np.timedelta64(1, "D"))
+    n_items = n_items if n_items is not None else item_rows(sf)
+    n_ca = n_ca if n_ca is not None else max(int(CA_SF1_ROWS * max(sf, 0.02)), 100)
+    quantity = rng.integers(1, 101, n).astype(np.int32)
+    list_price = np.round(rng.random(n) * 190 + 10, 2)
+    sales_price = np.round(list_price * (0.2 + rng.random(n) * 0.8), 2)
+    t = pa.table(
+        {
+            "ss_sold_date_sk": rng.integers(lo, hi + 1, n).astype(np.int64),
+            "ss_sold_time_sk": rng.integers(8 * 3600, 21 * 3600, n).astype(np.int64),
+            "ss_item_sk": rng.integers(1, n_items + 1, n).astype(np.int64),
+            "ss_customer_sk": rng.integers(1, int(CUSTOMER_SF1_ROWS * max(sf, 0.02)) + 1, n).astype(np.int64),
+            "ss_cdemo_sk": rng.integers(1, cd_rows(sf) + 1, n).astype(np.int64),
+            "ss_hdemo_sk": rng.integers(1, HD_ROWS + 1, n).astype(np.int64),
+            "ss_addr_sk": rng.integers(1, n_ca + 1, n).astype(np.int64),
+            "ss_store_sk": rng.integers(1, STORE_ROWS + 1, n).astype(np.int64),
+            "ss_promo_sk": rng.integers(1, 301, n).astype(np.int64),
+            "ss_quantity": quantity,
+            "ss_list_price": list_price,
+            "ss_sales_price": sales_price,
+            "ss_coupon_amt": np.round(np.where(rng.random(n) < 0.2, rng.random(n) * 50, 0.0), 2),
+            "ss_ext_sales_price": np.round(quantity * sales_price, 2),
+            "ss_net_profit": np.round(quantity * (sales_price - list_price * 0.5), 2),
+        }
+    )
+    return _parts(t, root, files)
+
+
+_GENS = {
+    "store_sales": gen_store_sales,
+    "date_dim": lambda root, sf=1.0: gen_date_dim(root),
+    "item": gen_item,
+    "store": lambda root, sf=1.0: gen_store(root),
+    "customer_demographics": gen_customer_demographics,
+    "household_demographics": lambda root, sf=1.0: gen_household_demographics(root),
+    "time_dim": lambda root, sf=1.0: gen_time_dim(root),
+    "customer_address": gen_customer_address,
+}
+
+TABLES = tuple(_GENS)
+
+
+def cached_tpcds(sf: float = 1.0, cache_root: Path | None = None) -> dict[str, Path]:
+    import shutil
+    import tempfile
+
+    base = cache_root or Path(tempfile.gettempdir()) / f"hs_tpcds_sf{sf:g}"
+    roots = {}
+    for name, gen in _GENS.items():
+        root = base / name
+        if not (root / "_COMPLETE").exists():
+            shutil.rmtree(root, ignore_errors=True)
+            gen(root, sf=sf)
+            (root / "_COMPLETE").touch()
+        roots[name] = root
+    return roots
+
+
+# --------------------------------------------------------------------------
+# The nine queries. Each takes the dict of registered scans and returns a
+# LogicalPlan. The innermost join is the one the index rewrite aligns.
+
+def tpcds_queries(t: dict) -> dict:
+    from hyperspace_tpu import AggSpec, col, lit, when
+
+    ss, dd, item, store = t["store_sales"], t["date_dim"], t["item"], t["store"]
+    cd, hd, td, ca = (
+        t["customer_demographics"],
+        t["household_demographics"],
+        t["time_dim"],
+        t["customer_address"],
+    )
+
+    def brand_report(manufact_or_manager, months, years, manager=False, cat=False):
+        """The q3/q42/q52/q55 family: ss x date_dim x item with an item
+        attribute filter and a month/year window."""
+        dpred = col("d_moy") == lit(months)
+        if years is not None:
+            dpred = dpred & (col("d_year") == lit(years))
+        dim_filter = dd.select("d_date_sk", "d_year", "d_moy").filter(dpred)
+        it = item.select(
+            "i_item_sk", "i_brand_id", "i_brand", "i_category_id", "i_category",
+            "i_manufact_id", "i_manager_id",
+        ).filter(
+            (col("i_manager_id") == lit(manufact_or_manager))
+            if manager
+            else (col("i_manufact_id") == lit(manufact_or_manager))
+        )
+        group = ["d_year", "i_category_id", "i_category"] if cat else ["d_year", "i_brand_id", "i_brand"]
+        return (
+            ss.select("ss_sold_date_sk", "ss_item_sk", "ss_ext_sales_price")
+            .join(dim_filter, ["ss_sold_date_sk"], ["d_date_sk"])
+            .join(it, ["ss_item_sk"], ["i_item_sk"])
+            .aggregate(group, [AggSpec.of("sum", "ss_ext_sales_price", "sum_sales")])
+            .sort([("d_year", True), ("sum_sales", False), (group[1], True)])
+            .limit(100)
+        )
+
+    q3 = brand_report(128, 11, None)                      # i_manufact_id = 128, d_moy = 11
+    q42 = brand_report(1, 11, 2000, manager=True, cat=True)
+    q52 = brand_report(1, 11, 2000, manager=True)
+    q55 = brand_report(28, 11, 1999, manager=True)
+
+    # q7: average measures for single college-educated male shoppers under
+    # a no-email-or-no-event promotion in 2000 (promotion flags are
+    # modeled by promo-key parity).
+    q7 = (
+        ss.select(
+            "ss_cdemo_sk", "ss_sold_date_sk", "ss_item_sk", "ss_promo_sk",
+            "ss_quantity", "ss_list_price", "ss_coupon_amt", "ss_sales_price",
+        )
+        .join(
+            cd.select("cd_demo_sk", "cd_gender", "cd_marital_status", "cd_education_status")
+            .filter(
+                (col("cd_gender") == lit("M"))
+                & (col("cd_marital_status") == lit("S"))
+                & (col("cd_education_status") == lit("College"))
+            ),
+            ["ss_cdemo_sk"], ["cd_demo_sk"],
+        )
+        .join(
+            dd.select("d_date_sk", "d_year").filter(col("d_year") == lit(2000)),
+            ["ss_sold_date_sk"], ["d_date_sk"],
+        )
+        .join(item.select("i_item_sk", "i_item_id"), ["ss_item_sk"], ["i_item_sk"])
+        # promotion is modeled by promo_sk parity (channel flags cycle).
+        .filter((col("ss_promo_sk") % lit(2)) == lit(0))
+        .aggregate(
+            ["i_item_id"],
+            [
+                AggSpec.of("mean", "ss_quantity", "agg1"),
+                AggSpec.of("mean", "ss_list_price", "agg2"),
+                AggSpec.of("mean", "ss_coupon_amt", "agg3"),
+                AggSpec.of("mean", "ss_sales_price", "agg4"),
+            ],
+        )
+        .sort(["i_item_id"])
+        .limit(100)
+    )
+
+    # q27 (flat group-by form): averages by item and store state for
+    # married primary-educated female shoppers in 2002.
+    q27 = (
+        ss.select(
+            "ss_cdemo_sk", "ss_sold_date_sk", "ss_item_sk", "ss_store_sk",
+            "ss_quantity", "ss_list_price", "ss_coupon_amt", "ss_sales_price",
+        )
+        .join(
+            cd.select("cd_demo_sk", "cd_gender", "cd_marital_status", "cd_education_status")
+            .filter(
+                (col("cd_gender") == lit("F"))
+                & (col("cd_marital_status") == lit("M"))
+                & (col("cd_education_status") == lit("Primary"))
+            ),
+            ["ss_cdemo_sk"], ["cd_demo_sk"],
+        )
+        .join(
+            dd.select("d_date_sk", "d_year").filter(col("d_year") == lit(2002)),
+            ["ss_sold_date_sk"], ["d_date_sk"],
+        )
+        .join(store.select("s_store_sk", "s_state"), ["ss_store_sk"], ["s_store_sk"])
+        .join(item.select("i_item_sk", "i_item_id"), ["ss_item_sk"], ["i_item_sk"])
+        .aggregate(
+            ["i_item_id", "s_state"],
+            [
+                AggSpec.of("mean", "ss_quantity", "agg1"),
+                AggSpec.of("mean", "ss_list_price", "agg2"),
+                AggSpec.of("mean", "ss_coupon_amt", "agg3"),
+                AggSpec.of("mean", "ss_sales_price", "agg4"),
+            ],
+        )
+        .sort(["i_item_id", "s_state"])
+        .limit(100)
+    )
+
+    # q43: weekly store pivot — day-name CASE sums by store, one year.
+    def day_sum(name, alias):
+        return AggSpec.of(
+            "sum",
+            when(col("d_day_name") == lit(name), col("ss_sales_price")).otherwise(0.0),
+            alias,
+        )
+
+    q43 = (
+        ss.select("ss_sold_date_sk", "ss_store_sk", "ss_sales_price")
+        .join(
+            dd.select("d_date_sk", "d_year", "d_day_name").filter(col("d_year") == lit(2000)),
+            ["ss_sold_date_sk"], ["d_date_sk"],
+        )
+        .join(store.select("s_store_sk", "s_store_id", "s_store_name"), ["ss_store_sk"], ["s_store_sk"])
+        .aggregate(
+            ["s_store_name", "s_store_id"],
+            [
+                day_sum("Sunday", "sun_sales"),
+                day_sum("Monday", "mon_sales"),
+                day_sum("Tuesday", "tue_sales"),
+                day_sum("Wednesday", "wed_sales"),
+                day_sum("Thursday", "thu_sales"),
+                day_sum("Friday", "fri_sales"),
+                day_sum("Saturday", "sat_sales"),
+            ],
+        )
+        .sort(["s_store_name", "s_store_id"])
+        .limit(100)
+    )
+
+    # q48: quantity sold under OR'd demographic/price and address/profit
+    # band predicates (the cross-side OR stays a residual Kleene filter).
+    q48 = (
+        ss.select(
+            "ss_cdemo_sk", "ss_sold_date_sk", "ss_addr_sk", "ss_store_sk",
+            "ss_quantity", "ss_sales_price", "ss_net_profit",
+        )
+        .join(
+            cd.select("cd_demo_sk", "cd_marital_status", "cd_education_status"),
+            ["ss_cdemo_sk"], ["cd_demo_sk"],
+        )
+        .join(
+            dd.select("d_date_sk", "d_year").filter(col("d_year") == lit(2000)),
+            ["ss_sold_date_sk"], ["d_date_sk"],
+        )
+        .join(ca.select("ca_address_sk", "ca_country", "ca_state"), ["ss_addr_sk"], ["ca_address_sk"])
+        .filter(
+            (
+                ((col("cd_marital_status") == lit("M")) & (col("cd_education_status") == lit("4 yr Degree")) & col("ss_sales_price").between(100.0, 150.0))
+                | ((col("cd_marital_status") == lit("D")) & (col("cd_education_status") == lit("2 yr Degree")) & col("ss_sales_price").between(50.0, 100.0))
+                | ((col("cd_marital_status") == lit("S")) & (col("cd_education_status") == lit("College")) & col("ss_sales_price").between(150.0, 200.0))
+            )
+            & (col("ca_country") == lit("United States"))
+            & (
+                (col("ca_state").isin(["CA", "OR", "WA"]) & col("ss_net_profit").between(0.0, 2000.0))
+                | (col("ca_state").isin(["TX", "OH", "GA"]) & col("ss_net_profit").between(150.0, 3000.0))
+                | (col("ca_state").isin(["FL", "NM", "KY"]) & col("ss_net_profit").between(50.0, 25000.0))
+            )
+        )
+        .aggregate([], [AggSpec.of("sum", "ss_quantity", "quantity")])
+    )
+
+    # q96: count of evening shoppers with 7 dependents at store 'ese'.
+    q96 = (
+        ss.select("ss_hdemo_sk", "ss_sold_time_sk", "ss_store_sk")
+        .join(
+            hd.select("hd_demo_sk", "hd_dep_count").filter(col("hd_dep_count") == lit(7)),
+            ["ss_hdemo_sk"], ["hd_demo_sk"],
+        )
+        .join(
+            td.select("t_time_sk", "t_hour", "t_minute").filter(
+                (col("t_hour") == lit(20)) & (col("t_minute") >= lit(30))
+            ),
+            ["ss_sold_time_sk"], ["t_time_sk"],
+        )
+        .join(
+            store.select("s_store_sk", "s_store_name").filter(col("s_store_name") == lit("ese")),
+            ["ss_store_sk"], ["s_store_sk"],
+        )
+        .aggregate([], [AggSpec.of("count", None, "cnt")])
+    )
+
+    return {
+        "q3": q3, "q7": q7, "q27": q27, "q42": q42, "q43": q43,
+        "q48": q48, "q52": q52, "q55": q55, "q96": q96,
+    }
+
+
+def tpcds_indexes(hs, scans: dict) -> None:
+    """The covering indexes a Hyperspace user would build for this slice:
+    the fact table bucketed on each probing dimension key, plus the
+    matching dimension-side indexes (equal bucket counts => the innermost
+    join of every query runs zero-exchange)."""
+    from hyperspace_tpu import IndexConfig
+
+    ss, dd, cd, hd = scans["store_sales"], scans["date_dim"], scans["customer_demographics"], scans["household_demographics"]
+    hs.create_index(ss, IndexConfig(
+        "ss_by_date", ["ss_sold_date_sk"],
+        ["ss_item_sk", "ss_store_sk", "ss_ext_sales_price", "ss_sales_price"],
+    ))
+    hs.create_index(ss, IndexConfig(
+        "ss_by_cdemo", ["ss_cdemo_sk"],
+        ["ss_sold_date_sk", "ss_item_sk", "ss_store_sk", "ss_addr_sk", "ss_promo_sk",
+         "ss_quantity", "ss_list_price", "ss_coupon_amt", "ss_sales_price", "ss_net_profit"],
+    ))
+    hs.create_index(ss, IndexConfig(
+        "ss_by_hdemo", ["ss_hdemo_sk"], ["ss_sold_time_sk", "ss_store_sk"],
+    ))
+    hs.create_index(dd, IndexConfig(
+        "dd_by_sk", ["d_date_sk"], ["d_year", "d_moy", "d_day_name"],
+    ))
+    hs.create_index(cd, IndexConfig(
+        "cd_by_sk", ["cd_demo_sk"],
+        ["cd_gender", "cd_marital_status", "cd_education_status"],
+    ))
+    hs.create_index(hd, IndexConfig(
+        "hd_by_sk", ["hd_demo_sk"], ["hd_dep_count"],
+    ))

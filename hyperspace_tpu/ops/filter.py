@@ -81,23 +81,51 @@ def _null_expr(table: ColumnTable, names: list[str]) -> Expr | None:
 
 
 def _or_chain(parts: list[Expr]) -> Expr:
-    import functools
+    """BALANCED disjunction (depth log2 n): a left-deep chain overflows
+    every recursive walker past a few hundred terms."""
+    if len(parts) == 1:
+        return parts[0]
+    mid = len(parts) // 2
+    return Or(_or_chain(parts[:mid]), _or_chain(parts[mid:]))
 
-    return functools.reduce(Or, parts)
+
+# Above this many runs the desugared comparison tree stops being a win
+# (hundreds of fused comparisons per row); a code->bool lookup table is
+# one gather instead.
+_MAX_CODE_RUNS = 64
 
 
-def _codes_runs_expr(col: Col, codes: "np.ndarray") -> Expr:
+@dataclasses.dataclass(eq=False, repr=True)
+class _DictLut(Expr):
+    """Internal leaf: boolean lookup over a string column's dictionary
+    codes (lut[code]); produced by translate_predicate when a LIKE/IN
+    match set is too scattered for range desugaring. Never serialized —
+    it exists only between translation and evaluation."""
+
+    col: Col
+    lut: "np.ndarray"  # bool, [dictionary size]
+
+    def references(self):
+        return self.col.references()
+
+
+def _codes_runs_expr(col: Col, codes: "np.ndarray", dict_size: int) -> Expr:
     """Matched dictionary codes (sorted int array) → the equivalent
-    predicate in the code domain: an OR of contiguous code ranges. A
-    prefix LIKE over a SORTED dictionary is always ONE range; arbitrary
-    patterns decompose into few runs. All leaves are int comparisons —
-    device-lowerable, null-aware via the normal _Cmp3 machinery."""
+    predicate in the code domain: an OR of contiguous code ranges (a
+    prefix LIKE over a SORTED dictionary is always ONE range), or a
+    dictionary lookup table when the match set is scattered (NOT LIKE
+    over near-unique comments). All forms are device-lowerable and
+    null-aware via the normal _Cmp3 machinery."""
     if len(codes) == 0:
         # No dictionary value matches: always-false but still UNKNOWN for
         # null inputs (-1 is never a real code).
         return BinOp("eq", col, Lit(np.int32(-1)))
     codes = np.asarray(codes, dtype=np.int64)
     breaks = np.flatnonzero(np.diff(codes) > 1)
+    if len(breaks) + 1 > _MAX_CODE_RUNS:
+        lut = np.zeros(dict_size, dtype=bool)
+        lut[codes] = True
+        return _DictLut(col, lut)
     starts = np.concatenate([[0], breaks + 1])
     ends = np.concatenate([breaks, [len(codes) - 1]])
     parts: list[Expr] = []
@@ -171,7 +199,7 @@ def translate_predicate(table: ColumnTable, e: Expr) -> Expr:
             name, vals = _substr_values(table, l)
             cmp = getattr(vals.astype(str), _NP_CMP[e.op])
             codes = np.flatnonzero(cmp(str(r.value)))
-            return _codes_runs_expr(Col(name), codes)
+            return _codes_runs_expr(Col(name), codes, len(vals))
         if isinstance(l, DatePart) and isinstance(r, Lit):
             t = _translate_date_part_cmp(e.op, l, r.value)
             if t is not None:
@@ -188,7 +216,7 @@ def translate_predicate(table: ColumnTable, e: Expr) -> Expr:
             name, vals = _substr_values(table, child)
             want = {str(v) for v in e.values}
             codes = np.flatnonzero([v in want for v in vals])
-            return _codes_runs_expr(Col(name), codes)
+            return _codes_runs_expr(Col(name), codes, len(vals))
         if isinstance(child, Col):
             if table.schema.field(child.name).is_string:
                 codes = []
@@ -197,7 +225,9 @@ def translate_predicate(table: ColumnTable, e: Expr) -> Expr:
                     pos = int(np.searchsorted(d, v))
                     if pos < len(d) and d[pos] == v:
                         codes.append(pos)
-                return _codes_runs_expr(child, np.sort(np.unique(codes)) if codes else np.array([]))
+                return _codes_runs_expr(
+                    child, np.sort(np.unique(codes)) if codes else np.array([]), len(d)
+                )
             return _or_chain([BinOp("eq", child, Lit(v)) for v in e.values])
         return e  # DatePart / arithmetic probes: host evaluation
     if isinstance(e, Like):
@@ -206,7 +236,11 @@ def translate_predicate(table: ColumnTable, e: Expr) -> Expr:
         if not isinstance(e.child, Col):
             raise HyperspaceError("LIKE applies to a column")
         f = table.schema.field(e.child.name)
-        return _codes_runs_expr(Col(f.name), _like_codes(table, e.child.name, e.pattern))
+        return _codes_runs_expr(
+            Col(f.name),
+            _like_codes(table, e.child.name, e.pattern),
+            len(table.dictionaries[f.name]),
+        )
     if isinstance(e, And):
         return And(translate_predicate(table, e.left), translate_predicate(table, e.right))
     if isinstance(e, Or):
@@ -483,6 +517,8 @@ def _lower(table: ColumnTable, e: Expr) -> Expr:
         # directly (true where any referenced column is null).
         nul = _null_expr(table, sorted(e.references()))
         return _Cmp3(nul if nul is not None else Lit(np.bool_(False)), None)
+    if isinstance(e, _DictLut):
+        return _Cmp3(e, _null_expr(table, [e.col.name]))
     if isinstance(e, BinOp) and e.is_comparison:
         l, r = e.left, e.right
         if isinstance(l, Lit) and isinstance(r, Col):
@@ -520,6 +556,11 @@ def _structure_key(e: Expr, lits: list) -> tuple:
             _structure_key(e.value, lits),
             _structure_key(e.null, lits) if e.null is not None else None,
         )
+    if isinstance(e, _DictLut):
+        # The lut enters as a traced array argument: same-structure
+        # predicates over different dictionaries share the compiled fn.
+        lits.append(e.lut)
+        return ("dictlut", e.col.name.lower())
     if isinstance(e, Lit):
         lits.append(e.value)
         return ("lit",)
@@ -541,6 +582,9 @@ def _eval_with_args(e: Expr, cols: dict, lit_iter) -> object:
     (consumed in the same walk order _structure_key used)."""
     if isinstance(e, Lit):
         return next(lit_iter)
+    if isinstance(e, _DictLut):
+        lut = next(lit_iter)
+        return lut[cols[e.col.name.lower()]]
     if isinstance(e, Col):
         return cols[e.name.lower()]
     if isinstance(e, BinOp):
@@ -644,6 +688,10 @@ def _host_mask(table: ColumnTable, predicate: Expr) -> np.ndarray:
         if isinstance(e, IsNull):
             known = known_mask(e.child)
             return ~known, known  # IS NULL is never UNKNOWN
+        if isinstance(e, _DictLut):
+            v = e.lut[resolve(e.col.name)]
+            known = known_mask(e)
+            return v & known, ~v & known
         # Leaf comparison/expression: any null input makes it unknown.
         with np.errstate(all="ignore"):
             v = np.broadcast_to(np.asarray(evaluate(e, resolve, np), dtype=bool), (n_rows,))

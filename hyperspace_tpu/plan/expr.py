@@ -55,6 +55,9 @@ class Expr:
     def __truediv__(self, other):
         return BinOp("div", self, _wrap(other))
 
+    def __mod__(self, other):
+        return BinOp("mod", self, _wrap(other))
+
     def __and__(self, other):
         return And(self, _wrap(other))
 

@@ -325,3 +325,30 @@ def test_year_comparison_feeds_range_pruning(tmp_path):
     got = session.to_pandas(ds.filter(year(col("d")) > 2000))
     assert len(got) == 0
     assert session.last_query_stats["files_pruned"] == 8
+
+
+def test_scattered_like_over_large_dictionary(tmp_path):
+    """NOT LIKE over a near-unique string column (TPC-H Q13's o_comment
+    shape): thousands of scattered match runs must neither overflow the
+    recursive walkers nor mis-evaluate — the translation switches to a
+    dictionary lookup table."""
+    rng = np.random.default_rng(5)
+    n = 20_000
+    body = np.array([f"word{int(i):06d} text" for i in rng.integers(0, 10**6, n)], dtype=object)
+    special = rng.random(n) < 0.01
+    vals = np.where(special, "the special handling of requests", body).astype(object)
+    df = pd.DataFrame({"c": vals, "v": np.arange(n, dtype=np.int64)})
+    root = tmp_path / "lut"
+    root.mkdir()
+    pq.write_table(pa.Table.from_pandas(df, preserve_index=False), root / "p.parquet")
+    session = HyperspaceSession(system_path=str(tmp_path / "idx"), num_buckets=4)
+    ds = session.parquet(root)
+
+    got = run_both_venues(session, ds.filter(~col("c").like("%special%requests%")))
+    exp = df[~df.c.str.contains("special.*requests")]
+    assert len(got) == len(exp)
+
+    # Scattered positive match: every comment ending in '1 text'.
+    got = run_both_venues(session, ds.filter(col("c").like("%1 text")))
+    exp = df[df.c.str.endswith("1 text")]
+    assert len(got) == len(exp)
