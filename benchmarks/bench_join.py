@@ -68,8 +68,52 @@ def main(sf: float = 1.0):
             "unit": "x",
             "vs_baseline": round(speedup, 3),
         }))
+
+        _bench_broadcast(session, sf, tmp)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _bench_broadcast(session, sf: float, tmp: Path):
+    """Dimension join with NO index anywhere (the reference environment's
+    BroadcastExchange fallback, PhysicalOperatorAnalyzer.scala:46-50):
+    lineitem x part, small side probed vs both sides merge-sorted."""
+    import time
+
+    from benchmarks.datagen import cached_tpch
+    from hyperspace_tpu.config import JOIN_BROADCAST_MAX_ROWS
+
+    li_root, p_root = cached_tpch(sf=sf, tables=("lineitem", "part"))
+    li = session.parquet(li_root)
+    part = session.parquet(p_root)
+    session.disable_hyperspace()
+    q = li.select("l_partkey", "l_extendedprice").join(
+        part.select("p_partkey", "p_brand"), ["l_partkey"], ["p_partkey"]
+    )
+
+    session.conf.set(JOIN_BROADCAST_MAX_ROWS, 0)
+    n_merge = session.run(q).num_rows  # warmup
+    t0 = time.perf_counter()
+    session.run(q)
+    t_merge = time.perf_counter() - t0
+    assert session.last_query_stats["join_path"] == "single-partition"
+
+    session.conf.set(JOIN_BROADCAST_MAX_ROWS, 4_000_000)
+    n_bc = session.run(q).num_rows  # warmup
+    t0 = time.perf_counter()
+    session.run(q)
+    t_bc = time.perf_counter() - t0
+    assert session.last_query_stats["join_path"] == "broadcast-hash"
+    assert n_bc == n_merge, (n_bc, n_merge)
+
+    sp = t_merge / t_bc
+    log(f"broadcast {t_bc:.2f}s  merge {t_merge:.2f}s  rows={n_bc}")
+    print(json.dumps({
+        "metric": "broadcast_dimension_join_speedup",
+        "value": round(sp, 3),
+        "unit": "x",
+        "vs_baseline": round(sp, 3),
+    }))
 
 
 if __name__ == "__main__":

@@ -204,8 +204,12 @@ def gen_time_dim(root: Path) -> int:
     return _parts(t, root, 1)
 
 
+def ca_rows(sf: float) -> int:
+    return max(int(CA_SF1_ROWS * max(sf, 0.02)), 100)
+
+
 def gen_customer_address(root: Path, sf: float = 1.0, seed: int = 62) -> int:
-    n = max(int(CA_SF1_ROWS * max(sf, 0.02)), 100)
+    n = ca_rows(sf)
     rng = np.random.default_rng(seed)
     t = pa.table(
         {
@@ -228,7 +232,7 @@ def gen_store_sales(root: Path, sf: float = 1.0, seed: int = 60, files: int = 8,
     lo = DD_SK0 + int((np.datetime64("1998-01-01") - np.datetime64("1900-01-02")) // np.timedelta64(1, "D"))
     hi = DD_SK0 + int((np.datetime64("2002-12-31") - np.datetime64("1900-01-02")) // np.timedelta64(1, "D"))
     n_items = n_items if n_items is not None else item_rows(sf)
-    n_ca = n_ca if n_ca is not None else max(int(CA_SF1_ROWS * max(sf, 0.02)), 100)
+    n_ca = n_ca if n_ca is not None else ca_rows(sf)
     quantity = rng.integers(1, 101, n).astype(np.int32)
     list_price = np.round(rng.random(n) * 190 + 10, 2)
     sales_price = np.round(list_price * (0.2 + rng.random(n) * 0.8), 2)

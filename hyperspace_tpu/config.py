@@ -39,6 +39,12 @@ BUILD_VENUE = "hyperspace.build.venue"
 AGG_VENUE = "hyperspace.agg.venue"
 SORT_VENUE = "hyperspace.sort.venue"
 FILTER_VENUE = "hyperspace.filter.venue"
+# Broadcast hash join: a non-aligned join whose smaller side has at most
+# this many rows (and is at least 4x smaller than the other) probes the
+# large side against the sorted small side instead of sorting both for a
+# merge (the analog of Spark's BroadcastExchange fallback the reference
+# environment counts, PhysicalOperatorAnalyzer.scala:46-50). 0 disables.
+JOIN_BROADCAST_MAX_ROWS = "hyperspace.join.broadcast.maxRows"
 
 # Directory-layout constants (reference index/IndexConstants.scala:38-39).
 HYPERSPACE_LOG_DIR = "_hyperspace_log"
@@ -51,6 +57,7 @@ DEFAULT_HYBRID_SCAN_MAX_APPENDED_RATIO = 0.3
 DEFAULT_BUILD_MEMORY_BUDGET = 4 << 30
 DEFAULT_JOIN_VENUE = "auto"
 DEFAULT_JOIN_VENUE_MIN_MBPS = 200.0
+DEFAULT_JOIN_BROADCAST_MAX_ROWS = 4_000_000
 
 
 @dataclasses.dataclass
@@ -70,6 +77,7 @@ class HyperspaceConf:
     agg_venue: str = DEFAULT_JOIN_VENUE
     sort_venue: str = DEFAULT_JOIN_VENUE
     filter_venue: str = DEFAULT_JOIN_VENUE
+    join_broadcast_max_rows: int = DEFAULT_JOIN_BROADCAST_MAX_ROWS
     overrides: dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def __post_init__(self):
@@ -104,6 +112,8 @@ class HyperspaceConf:
             self.sort_venue = str(value)
         elif key == FILTER_VENUE:
             self.filter_venue = str(value)
+        elif key == JOIN_BROADCAST_MAX_ROWS:
+            self.join_broadcast_max_rows = int(value)
 
     def get(self, key: str, default: Any = None) -> Any:
         if key in self.overrides:
@@ -134,4 +144,6 @@ class HyperspaceConf:
             return self.sort_venue
         if key == FILTER_VENUE:
             return self.filter_venue
+        if key == JOIN_BROADCAST_MAX_ROWS:
+            return self.join_broadcast_max_rows
         return default

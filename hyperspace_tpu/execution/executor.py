@@ -838,8 +838,11 @@ class Executor:
             out = self._aligned_join(plan, left_side, right_side, lside, rside)
         else:
             out = self._partition_join(plan, lside, rside)
+        if self.stats["join_kernel"] == "host-broadcast-hash":
+            path = "broadcast-hash"
+            self.stats["join_path"] = path
         self._phys(
-            "SortMergeJoin",
+            "BroadcastHashJoin" if path == "broadcast-hash" else "SortMergeJoin",
             path=path,
             kernel=self.stats["join_kernel"],
             buckets=self.stats["num_buckets"],
@@ -1342,7 +1345,12 @@ class Executor:
 
     def _match_pairs(self, plan: Join, lside: "SideData", rside: "SideData"):
         """(lidx, ridx) global match row indices of the equi-join, from the
-        venue-selected merge kernel over bucket-sorted key codes."""
+        venue-selected merge kernel over bucket-sorted key codes. A
+        heavily asymmetric single-partition join takes the broadcast hash
+        path instead: only the small side is sorted, the large side
+        probes it — the analog of Spark's BroadcastExchange fallback the
+        reference environment supplies for small sides
+        (PhysicalOperatorAnalyzer.scala:46-50)."""
         lt, rt = lside.table, rside.table
         lkeys = [lt.schema.field(c).name for c in plan.left_on]
         rkeys = [rt.schema.field(c).name for c in plan.right_on]
@@ -1350,6 +1358,14 @@ class Executor:
         # Shared order-preserving factorization of the key tuples.
         lc, rc = _factorize_keys([lt], [rt], lkeys, rkeys)
         lcodes, rcodes = lc[0], rc[0]
+
+        b0 = len(lside.offsets) - 1
+        if b0 == 1 and self._should_broadcast(lt.num_rows, rt.num_rows):
+            res = _broadcast_probe(lcodes, rcodes)
+            if res is not None:
+                self.stats["num_buckets"] = 1
+                self.stats["join_kernel"] = "host-broadcast-hash"
+                return res
 
         lcodes, lperm = _bucket_sorted_codes(lcodes, lside)
         rcodes, rperm = _bucket_sorted_codes(rcodes, rside)
@@ -1393,6 +1409,20 @@ class Executor:
         if rperm is not None:
             ridx = rperm[ridx]
         return lidx, ridx
+
+    def _should_broadcast(self, n_l: int, n_r: int) -> bool:
+        """Small-enough and asymmetric-enough for the broadcast probe."""
+        from hyperspace_tpu.config import DEFAULT_JOIN_BROADCAST_MAX_ROWS
+
+        cap = (
+            self.conf.join_broadcast_max_rows
+            if self.conf is not None
+            else DEFAULT_JOIN_BROADCAST_MAX_ROWS
+        )
+        if cap <= 0:
+            return False
+        small, large = min(n_l, n_r), max(n_l, n_r)
+        return 0 < small <= cap and large >= 4 * small
 
     def _gather_pairs(
         self, plan: Join, lt: ColumnTable, rt: ColumnTable, lidx, ridx
@@ -1443,6 +1473,57 @@ class Executor:
             else:
                 _null_field(f, sub.num_rows, lt, cols, dicts, val)
         return ColumnTable(plan.schema, cols, dicts, val)
+
+
+def _broadcast_probe(lcodes: np.ndarray, rcodes: np.ndarray):
+    """Match pairs via a broadcast hash table: the smaller side builds a
+    dense code -> (start, count) table, every large-side row probes it
+    with ONE vectorized gather (no binary search — random-access
+    searchsorted over millions of probes is ~10x slower than a
+    cache-resident table), and duplicate runs expand vectorized. The
+    large side is never sorted. Null codes are side-distinct negatives
+    and never match. Returns None when the shared code space is too
+    sparse for a table (caller falls back to the merge kernel); else
+    (lidx, ridx) in the merge path's contract."""
+    swap = len(lcodes) < len(rcodes)
+    build, probe = (lcodes, rcodes) if swap else (rcodes, lcodes)
+    top = 0
+    if len(build):
+        top = max(top, int(build.max()) + 1)
+    if len(probe):
+        top = max(top, int(probe.max()) + 1)
+    if top == 0:
+        # Every key on both sides is null-coded: no row can match.
+        empty = np.zeros(0, dtype=np.int64)
+        return empty, empty
+    if top > 8 * len(build) + 65_536:
+        return None  # sparse code space: the table would dwarf the side
+    bvalid = build >= 0
+    counts = np.bincount(build[bvalid], minlength=top)
+    starts = np.concatenate([[0], np.cumsum(counts[:-1])]) if top else np.zeros(0, np.int64)
+    order = np.argsort(build, kind="stable")  # null codes sort first
+    nneg = int((~bvalid).sum())
+    pvalid = probe >= 0
+    pc = np.where(pvalid, probe, 0)
+    cnt = np.where(pvalid, counts[pc], 0)
+    lo = nneg + starts[pc]
+    if not counts.size or counts.max() <= 1:
+        # Unique build keys (the normal dimension-table case): each probe
+        # row matches 0 or 1 build rows — no run expansion at all.
+        matched = cnt > 0
+        probe_idx = np.flatnonzero(matched)
+        build_idx = order[lo[matched]]
+        if swap:
+            return build_idx, probe_idx
+        return probe_idx, build_idx
+    total = int(cnt.sum())
+    probe_idx = np.repeat(np.arange(len(probe), dtype=np.int64), cnt)
+    run_starts = np.cumsum(cnt) - cnt
+    within = np.arange(total, dtype=np.int64) - np.repeat(run_starts, cnt)
+    build_idx = order[np.repeat(lo, cnt) + within]
+    if swap:
+        return build_idx, probe_idx  # build side is the LEFT input
+    return probe_idx, build_idx
 
 
 def _copy_field(out_f, src: ColumnTable, src_name: str, cols, dicts, val) -> None:
@@ -1520,20 +1601,25 @@ def _factorize_keys(ltables, rtables, lkeys, rkeys):
     lnulls = [_key_null_mask(t, lkeys) for t in ltables]
     rnulls = [_key_null_mask(t, rkeys) for t in rtables]
     has_nulls = any(m is not None for m in lnulls + rnulls)
-    # Fast path: a single integer key whose values already fit int32 needs
-    # no ranking at all — the raw values ARE order-preserving codes.
-    # (Skipped with nulls: raw values could collide with the null codes.)
+    # Fast path: a single integer key whose value SPAN fits int32 needs no
+    # ranking — values shifted by the minimum are order-preserving codes.
+    # Codes are NON-NEGATIVE by construction, so a negative code always
+    # means a null-keyed row (the invariant _broadcast_probe and the
+    # null-code scheme below rely on). (Skipped with nulls: raw values
+    # could collide with the null codes.)
     if len(lkeys) == 1 and not has_nulls:
         lvals = [_logical_key(t, lkeys[0]) for t in ltables]
         rvals = [_logical_key(t, rkeys[0]) for t in rtables]
         if all(np.issubdtype(v.dtype, np.integer) for v in lvals + rvals):
             lo = min((int(v.min()) for v in lvals + rvals if len(v)), default=0)
             hi = max((int(v.max()) for v in lvals + rvals if len(v)), default=0)
-            # Strictly below int32 max: the sentinel pad must sort last.
-            if lo >= np.iinfo(np.int32).min and hi < np.iinfo(np.int32).max:
+            # Span strictly below int32 max: the sentinel pad must still
+            # sort last after the shift.
+            if hi - lo < np.iinfo(np.int32).max - 1:
+                shift = np.int64(lo)
                 return (
-                    [v.astype(np.int32) for v in lvals],
-                    [v.astype(np.int32) for v in rvals],
+                    [(v.astype(np.int64) - shift).astype(np.int32) for v in lvals],
+                    [(v.astype(np.int64) - shift).astype(np.int32) for v in rvals],
                 )
 
     per_col_codes_l: list[list[np.ndarray]] = [[] for _ in ltables]

