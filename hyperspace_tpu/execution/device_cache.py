@@ -1,0 +1,148 @@
+"""Device-resident array cache: the HBM half of the bucketed columnar
+container (SURVEY.md §2.3).
+
+Decoded index tables are host-cached and FROZEN (execution/io.py); their
+arrays are therefore identity-stable for as long as they live. This
+module keys derived artifacts on that identity — `(id(base), variant)` —
+while holding a reference to the base array so the id can never be
+recycled underneath an entry. Refresh/rebuild produces new host arrays
+with new ids, so invalidation is automatic; eviction is LRU under a byte
+budget.
+
+Two instances cover the read hot path:
+- DEVICE_CACHE: uploaded (padded, optionally sharded) `jax.Array`s —
+  repeat queries over the same index version serve straight from HBM
+  instead of re-staging over PCIe/the tunnel;
+- HOST_DERIVED: host-side derived arrays (order-preserving 64-bit key
+  words, join key codes, bucket-major pads) that would otherwise be
+  recomputed per query. Entries are frozen on insert so they are
+  themselves valid cache bases.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+
+class RefCache:
+    """Identity-keyed LRU memo with a byte budget. Entries hold strong
+    references to their base arrays, so id()-based keys stay valid for
+    the lifetime of the entry."""
+
+    def __init__(self, budget_bytes: int):
+        self.budget = int(budget_bytes)
+        self._lock = threading.Lock()
+        self._entries: dict[tuple, tuple[int, tuple, object]] = {}
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+
+    def get_or_build(self, key: tuple, base_refs: tuple, build):
+        """`build() -> (value, nbytes)`; value cached under `key` while
+        `base_refs` are pinned."""
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is not None:
+                self._entries[key] = self._entries.pop(key)  # LRU touch
+                self.hits += 1
+                return hit[2]
+            self.misses += 1
+        value, nbytes = build()
+        with self._lock:
+            if nbytes <= self.budget // 4 and key not in self._entries:
+                self._entries[key] = (nbytes, base_refs, value)
+                self._bytes += nbytes
+                while self._bytes > self.budget and self._entries:
+                    k = next(iter(self._entries))
+                    nb, _, _ = self._entries.pop(k)
+                    self._bytes -= nb
+        return value
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes": self._bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+            }
+
+
+DEVICE_CACHE = RefCache(int(os.environ.get("HYPERSPACE_DEVICE_CACHE_BYTES", 2 << 30)))
+HOST_DERIVED = RefCache(int(os.environ.get("HYPERSPACE_DERIVED_CACHE_BYTES", 1 << 30)))
+
+
+def is_stable(arr: np.ndarray) -> bool:
+    """True when the array's identity is a valid cache key: frozen arrays
+    (decoded-table cache entries and HOST_DERIVED values) never mutate
+    and are pinned by the entry that caches against them."""
+    return isinstance(arr, np.ndarray) and not arr.flags.writeable
+
+
+def freeze(arr: np.ndarray) -> np.ndarray:
+    arr.flags.writeable = False
+    return arr
+
+
+def device_put_padded(arr: np.ndarray, n_pad: int, sharding=None):
+    """Upload `arr` padded with zeros to length n_pad (row dim), through
+    DEVICE_CACHE when the base is stable. `sharding` is a
+    jax.sharding.Sharding or None."""
+    import jax
+    import jax.numpy as jnp
+
+    def build():
+        a = arr
+        if len(a) != n_pad:
+            a = np.concatenate([a, np.zeros(n_pad - len(a), dtype=a.dtype)])
+        dev = jnp.asarray(a) if sharding is None else jax.device_put(a, sharding)
+        return dev, int(dev.nbytes)
+
+    if not is_stable(arr):
+        return build()[0]
+    skey = None
+    if sharding is not None:
+        try:
+            skey = (str(sharding.mesh.shape), str(sharding.spec))
+        except Exception:
+            skey = repr(sharding)
+    return DEVICE_CACHE.get_or_build(
+        ("pad", id(arr), n_pad, skey), (arr,), build
+    )
+
+
+def device_put_cached(arr: np.ndarray):
+    """Upload `arr` as-is, through DEVICE_CACHE when stable."""
+    import jax.numpy as jnp
+
+    def build():
+        dev = jnp.asarray(arr)
+        return dev, int(dev.nbytes)
+
+    if not is_stable(arr):
+        return build()[0]
+    return DEVICE_CACHE.get_or_build(("raw", id(arr), arr.shape), (arr,), build)
+
+
+def derived(key: tuple, base_refs: tuple, build_host):
+    """Memoize a host-derived array of stable bases; the value is frozen
+    so it can serve as a cache base itself. `build_host() -> np.ndarray`."""
+
+    def build():
+        out = build_host()
+        return freeze(out), int(out.nbytes)
+
+    return HOST_DERIVED.get_or_build(key, base_refs, build)
+
+
+def clear_all() -> None:
+    DEVICE_CACHE.clear()
+    HOST_DERIVED.clear()

@@ -927,7 +927,7 @@ class Executor:
             )
         self.stats["files_read"] += hio.table_cache_stats()["miss_files"] - before
         counts = np.array([t.num_rows for t in tables], dtype=np.int64)
-        base = ColumnTable.concat(tables)
+        base = _concat_side_cached(tables)
         offsets = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
         sorted_within = all(len(g) == 1 for g in groups)
         if side.delta is not None:
@@ -1055,11 +1055,11 @@ class Executor:
 
         lkeys = [data["left"].table.schema.field(c).name for c in join.left_on]
         rkeys = [data["right"].table.schema.field(c).name for c in join.right_on]
-        lc, rc = _factorize_keys([data["left"].table], [data["right"].table], lkeys, rkeys)
+        lc0, rc0 = _factorize_keys_cached(data["left"].table, data["right"].table, lkeys, rkeys)
         codes = {}
         perms = {}
-        codes["left"], perms["left"] = _bucket_sorted_codes(lc[0], data["left"])
-        codes["right"], perms["right"] = _bucket_sorted_codes(rc[0], data["right"])
+        codes["left"], perms["left"] = _bucket_sorted_codes(lc0, data["left"])
+        codes["right"], perms["right"] = _bucket_sorted_codes(rc0, data["right"])
         secondary = "right" if primary == "left" else "left"
 
         # Group ids on the primary table (original row order).
@@ -1323,9 +1323,9 @@ class Executor:
         lt, rt = lside.table, rside.table
         lkeys = [lt.schema.field(c).name for c in plan.left_on]
         rkeys = [rt.schema.field(c).name for c in plan.right_on]
-        lc, rc = _factorize_keys([lt], [rt], lkeys, rkeys)
-        lcodes = lc[0].astype(np.int64)
-        rcodes = rc[0].astype(np.int64)
+        lc0, rc0 = _factorize_keys_cached(lt, rt, lkeys, rkeys)
+        lcodes = lc0.astype(np.int64)
+        rcodes = rc0.astype(np.int64)
         b = len(lside.offsets) - 1
         self.stats["num_buckets"] = b
         self.stats["join_kernel"] = "host-membership-probe"
@@ -1356,8 +1356,7 @@ class Executor:
         rkeys = [rt.schema.field(c).name for c in plan.right_on]
 
         # Shared order-preserving factorization of the key tuples.
-        lc, rc = _factorize_keys([lt], [rt], lkeys, rkeys)
-        lcodes, rcodes = lc[0], rc[0]
+        lcodes, rcodes = _factorize_keys_cached(lt, rt, lkeys, rkeys)
 
         b0 = len(lside.offsets) - 1
         if b0 == 1 and self._should_broadcast(lt.num_rows, rt.num_rows):
@@ -1390,8 +1389,8 @@ class Executor:
             lidx, ridx, totals = host_res
             self.stats["join_kernel"] = "host-native-merge"
         else:
-            lk = _pad_bucket_major(lcodes, lside.offsets)
-            rk = _pad_bucket_major(rcodes, rside.offsets)
+            lk = _pad_bucket_major_cached(lcodes, lside.offsets)
+            rk = _pad_bucket_major_cached(rcodes, rside.offsets)
             if self.mesh is not None:
                 from hyperspace_tpu.parallel.mesh import mesh_for_parallelism, mesh_size
 
@@ -1567,6 +1566,76 @@ def _null_field(out_f, n: int, dict_src: ColumnTable | None, cols, dicts, val) -
     else:
         cols[out_f.name] = np.zeros(n, dtype=out_f.device_dtype)
     val[out_f.name] = np.zeros(n, dtype=bool)
+
+
+def _concat_side_cached(tables: list[ColumnTable]) -> ColumnTable:
+    """Concatenated bucket-grouped side table, memoized on the identity
+    of the per-bucket cached tables (the device plane's HBM-resident
+    container rests on this stability: frozen concat => stable codes =>
+    cached pads => cached uploads). Falls through for single groups (the
+    cached table passes through already frozen)."""
+    from hyperspace_tpu.execution import device_cache as dc
+
+    if len(tables) == 1:
+        return tables[0]
+
+    def build():
+        out = ColumnTable.concat(tables)
+        for arr in (*out.columns.values(), *out.validity.values(), *out.dictionaries.values()):
+            dc.freeze(arr)
+        # _table_nbytes counts string payloads, not just object pointers —
+        # the budget must see what the entry actually retains.
+        return out, int(hio._table_nbytes(out))
+
+    return dc.HOST_DERIVED.get_or_build(
+        ("sidecat", tuple(id(t) for t in tables)), tuple(tables), build
+    )
+
+
+def _factorize_keys_cached(lt: ColumnTable, rt: ColumnTable, lkeys, rkeys):
+    """Pairwise key factorization memoized on the IDENTITY of every input
+    it reads (key columns, dictionaries, validity) — valid only when all
+    are stable (frozen index-cache arrays). Repeat joins over the same
+    index version skip ranking entirely; codes are frozen so downstream
+    pad/upload caches can key on them. Returns (lcodes, rcodes)."""
+    from hyperspace_tpu.execution import device_cache as dc
+
+    refs: list = []
+    parts: list = []
+    for t, keys in ((lt, lkeys), (rt, rkeys)):
+        for k in keys:
+            f = t.schema.field(k)
+            for a in (t.columns[f.name], t.dictionaries.get(f.name), t.validity.get(f.name)):
+                if a is None:
+                    parts.append(None)
+                    continue
+                if not dc.is_stable(a):
+                    lc, rc = _factorize_keys([lt], [rt], lkeys, rkeys)
+                    return lc[0], rc[0]
+                refs.append(a)
+                parts.append(id(a))
+
+    def build():
+        lc, rc = _factorize_keys([lt], [rt], lkeys, rkeys)
+        out = (dc.freeze(lc[0]), dc.freeze(rc[0]))
+        return out, int(lc[0].nbytes + rc[0].nbytes)
+
+    return dc.HOST_DERIVED.get_or_build(("fact", tuple(parts)), tuple(refs), build)
+
+
+def _pad_bucket_major_cached(codes: np.ndarray, offsets: np.ndarray) -> np.ndarray:
+    """Bucket-major pad through the derived cache when the codes are
+    stable (index-sorted, frozen) — the [B, L] device upload then hits
+    the HBM cache too."""
+    from hyperspace_tpu.execution import device_cache as dc
+
+    if dc.is_stable(codes):
+        return dc.derived(
+            ("padbm", id(codes), offsets.tobytes()),
+            (codes,),
+            lambda: _pad_bucket_major(codes, offsets),
+        )
+    return _pad_bucket_major(codes, offsets)
 
 
 def _key_null_mask(table: ColumnTable, keys: list[str]) -> np.ndarray | None:

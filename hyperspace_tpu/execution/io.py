@@ -50,6 +50,11 @@ def clear_table_cache() -> None:
     with _cache_lock:
         _cache.clear()
         _cache_bytes = 0
+    # Device/derived caches key on the identity of (now-released) host
+    # arrays; drop them too so the pinned references don't linger.
+    from hyperspace_tpu.execution import device_cache
+
+    device_cache.clear_all()
 
 
 def table_cache_stats() -> dict:
@@ -100,11 +105,15 @@ def read_parquet_cached(files: list[str], columns: list[str] | None = None, sche
         _cache_stats["misses"] += 1
         _cache_stats["miss_files"] += len(files)
     table = read_parquet(files, columns=columns, schema=schema)
-    _freeze_table(table)
     nb = _table_nbytes(table)
     global _cache_bytes
     with _cache_lock:
         if nb <= _CACHE_BUDGET // 4:
+            # Freeze ONLY what actually enters the cache: frozen ⟺
+            # identity-stable. A table too large to cache is re-decoded
+            # per query with fresh ids — freezing it would make the
+            # device/derived caches accumulate dead never-hit entries.
+            _freeze_table(table)
             if key in _cache:
                 _cache_bytes -= _cache.pop(key)[1]
             _cache[key] = (mtimes, nb, table)

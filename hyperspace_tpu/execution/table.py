@@ -88,6 +88,14 @@ class ColumnTable:
         columns: dict[str, np.ndarray] = {}
         dictionaries: dict[str, np.ndarray] = {}
         validity: dict[str, np.ndarray] = {}
+
+        def _owned(arr: np.ndarray) -> np.ndarray:
+            """Arrow zero-copy buffers surface as READ-ONLY numpy arrays;
+            copy those so that writeable=False means exactly one thing in
+            this engine: frozen by the cache layer (identity-stable).
+            Without this, per-query scan arrays would masquerade as
+            cacheable and pile dead entries into the device cache."""
+            return arr if arr.flags.writeable else arr.copy()
         for f in schema.fields:
             arr = table.column(f.name)
             valid = None
@@ -97,7 +105,7 @@ class ColumnTable:
                         f"vector column {f.name!r} contains {arr.null_count} null "
                         "rows; null embeddings are not supported"
                     )
-                valid = np.asarray(pc.is_valid(arr).combine_chunks())
+                valid = _owned(np.asarray(pc.is_valid(arr).combine_chunks()))
                 validity[f.name] = valid
             if f.is_string:
                 values = arr.to_pandas().to_numpy(dtype=object)
@@ -120,7 +128,7 @@ class ColumnTable:
                         f"vector column {f.name!r} contains null elements"
                     )
                 flat = child.to_numpy(zero_copy_only=False)
-                columns[f.name] = (
+                columns[f.name] = _owned(
                     np.ascontiguousarray(flat).astype(np.float32, copy=False).reshape(-1, f.dim)
                 )
             else:
@@ -133,7 +141,9 @@ class ColumnTable:
                     # fill crashes on bool columns).
                     arr = pc.fill_null(arr, pa.scalar(False if f.dtype == "bool" else 0, arr.type))
                 np_arr = arr.to_numpy(zero_copy_only=False)
-                columns[f.name] = np.ascontiguousarray(np_arr).astype(f.device_dtype, copy=False)
+                columns[f.name] = _owned(
+                    np.ascontiguousarray(np_arr).astype(f.device_dtype, copy=False)
+                )
         return ColumnTable(schema, columns, dictionaries, validity)
 
     @staticmethod

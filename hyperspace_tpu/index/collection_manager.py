@@ -182,6 +182,13 @@ class CachingIndexCollectionManager(IndexCollectionManager):
 
     def clear_cache(self) -> None:
         self._cache.clear()
+        # Index mutations version the data files: uploads and derived
+        # arrays of the superseded version can never hit again — drop
+        # them instead of letting the dead working set pin HBM/host
+        # memory until LRU pressure (round-3 advisor).
+        from hyperspace_tpu.execution import device_cache
+
+        device_cache.clear_all()
 
     def get_indexes(self, states_filter=(states.ACTIVE,)) -> list[IndexLogEntry]:
         if tuple(states_filter) == (states.ACTIVE,):

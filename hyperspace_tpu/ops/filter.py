@@ -638,21 +638,39 @@ def _pow2(n: int) -> int:
 
 def _resolve_column(table: ColumnTable, name: str, memo: dict) -> np.ndarray:
     """A physical or virtual (pair-lowered hi/lo, is-null) column as a
-    host array."""
+    host array. Virtual columns derived from STABLE (frozen, cached)
+    base columns are memoized across queries — repeat filters over the
+    same index version skip the 64-bit key derivation entirely."""
+    from hyperspace_tpu.execution import device_cache as dc
+
     if _SEP not in name:
         return table.columns[table.schema.field(name).name]
     base, tag = name.split(_SEP, 1)
     if tag == "nul":
-        return ~table.valid_mask(base)
+        valid = table.valid_mask(base)
+        if dc.is_stable(valid):
+            return dc.derived(("nul", id(valid)), (valid,), lambda: ~valid)
+        return ~valid
     domain, word = tag[0], tag[1:]
+    base_arr = table.columns[table.schema.field(base).name]
     key = (base.lower(), domain)
     u = memo.get(key)
     if u is None:
-        u = _ordered_u64(table.columns[table.schema.field(base).name], domain)
+        if dc.is_stable(base_arr):
+            u = dc.derived(
+                ("u64", id(base_arr), domain), (base_arr,),
+                lambda: _ordered_u64(base_arr, domain),
+            )
+        else:
+            u = _ordered_u64(base_arr, domain)
         memo[key] = u
     if word == "hi":
-        return (u >> np.uint64(32)).astype(np.uint32)
-    return (u & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        compute = lambda: (u >> np.uint64(32)).astype(np.uint32)  # noqa: E731
+    else:
+        compute = lambda: (u & np.uint64(0xFFFFFFFF)).astype(np.uint32)  # noqa: E731
+    if dc.is_stable(u):
+        return dc.derived(("word", id(u), word), (u,), compute)
+    return compute()
 
 
 def _host_mask(table: ColumnTable, predicate: Expr) -> np.ndarray:
@@ -747,15 +765,16 @@ def eval_predicate_mask(
 
         if mesh_size(mesh) > 1 and n_pad % mesh_size(mesh) == 0:
             sharding = NamedSharding(mesh, PartitionSpec(mesh_axes(mesh)))
+    from hyperspace_tpu.execution.device_cache import device_put_padded
+
     arrays = []
     layout = []
     memo: dict = {}
     for name in names:
         arr = _resolve_column(table, name, memo)
-        if len(arr) != n_pad:
-            arr = np.concatenate([arr, np.zeros(n_pad - n, dtype=arr.dtype)])
-        dev = jnp.asarray(arr) if sharding is None else jax.device_put(arr, sharding)
-        arrays.append(dev)
+        # Stable (frozen index-cache or derived) columns upload through
+        # the device cache: repeat queries serve from HBM, no re-staging.
+        arrays.append(device_put_padded(arr, n_pad, sharding))
         layout.append((name.lower(), arr.dtype.str))
     lit_args = [np.asarray(v) for v in lits]
 

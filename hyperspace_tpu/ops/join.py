@@ -200,10 +200,14 @@ def merge_join(lkeys_np: np.ndarray, rkeys_np: np.ndarray):
     code arrays padded with their dtype's max (sentinel_for). Returns
     (li_flat, ri_flat, totals): bucket-major dense local row indices —
     bucket b's matches occupy [cumsum(totals)[b-1], cumsum(totals)[b])."""
+    from hyperspace_tpu.execution.device_cache import device_put_cached
+
     if lkeys_np.dtype.itemsize > 4 or rkeys_np.dtype.itemsize > 4:
         lkeys_np, rkeys_np = _rank_codes_to_int32(lkeys_np, rkeys_np)
-    lk = jnp.asarray(lkeys_np)
-    rk = jnp.asarray(rkeys_np)
+    # Stable (frozen index-derived) key arrays serve from the HBM cache
+    # on repeat queries — the [B, L] upload happens once per version.
+    lk = device_put_cached(lkeys_np)
+    rk = device_put_cached(rkeys_np)
     shift = pack_shift(lkeys_np.shape[1], rkeys_np.shape[1])
     shape_key = (lkeys_np.shape, rkeys_np.shape, str(lkeys_np.dtype))
 
@@ -307,6 +311,8 @@ def merge_join_sharded(lkeys_np: np.ndarray, rkeys_np: np.ndarray, mesh: Mesh):
     merge_join. The caller guarantees B % mesh_size == 0."""
     from hyperspace_tpu.parallel.mesh import mesh_axes, mesh_size
 
+    from hyperspace_tpu.execution.device_cache import device_put_cached
+
     if lkeys_np.dtype.itemsize > 4 or rkeys_np.dtype.itemsize > 4:
         lkeys_np, rkeys_np = _rank_codes_to_int32(lkeys_np, rkeys_np)
     d = mesh_size(mesh)
@@ -314,8 +320,8 @@ def merge_join_sharded(lkeys_np: np.ndarray, rkeys_np: np.ndarray, mesh: Mesh):
     if d == 1 or num_b % d != 0:
         return merge_join(lkeys_np, rkeys_np)
     axes = mesh_axes(mesh)
-    lk = jnp.asarray(lkeys_np)
-    rk = jnp.asarray(rkeys_np)
+    lk = device_put_cached(lkeys_np)
+    rk = device_put_cached(rkeys_np)
 
     totals = _make_sharded_count(mesh, axes)(lk, rk)
     totals_h = np.asarray(jax.device_get(totals))

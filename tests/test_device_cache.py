@@ -1,0 +1,147 @@
+"""HBM-resident array cache: repeat device-venue queries over the same
+index version serve uploads from the cache (no re-staging), entries pin
+their base arrays, refresh invalidates by identity, and results stay
+byte-identical with the cache cold or hot."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col
+from hyperspace_tpu.config import FILTER_VENUE, JOIN_VENUE
+from hyperspace_tpu.execution import device_cache as dc
+
+
+@pytest.fixture()
+def indexed(tmp_path):
+    rng = np.random.default_rng(31)
+    n = 30_000
+    df = pd.DataFrame(
+        {
+            "k": rng.integers(0, 5_000, n).astype(np.int32),
+            "v": rng.normal(size=n),
+        }
+    )
+    root = tmp_path / "src"
+    root.mkdir()
+    pq.write_table(pa.Table.from_pandas(df, preserve_index=False), root / "p.parquet")
+    session = HyperspaceSession(system_path=str(tmp_path / "idx"), num_buckets=4)
+    hs = Hyperspace(session)
+    ds = session.parquet(root)
+    hs.create_index(ds, IndexConfig("dc_k", ["k"], ["v"]))
+    session.enable_hyperspace()
+    dc.clear_all()
+    return session, ds, df, hs
+
+
+def test_repeat_filter_hits_device_cache(indexed):
+    """A rewritten filter with no key bounds reads whole (cached, frozen)
+    bucket files; the repeat run serves every upload from the device
+    cache and the non-rewritten raw path inserts NOTHING (per-query scan
+    arrays must never pollute the identity-keyed caches)."""
+    session, ds, df, _ = indexed
+    session.conf.set(FILTER_VENUE, "device")
+    q = ds.filter(((col("k") % 2) == 0) & (col("v") > 0.0))
+
+    first = session.to_pandas(q)
+    assert "IndexScan" in repr(session.last_physical_plan)
+    h0 = dc.DEVICE_CACHE.stats()["hits"]
+    second = session.to_pandas(q)
+    h1 = dc.DEVICE_CACHE.stats()["hits"]
+    assert h1 > h0, "repeat query did not serve uploads from the device cache"
+    pd.testing.assert_frame_equal(
+        first.sort_values(["k", "v"]).reset_index(drop=True),
+        second.sort_values(["k", "v"]).reset_index(drop=True),
+    )
+    exp = df[(df.k % 2 == 0) & (df.v > 0.0)]
+    assert len(second) == len(exp)
+
+    # Raw (unrewritten) repeat queries: fresh scan arrays are writeable,
+    # so no cache entries accrue.
+    session.disable_hyperspace()
+    session.to_pandas(q)
+    e0 = dc.DEVICE_CACHE.stats()["entries"] + dc.HOST_DERIVED.stats()["entries"]
+    session.to_pandas(q)
+    e1 = dc.DEVICE_CACHE.stats()["entries"] + dc.HOST_DERIVED.stats()["entries"]
+    assert e1 == e0, "raw scans polluted the identity-keyed caches"
+    session.enable_hyperspace()
+
+
+def test_repeat_point_lookup_hits_device_cache(indexed):
+    session, ds, df, _ = indexed
+    session.conf.set(FILTER_VENUE, "device")
+    q = ds.filter(col("k") == 1234)
+    first = session.to_pandas(q)
+    h0 = dc.DEVICE_CACHE.stats()["hits"]
+    second = session.to_pandas(q)
+    h1 = dc.DEVICE_CACHE.stats()["hits"]
+    assert h1 > h0
+    assert len(first) == len(second) == int((df.k == 1234).sum())
+
+
+def test_repeat_join_skips_factorization(tmp_path):
+    rng = np.random.default_rng(32)
+    f = pd.DataFrame({"k": rng.integers(0, 1000, 40_000).astype(np.int64), "a": rng.normal(size=40_000)})
+    d = pd.DataFrame({"k": np.arange(900, dtype=np.int64), "b": rng.normal(size=900)})
+    for nm, fr in (("f", f), ("d", d)):
+        (tmp_path / nm).mkdir()
+        pq.write_table(pa.Table.from_pandas(fr, preserve_index=False), tmp_path / nm / "p.parquet")
+    session = HyperspaceSession(system_path=str(tmp_path / "idx"), num_buckets=4)
+    hs = Hyperspace(session)
+    fs, ds = session.parquet(tmp_path / "f"), session.parquet(tmp_path / "d")
+    hs.create_index(fs, IndexConfig("fk2", ["k"], ["a"]))
+    hs.create_index(ds, IndexConfig("dk2", ["k"], ["b"]))
+    session.enable_hyperspace()
+    session.conf.set(JOIN_VENUE, "device")
+    dc.clear_all()
+
+    q = fs.join(ds, ["k"])
+    r1 = session.to_pandas(q)
+    m0 = dc.HOST_DERIVED.stats()
+    r2 = session.to_pandas(q)
+    m1 = dc.HOST_DERIVED.stats()
+    assert m1["hits"] > m0["hits"], "repeat join re-derived the key codes"
+    assert len(r1) == len(r2) == len(f.merge(d, on="k"))
+
+
+def test_derived_entries_are_frozen_and_pinned(indexed):
+    session, ds, _, _ = indexed
+    session.conf.set(FILTER_VENUE, "device")
+    session.to_pandas(ds.filter(((col("k") % 2) == 0) & (col("v") > 0.5)))
+    st = dc.HOST_DERIVED.stats()
+    # 64-bit pair lowering of the float column produced derived entries.
+    assert st["entries"] > 0
+    for key, (nb, refs, val) in list(dc.HOST_DERIVED._entries.items()):
+        if isinstance(val, np.ndarray):
+            assert not val.flags.writeable
+
+
+def test_refresh_invalidates_by_identity(indexed, tmp_path):
+    session, ds, df, hs = indexed
+    session.conf.set(FILTER_VENUE, "device")
+    q = ds.filter(col("k") == 123)
+    n1 = len(session.to_pandas(q))
+    assert n1 == int((df.k == 123).sum())
+
+    # Append rows and refresh: new version => new files => new host
+    # arrays => cache misses, fresh correct results.
+    extra = pd.DataFrame({"k": np.full(7, 123, dtype=np.int32), "v": np.zeros(7)})
+    pq.write_table(
+        pa.Table.from_pandas(extra, preserve_index=False), tmp_path / "src" / "p2.parquet"
+    )
+    hs.refresh_index("dc_k")
+    n2 = len(session.to_pandas(q))
+    assert n2 == n1 + 7
+
+
+def test_cache_budget_bounds_memory():
+    c = dc.RefCache(budget_bytes=1000)
+    base = np.arange(10)
+    base.flags.writeable = False
+    for i in range(50):
+        c.get_or_build(("x", i), (base,), lambda: (np.zeros(30), 240))
+    st = c.stats()
+    assert st["bytes"] <= 1000
+    assert st["entries"] <= 1000 // 240 + 1
