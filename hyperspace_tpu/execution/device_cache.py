@@ -65,6 +65,8 @@ class RefCache:
         with self._lock:
             self._entries.clear()
             self._bytes = 0
+            self.hits = 0
+            self.misses = 0
 
     def stats(self) -> dict:
         with self._lock:

@@ -86,17 +86,32 @@ def _bucket_sorted_codes(codes: np.ndarray, side: SideData):
     """Ensure codes are non-decreasing within each bucket. Returns
     (sorted codes, perm) where perm maps sorted positions back to the
     side's row order (None when already sorted — the index-file case,
-    verified with one vectorized pass)."""
+    verified with one vectorized pass, memoized for stable codes)."""
+    from hyperspace_tpu.execution import device_cache as dc
+
     n = len(codes)
     if n == 0:
         return codes, None
+    if side.sorted_within:
+
+        def check() -> bool:
+            counts0 = np.diff(side.offsets)
+            b_of = np.repeat(np.arange(len(counts0), dtype=np.int64), counts0)
+            d = np.diff(codes)
+            return not np.any(d[b_of[:-1] == b_of[1:]] < 0)
+
+        if dc.is_stable(codes):
+            ok = dc.HOST_DERIVED.get_or_build(
+                ("sortck", id(codes), side.offsets.tobytes()),
+                (codes,),
+                lambda: (check(), 1),
+            )
+        else:
+            ok = check()
+        if ok:
+            return codes, None
     counts = np.diff(side.offsets)
     bucket_of = np.repeat(np.arange(len(counts), dtype=np.int64), counts)
-    if side.sorted_within:
-        d = np.diff(codes)
-        same = bucket_of[:-1] == bucket_of[1:]
-        if not np.any(d[same] < 0):
-            return codes, None
     perm = np.lexsort((codes, bucket_of))  # stable; regroups identically
     return codes[perm], perm
 
@@ -1062,8 +1077,9 @@ class Executor:
         codes["right"], perms["right"] = _bucket_sorted_codes(rc0, data["right"])
         secondary = "right" if primary == "left" else "left"
 
-        # Group ids on the primary table (original row order).
-        gid_orig, k, first_idx = group_ids(data[primary].table, plan.group_by)
+        # Group ids on the primary table (original row order; memoized
+        # for stable index-backed sides).
+        gid_orig, k, first_idx = _group_ids_cached(data[primary].table, plan.group_by)
         if k == 0:  # empty primary side
             if plan.group_by:
                 return ColumnTable.empty(plan.schema)
@@ -1071,14 +1087,9 @@ class Executor:
 
         def spec_input(side: str, spec):
             """(masked values, indicator) per original row of `side` with
-            the plain aggregate path's null semantics (ops/aggregate)."""
-            tbl = data[side].table
-            vals, valid, _ = agg_input(tbl, spec)
-            vals = np.asarray(vals, dtype=np.float64)
-            if valid is not None:
-                vals = np.where(valid, vals, 0.0)
-            ind = np.ones(tbl.num_rows, np.float64) if valid is None else valid.astype(np.float64)
-            return vals, ind
+            the plain aggregate path's null semantics (ops/aggregate);
+            memoized per (expression, input identity) for stable sides."""
+            return _agg_channels_cached(data[side].table, spec)
 
         host_res = None
         if (
@@ -1222,9 +1233,20 @@ class Executor:
             else:
                 parts.append(("pri", vals if spec.fn in ("sum", "mean") else None, ind))
 
-        rvals = (
-            np.stack(sec_arrays) if sec_arrays else np.zeros((0, tbl_s.num_rows))
-        )
+        from hyperspace_tpu.execution import device_cache as dc
+
+        if sec_arrays and all(dc.is_stable(a) for a in sec_arrays):
+            # The [A, n] channel stack is a 100MB-scale memcpy per query;
+            # stable channels stack once per index version.
+            rvals = dc.derived(
+                ("stack", tuple(id(a) for a in sec_arrays)),
+                tuple(sec_arrays),
+                lambda: np.stack(sec_arrays),
+            )
+        elif sec_arrays:
+            rvals = np.stack(sec_arrays)
+        else:
+            rvals = np.zeros((0, tbl_s.num_rows))
         res = native.merge_join_accumulate(
             codes[primary], data[primary].offsets,
             codes[secondary], data[secondary].offsets, rvals,
@@ -1578,6 +1600,20 @@ def _concat_side_cached(tables: list[ColumnTable]) -> ColumnTable:
 
     if len(tables) == 1:
         return tables[0]
+    # Only identity-stable inputs may be memoized (and only then may the
+    # output be frozen): per-query tables too large for the io cache get
+    # fresh ids every time — caching against those would pile dead pinned
+    # entries, and freezing their concat would let every downstream cache
+    # mistake per-query arrays for stable ones.
+    stable = all(
+        all(
+            dc.is_stable(a)
+            for a in (*t.columns.values(), *t.validity.values(), *t.dictionaries.values())
+        )
+        for t in tables
+    )
+    if not stable:
+        return ColumnTable.concat(tables)
 
     def build():
         out = ColumnTable.concat(tables)
@@ -1592,6 +1628,80 @@ def _concat_side_cached(tables: list[ColumnTable]) -> ColumnTable:
     )
 
 
+def _stable_table_refs(table: ColumnTable, names: set[str]):
+    """(refs, id-parts) over every array the named columns touch (data,
+    dictionary, validity), or (None, None) when any is unstable."""
+    from hyperspace_tpu.execution import device_cache as dc
+
+    refs: list = []
+    parts: list = []
+    for nm in sorted(names):
+        f = table.schema.field(nm)
+        for a in (table.columns[f.name], table.dictionaries.get(f.name), table.validity.get(f.name)):
+            if a is None:
+                parts.append(None)
+                continue
+            if not dc.is_stable(a):
+                return None, None
+            refs.append(a)
+            parts.append(id(a))
+    return tuple(refs), tuple(parts)
+
+
+def _group_ids_cached(table: ColumnTable, group_by: list[str]):
+    """group_ids memoized on the identity of the (stable) group-key
+    arrays — repeat aggregations over the same index version skip the
+    factorization of millions of keys."""
+    from hyperspace_tpu.execution import device_cache as dc
+    from hyperspace_tpu.ops.aggregate import group_ids
+
+    if not group_by:
+        return group_ids(table, group_by)
+    refs, parts = _stable_table_refs(table, {c.lower() for c in group_by})
+    if refs is None:
+        return group_ids(table, group_by)
+
+    def build():
+        gid, k, first = group_ids(table, group_by)
+        dc.freeze(gid)
+        dc.freeze(first)
+        return (gid, k, first), int(gid.nbytes + first.nbytes)
+
+    return dc.HOST_DERIVED.get_or_build(
+        ("gid", tuple(c.lower() for c in group_by), parts), refs, build
+    )
+
+
+def _agg_channels_cached(tbl: ColumnTable, spec):
+    """(masked values, indicator) channels for one AggSpec, memoized per
+    (expression, input identity) for stable tables."""
+    import json
+
+    from hyperspace_tpu.execution import device_cache as dc
+    from hyperspace_tpu.ops.aggregate import agg_input
+
+    def raw():
+        vals, valid, _ = agg_input(tbl, spec)
+        vals = np.asarray(vals, dtype=np.float64)
+        if valid is not None:
+            vals = np.where(valid, vals, 0.0)
+        ind = np.ones(tbl.num_rows, np.float64) if valid is None else valid.astype(np.float64)
+        return vals, ind
+
+    refs, parts = _stable_table_refs(tbl, {r.lower() for r in spec.references()})
+    if not refs:  # unstable or constant expression: no identity to key on
+        return raw()
+    key = ("aggin", json.dumps(spec.expr.to_json(), sort_keys=True), parts)
+
+    def build():
+        vals, ind = raw()
+        dc.freeze(vals)
+        dc.freeze(ind)
+        return (vals, ind), int(vals.nbytes + ind.nbytes)
+
+    return dc.HOST_DERIVED.get_or_build(key, refs, build)
+
+
 def _factorize_keys_cached(lt: ColumnTable, rt: ColumnTable, lkeys, rkeys):
     """Pairwise key factorization memoized on the IDENTITY of every input
     it reads (key columns, dictionaries, validity) — valid only when all
@@ -1600,27 +1710,20 @@ def _factorize_keys_cached(lt: ColumnTable, rt: ColumnTable, lkeys, rkeys):
     pad/upload caches can key on them. Returns (lcodes, rcodes)."""
     from hyperspace_tpu.execution import device_cache as dc
 
-    refs: list = []
-    parts: list = []
-    for t, keys in ((lt, lkeys), (rt, rkeys)):
-        for k in keys:
-            f = t.schema.field(k)
-            for a in (t.columns[f.name], t.dictionaries.get(f.name), t.validity.get(f.name)):
-                if a is None:
-                    parts.append(None)
-                    continue
-                if not dc.is_stable(a):
-                    lc, rc = _factorize_keys([lt], [rt], lkeys, rkeys)
-                    return lc[0], rc[0]
-                refs.append(a)
-                parts.append(id(a))
+    lrefs, lparts = _stable_table_refs(lt, {k.lower() for k in lkeys})
+    rrefs, rparts = _stable_table_refs(rt, {k.lower() for k in rkeys})
+    if lrefs is None or rrefs is None:
+        lc, rc = _factorize_keys([lt], [rt], lkeys, rkeys)
+        return lc[0], rc[0]
+    refs = lrefs + rrefs
+    parts = (lparts, rparts)
 
     def build():
         lc, rc = _factorize_keys([lt], [rt], lkeys, rkeys)
         out = (dc.freeze(lc[0]), dc.freeze(rc[0]))
         return out, int(lc[0].nbytes + rc[0].nbytes)
 
-    return dc.HOST_DERIVED.get_or_build(("fact", tuple(parts)), tuple(refs), build)
+    return dc.HOST_DERIVED.get_or_build(("fact", parts), refs, build)
 
 
 def _pad_bucket_major_cached(codes: np.ndarray, offsets: np.ndarray) -> np.ndarray:

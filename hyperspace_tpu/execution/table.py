@@ -94,7 +94,11 @@ class ColumnTable:
             copy those so that writeable=False means exactly one thing in
             this engine: frozen by the cache layer (identity-stable).
             Without this, per-query scan arrays would masquerade as
-            cacheable and pile dead entries into the device cache."""
+            cacheable and pile dead entries into the device cache. The
+            copy only triggers for single-chunk null-free columns (the
+            zero-copy case) and is small next to the parquet decode that
+            produced them — a deliberate trade for an airtight stability
+            invariant."""
             return arr if arr.flags.writeable else arr.copy()
         for f in schema.fields:
             arr = table.column(f.name)
