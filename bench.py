@@ -72,7 +72,7 @@ def main():
         # ---- SF1 build --------------------------------------------------
         session, hs, df, sel_bytes, build_s = build_once(tmp / "indexes", li_root, 200)
         gbps = sel_bytes / 1e9 / build_s
-        log(f"build sf=1:   {build_s:.2f}s -> {gbps:.3f} GB/s/chip (selected cols, 6,001,215 rows)")
+        log(f"build sf=1:   {build_s:.2f}s -> {gbps:.3f} GB/s/chip (selected cols, ~6.0M rows)")
 
         # ---- point lookups ---------------------------------------------
         rng = np.random.default_rng(7)

@@ -1,0 +1,85 @@
+"""Index-build throughput curve: GB/s/chip at SF0.1 / SF1 (and SF10 with
+an argument), through BOTH build paths — in-memory (source fits the
+budget) and streaming out-of-core (budget deliberately capped below the
+source, so the row-group chunk pipeline with spill runs). Emits one JSON
+line with the streaming GB/s at the largest scale and the full curve;
+the gate is streaming staying within 2x of in-memory (the out-of-core
+path must not fall off a cliff — CreateActionBase.scala:99-120 builds
+from any-size sources via Spark's shuffle)."""
+
+from __future__ import annotations
+
+import json
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+from benchmarks.harness import log  # noqa: E402
+
+INDEXED = ["l_orderkey"]
+INCLUDED = ["l_partkey", "l_quantity", "l_extendedprice", "l_discount"]
+
+
+def _build(tmp: Path, data_root: Path, tag: str, budget: int | None):
+    from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig
+    from hyperspace_tpu.config import INDEX_BUILD_MEMORY_BUDGET
+    from hyperspace_tpu.dataset import list_data_files
+    from hyperspace_tpu.execution import io as hio
+
+    session = HyperspaceSession(system_path=str(tmp / f"idx_{tag}"), num_buckets=64)
+    if budget is not None:
+        session.conf.set(INDEX_BUILD_MEMORY_BUDGET, budget)
+    hs = Hyperspace(session)
+    df = session.parquet(data_root)
+    files = [fi.path for fi in list_data_files(data_root)]
+    sel_bytes = hio.estimate_uncompressed_bytes(files, INDEXED + INCLUDED)
+    t0 = time.perf_counter()
+    hs.create_index(df, IndexConfig(f"bb_{tag}", INDEXED, INCLUDED))
+    dt = time.perf_counter() - t0
+    return sel_bytes, dt
+
+
+def main(sfs=(0.1, 1.0)):
+    from benchmarks.datagen import cached_tpch
+
+    tmp = Path(tempfile.mkdtemp(prefix="hs_build_"))
+    curve = []
+    try:
+        for sf in sfs:
+            (li_root,) = cached_tpch(sf=sf, tables=("lineitem",))
+            sel, t_mem = _build(tmp, li_root, f"mem{sf:g}", budget=None)
+            # Streaming: cap the budget to ~1/8 of the source so the
+            # chunked out-of-core path (spill + budget-bounded phase 2)
+            # is what actually runs.
+            budget = max(sel // 8, 64 << 20)
+            _, t_stream = _build(tmp, li_root, f"str{sf:g}", budget=budget)
+            point = {
+                "sf": sf,
+                "selected_gb": round(sel / 1e9, 3),
+                "inmem_gbps": round(sel / 1e9 / t_mem, 4),
+                "stream_gbps": round(sel / 1e9 / t_stream, 4),
+                "stream_budget_mb": budget >> 20,
+                "stream_over_inmem": round(t_mem / t_stream, 3),
+            }
+            curve.append(point)
+            log(f"sf={sf:g}: in-mem {t_mem:.2f}s ({point['inmem_gbps']} GB/s)  "
+                f"streaming {t_stream:.2f}s ({point['stream_gbps']} GB/s, "
+                f"budget {budget >> 20} MB)")
+        last = curve[-1]
+        print(json.dumps({
+            "metric": "index_build_streaming_gbps",
+            "value": last["stream_gbps"],
+            "unit": "GB/s/chip",
+            "vs_baseline": round(last["stream_gbps"] / max(last["inmem_gbps"], 1e-9), 3),
+            "curve": curve,
+        }))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sfs = [float(a) for a in sys.argv[1:]] or [0.1, 1.0]
+    main(tuple(sfs))

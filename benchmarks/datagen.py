@@ -93,92 +93,111 @@ def _write_parts(t: "pa.Table", root: Path, files: int) -> None:
 
 
 def gen_tpch_lineitem(
-    root: Path, sf: float = 1.0, seed: int = 42, files: int = 8
+    root: Path, sf: float = 1.0, seed: int = 42, files: int | None = None
 ) -> int:
     """TPC-H-faithful lineitem: full 16-column schema (ints, decimals as
     float64, 1-char flags, dates, mode/instruction strings, comments),
-    SF1 row count 6,001,215, ~4 lines per order. Synthetic value
-    distributions (no dbgen), deterministic under the seed; returns
-    in-memory byte size."""
-    n = int(TPCH_SF1_LINEITEM_ROWS * sf)
+    ~4 lines per order (SF1 ≈ 6.0M rows). Generated CHUNK BY CHUNK —
+    each file covers a contiguous order range with its own derived seed
+    — so peak memory stays one chunk regardless of scale factor (SF10+
+    would not fit a full-table build). Deterministic under the seed;
+    returns total in-memory byte size."""
     n_orders = int(TPCH_SF1_ORDERS_ROWS * sf)
-    rng = np.random.default_rng(seed)
-    # ~4 lines per order: repeat each orderkey a random 1-7 times.
-    orderkey = np.repeat(
-        np.arange(n_orders, dtype=np.int64), rng.integers(1, 8, n_orders)
-    )[:n]
-    orderkey = np.concatenate(
-        [orderkey, rng.integers(0, n_orders, max(0, n - len(orderkey))).astype(np.int64)]
-    )[:n]
-    m = len(orderkey)
-    linenumber = np.ones(m, dtype=np.int32)
-    shipdate = (
-        _EPOCH_1992 + rng.integers(0, _DATE_SPAN, m) + rng.integers(1, 122, m)
-    ).astype(np.int32)
-    quantity = rng.integers(1, 51, m).astype(np.float64)
-    extendedprice = np.round(quantity * (900 + rng.random(m) * 100_000) / 100, 2)
-    comments = np.char.add(
-        np.char.add(
-            _SHIPMODE[rng.integers(0, len(_SHIPMODE), m)].astype(str), " carefully "
-        ),
-        _SHIPINSTRUCT[rng.integers(0, len(_SHIPINSTRUCT), m)].astype(str),
-    )
-    t = pa.table(
-        {
-            "l_orderkey": orderkey,
-            "l_partkey": rng.integers(0, int(200_000 * max(sf, 0.01)), m).astype(np.int64),
-            "l_suppkey": rng.integers(0, int(10_000 * max(sf, 0.01)), m).astype(np.int64),
-            "l_linenumber": linenumber,
-            "l_quantity": quantity,
-            "l_extendedprice": extendedprice,
-            "l_discount": np.round(rng.integers(0, 11, m) / 100.0, 2),
-            "l_tax": np.round(rng.integers(0, 9, m) / 100.0, 2),
-            "l_returnflag": pa.array(_RETURNFLAGS[rng.integers(0, 3, m)]),
-            "l_linestatus": pa.array(_LINESTATUS[(shipdate > _EPOCH_1992 + 1260).astype(int)]),
-            "l_shipdate": pa.array(shipdate, type=pa.date32()),
-            "l_commitdate": pa.array(shipdate + rng.integers(-30, 31, m).astype(np.int32), type=pa.date32()),
-            "l_receiptdate": pa.array(shipdate + rng.integers(1, 31, m).astype(np.int32), type=pa.date32()),
-            "l_shipinstruct": pa.array(_SHIPINSTRUCT[rng.integers(0, 4, m)]),
-            "l_shipmode": pa.array(_SHIPMODE[rng.integers(0, 7, m)]),
-            "l_comment": pa.array(comments.astype(object)),
-        }
-    )
-    _write_parts(t, root, files)
-    return t.nbytes
+    if files is None:
+        files = max(8, int(round(8 * sf)))
+    root.mkdir(parents=True, exist_ok=True)
+    per_orders = (n_orders + files - 1) // files
+    total = 0
+    for i in range(files):
+        o0, o1 = i * per_orders, min((i + 1) * per_orders, n_orders)
+        if o0 >= o1:
+            break
+        rng = np.random.default_rng(seed + 7919 * i)
+        # ~4 lines per order: repeat each orderkey a random 1-7 times.
+        orderkey = np.repeat(
+            np.arange(o0, o1, dtype=np.int64), rng.integers(1, 8, o1 - o0)
+        )
+        m = len(orderkey)
+        shipdate = (
+            _EPOCH_1992 + rng.integers(0, _DATE_SPAN, m) + rng.integers(1, 122, m)
+        ).astype(np.int32)
+        quantity = rng.integers(1, 51, m).astype(np.float64)
+        extendedprice = np.round(quantity * (900 + rng.random(m) * 100_000) / 100, 2)
+        comments = np.char.add(
+            np.char.add(
+                _SHIPMODE[rng.integers(0, len(_SHIPMODE), m)].astype(str), " carefully "
+            ),
+            _SHIPINSTRUCT[rng.integers(0, 4, m)].astype(str),
+        )
+        t = pa.table(
+            {
+                "l_orderkey": orderkey,
+                "l_partkey": rng.integers(0, int(200_000 * max(sf, 0.01)), m).astype(np.int64),
+                "l_suppkey": rng.integers(0, int(10_000 * max(sf, 0.01)), m).astype(np.int64),
+                "l_linenumber": np.ones(m, dtype=np.int32),
+                "l_quantity": quantity,
+                "l_extendedprice": extendedprice,
+                "l_discount": np.round(rng.integers(0, 11, m) / 100.0, 2),
+                "l_tax": np.round(rng.integers(0, 9, m) / 100.0, 2),
+                "l_returnflag": pa.array(_RETURNFLAGS[rng.integers(0, 3, m)]),
+                "l_linestatus": pa.array(_LINESTATUS[(shipdate > _EPOCH_1992 + 1260).astype(int)]),
+                "l_shipdate": pa.array(shipdate, type=pa.date32()),
+                "l_commitdate": pa.array(shipdate + rng.integers(-30, 31, m).astype(np.int32), type=pa.date32()),
+                "l_receiptdate": pa.array(shipdate + rng.integers(1, 31, m).astype(np.int32), type=pa.date32()),
+                "l_shipinstruct": pa.array(_SHIPINSTRUCT[rng.integers(0, 4, m)]),
+                "l_shipmode": pa.array(_SHIPMODE[rng.integers(0, 7, m)]),
+                "l_comment": pa.array(comments.astype(object)),
+            }
+        )
+        pq.write_table(t, root / f"part-{i}.parquet", row_group_size=262_144)
+        total += t.nbytes
+    return total
 
 
-def gen_tpch_orders(root: Path, sf: float = 1.0, seed: int = 43, files: int = 4) -> int:
-    """TPC-H-faithful orders (9 columns, SF1 = 1.5M rows)."""
+def gen_tpch_orders(root: Path, sf: float = 1.0, seed: int = 43, files: int | None = None) -> int:
+    """TPC-H-faithful orders (9 columns, SF1 = 1.5M rows), generated
+    chunk by chunk like lineitem."""
     n = int(TPCH_SF1_ORDERS_ROWS * sf)
-    rng = np.random.default_rng(seed)
-    orderdate = (_EPOCH_1992 + rng.integers(0, _DATE_SPAN, n)).astype(np.int32)
-    t = pa.table(
-        {
-            "o_orderkey": np.arange(n, dtype=np.int64),
-            "o_custkey": rng.integers(0, n // 10 + 1, n).astype(np.int64),
-            "o_orderstatus": pa.array(_ORDERSTATUS[rng.integers(0, 3, n)]),
-            "o_totalprice": np.round(rng.random(n) * 500_000, 2),
-            "o_orderdate": pa.array(orderdate, type=pa.date32()),
-            "o_orderpriority": pa.array(_ORDERPRIORITY[rng.integers(0, 5, n)]),
-            "o_clerk": pa.array(
-                np.char.add("Clerk#", rng.integers(1, 1001, n).astype("U6")).astype(object)
-            ),
-            "o_shippriority": np.zeros(n, dtype=np.int32),
-            # ~1.2% of comments match Q13's '%special%requests%' exclusion.
-            "o_comment": pa.array(
-                np.where(
-                    rng.random(n) < 0.012,
-                    "the special packages wake furiously among the requests",
-                    np.char.add(
-                        _ORDERPRIORITY[rng.integers(0, 5, n)].astype(str),
-                        " instructions sleep quickly",
-                    ).astype(object),
-                ).astype(object)
-            ),
-        }
-    )
-    _write_parts(t, root, files)
-    return t.nbytes
+    if files is None:
+        files = max(4, int(round(4 * sf)))
+    root.mkdir(parents=True, exist_ok=True)
+    per = (n + files - 1) // files
+    total = 0
+    for i in range(files):
+        k0, k1 = i * per, min((i + 1) * per, n)
+        if k0 >= k1:
+            break
+        rng = np.random.default_rng(seed + 7919 * i)
+        m = k1 - k0
+        orderdate = (_EPOCH_1992 + rng.integers(0, _DATE_SPAN, m)).astype(np.int32)
+        t = pa.table(
+            {
+                "o_orderkey": np.arange(k0, k1, dtype=np.int64),
+                "o_custkey": rng.integers(0, n // 10 + 1, m).astype(np.int64),
+                "o_orderstatus": pa.array(_ORDERSTATUS[rng.integers(0, 3, m)]),
+                "o_totalprice": np.round(rng.random(m) * 500_000, 2),
+                "o_orderdate": pa.array(orderdate, type=pa.date32()),
+                "o_orderpriority": pa.array(_ORDERPRIORITY[rng.integers(0, 5, m)]),
+                "o_clerk": pa.array(
+                    np.char.add("Clerk#", rng.integers(1, 1001, m).astype("U6")).astype(object)
+                ),
+                "o_shippriority": np.zeros(m, dtype=np.int32),
+                # ~1.2% of comments match Q13's '%special%requests%' exclusion.
+                "o_comment": pa.array(
+                    np.where(
+                        rng.random(m) < 0.012,
+                        "the special packages wake furiously among the requests",
+                        np.char.add(
+                            _ORDERPRIORITY[rng.integers(0, 5, m)].astype(str),
+                            " instructions sleep quickly",
+                        ).astype(object),
+                    ).astype(object)
+                ),
+            }
+        )
+        pq.write_table(t, root / f"part-{i}.parquet", row_group_size=262_144)
+        total += t.nbytes
+    return total
 
 
 TPCH_SF1_PART_ROWS = 200_000
@@ -273,8 +292,8 @@ def cached_tpch(
 
     import shutil
 
-    # v2: orders comments + part/customer tables added in round 3.
-    base = cache_root or Path(tempfile.gettempdir()) / f"hs_tpch_v2_sf{sf:g}"
+    # v3: chunked (memory-bounded) lineitem/orders generation.
+    base = cache_root or Path(tempfile.gettempdir()) / f"hs_tpch_v3_sf{sf:g}"
     roots = []
     # A _COMPLETE marker written AFTER generation guards against reusing a
     # partial dataset from an interrupted run.

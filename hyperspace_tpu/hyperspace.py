@@ -22,6 +22,33 @@ from hyperspace_tpu.plan.nodes import LogicalPlan, Scan
 from hyperspace_tpu.rules.base import apply_rules
 
 
+def _enable_persistent_compile_cache() -> None:
+    """Point XLA's persistent compilation cache at a stable directory so
+    short-lived processes skip the 1-40s first-compile cost (the fixed
+    overhead that dominated small-scale builds). Opt out with
+    HYPERSPACE_XLA_CACHE_DIR=''. Idempotent; failures are non-fatal."""
+    import os
+
+    d = os.environ.get("HYPERSPACE_XLA_CACHE_DIR")
+    if d is None:
+        base = os.environ.get("HYPERSPACE_CACHE_DIR") or os.path.expanduser(
+            "~/.cache/hyperspace_tpu"
+        )
+        d = os.path.join(base, "xla")
+    if not d:
+        return
+    try:
+        import jax
+
+        if jax.config.jax_compilation_cache_dir:
+            return  # user already configured one
+        os.makedirs(d, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", d)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+    except Exception:
+        pass
+
+
 class HyperspaceSession:
     """The engine session: configuration + mesh + executor + rule toggle."""
 
@@ -31,6 +58,7 @@ class HyperspaceSession:
             kwargs["system_path"] = str(system_path)
         if num_buckets is not None:
             kwargs["num_buckets"] = int(num_buckets)
+        _enable_persistent_compile_cache()
         self.conf = HyperspaceConf(**kwargs)
         self.mesh = mesh
         self._enabled = False

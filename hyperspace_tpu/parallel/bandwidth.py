@@ -66,12 +66,49 @@ def pick_venue(
     return "host" if d2h_mb_per_s() < floor_mbps else "device"
 
 
+# A measured link speed is a property of the deployment, not the
+# process: persist it so short-lived runs (the point-lookup CLI shape)
+# skip the ~0.3-1s probe entirely.
+_PROBE_TTL_S = 24 * 3600.0
+
+
+def _probe_cache_path():
+    import os
+    from pathlib import Path
+
+    d = os.environ.get("HYPERSPACE_CACHE_DIR") or os.path.expanduser("~/.cache/hyperspace_tpu")
+    return Path(d) / "bandwidth.json"
+
+
+def _device_key() -> str:
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        return f"{dev.platform}:{getattr(dev, 'device_kind', '?')}"
+    except Exception:
+        return "unknown"
+
+
 @functools.lru_cache(maxsize=1)
 def d2h_mb_per_s() -> float:
-    """Measured device→host bandwidth (MB/s), probed once."""
+    """Measured device→host bandwidth (MB/s), probed once per deployment
+    (persisted with a TTL) rather than once per process."""
+    import json
+
     import jax
     import jax.numpy as jnp
     import numpy as np
+
+    key = _device_key()
+    path = _probe_cache_path()
+    try:
+        data = json.loads(path.read_text())
+        ts, mbps = data[key]
+        if time.time() - ts < _PROBE_TTL_S:
+            return float(mbps)
+    except Exception:
+        data = {}
 
     try:
         x = jnp.arange(1 << 20, dtype=jnp.uint32)  # 4 MB
@@ -79,6 +116,14 @@ def d2h_mb_per_s() -> float:
         t0 = time.perf_counter()
         np.asarray(jax.device_get(x))
         dt = time.perf_counter() - t0
-        return 4.0 / max(dt, 1e-9)
+        mbps = 4.0 / max(dt, 1e-9)
     except Exception:
         return float("inf")  # probe failure: assume fast, keep device path
+    try:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        data = data if isinstance(data, dict) else {}
+        data[key] = [time.time(), mbps]
+        path.write_text(json.dumps(data))
+    except Exception:
+        pass
+    return mbps
