@@ -53,8 +53,14 @@ def main(sfs=(0.1, 1.0)):
             sel, t_mem = _build(tmp, li_root, f"mem{sf:g}", budget=None)
             # Streaming: cap the budget to ~1/8 of the source so the
             # chunked out-of-core path (spill + budget-bounded phase 2)
-            # is what actually runs.
-            budget = max(sel // 8, 64 << 20)
+            # is what actually runs — the budget MUST be below the
+            # source estimate or the in-memory path runs and the point
+            # is mislabeled.
+            budget = max(sel // 8, 8 << 20)
+            assert budget < sel, (
+                f"sf={sf}: source ({sel >> 20} MB) fits the streaming "
+                f"budget ({budget >> 20} MB) — point would not stream"
+            )
             _, t_stream = _build(tmp, li_root, f"str{sf:g}", budget=budget)
             point = {
                 "sf": sf,
@@ -62,13 +68,16 @@ def main(sfs=(0.1, 1.0)):
                 "inmem_gbps": round(sel / 1e9 / t_mem, 4),
                 "stream_gbps": round(sel / 1e9 / t_stream, 4),
                 "stream_budget_mb": budget >> 20,
-                "stream_over_inmem": round(t_mem / t_stream, 3),
             }
             curve.append(point)
             log(f"sf={sf:g}: in-mem {t_mem:.2f}s ({point['inmem_gbps']} GB/s)  "
                 f"streaming {t_stream:.2f}s ({point['stream_gbps']} GB/s, "
                 f"budget {budget >> 20} MB)")
         last = curve[-1]
+        if last["sf"] >= 1.0:
+            # The docstring's gate: out-of-core must stay within 2x of
+            # the in-memory throughput at the largest (real) scale.
+            assert last["stream_gbps"] * 2 >= last["inmem_gbps"], last
         print(json.dumps({
             "metric": "index_build_streaming_gbps",
             "value": last["stream_gbps"],

@@ -104,11 +104,18 @@ def d2h_mb_per_s() -> float:
     path = _probe_cache_path()
     try:
         data = json.loads(path.read_text())
+        if not isinstance(data, dict):
+            data = {}
+    except Exception:
+        data = {}
+    try:
+        # Missing/expired entry for THIS device must not discard other
+        # devices' cached entries on the rewrite below.
         ts, mbps = data[key]
         if time.time() - ts < _PROBE_TTL_S:
             return float(mbps)
     except Exception:
-        data = {}
+        pass
 
     try:
         x = jnp.arange(1 << 20, dtype=jnp.uint32)  # 4 MB
@@ -121,7 +128,6 @@ def d2h_mb_per_s() -> float:
         return float("inf")  # probe failure: assume fast, keep device path
     try:
         path.parent.mkdir(parents=True, exist_ok=True)
-        data = data if isinstance(data, dict) else {}
         data[key] = [time.time(), mbps]
         path.write_text(json.dumps(data))
     except Exception:
