@@ -549,6 +549,34 @@ class Executor:
         if not asc0:
             lanes = [~l for l in lanes]
         lu = lanes_as_unsigned(lanes[:2])
+        from hyperspace_tpu.parallel.mesh import mesh_size
+
+        if (
+            self.mesh is not None
+            and mesh_size(self.mesh) > 1
+            # Venue-gated like every other operator: auto prefers the
+            # distributed kernel on a real mesh (the query-plane sharding
+            # is the point), HYPERSPACE_VENUE=host / sort_venue=host
+            # still force the host partition path.
+            and self._venue("sort_venue", "hyperspace.sort.venue", True, needs_native=False)
+            == "device"
+        ):
+            # Mesh-sharded selection: per-device first-n + one threshold
+            # broadcast; the ORDER BY participates in the mesh.
+            from hyperspace_tpu.ops.sortkeys import distributed_top_n_candidates
+
+            cand = distributed_top_n_candidates(lu, n, self.mesh)
+            if cand is not None:
+                sub = table.take(cand)
+                self._phys(
+                    "TopN",
+                    n=n,
+                    kernel="mesh-sharded-select + sort",
+                    candidates=len(cand),
+                    devices=mesh_size(self.mesh),
+                )
+                full = self._sorted_table(sub, sort_plan)
+                return full.take(np.arange(min(n, full.num_rows)))
         kpack = (lu[0].astype(np.uint64) << np.uint64(32)) | (
             lu[1].astype(np.uint64) if lu.shape[0] > 1 else np.uint64(0)
         )

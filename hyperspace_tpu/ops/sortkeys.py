@@ -179,6 +179,99 @@ def _make_batch_sort(num_operands: int, num_keys: int):
     return jax.jit(f)
 
 
+@functools.lru_cache(maxsize=16)
+def _make_sharded_topn(mesh, axes, n: int):
+    """Per-shard first-n selection by a (hi, lo) uint32 key pair: one
+    lax.sort per device under shard_map, zero collectives; the sharded
+    outputs concatenate to the D*n global candidate list."""
+    import jax
+    from jax import lax, shard_map
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(axes)
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=(spec, spec, spec),
+        check_vma=False,
+    )
+    def fn(hi, lo, idx):
+        s = lax.sort((hi, lo, idx), num_keys=2, is_stable=True)
+        return s[0][:n], s[1][:n], s[2][:n]
+
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=16)
+def _make_sharded_le(mesh, axes):
+    """Elementwise (hi, lo) <= (thr_hi, thr_lo) over the sharded rows."""
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(axes)
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(spec, spec, P(), P()), out_specs=spec,
+        check_vma=False,
+    )
+    def fn(hi, lo, thi, tlo):
+        return (hi < thi) | ((hi == thi) & (lo <= tlo))
+
+    return jax.jit(fn)
+
+
+def distributed_top_n_candidates(lanes_u32: np.ndarray, n: int, mesh) -> np.ndarray | None:
+    """Candidate row indices provably containing the global top-n by the
+    packed 64-bit key prefix, computed SPMD over the mesh (the ORDER BY
+    participation the reference gets from Spark's TakeOrderedAndProject
+    running on every executor): each device selects its shard's first n
+    by one local lax.sort; the n-th smallest prefix over the D*n union
+    is an inclusive threshold; a sharded elementwise pass emits every
+    row at or below it (prefix ties stay in — the exact candidate-set
+    sort settles total order). Returns None when the mesh cannot help."""
+    import jax
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.parallel.mesh import mesh_axes, mesh_size
+
+    d = mesh_size(mesh)
+    n_rows = lanes_u32.shape[1]
+    if d <= 1 or n <= 0 or n_rows < 2 * n * d:
+        return None
+    hi = lanes_u32[0]
+    lo = lanes_u32[1] if lanes_u32.shape[0] > 1 else np.zeros(n_rows, np.uint32)
+    n_pad = 1 << (int(n_rows - 1).bit_length())
+    if n_pad % d:
+        n_pad = ((n_pad + d - 1) // d) * d
+    if n_pad // d < n:
+        return None
+
+    def pad(a, fill):
+        out = np.full(n_pad, fill, dtype=a.dtype)
+        out[:n_rows] = a
+        return out
+
+    axes = mesh_axes(mesh)
+    hi_p = jnp.asarray(pad(hi, np.uint32(0xFFFFFFFF)))
+    lo_p = jnp.asarray(pad(lo, np.uint32(0xFFFFFFFF)))
+    idx = jnp.asarray(np.arange(n_pad, dtype=np.int32))
+    chi, clo, cidx = jax.device_get(_make_sharded_topn(mesh, axes, n)(hi_p, lo_p, idx))
+    valid = cidx < n_rows
+    chi, clo = chi[valid], clo[valid]
+    if len(chi) < n:
+        return None  # fewer real rows than n across shards: caller sorts all
+    order = np.lexsort((clo, chi))
+    thr_hi, thr_lo = chi[order[n - 1]], clo[order[n - 1]]
+    mask = np.asarray(
+        jax.device_get(
+            _make_sharded_le(mesh, axes)(
+                hi_p, lo_p, jnp.uint32(thr_hi), jnp.uint32(thr_lo)
+            )
+        )
+    )[:n_rows]
+    return np.flatnonzero(mask)
+
+
 def device_sort_perms(tables, key_columns: list[str]) -> list[np.ndarray]:
     """Batched per-table stable key-sort permutation on device.
 

@@ -288,3 +288,45 @@ def test_e2e_join_distributed_on_mesh(tmp_path):
     assert stats["join_path"] == "zero-exchange-aligned"
     assert stats["join_devices"] == 8
     assert got.equals(expected[got.columns.tolist()])
+
+
+def test_mesh_distributed_top_n_matches_host(tmp_path):
+    """ORDER BY ... LIMIT n over an 8-device mesh: per-shard first-n
+    selection + threshold mask must match the single-device result
+    exactly (ties included)."""
+    import pandas as pd
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from hyperspace_tpu import HyperspaceSession
+    from hyperspace_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.default_rng(41)
+    n = 200_000
+    df = pd.DataFrame(
+        {
+            "v": np.round(rng.normal(size=n), 2),  # heavy ties
+            "tag": rng.integers(0, 1000, n).astype(np.int64),
+        }
+    )
+    root = tmp_path / "topn"
+    root.mkdir()
+    pq.write_table(pa.Table.from_pandas(df, preserve_index=False), root / "p.parquet")
+
+    outs = {}
+    for mesh in (None, make_mesh()):
+        session = HyperspaceSession(
+            system_path=str(tmp_path / f"idx_{mesh is None}"), num_buckets=4, mesh=mesh
+        )
+        ds = session.parquet(root)
+        q = ds.sort([("v", False), ("tag", True)]).limit(25)
+        outs[mesh is None] = session.to_pandas(q).reset_index(drop=True)
+        if mesh is not None:
+            plan = repr(session.last_physical_plan)
+            assert "mesh-sharded-select" in plan, plan
+    pd.testing.assert_frame_equal(outs[True], outs[False])
+    exp = (
+        df.sort_values(["v", "tag"], ascending=[False, True]).head(25).reset_index(drop=True)
+    )
+    np.testing.assert_allclose(outs[False]["v"], exp["v"])
+    np.testing.assert_array_equal(outs[False]["tag"], exp["tag"])
