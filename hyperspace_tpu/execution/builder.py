@@ -95,6 +95,23 @@ def _host_sort_perms(tables, indexed_columns: list[str]) -> list[np.ndarray]:
     return perms
 
 
+def _prefetched(it):
+    """One-ahead prefetch over an iterator: the next item decodes on a
+    worker thread while the caller processes the current one."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    sentinel = object()
+    it = iter(it)
+    with ThreadPoolExecutor(max_workers=1) as ex:
+        fut = ex.submit(next, it, sentinel)
+        while True:
+            cur = fut.result()
+            if cur is sentinel:
+                return
+            fut = ex.submit(next, it, sentinel)
+            yield cur
+
+
 class DeviceIndexBuilder:
     """IndexWriter over a device mesh (defaults to all local devices).
 
@@ -180,18 +197,20 @@ class DeviceIndexBuilder:
                 )
                 return
         else:
-            # Non-parquet sources have no row-group chunking; a rough
-            # on-disk-size inflate guards the in-memory path.
+            # Non-parquet sources: a rough on-disk-size inflate picks the
+            # path; above the budget they stream too — CSV by record
+            # batches, ORC by stripes, JSON at file granularity (pyarrow
+            # has no incremental JSON reader, so the memory bound holds
+            # per file there).
             import os
 
             est = sum(os.stat(f).st_size for f in files) * 4
             if est > self.memory_budget_bytes:
-                raise HyperspaceError(
-                    f"{plan.format} source (~{est >> 20} MiB decoded estimate) exceeds "
-                    "the build memory budget; the streaming out-of-core build supports "
-                    "parquet sources only — raise hyperspace.index.build.memoryBudgetBytes "
-                    "or convert the source to parquet"
+                self._write_streaming(
+                    files, plan.scan_schema, columns, indexed_columns, num_buckets,
+                    dest_path, est, fmt=plan.format,
                 )
+                return
         table = hio.read_table_files(files, plan.format, columns=columns, schema=plan.schema)
         self.write_table(table, indexed_columns, num_buckets, dest_path)
         self.last_build_stats = {"path": "in-memory", "bytes_estimate": est, "rows": table.num_rows}
@@ -283,6 +302,7 @@ class DeviceIndexBuilder:
         dest_path: Path,
         est_bytes: int,
         footers=None,
+        fmt: str = "parquet",
     ) -> None:
         import shutil
         from concurrent.futures import ThreadPoolExecutor
@@ -301,38 +321,37 @@ class DeviceIndexBuilder:
         payload_names = [f.name for f in sub_schema.fields if f.name not in key_names]
         ordered = key_names + payload_names
 
-        chunks = hio.plan_row_group_chunks(files, self.chunk_bytes, columns, footers=footers)
         writers: dict[int, pq.ParquetWriter] = {}
         total_rows = 0
+        n_chunks = 0
         try:
-            # Phase 1: stream chunks; decode of chunk i+1 overlaps the
-            # hash/partition/spill of chunk i.
-            with ThreadPoolExecutor(max_workers=1) as prefetcher:
-                nxt = prefetcher.submit(hio.read_chunk, chunks[0], columns) if chunks else None
-                for i in range(len(chunks)):
-                    at = nxt.result()
-                    if i + 1 < len(chunks):
-                        nxt = prefetcher.submit(hio.read_chunk, chunks[i + 1], columns)
-                    ct = ColumnTable.from_arrow(at, sub_schema).select(ordered)
-                    total_rows += ct.num_rows
-                    bucket = bucket_ids(
-                        compute_row_hashes(ct, indexed_columns), num_buckets, np
-                    )
-                    order = np.argsort(bucket, kind="stable")
-                    sb = bucket[order]
-                    starts = np.searchsorted(sb, np.arange(num_buckets + 1))
-                    arrow_sorted = ct.take(order).to_arrow()
-                    for b in range(num_buckets):
-                        lo, hi = int(starts[b]), int(starts[b + 1])
-                        if hi <= lo:
-                            continue
-                        w = writers.get(b)
-                        if w is None:
-                            w = pq.ParquetWriter(
-                                spill / hio.bucket_file_name(b), arrow_sorted.schema
-                            )
-                            writers[b] = w
-                        w.write_table(arrow_sorted.slice(lo, hi - lo))
+            # Phase 1: stream decoded chunks (format-aware iterator);
+            # decode of chunk i+1 overlaps the hash/partition/spill of
+            # chunk i via the one-ahead prefetcher.
+            for at in _prefetched(
+                self._decoded_chunks(files, fmt, columns, schema, footers=footers)
+            ):
+                n_chunks += 1
+                ct = ColumnTable.from_arrow(at, sub_schema).select(ordered)
+                total_rows += ct.num_rows
+                bucket = bucket_ids(
+                    compute_row_hashes(ct, indexed_columns), num_buckets, np
+                )
+                order = np.argsort(bucket, kind="stable")
+                sb = bucket[order]
+                starts = np.searchsorted(sb, np.arange(num_buckets + 1))
+                arrow_sorted = ct.take(order).to_arrow()
+                for b in range(num_buckets):
+                    lo, hi = int(starts[b]), int(starts[b + 1])
+                    if hi <= lo:
+                        continue
+                    w = writers.get(b)
+                    if w is None:
+                        w = pq.ParquetWriter(
+                            spill / hio.bucket_file_name(b), arrow_sorted.schema
+                        )
+                        writers[b] = w
+                    w.write_table(arrow_sorted.slice(lo, hi - lo))
             for w in writers.values():
                 w.close()
 
@@ -395,10 +414,84 @@ class DeviceIndexBuilder:
             shutil.rmtree(spill, ignore_errors=True)
         self.last_build_stats = {
             "path": "streaming",
+            "format": fmt,
             "bytes_estimate": est_bytes,
-            "chunks": len(chunks),
+            "chunks": n_chunks,
             "rows": total_rows,
         }
+
+    def _decoded_chunks(self, files, fmt: str, columns, schema, footers=None):
+        """Yield pyarrow Tables of ≤ ~chunk_bytes decoded source data,
+        format-aware: parquet by footer-planned row groups, CSV by
+        streamed record batches, ORC by stripes, JSON per file (pyarrow
+        has no incremental JSON reader)."""
+        import pyarrow as pa
+
+        if fmt == "parquet":
+            chunks = hio.plan_row_group_chunks(
+                files, self.chunk_bytes, columns, footers=footers
+            )
+            for c in chunks:
+                yield hio.read_chunk(c, columns)
+            return
+        if fmt == "csv":
+            from pyarrow import csv as pcsv
+
+            types = hio._arrow_types_for(schema)
+            for f in files:
+                opts = pcsv.ConvertOptions(
+                    include_columns=list(columns) if columns is not None else None,
+                    column_types=types,
+                )
+                ropts = pcsv.ReadOptions(
+                    block_size=int(max(16 << 10, min(self.chunk_bytes // 4, (1 << 31) - 1)))
+                )
+                with pcsv.open_csv(f, read_options=ropts, convert_options=opts) as reader:
+                    buf, size = [], 0
+                    for batch in reader:
+                        buf.append(batch)
+                        size += batch.nbytes
+                        if size >= self.chunk_bytes:
+                            yield pa.Table.from_batches(buf)
+                            buf, size = [], 0
+                    if buf:
+                        yield pa.Table.from_batches(buf)
+            return
+        if fmt == "orc":
+            from pyarrow import orc
+
+            for f in files:
+                o = orc.ORCFile(f)
+                buf, size = [], 0
+                for s in range(o.nstripes):
+                    rb = o.read_stripe(s, columns=list(columns) if columns is not None else None)
+                    buf.append(rb)
+                    size += rb.nbytes
+                    if size >= self.chunk_bytes:
+                        yield pa.Table.from_batches(buf)
+                        buf, size = [], 0
+                if buf:
+                    yield pa.Table.from_batches(buf)
+            return
+        if fmt == "json":
+            import os
+
+            for f in files:
+                # No incremental JSON reader exists in pyarrow: the bound
+                # holds per FILE. A single file above the budget would
+                # silently break it — fail with the actionable message
+                # instead of OOMing.
+                if os.stat(f).st_size * 4 > self.memory_budget_bytes:
+                    raise HyperspaceError(
+                        f"json file {f} (~{os.stat(f).st_size * 4 >> 20} MiB decoded "
+                        "estimate) exceeds the build memory budget and JSON has no "
+                        "incremental reader; raise "
+                        "hyperspace.index.build.memoryBudgetBytes, split the file, "
+                        "or convert the source to parquet"
+                    )
+                yield hio._read_one_file(f, "json", list(columns) if columns is not None else None, schema)
+            return
+        raise HyperspaceError(f"unsupported streaming source format {fmt!r}")
 
     # -- OptimizeAction's compactor seam ---------------------------------
     def compact(self, entry, src_paths: list[Path] | Path, dest_path: Path) -> None:

@@ -438,9 +438,67 @@ class Executor:
             self._cur_phys.detail["n"] = plan.n
             if isinstance(plan.child, Sort):
                 return self._top_n(plan.child, plan.n)
+            early = self._limit_early_out(plan.child, plan.n)
+            if early is not None:
+                return early
             t = self._execute(plan.child)
             return t.take(np.arange(min(plan.n, t.num_rows)))
         raise HyperspaceError(f"cannot execute plan node {type(plan).__name__}")
+
+    def _limit_early_out(self, child: LogicalPlan, n: int) -> ColumnTable | None:
+        """LIMIT over an unordered linear scan chain: pull rows file by
+        file and STOP once n rows survive, instead of materializing the
+        whole child (any n rows are a correct answer without ORDER BY —
+        the analog of Spark's CollectLimit incremental take). Returns
+        None when the shape doesn't apply (non-linear child, single
+        file, pinned hybrid scans)."""
+        import functools
+
+        chain: list[LogicalPlan] = []
+        node = child
+        while isinstance(node, (Project, Filter)):
+            chain.append(node)
+            node = node.child
+        if not isinstance(node, Scan):
+            return None
+        files = self._scan_files(node)
+        preds = [w.predicate for w in chain if isinstance(w, Filter)]
+        if node.bucket_spec is not None and preds:
+            # Index scans prune FIRST — a point lookup must stay a
+            # single-file IndexPointLookup, not a file-by-file walk
+            # through non-owning buckets.
+            pred = functools.reduce(And, preds)
+            pruned = self._prune_bucket_files(node, pred)
+            if pruned is None:
+                ranged = self._range_prune_list(node, pred)
+                pruned = ranged[0] if ranged is not None else None
+            if pruned is not None:
+                files = pruned
+        if len(files) <= 1:
+            return None
+        parts: list[ColumnTable] = []
+        total = 0
+        scanned = 0
+        for f in files:
+            sub: LogicalPlan = dataclasses.replace(node, files=[f])
+            for wrapper in reversed(chain):
+                sub = dataclasses.replace(wrapper, child=sub)
+            # Sequential by design: stopping early is the point; the
+            # non-limited path keeps its thread-pooled parallel reads.
+            t = self._execute(sub)
+            scanned += 1
+            if t.num_rows:
+                parts.append(t)
+                total += t.num_rows
+            if total >= n:
+                break
+        self._phys(
+            "LimitEarlyOut", files_scanned=scanned, files_total=len(files)
+        )
+        if not parts:
+            return ColumnTable.empty(child.schema)
+        out = ColumnTable.concat(parts) if len(parts) > 1 else parts[0]
+        return out.take(np.arange(min(n, out.num_rows)))
 
     def _join_venue(self) -> str:
         """auto: host when the measured device→host link is slower than

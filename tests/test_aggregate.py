@@ -736,4 +736,3 @@ def test_host_reduceat_with_trailing_empty_groups():
     np.testing.assert_array_equal(res[1][:2], [5.0, 9.0])
     assert np.isinf(res[0][2]) and np.isinf(res[0][3])  # empty -> identity
     np.testing.assert_array_equal(cnt[0], [2, 1, 0, 0])
-

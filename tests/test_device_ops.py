@@ -318,6 +318,12 @@ def test_mesh_distributed_top_n_matches_host(tmp_path):
         session = HyperspaceSession(
             system_path=str(tmp_path / f"idx_{mesh is None}"), num_buckets=4, mesh=mesh
         )
+        if mesh is not None:
+            # Pin the venue: the assertion below is about the device
+            # kernel, and must hold under a HYPERSPACE_VENUE=host sweep.
+            from hyperspace_tpu.config import SORT_VENUE
+
+            session.conf.set(SORT_VENUE, "device")
         ds = session.parquet(root)
         q = ds.sort([("v", False), ("tag", True)]).limit(25)
         outs[mesh is None] = session.to_pandas(q).reset_index(drop=True)

@@ -211,3 +211,41 @@ def test_explain_output(session, hs, sample_parquet):
     assert "ShuffleExchange-equivalents eliminated: 1" in text
     # explain must not leave the session toggled on
     assert not session.is_hyperspace_enabled()
+
+
+def test_limit_early_out_stops_scanning(tmp_path):
+    """LIMIT over an unordered multi-file scan stops reading once n rows
+    survive instead of materializing the whole table."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from hyperspace_tpu import HyperspaceSession, col
+
+    root = tmp_path / "many"
+    root.mkdir()
+    for i in range(10):
+        pq.write_table(
+            pa.table({"k": np.arange(i * 100, (i + 1) * 100, dtype=np.int64)}),
+            root / f"part-{i}.parquet",
+        )
+    session = HyperspaceSession(system_path=str(tmp_path / "idx"), num_buckets=2)
+    ds = session.parquet(root)
+
+    out = session.to_pandas(ds.limit(5))
+    assert len(out) == 5
+    plan = repr(session.last_physical_plan)
+    assert "LimitEarlyOut" in plan
+    assert "'files_scanned': 1" in plan, plan
+
+    # With a filter that only later files satisfy, scanning continues
+    # exactly until enough rows survive.
+    out = session.to_pandas(ds.filter(col("k") >= 750).limit(5))
+    assert len(out) == 5
+    assert (out.k >= 750).all()
+    plan = repr(session.last_physical_plan)
+    assert "'files_scanned': 8" in plan, plan
+
+    # Fewer matches than n: every file scanned, all matches returned.
+    out = session.to_pandas(ds.filter(col("k") >= 997).limit(10))
+    assert len(out) == 3
+    assert "'files_total': 10" in repr(session.last_physical_plan)

@@ -104,7 +104,10 @@ def test_unsupported_format_raises(tmp_path):
         Dataset.of_format(tmp_path, "avro")
 
 
-def test_non_parquet_over_budget_raises(tmp_path):
+def test_non_parquet_over_budget_streams(tmp_path):
+    """A CSV source above the memory budget no longer raises: it builds
+    through the streaming out-of-core pipeline (record-batch chunks) and
+    the resulting index serves queries identically."""
     df = _frame(2000)
     root = tmp_path / "src"
     _write(df, root, "csv")
@@ -112,8 +115,11 @@ def test_non_parquet_over_budget_raises(tmp_path):
     session.conf.set("hyperspace.index.build.memoryBudgetBytes", 1024)
     hs = Hyperspace(session)
     scan = session.csv(root)
-    with pytest.raises(HyperspaceError, match="streaming out-of-core build supports parquet"):
-        hs.create_index(scan, IndexConfig("c_k", ["k"], ["v", "tag"]))
+    hs.create_index(scan, IndexConfig("c_k", ["k"], ["v", "tag"]))
+    session.enable_hyperspace()
+    some_k = int(df.k.iloc[0])
+    got = session.to_pandas(scan.filter(col("k") == some_k))
+    assert len(got) == int((df.k == some_k).sum())
 
 
 def test_csv_decode_pinned_to_registered_schema(tmp_path):
