@@ -94,6 +94,16 @@ def freeze(arr: np.ndarray) -> np.ndarray:
     return arr
 
 
+def _x64_now() -> bool:
+    """jnp.asarray dtype resolution depends on the ACTIVE x64 scope
+    (float64 downcasts to float32 outside it) — the upload key must
+    distinguish the two or a cross-scope hit would return the wrong
+    device dtype."""
+    import jax
+
+    return bool(jax.config.jax_enable_x64)
+
+
 def device_put_padded(arr: np.ndarray, n_pad: int, sharding=None):
     """Upload `arr` padded with zeros to length n_pad (row dim), through
     DEVICE_CACHE when the base is stable. `sharding` is a
@@ -117,7 +127,7 @@ def device_put_padded(arr: np.ndarray, n_pad: int, sharding=None):
         except Exception:
             skey = repr(sharding)
     return DEVICE_CACHE.get_or_build(
-        ("pad", id(arr), n_pad, skey), (arr,), build
+        ("pad", id(arr), n_pad, skey, _x64_now()), (arr,), build
     )
 
 
@@ -131,7 +141,9 @@ def device_put_cached(arr: np.ndarray):
 
     if not is_stable(arr):
         return build()[0]
-    return DEVICE_CACHE.get_or_build(("raw", id(arr), arr.shape), (arr,), build)
+    return DEVICE_CACHE.get_or_build(
+        ("raw", id(arr), arr.shape, _x64_now()), (arr,), build
+    )
 
 
 def derived(key: tuple, base_refs: tuple, build_host):

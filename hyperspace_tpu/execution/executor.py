@@ -1236,11 +1236,14 @@ class Executor:
         self, plan, data, codes, perms, primary, secondary, spec_sides, gid_orig, k, spec_input
     ):
         """Device venue: the run-prefix kernel over bucket-major padded
-        channels (ops/join_agg.py)."""
+        channels (ops/join_agg.py). Pads, the channel stacks, and the
+        uploads all route through the identity caches, so repeat queries
+        over a stable index version serve from HBM."""
+        from hyperspace_tpu.execution import device_cache as dcache
         from hyperspace_tpu.ops.join_agg import fused_join_aggregate
 
-        pk = _pad_bucket_major(codes[primary], data[primary].offsets)
-        sk = _pad_bucket_major(codes[secondary], data[secondary].offsets)
+        pk = _pad_bucket_major_cached(codes[primary], data[primary].offsets)
+        sk = _pad_bucket_major_cached(codes[secondary], data[secondary].offsets)
         b, lp = pk.shape
         ls = sk.shape[1]
 
@@ -1250,11 +1253,25 @@ class Executor:
             if perms[side] is not None:
                 v = v[perms[side]]
             width = lp if side == primary else ls
-            return _pad_bucket_major(v, data[side].offsets, fill=fill, width=width)
+            return _pad_bucket_major_cached(v, data[side].offsets, fill=fill, width=width)
 
         # pad_rows reorders by perm internally — pass the ORIGINAL-order gid;
         # pads carry group id k (the dead segment).
-        gid_pad = pad_rows(primary, gid_orig, fill=float(k)).astype(np.int32)
+        def build_gid():
+            return pad_rows(primary, gid_orig, fill=float(k)).astype(np.int32)
+
+        if dcache.is_stable(gid_orig) and perms[primary] is None:
+            # Cacheable only when NO per-join permutation applies: the
+            # perm depends on the join keys, which this key does not
+            # carry — a different-keyed join sharing gid_orig must not
+            # reuse the other layout's pad.
+            gid_pad = dcache.derived(
+                ("gidpad", id(gid_orig), data[primary].offsets.tobytes(), k, lp),
+                (gid_orig,),
+                build_gid,
+            )
+        else:
+            gid_pad = build_gid()
 
         channels: list[tuple] = [("star",)]
         p_arrays: list[np.ndarray] = []
@@ -1281,8 +1298,8 @@ class Executor:
             ci = add_channel(s, pad_rows(s, ind))
             spec_layout.append((vi, ci))
 
-        pvals = np.stack(p_arrays) if p_arrays else np.zeros((0, b, lp))
-        svals = np.stack(s_arrays) if s_arrays else np.zeros((0, b, ls))
+        pvals = _stack_cached(p_arrays, (0, b, lp))
+        svals = _stack_cached(s_arrays, (0, b, ls))
         out = fused_join_aggregate(pk, sk, pvals, svals, gid_pad, k, tuple(channels))
         return out, spec_layout
 
@@ -1319,20 +1336,7 @@ class Executor:
             else:
                 parts.append(("pri", vals if spec.fn in ("sum", "mean") else None, ind))
 
-        from hyperspace_tpu.execution import device_cache as dc
-
-        if sec_arrays and all(dc.is_stable(a) for a in sec_arrays):
-            # The [A, n] channel stack is a 100MB-scale memcpy per query;
-            # stable channels stack once per index version.
-            rvals = dc.derived(
-                ("stack", tuple(id(a) for a in sec_arrays)),
-                tuple(sec_arrays),
-                lambda: np.stack(sec_arrays),
-            )
-        elif sec_arrays:
-            rvals = np.stack(sec_arrays)
-        else:
-            rvals = np.zeros((0, tbl_s.num_rows))
+        rvals = _stack_cached(sec_arrays, (0, tbl_s.num_rows))
         res = native.merge_join_accumulate(
             codes[primary], data[primary].offsets,
             codes[secondary], data[secondary].offsets, rvals,
@@ -1812,19 +1816,35 @@ def _factorize_keys_cached(lt: ColumnTable, rt: ColumnTable, lkeys, rkeys):
     return dc.HOST_DERIVED.get_or_build(("fact", parts), refs, build)
 
 
-def _pad_bucket_major_cached(codes: np.ndarray, offsets: np.ndarray) -> np.ndarray:
-    """Bucket-major pad through the derived cache when the codes are
+def _pad_bucket_major_cached(
+    codes: np.ndarray, offsets: np.ndarray, fill=None, width: int | None = None
+) -> np.ndarray:
+    """Bucket-major pad through the derived cache when the input is
     stable (index-sorted, frozen) — the [B, L] device upload then hits
     the HBM cache too."""
     from hyperspace_tpu.execution import device_cache as dc
 
     if dc.is_stable(codes):
         return dc.derived(
-            ("padbm", id(codes), offsets.tobytes()),
+            ("padbm", id(codes), offsets.tobytes(), repr(fill), width),
             (codes,),
-            lambda: _pad_bucket_major(codes, offsets),
+            lambda: _pad_bucket_major(codes, offsets, fill=fill, width=width),
         )
-    return _pad_bucket_major(codes, offsets)
+    return _pad_bucket_major(codes, offsets, fill=fill, width=width)
+
+
+def _stack_cached(arrs: list, empty_shape: tuple) -> np.ndarray:
+    """np.stack through the derived cache when every channel is stable
+    (the [A, n] float64 stack is a 100MB-scale memcpy per query)."""
+    from hyperspace_tpu.execution import device_cache as dc
+
+    if not arrs:
+        return np.zeros(empty_shape)
+    if all(dc.is_stable(a) for a in arrs):
+        return dc.derived(
+            ("stack", tuple(id(a) for a in arrs)), tuple(arrs), lambda: np.stack(arrs)
+        )
+    return np.stack(arrs)
 
 
 def _key_null_mask(table: ColumnTable, keys: list[str]) -> np.ndarray | None:

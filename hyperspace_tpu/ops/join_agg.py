@@ -76,17 +76,21 @@ def fused_join_aggregate(
     """Host wrapper: pads the group dimension (+1 dead segment for pads)
     and runs the fused device program on the persistent x64 worker thread
     (parallel/x64.py). Returns [C, num_groups] float64."""
+    from hyperspace_tpu.execution.device_cache import device_put_cached
     from hyperspace_tpu.parallel.x64 import run_x64
 
     k_seg = 1 << max(int(num_groups).bit_length(), 1)  # >= num_groups+1
 
     def call():
+        # Stable (frozen, identity-cached) inputs serve from the HBM
+        # cache on repeat queries; the upload keys carry the active x64
+        # scope, so the float64 channels stay float64.
         out = _fused_join_agg(
-            jnp.asarray(pk),
-            jnp.asarray(sk),
-            jnp.asarray(pvals),
-            jnp.asarray(svals),
-            jnp.asarray(gid),
+            device_put_cached(pk),
+            device_put_cached(sk),
+            device_put_cached(pvals),
+            device_put_cached(svals),
+            device_put_cached(gid),
             k_seg,
             channels,
         )

@@ -145,3 +145,38 @@ def test_cache_budget_bounds_memory():
     st = c.stats()
     assert st["bytes"] <= 1000
     assert st["entries"] <= 1000 // 240 + 1
+
+
+def test_repeat_fused_join_agg_device_venue_hits_cache(tmp_path):
+    """The fused join-aggregate DEVICE path serves its pads, channel
+    stacks, and uploads from the caches on repeat queries."""
+    from hyperspace_tpu import AggSpec, IndexConfig
+    from hyperspace_tpu.config import AGG_VENUE
+
+    rng = np.random.default_rng(33)
+    f = pd.DataFrame({"k": rng.integers(0, 500, 30_000).astype(np.int64), "a": rng.normal(size=30_000)})
+    d = pd.DataFrame({"k": np.arange(500, dtype=np.int64), "w": rng.normal(size=500)})
+    for nm, fr in (("ff", f), ("dd", d)):
+        (tmp_path / nm).mkdir()
+        pq.write_table(pa.Table.from_pandas(fr, preserve_index=False), tmp_path / nm / "p.parquet")
+    session = HyperspaceSession(system_path=str(tmp_path / "idx"), num_buckets=4)
+    hs = Hyperspace(session)
+    fs, ds = session.parquet(tmp_path / "ff"), session.parquet(tmp_path / "dd")
+    hs.create_index(fs, IndexConfig("fj_f", ["k"], ["a"]))
+    hs.create_index(ds, IndexConfig("fj_d", ["k"], ["w"]))
+    session.enable_hyperspace()
+    session.conf.set(JOIN_VENUE, "device")
+    session.conf.set(AGG_VENUE, "device")
+    dc.clear_all()
+
+    q = fs.join(ds, ["k"]).aggregate([], [AggSpec.of("sum", "a", "sa"), AggSpec.of("count", None, "n")])
+    r1 = session.to_pandas(q)
+    assert session.last_query_stats["agg_path"] == "fused-join-agg"
+    h0 = dc.DEVICE_CACHE.stats()["hits"]
+    r2 = session.to_pandas(q)
+    h1 = dc.DEVICE_CACHE.stats()["hits"]
+    assert h1 > h0, "fused join-agg repeat did not hit the device cache"
+    np.testing.assert_allclose(r1["sa"], r2["sa"])
+    exp = f.merge(d, on="k")
+    np.testing.assert_allclose(float(r1.loc[0, "sa"]), float(exp["a"].sum()), rtol=1e-9)
+    assert int(r1.loc[0, "n"]) == len(exp)
