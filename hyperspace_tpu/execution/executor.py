@@ -523,6 +523,18 @@ class Executor:
     def _aggregate(self, plan: "Aggregate") -> ColumnTable:
         from hyperspace_tpu.ops.aggregate import aggregate_table
 
+        if any(a.fn == "count_distinct" for a in plan.aggs):
+            self._phys("CountDistinctReaggregate")
+            plan2, count_aliases = _desugar_count_distinct(plan)
+            out = self._execute(plan2)
+            # SQL count is never NULL: the outer SUM of count partials
+            # yields NULL over zero inner rows — restore the 0.
+            for alias in count_aliases:
+                f = out.schema.field(alias)
+                v = out.validity.pop(f.name, None)
+                if v is not None:
+                    out.columns[f.name] = np.where(v, out.columns[f.name], 0)
+            return out
         venue = self._agg_venue()
         # Fuse Aggregate(Join) on both venues: the device run-prefix
         # kernel avoids the match-pair readback; the host C++
@@ -1716,6 +1728,58 @@ def _concat_side_cached(tables: list[ColumnTable]) -> ColumnTable:
     return dc.HOST_DERIVED.get_or_build(
         ("sidecat", tuple(id(t) for t in tables)), tuple(tables), build
     )
+
+
+def _desugar_count_distinct(plan: "Aggregate"):
+    """count(distinct col) as a TWO-PHASE re-aggregation: the inner
+    aggregate groups by (group keys, distinct column) — its rows are the
+    distinct (group, value) pairs — and computes partials for every
+    sibling aggregate; the outer counts the distinct column (nulls
+    excluded, SQL semantics) and recombines the partials (sum of sums /
+    counts, min of mins, max of maxes). The Spark analog is the planner's
+    distinct-aggregate Expand rewrite. Returns (desugared plan, aliases
+    of the original count specs — the caller zero-fills their NULLs)."""
+    from hyperspace_tpu.plan.nodes import AggSpec, Aggregate
+
+    dcol = None
+    dnames: set[str] = set()
+    for a in plan.aggs:
+        if a.fn == "mean":
+            raise HyperspaceError(
+                "mean cannot share an aggregate with count_distinct; "
+                "compute sum and count instead and divide"
+            )
+        if a.fn != "count_distinct":
+            continue
+        if not isinstance(a.expr, Col):
+            raise HyperspaceError("count_distinct requires a plain column")
+        dnames.add(a.expr.name.lower())
+        if dcol is None:
+            dcol = a.expr.name
+    if len(dnames) != 1:
+        raise HyperspaceError(
+            "one aggregate supports a single distinct column; compute "
+            "further distinct counts in separate aggregates and join"
+        )
+    group_low = {c.lower() for c in plan.group_by}
+    inner_groups = list(plan.group_by) + ([dcol] if dcol.lower() not in group_low else [])
+    inner_aggs: list = []
+    outer_aggs: list = []
+    count_aliases: list[str] = []
+    for i, a in enumerate(plan.aggs):
+        if a.fn == "count_distinct":
+            outer_aggs.append(AggSpec("count", Col(dcol), a.alias))
+            continue
+        part = f"__partial_{i}"
+        if a.fn == "count":
+            inner_aggs.append(AggSpec("count", a.expr, part))
+            outer_aggs.append(AggSpec("sum", Col(part), a.alias))
+            count_aliases.append(a.alias)
+        else:  # sum / min / max recombine with themselves
+            inner_aggs.append(AggSpec(a.fn, a.expr, part))
+            outer_aggs.append(AggSpec(a.fn, Col(part), a.alias))
+    inner = Aggregate(plan.child, inner_groups, inner_aggs)
+    return Aggregate(inner, list(plan.group_by), outer_aggs), count_aliases
 
 
 def _stable_table_refs(table: ColumnTable, names: set[str]):

@@ -259,13 +259,15 @@ class Join(LogicalPlan):
 
 @dataclasses.dataclass
 class AggSpec:
-    """One aggregation: fn over an expression (None = count(*))."""
+    """One aggregation: fn over an expression (None = count(*)).
+    count_distinct counts distinct non-null values of a column and
+    executes as a two-phase re-aggregation (the executor desugars it)."""
 
-    fn: str  # sum | count | min | max | mean
+    fn: str  # sum | count | min | max | mean | count_distinct
     expr: Expr | None
     alias: str
 
-    _FNS = ("sum", "count", "min", "max", "mean")
+    _FNS = ("sum", "count", "min", "max", "mean", "count_distinct")
 
     def __post_init__(self):
         if self.fn not in self._FNS:
@@ -327,7 +329,7 @@ class Aggregate(LogicalPlan):
         child = self.child.schema
         fields = [child.field(c) for c in self.group_by]
         for a in self.aggs:
-            if a.fn == "count":
+            if a.fn in ("count", "count_distinct"):
                 dtype = "int64"
             elif a.fn == "mean":
                 dtype = "float64"

@@ -736,3 +736,97 @@ def test_host_reduceat_with_trailing_empty_groups():
     np.testing.assert_array_equal(res[1][:2], [5.0, 9.0])
     assert np.isinf(res[0][2]) and np.isinf(res[0][3])  # empty -> identity
     np.testing.assert_array_equal(cnt[0], [2, 1, 0, 0])
+
+
+@pytest.mark.parametrize("venue", ["host", "device"])
+def test_count_distinct(tmp_path, venue):
+    """count(distinct col): two-phase re-aggregation, nulls excluded,
+    combinable with plain aggregates (TPC-H Q16's shape)."""
+    from hyperspace_tpu.config import AGG_VENUE
+
+    rng = np.random.default_rng(29)
+    n = 8_000
+    nulls = rng.random(n) < 0.1
+    df = pd.DataFrame(
+        {
+            "g": rng.integers(0, 12, n).astype(np.int64),
+            "supp": pd.array(np.where(nulls, 0, rng.integers(0, 300, n)), dtype="Int64"),
+            "qty": rng.integers(1, 50, n).astype(np.int64),
+        }
+    )
+    df.loc[nulls, "supp"] = pd.NA
+    root = tmp_path / f"cd_{venue}"
+    root.mkdir()
+    pq.write_table(pa.Table.from_pandas(df, preserve_index=False), root / "p.parquet")
+    session = _session(tmp_path)
+    session.conf.set(AGG_VENUE, venue)
+    ds = session.parquet(root)
+
+    q = ds.aggregate(
+        ["g"],
+        [
+            AggSpec.of("count_distinct", "supp", "nsupp"),
+            AggSpec.of("sum", "qty", "sq"),
+            AggSpec.of("count", None, "rows"),
+            AggSpec.of("min", "qty", "mn"),
+        ],
+    )
+    got = session.to_pandas(q).sort_values("g").reset_index(drop=True)
+    assert "CountDistinctReaggregate" in repr(session.last_physical_plan)
+    exp = (
+        df.groupby("g")
+        .agg(
+            nsupp=("supp", "nunique"),
+            sq=("qty", "sum"),
+            rows=("g", "size"),
+            mn=("qty", "min"),
+        )
+        .reset_index()
+    )
+    np.testing.assert_array_equal(got["g"], exp["g"])
+    np.testing.assert_array_equal(got["nsupp"], exp["nsupp"])
+    np.testing.assert_array_equal(got["sq"], exp["sq"])
+    np.testing.assert_array_equal(got["rows"], exp["rows"])
+    np.testing.assert_array_equal(got["mn"], exp["mn"])
+
+    # Global (no group) variant.
+    got = session.to_pandas(ds.aggregate([], [AggSpec.of("count_distinct", "supp", "ns")]))
+    assert int(got.loc[0, "ns"]) == int(df.supp.nunique())
+
+
+def test_count_distinct_restrictions(tmp_path):
+    from hyperspace_tpu.exceptions import HyperspaceError
+
+    t = pa.table({"g": [1, 2], "a": [1, 2], "b": [3, 4]})
+    root = tmp_path / "cdr"
+    root.mkdir()
+    pq.write_table(t, root / "p.parquet")
+    session = _session(tmp_path)
+    ds = session.parquet(root)
+    with pytest.raises(HyperspaceError, match="single distinct column"):
+        session.run(ds.aggregate([], [
+            AggSpec.of("count_distinct", "a", "na"),
+            AggSpec.of("count_distinct", "b", "nb"),
+        ]))
+    with pytest.raises(HyperspaceError, match="mean cannot share"):
+        session.run(ds.aggregate([], [
+            AggSpec.of("count_distinct", "a", "na"),
+            AggSpec.of("mean", "b", "mb"),
+        ]))
+
+
+def test_count_distinct_empty_input_counts_are_zero(tmp_path):
+    """count(*) / count(col) siblings of count_distinct stay 0 (never
+    NULL) over empty input — SQL count is never NULL."""
+    t = pa.table({"g": pa.array([], type=pa.int64()), "a": pa.array([], type=pa.int64())})
+    root = tmp_path / "cde"
+    root.mkdir()
+    pq.write_table(t, root / "p.parquet")
+    session = _session(tmp_path)
+    ds = session.parquet(root)
+    got = session.to_pandas(ds.aggregate([], [
+        AggSpec.of("count_distinct", "a", "na"),
+        AggSpec.of("count", None, "rows"),
+    ]))
+    assert int(got.loc[0, "na"]) == 0
+    assert got.loc[0, "rows"] is not None and int(got.loc[0, "rows"]) == 0
