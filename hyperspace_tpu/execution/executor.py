@@ -1108,10 +1108,15 @@ class Executor:
     # -- fused join + aggregation ----------------------------------------
     def _try_fused_join_aggregate(self, plan: Aggregate) -> ColumnTable | None:
         """Aggregate(Join) without materializing the joined pairs
-        (ops/join_agg.py). Applies when every aggregate is sum/count/mean
-        over a single side's numeric expression and the grouping columns
-        (if any) come from one side; min/max and cross-side expressions
+        (ops/join_agg.py). Applies when every aggregate is
+        sum/count/mean/min/max over a single side's numeric expression
+        and the grouping columns (if any) come from one side; cross-side
+        expressions fall back to the materialized join. min/max run as
+        per-key run-extremum channels on the HOST venue (all equal-key
+        secondary rows are one contiguous run of the sorted side, and
+        extrema are multiplicity-independent); on the device venue they
         fall back to the materialized join."""
+        from hyperspace_tpu import native
         from hyperspace_tpu.ops.aggregate import agg_input, finalize_agg_values, group_ids
 
         child = plan.child
@@ -1120,6 +1125,10 @@ class Executor:
         if not isinstance(child, Join) or child.how != "inner":
             return None
         join = child
+        if any(a.fn in ("min", "max") for a in plan.aggs) and (
+            self._join_venue() != "host" or not native.available()
+        ):
+            return None  # run-extremum channels exist on the host venue only
         lnames = {n.lower() for n in join.left.schema.names}
         rnames = {n.lower() for n in join.right.schema.names}
 
@@ -1140,7 +1149,7 @@ class Executor:
 
         spec_sides: list[str | None] = []
         for a in plan.aggs:
-            if a.fn not in ("sum", "count", "mean"):
+            if a.fn not in ("sum", "count", "mean", "min", "max"):
                 return None
             if a.expr is None:
                 spec_sides.append(None)  # count(*)
@@ -1203,6 +1212,11 @@ class Executor:
             self.stats["join_kernel"] = "host-native-merge-accumulate"
             out, spec_layout = host_res
         else:
+            # The min/max gate above guarantees the host path for
+            # extremum channels; the device kernel has no mm layout.
+            assert not any(a.fn in ("min", "max") for a in plan.aggs), (
+                "host fused path unavailable for a min/max aggregate"
+            )
             self.stats["join_kernel"] = "device-run-prefix"
             out, spec_layout = self._device_fused_channels(
                 plan, data, codes, perms, primary, secondary, spec_sides,
@@ -1338,7 +1352,11 @@ class Executor:
                 parts.append(("star",))
                 continue
             vals, ind = spec_input(s, spec)
-            if s == secondary:
+            if spec.fn in ("min", "max"):
+                # Extremum channels bypass the sum accumulator: per-KEY
+                # run extrema (secondary) / matched-row extrema (primary).
+                parts.append(("mm", spec.fn, s, vals, ind))
+            elif s == secondary:
                 vi = None
                 if spec.fn in ("sum", "mean"):
                     sec_arrays.append(sec_sorted(vals))
@@ -1371,6 +1389,14 @@ class Executor:
                 return np.zeros(k)
             return np.bincount(gid_orig, weights=w, minlength=k)
 
+        mm_rows = None
+        if any(p[0] == "mm" for p in parts):
+            mm_rows = _RunExtremum(
+                codes[primary], data[primary].offsets, pperm,
+                codes[secondary], data[secondary].offsets, perms[secondary],
+                matches, n_l,
+            )
+
         out: list[np.ndarray] = [greduce(matches)]  # star = pairs per group
         spec_layout: list[tuple[int | None, int]] = []
         for part in parts:
@@ -1384,6 +1410,15 @@ class Executor:
                     v_idx = len(out) - 1
                 out.append(greduce(acc[ci]))
                 spec_layout.append((v_idx, len(out) - 1))
+            elif part[0] == "mm":
+                from hyperspace_tpu.ops.aggregate import aggregate_arrays_host
+
+                _, fn, s, vals, ind = part
+                row_ext, row_valid = mm_rows.per_primary_row(fn, s, secondary, vals, ind)
+                res, cnt = aggregate_arrays_host([(row_ext, row_valid, fn)], gid_orig, k)
+                out.append(res[0])
+                out.append(cnt[0])
+                spec_layout.append((len(out) - 2, len(out) - 1))
             else:
                 _, vals, ind = part
                 v_idx = None
@@ -1453,14 +1488,8 @@ class Executor:
         b = len(lside.offsets) - 1
         self.stats["num_buckets"] = b
         self.stats["join_kernel"] = "host-membership-probe"
-        counts_l = np.diff(lside.offsets)
-        counts_r = np.diff(rside.offsets)
-        bucket_l = np.repeat(np.arange(b, dtype=np.int64), counts_l)
-        bucket_r = np.repeat(np.arange(b, dtype=np.int64), counts_r)
-        # Composite (bucket, code) key: codes span int32 (±2^31), buckets
-        # are small — the shifted sum is collision-free in int64.
-        comp_l = (bucket_l << np.int64(33)) + lcodes
-        comp_r = np.sort((bucket_r << np.int64(33)) + rcodes)
+        comp_l = _composite_keys(lcodes, lside.offsets)
+        comp_r = np.sort(_composite_keys(rcodes, rside.offsets))
         pos = np.searchsorted(comp_r, comp_l)
         matched = np.zeros(lt.num_rows, dtype=bool)
         in_range = pos < len(comp_r)
@@ -1728,6 +1757,81 @@ def _concat_side_cached(tables: list[ColumnTable]) -> ColumnTable:
     return dc.HOST_DERIVED.get_or_build(
         ("sidecat", tuple(id(t) for t in tables)), tuple(tables), build
     )
+
+
+def _composite_keys(codes: np.ndarray, offsets: np.ndarray) -> np.ndarray:
+    """(bucket << 33) + code composites: codes span int32 (±2^31) and
+    buckets are small, so the shifted sum is collision-free in int64 and
+    globally SORTED for bucket-major key-sorted inputs. Shared by the
+    semi/anti membership probe and the fused run-extremum channels."""
+    b = np.repeat(np.arange(len(offsets) - 1, dtype=np.int64), np.diff(offsets))
+    return (b << np.int64(33)) + codes.astype(np.int64)
+
+
+class _RunExtremum:
+    """Per-primary-row extrema over the secondary match runs, shared by
+    every min/max channel of one fused join-aggregation. The secondary
+    side is bucket-major key-sorted, so all rows with one key form a
+    contiguous run; the composite key is globally sorted and each
+    primary row's run bounds come from two searchsorteds (built LAZILY —
+    primary-side-only channels never pay for them). Extrema are
+    multiplicity-independent, so the per-KEY extremum stands in for
+    every duplicate primary row with that key."""
+
+    def __init__(self, pri_codes, pri_offsets, pperm, sec_codes, sec_offsets, sperm, matches, n_l):
+        self.sperm = sperm
+        self.pperm = pperm
+        self.matches = matches
+        self.n_l = n_l
+        self._pri = (pri_codes, pri_offsets)
+        self._sec = (sec_codes, sec_offsets)
+        self._runs = None
+
+    def _run_index(self):
+        if self._runs is None:
+            cp = _composite_keys(*self._pri)
+            cs = _composite_keys(*self._sec)
+            st = np.searchsorted(cs, cp, side="left")
+            en = np.searchsorted(cs, cp, side="right")
+            if len(cs):
+                starts = np.concatenate([[0], np.flatnonzero(np.diff(cs) != 0) + 1])
+                ridx = np.clip(
+                    np.searchsorted(starts, st, side="right") - 1, 0, len(starts) - 1
+                )
+            else:
+                starts = np.zeros(0, np.int64)
+                ridx = np.zeros(len(cp), np.int64)
+            self._runs = (st, en, en > st, starts, ridx)
+        return self._runs
+
+    def per_primary_row(self, fn: str, side: str, secondary: str, vals, ind):
+        """(row extremum, row validity) in ORIGINAL primary order for one
+        channel; `vals`/`ind` are the channel's per-orig-row arrays of
+        `side` (invalid slots already zeroed, `ind` marking them)."""
+        identity = np.inf if fn == "min" else -np.inf
+        if side == secondary:
+            _st, _en, has, starts, ridx = self._run_index()
+            sv = vals if self.sperm is None else vals[self.sperm]
+            si = ind if self.sperm is None else ind[self.sperm]
+            if not len(starts):
+                return np.full(self.n_l, identity), np.zeros(self.n_l, bool)
+            op = np.minimum if fn == "min" else np.maximum
+            sv = np.where(si > 0, np.asarray(sv, np.float64), identity)
+            key_ext = op.reduceat(sv, starts)
+            key_validcnt = np.add.reduceat(np.asarray(si, np.float64), starts)
+            ext_sorted = np.where(has, key_ext[ridx], identity)
+            valid_sorted = has & (key_validcnt[ridx] > 0)
+            if self.pperm is not None:
+                ext = np.empty(self.n_l)
+                ext[self.pperm] = ext_sorted
+                valid = np.empty(self.n_l, bool)
+                valid[self.pperm] = valid_sorted
+                return ext, valid
+            return ext_sorted, valid_sorted
+        # Primary-side channel: extremum over the group's MATCHED rows.
+        v = np.where(np.asarray(ind) > 0, np.asarray(vals, np.float64), identity)
+        valid = (self.matches > 0) & (np.asarray(ind) > 0)
+        return v, valid
 
 
 def _desugar_count_distinct(plan: "Aggregate"):

@@ -266,20 +266,103 @@ def test_fused_join_agg_group_by_left_side(tmp_path, join_tables):
     np.testing.assert_array_equal(got["n"], exp["n"])
 
 
-def test_join_agg_minmax_falls_back_to_materialized(tmp_path, join_tables):
+def test_join_agg_minmax(tmp_path, join_tables):
+    """min/max over a join: the HOST venue fuses them as run-extremum
+    channels (per-key extrema of the sorted secondary side); the device
+    venue falls back to the materialized join. Results identical either
+    way, covering secondary-side (amount), primary-side (weight), and
+    mixed sibling aggregates."""
+    from hyperspace_tpu import native
+    from hyperspace_tpu.config import JOIN_VENUE
+
     fact_root, dim_root = join_tables
-    session = _session(tmp_path)
-    fact = session.parquet(fact_root)
-    dim = session.parquet(dim_root)
-    q = fact.join(dim, ["k"]).aggregate(["cat"], [AggSpec.of("max", "amount", "mx")])
-    got = session.to_pandas(q).sort_values("cat").reset_index(drop=True)
-    assert session.last_query_stats["agg_path"].startswith("segment-reduce")  # not fused
     f = pq.read_table(fact_root).to_pandas()
     d = pq.read_table(dim_root).to_pandas()
+    j = f.merge(d, on="k")
     exp = (
-        f.merge(d, on="k").groupby("cat")["amount"].max().reset_index(name="mx")
-    ).sort_values("cat").reset_index(drop=True)
-    np.testing.assert_allclose(got["mx"], exp["mx"])
+        j.groupby("cat")
+        .agg(mx=("amount", "max"), mn=("amount", "min"), wmx=("weight", "max"),
+             sa=("amount", "sum"), n=("cat", "size"))
+        .reset_index()
+        .sort_values("cat")
+        .reset_index(drop=True)
+    )
+    outs = {}
+    for venue in ("host", "device"):
+        if venue == "host" and not native.available():
+            continue
+        session = _session(tmp_path)
+        session.conf.set(JOIN_VENUE, venue)
+        fact = session.parquet(fact_root)
+        dim = session.parquet(dim_root)
+        q = fact.join(dim, ["k"]).aggregate(
+            ["cat"],
+            [
+                AggSpec.of("max", "amount", "mx"),
+                AggSpec.of("min", "amount", "mn"),
+                AggSpec.of("max", "weight", "wmx"),
+                AggSpec.of("sum", "amount", "sa"),
+                AggSpec.of("count", None, "n"),
+            ],
+        )
+        got = session.to_pandas(q).sort_values("cat").reset_index(drop=True)
+        if venue == "host":
+            assert session.last_query_stats["agg_path"] == "fused-join-agg"
+            assert session.last_query_stats["join_kernel"] == "host-native-merge-accumulate"
+        else:
+            assert session.last_query_stats["agg_path"].startswith("segment-reduce")
+        outs[venue] = got
+        assert list(got["cat"]) == list(exp["cat"])
+        for c in ("mx", "mn", "wmx", "sa"):
+            np.testing.assert_allclose(got[c], exp[c], rtol=1e-9, err_msg=f"{venue}.{c}")
+        np.testing.assert_array_equal(got["n"], exp["n"])
+    if len(outs) == 2:
+        pd.testing.assert_frame_equal(outs["host"], outs["device"])
+
+
+def test_fused_minmax_with_nulls_and_unmatched(tmp_path):
+    """Fused min/max null semantics: null measure values are ignored, a
+    group whose matched rows are all-null yields NULL, multiplicity does
+    not skew extrema (duplicate keys), results equal the materialized
+    join."""
+    from hyperspace_tpu import native
+    from hyperspace_tpu.config import JOIN_VENUE
+
+    if not native.available():
+        pytest.skip("native library not built")
+    rng = np.random.default_rng(51)
+    n = 4_000
+    amount = rng.random(n) * 100
+    nulls = rng.random(n) < 0.2
+    fact = pa.table(
+        {
+            "k": rng.integers(0, 80, n).astype(np.int64),
+            "amount": pa.array(np.where(nulls, 0.0, amount), mask=nulls),
+        }
+    )
+    dim = pa.table(
+        {
+            "k": np.arange(60, dtype=np.int64),  # keys 60..79 unmatched
+            "cat": pa.array([f"c{i % 5}" for i in range(60)]),
+        }
+    )
+    (tmp_path / "f").mkdir()
+    (tmp_path / "d").mkdir()
+    pq.write_table(fact, tmp_path / "f" / "p.parquet")
+    pq.write_table(dim, tmp_path / "d" / "p.parquet")
+    session = _session(tmp_path)
+    session.conf.set(JOIN_VENUE, "host")
+    fs, ds = session.parquet(tmp_path / "f"), session.parquet(tmp_path / "d")
+    q = fs.join(ds, ["k"]).aggregate(
+        ["cat"], [AggSpec.of("min", "amount", "mn"), AggSpec.of("max", "amount", "mx")]
+    )
+    got = session.to_pandas(q).sort_values("cat").reset_index(drop=True)
+    assert session.last_query_stats["agg_path"] == "fused-join-agg"
+    fpd = fact.to_pandas()
+    jm = fpd.merge(dim.to_pandas(), on="k")
+    exp = jm.groupby("cat").agg(mn=("amount", "min"), mx=("amount", "max")).reset_index()
+    np.testing.assert_allclose(got["mn"].astype(float), exp["mn"].astype(float), rtol=1e-9)
+    np.testing.assert_allclose(got["mx"].astype(float), exp["mx"].astype(float), rtol=1e-9)
 
 
 def test_aggregate_over_index_rewrite_and_explain(tmp_path, sales):
