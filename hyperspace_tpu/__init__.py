@@ -12,7 +12,7 @@ sort, gather/filter and merge-join kernels); the host plane is pure Python.
 from hyperspace_tpu.exceptions import HyperspaceError
 from hyperspace_tpu.index.index_config import IndexConfig
 from hyperspace_tpu.plan.expr import col, date_lit, day, lit, month, when, year
-from hyperspace_tpu.plan.nodes import AggSpec
+from hyperspace_tpu.plan.nodes import AggSpec, WindowSpec
 from hyperspace_tpu.schema import Field, Schema
 
 __version__ = "0.1.0"
@@ -23,6 +23,7 @@ __all__ = [
     "col",
     "when",
     "AggSpec",
+    "WindowSpec",
     "lit",
     "Field",
     "Schema",

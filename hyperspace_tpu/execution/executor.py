@@ -41,6 +41,7 @@ from hyperspace_tpu.plan.nodes import (
     Scan,
     Sort,
     Union,
+    Window,
 )
 
 
@@ -423,8 +424,17 @@ class Executor:
         if isinstance(plan, Filter):
             return self._filter(plan)
         if isinstance(plan, Project):
-            self._cur_phys.detail["columns"] = list(plan.columns)
-            return self._execute(plan.child).select(plan.columns)
+            self._cur_phys.detail["columns"] = list(plan.output_names)
+            child = self._execute(plan.child)
+            if plan.is_simple:
+                return child.select(plan.columns)
+            from hyperspace_tpu.ops.project import project_table
+
+            self._phys(
+                "ProjectCompute",
+                computed=[c[0] for c in plan.columns if not isinstance(c, str)],
+            )
+            return project_table(child, plan.columns, plan.schema)
         if isinstance(plan, Join):
             return self._join(plan)
         if isinstance(plan, Union):
@@ -432,6 +442,19 @@ class Executor:
             return self._union(plan)
         if isinstance(plan, Aggregate):
             return self._aggregate(plan)
+        if isinstance(plan, Window):
+            from hyperspace_tpu.ops.window import window_table
+
+            t = self._execute(plan.child)
+            self._phys(
+                "WindowSortedSegments",
+                partitions=list(plan.partition_by),
+                frame=plan.frame,
+                funcs=[f.fn for f in plan.funcs],
+            )
+            return window_table(
+                t, plan.partition_by, plan.order_by, plan.funcs, plan.frame, plan.schema
+            )
         if isinstance(plan, Sort):
             return self._sort(plan)
         if isinstance(plan, Limit):
@@ -523,18 +546,29 @@ class Executor:
     def _aggregate(self, plan: "Aggregate") -> ColumnTable:
         from hyperspace_tpu.ops.aggregate import aggregate_table
 
+        if plan.grouping_sets is not None:
+            return self._grouping_sets_aggregate(plan)
         if any(a.fn == "count_distinct" for a in plan.aggs):
-            self._phys("CountDistinctReaggregate")
-            plan2, count_aliases = _desugar_count_distinct(plan)
-            out = self._execute(plan2)
-            # SQL count is never NULL: the outer SUM of count partials
-            # yields NULL over zero inner rows — restore the 0.
-            for alias in count_aliases:
-                f = out.schema.field(alias)
-                v = out.validity.pop(f.name, None)
-                if v is not None:
-                    out.columns[f.name] = np.where(v, out.columns[f.name], 0)
-            return out
+            for a in plan.aggs:
+                if a.fn == "count_distinct" and not isinstance(a.expr, Col):
+                    raise HyperspaceError("count_distinct requires a plain column")
+            dcols = {a.expr.name.lower() for a in plan.aggs if a.fn == "count_distinct"}
+            if len(dcols) == 1 and not any(a.fn == "mean" for a in plan.aggs):
+                # Single distinct column, no mean: the plan-level two-phase
+                # desugar keeps the inner aggregate eligible for the fused
+                # Aggregate(Join) path.
+                self._phys("CountDistinctReaggregate")
+                plan2, count_aliases = _desugar_count_distinct(plan)
+                out = self._execute(plan2)
+                # SQL count is never NULL: the outer SUM of count partials
+                # yields NULL over zero inner rows — restore the 0.
+                for alias in count_aliases:
+                    f = out.schema.field(alias)
+                    v = out.validity.pop(f.name, None)
+                    if v is not None:
+                        out.columns[f.name] = np.where(v, out.columns[f.name], 0)
+                return out
+            return self._distinct_aggregate(plan, sorted(dcols))
         venue = self._agg_venue()
         # Fuse Aggregate(Join) on both venues: the device run-prefix
         # kernel avoids the match-pair readback; the host C++
@@ -565,6 +599,152 @@ class Executor:
         return aggregate_table(
             table, plan.group_by, plan.aggs, plan.schema, venue=venue, mesh=mesh
         )
+
+    def _distinct_aggregate(self, plan: "Aggregate", dcols: list[str]) -> ColumnTable:
+        """General distinct expansion (the Spark planner's Expand analog
+        for multi-distinct aggregates, q38/q87 shapes): execute the child
+        ONCE, factorize the group keys ONCE, run the non-distinct specs
+        as a normal segment reduce sharing that factorization, and count
+        each distinct column by factorizing (group keys, column) pairs —
+        the representative row of each pair maps back to its outer group,
+        so a bincount over pair representatives IS the distinct count.
+        No join, no per-spec re-execution; mean shares freely."""
+        from hyperspace_tpu.ops.aggregate import aggregate_table, group_ids
+        from hyperspace_tpu.schema import Schema
+
+        ct = self._execute(plan.child)
+        venue = self._agg_venue()
+        gid, k, rep = group_ids(ct, plan.group_by)
+        self._phys(
+            "DistinctExpandAggregate",
+            distinct_cols=dcols,
+            groups=len(plan.group_by),
+            venue=venue,
+        )
+        out_schema = plan.schema
+        if k == 0 or (ct.num_rows == 0 and plan.group_by):
+            return ColumnTable.empty(out_schema)
+        regular = [a for a in plan.aggs if a.fn != "count_distinct"]
+        reg_fields = [out_schema.field(c) for c in plan.group_by]
+        reg_fields += [out_schema.field(a.alias) for a in regular]
+        base = aggregate_table(
+            ct, plan.group_by, regular, Schema(tuple(reg_fields)),
+            venue=venue, groups=(gid, k, rep),
+        )
+        cols = dict(base.columns)
+        dicts = dict(base.dictionaries)
+        validity = dict(base.validity)
+        pair_counts: dict[str, np.ndarray] = {}
+        for d in dcols:
+            pgid, pk, prep = group_ids(ct, [*plan.group_by, d])
+            del pgid, pk
+            outer = gid[prep]
+            vd = ct.valid_mask(d)
+            if vd is not None:
+                outer = outer[vd[prep]]  # SQL: distinct counts exclude NULL
+            pair_counts[d] = np.bincount(outer, minlength=k).astype(np.int64)
+        for a in plan.aggs:
+            if a.fn == "count_distinct":
+                cols[out_schema.field(a.alias).name] = pair_counts[a.expr.name.lower()]
+        return ColumnTable(out_schema, cols, dicts, validity)
+
+    def _grouping_sets_aggregate(self, plan: "Aggregate") -> ColumnTable:
+        """ROLLUP / CUBE / GROUPING SETS as ONE finest-grain aggregate
+        (which gets the fused Aggregate(Join) path when it applies) plus
+        cheap re-aggregations of its partials per set — the two-phase
+        machinery the count_distinct desugar introduced, generalized.
+        The union null-extends group columns a set aggregates away;
+        grouping() flags tell data NULLs from subtotal NULLs."""
+        from hyperspace_tpu.ops.aggregate import aggregate_table
+        from hyperspace_tpu.plan.expr import Col
+        from hyperspace_tpu.plan.nodes import AggSpec
+        from hyperspace_tpu.schema import Field, Schema
+
+        if any(a.fn == "count_distinct" for a in plan.aggs):
+            raise HyperspaceError("count_distinct inside grouping sets is not supported")
+
+        # Phase 1: finest grain over the full group_by, means split into
+        # sum+count partials so coarser sets can recompose them exactly.
+        base_specs: list[AggSpec] = []
+        for a in plan.aggs:
+            if a.fn == "grouping":
+                continue
+            if a.fn == "mean":
+                base_specs.append(AggSpec("sum", a.expr, f"__gs_sum_{a.alias}"))
+                base_specs.append(AggSpec("count", a.expr, f"__gs_cnt_{a.alias}"))
+            else:
+                base_specs.append(AggSpec(a.fn, a.expr, a.alias))
+        base = Aggregate(plan.child, plan.group_by, base_specs)
+        bt = self._execute(base)
+
+        out_schema = plan.schema
+        venue = self._agg_venue()
+        self._phys(
+            "GroupingSetsReaggregate",
+            sets=[list(s) for s in plan.grouping_sets],
+            venue=venue,
+        )
+
+        def refold(a: AggSpec) -> list[AggSpec]:
+            """Phase-2 spec(s) re-aggregating a phase-1 partial column."""
+            if a.fn == "mean":
+                return [
+                    AggSpec("sum", Col(f"__gs_sum_{a.alias}"), f"__gs_sum_{a.alias}"),
+                    AggSpec("sum", Col(f"__gs_cnt_{a.alias}"), f"__gs_cnt_{a.alias}"),
+                ]
+            fn2 = "sum" if a.fn in ("sum", "count") else a.fn
+            return [AggSpec(fn2, Col(a.alias), a.alias)]
+
+        parts: list[ColumnTable] = []
+        for s in plan.grouping_sets:
+            specs2 = [sp for a in plan.aggs if a.fn != "grouping" for sp in refold(a)]
+            fields = [bt.schema.field(c) for c in s]
+            for sp in specs2:
+                src = bt.schema.field(sp.expr.name)
+                dtype = src.dtype if sp.fn in ("min", "max") else (
+                    "int64" if src.dtype in ("int32", "int64", "bool", "date") else "float64"
+                )
+                fields.append(Field(sp.alias, dtype))
+            sub = aggregate_table(bt, list(s), specs2, Schema(tuple(fields)), venue=venue)
+
+            in_set = {c.lower() for c in s}
+            cols: dict[str, np.ndarray] = {}
+            dicts: dict[str, np.ndarray] = {}
+            validity: dict[str, np.ndarray] = {}
+            nrows = sub.num_rows
+            for f in out_schema.fields:
+                low = f.name.lower()
+                if low in {c.lower() for c in plan.group_by}:
+                    if low in in_set:
+                        _copy_field(f, sub, f.name, cols, dicts, validity)
+                    else:
+                        _null_field(f, nrows, bt if f.is_string else None, cols, dicts, validity)
+                    continue
+                spec = next(a for a in plan.aggs if a.alias.lower() == low)
+                if spec.fn == "grouping":
+                    cols[f.name] = np.full(
+                        nrows, 0 if spec.expr.name.lower() in in_set else 1, np.int64
+                    )
+                elif spec.fn == "mean":
+                    ssum = sub.column(f"__gs_sum_{spec.alias}").astype(np.float64)
+                    scnt = sub.column(f"__gs_cnt_{spec.alias}").astype(np.float64)
+                    sv = sub.valid_mask(f"__gs_sum_{spec.alias}")
+                    with np.errstate(invalid="ignore", divide="ignore"):
+                        cols[f.name] = np.where(scnt > 0, ssum / np.maximum(scnt, 1), 0.0)
+                    if sv is not None or (scnt == 0).any():
+                        ok = scnt > 0
+                        validity[f.name] = ok if sv is None else (ok & sv)
+                elif spec.fn == "count":
+                    # COUNT is never NULL: zero-row re-folds yield a NULL
+                    # sum partial — restore 0 (same rule as the
+                    # count_distinct desugar's outer sum).
+                    v = sub.valid_mask(spec.alias)
+                    c = sub.column(spec.alias)
+                    cols[f.name] = np.where(v, c, 0) if v is not None else c
+                else:
+                    _copy_field(f, sub, spec.alias, cols, dicts, validity)
+            parts.append(ColumnTable(out_schema, cols, dicts, validity))
+        return ColumnTable.concat(parts)
 
     def _venue(self, conf_attr: str, what: str, prefer_device: bool, needs_native: bool) -> str:
         """One pick_venue wrapper: conf defaults and the shared link floor
@@ -1002,6 +1182,11 @@ class Executor:
         # (possibly hybrid) index scan, in any order.
         while isinstance(node, (Project, Filter)):
             if isinstance(node, Project):
+                if not node.is_simple:
+                    # Computed entries can't be absorbed into the scan
+                    # column list; fall back to the general path (which
+                    # executes the Project node itself).
+                    return None
                 if project is None:  # outermost projection defines output
                     project = node.columns
                 node = node.child
@@ -1112,11 +1297,11 @@ class Executor:
         sum/count/mean/min/max over a single side's numeric expression
         and the grouping columns (if any) come from one side; cross-side
         expressions fall back to the materialized join. min/max run as
-        per-key run-extremum channels on the HOST venue (all equal-key
-        secondary rows are one contiguous run of the sorted side, and
-        extrema are multiplicity-independent); on the device venue they
-        fall back to the materialized join."""
-        from hyperspace_tpu import native
+        run-extremum channels on BOTH venues (all equal-key secondary
+        rows are one contiguous run of the sorted side, and extrema are
+        multiplicity-independent): the host C++ pass walks runs directly;
+        the device kernel takes the segmented-prefix-scan value at each
+        run end and folds groups with segment_min/max."""
         from hyperspace_tpu.ops.aggregate import agg_input, finalize_agg_values, group_ids
 
         child = plan.child
@@ -1125,10 +1310,6 @@ class Executor:
         if not isinstance(child, Join) or child.how != "inner":
             return None
         join = child
-        if any(a.fn in ("min", "max") for a in plan.aggs) and (
-            self._join_venue() != "host" or not native.available()
-        ):
-            return None  # run-extremum channels exist on the host venue only
         lnames = {n.lower() for n in join.left.schema.names}
         rnames = {n.lower() for n in join.right.schema.names}
 
@@ -1212,11 +1393,6 @@ class Executor:
             self.stats["join_kernel"] = "host-native-merge-accumulate"
             out, spec_layout = host_res
         else:
-            # The min/max gate above guarantees the host path for
-            # extremum channels; the device kernel has no mm layout.
-            assert not any(a.fn in ("min", "max") for a in plan.aggs), (
-                "host fused path unavailable for a min/max aggregate"
-            )
             self.stats["join_kernel"] = "device-run-prefix"
             out, spec_layout = self._device_fused_channels(
                 plan, data, codes, perms, primary, secondary, spec_sides,
@@ -1303,14 +1479,34 @@ class Executor:
         p_arrays: list[np.ndarray] = []
         s_arrays: list[np.ndarray] = []
 
-        def add_channel(side: str, padded: np.ndarray) -> int:
+        def add_channel(side: str, padded: np.ndarray, fn: str | None = None) -> int:
+            base = "p" if side == primary else "s"
+            kind = base + fn if fn in ("min", "max") else base
             if side == primary:
                 p_arrays.append(padded)
-                channels.append(("p", len(p_arrays) - 1))
+                channels.append((kind, len(p_arrays) - 1))
             else:
                 s_arrays.append(padded)
-                channels.append(("s", len(s_arrays) - 1))
+                channels.append((kind, len(s_arrays) - 1))
             return len(channels) - 1
+
+        def mm_values(vals: np.ndarray, ind: np.ndarray, fn: str) -> np.ndarray:
+            """Extremum channel input: nulls (and later pads) carry the
+            ±inf identity instead of the sum channels' zero. Identity-
+            cached so the derived pad/upload caches stay warm for stable
+            sides."""
+            ident = np.inf if fn == "min" else -np.inf
+
+            def build():
+                out = np.where(ind > 0, vals, ident)
+                dcache.freeze(out)
+                return out
+
+            if dcache.is_stable(vals) and dcache.is_stable(ind):
+                return dcache.derived(
+                    ("mmvals", id(vals), id(ind), fn), (vals, ind), build
+                )
+            return np.where(ind > 0, vals, ident)
 
         spec_layout: list[tuple[int | None, int]] = []  # (value ch, count ch; 0=star)
         for spec, s in zip(plan.aggs, spec_sides):
@@ -1321,6 +1517,11 @@ class Executor:
             vi = None
             if spec.fn in ("sum", "mean"):
                 vi = add_channel(s, pad_rows(s, vals))
+            elif spec.fn in ("min", "max"):
+                ident = np.inf if spec.fn == "min" else -np.inf
+                vi = add_channel(
+                    s, pad_rows(s, mm_values(vals, ind, spec.fn), fill=ident), spec.fn
+                )
             ci = add_channel(s, pad_rows(s, ind))
             spec_layout.append((vi, ci))
 
@@ -1845,26 +2046,10 @@ def _desugar_count_distinct(plan: "Aggregate"):
     of the original count specs — the caller zero-fills their NULLs)."""
     from hyperspace_tpu.plan.nodes import AggSpec, Aggregate
 
-    dcol = None
-    dnames: set[str] = set()
-    for a in plan.aggs:
-        if a.fn == "mean":
-            raise HyperspaceError(
-                "mean cannot share an aggregate with count_distinct; "
-                "compute sum and count instead and divide"
-            )
-        if a.fn != "count_distinct":
-            continue
-        if not isinstance(a.expr, Col):
-            raise HyperspaceError("count_distinct requires a plain column")
-        dnames.add(a.expr.name.lower())
-        if dcol is None:
-            dcol = a.expr.name
-    if len(dnames) != 1:
-        raise HyperspaceError(
-            "one aggregate supports a single distinct column; compute "
-            "further distinct counts in separate aggregates and join"
-        )
+    # The caller routes multi-distinct / mean-sharing aggregates to
+    # _distinct_aggregate; this fast path sees exactly one distinct
+    # column and no mean.
+    dcol = next(a.expr.name for a in plan.aggs if a.fn == "count_distinct")
     group_low = {c.lower() for c in plan.group_by}
     inner_groups = list(plan.group_by) + ([dcol] if dcol.lower() not in group_low else [])
     inner_aggs: list = []

@@ -27,16 +27,22 @@ def _node_label(plan: LogicalPlan) -> str:
     if isinstance(plan, Filter):
         return f"Filter {plan.predicate.to_json()}"
     if isinstance(plan, Project):
-        return f"Project {plan.columns}"
+        return f"Project {plan.output_names}"
     if isinstance(plan, Join):
         return f"Join on {list(zip(plan.left_on, plan.right_on))}"
     if isinstance(plan, Union):
         return "HybridScanUnion"
-    from hyperspace_tpu.plan.nodes import Aggregate, Limit, Sort
+    from hyperspace_tpu.plan.nodes import Aggregate, Limit, Sort, Window
 
     if isinstance(plan, Aggregate):
         aggs = [f"{a.fn}({a.alias})" for a in plan.aggs]
         return f"Aggregate groupBy={plan.group_by} aggs={aggs}"
+    if isinstance(plan, Window):
+        funcs = [f"{f.fn}({f.alias})" for f in plan.funcs]
+        return (
+            f"Window partitionBy={plan.partition_by} orderBy={plan.order_by} "
+            f"frame={plan.frame} funcs={funcs}"
+        )
     if isinstance(plan, Sort):
         return f"Sort by={plan.by}"
     if isinstance(plan, Limit):

@@ -212,6 +212,12 @@ def _expr_input(table: ColumnTable, e) -> tuple[np.ndarray, np.ndarray | None]:
         return table.columns[f.name], table.valid_mask(e.name)
     if isinstance(e, _Lit):
         return np.asarray(e.value), None
+    from hyperspace_tpu.plan.expr import DatePart as _DatePart
+    from hyperspace_tpu.plan.expr import eval_date_part
+
+    if isinstance(e, _DatePart):
+        vals, valid = _expr_input(table, e.child)
+        return eval_date_part(e.part, _full(np.asarray(vals), table.num_rows), np), valid
     from hyperspace_tpu.plan.expr import BinOp as _BinOp
 
     if isinstance(e, _BinOp):
@@ -397,9 +403,13 @@ def aggregate_table(
     table: ColumnTable, group_by: list[str], aggs: list, out_schema: Schema,
     venue: str = "device",
     mesh=None,
+    groups: tuple | None = None,
 ) -> ColumnTable:
-    """Execute a grouped aggregation over a materialized table."""
-    gid, k, first_idx = group_ids(table, group_by)
+    """Execute a grouped aggregation over a materialized table.
+    `groups` optionally passes a precomputed (gid, K, first_idx)
+    factorization so callers sharing one key layout across several
+    aggregations (distinct expansion, grouping sets) don't re-factorize."""
+    gid, k, first_idx = groups if groups is not None else group_ids(table, group_by)
 
     inputs = []
     string_dicts: dict[int, np.ndarray] = {}

@@ -28,40 +28,87 @@ import jax
 import jax.numpy as jnp
 
 
+def _seg_scan_extremum(vals, new_seg, op):
+    """Segmented inclusive prefix min/max along the last axis: the scan
+    restarts where `new_seg` is True. Standard associative segmented-scan
+    operator — maps to one `lax.associative_scan` (log-depth on device)."""
+
+    def comb(a, b):
+        fa, va = a
+        fb, vb = b
+        return fa | fb, jnp.where(fb, vb, op(va, vb))
+
+    _, out = jax.lax.associative_scan(comb, (new_seg, vals), axis=-1)
+    return out
+
+
 @functools.partial(jax.jit, static_argnames=("num_segments", "channels"))
 def _fused_join_agg(pk, sk, pvals, svals, gid, num_segments: int, channels: tuple):
     """pk/sk: [B, Lp]/[B, Ls] per-bucket sorted int32 codes (pads carry
     the dtype max). pvals [Ap, B, Lp] / svals [As, B, Ls]: float64
-    per-row channel values (nulls and pads pre-zeroed). gid [B, Lp]:
-    group ids (pads → num_segments-1). channels: ('star',) | ('p', j) |
-    ('s', j). Returns [len(channels), num_segments] float64."""
+    per-row channel values (nulls and pads pre-zeroed for sum channels,
+    pre-set to the ±inf identity for extremum channels). gid [B, Lp]:
+    group ids (pads → num_segments-1). channels: ('star',) | ('p'|'s', j)
+    sum channels | ('pmin'|'pmax'|'smin'|'smax', j) run-extremum channels
+    (an equi-join match run IS one key segment of the sorted secondary,
+    so its extremum is the segmented prefix scan value at the run end).
+    Returns [len(channels), num_segments] float64."""
 
     def one(pkb, skb, pvb, svb, gidb):
         st = jnp.searchsorted(skb, pkb, side="left").astype(jnp.int32)
         en = jnp.searchsorted(skb, pkb, side="right").astype(jnp.int32)
         real = pkb < jnp.iinfo(pkb.dtype).max
+        matched = real & (en > st)
         runlen = jnp.where(real, en - st, 0).astype(jnp.float64)
         p_prefix = None
-        if svb.shape[0]:
+        if svb.shape[0] and any(ch[0] == "s" for ch in channels):
             p_prefix = jnp.concatenate(
                 [jnp.zeros((svb.shape[0], 1), svb.dtype), jnp.cumsum(svb, axis=-1)],
                 axis=-1,
             )
-        ws = []
+        new_key = None
+        if any(ch[0] in ("smin", "smax") for ch in channels):
+            new_key = jnp.concatenate(
+                [jnp.ones(1, bool), skb[1:] != skb[:-1]]
+            )
+        outs = []
         for ch in channels:
-            if ch[0] == "star":
-                w = runlen
-            elif ch[0] == "p":
-                w = pvb[ch[1]] * runlen
-            else:
+            kind = ch[0]
+            if kind == "star":
+                outs.append(jax.ops.segment_sum(runlen, gidb, num_segments))
+            elif kind == "p":
+                outs.append(jax.ops.segment_sum(pvb[ch[1]] * runlen, gidb, num_segments))
+            elif kind == "s":
                 pj = p_prefix[ch[1]]
                 w = jnp.where(real, pj[en] - pj[st], 0.0)
-            ws.append(w)
-        w_all = jnp.stack(ws)  # [C, Lp]
-        return jax.vmap(lambda w: jax.ops.segment_sum(w, gidb, num_segments))(w_all)
+                outs.append(jax.ops.segment_sum(w, gidb, num_segments))
+            else:
+                is_min = kind.endswith("min")
+                ident = jnp.inf if is_min else -jnp.inf
+                seg_red = jax.ops.segment_min if is_min else jax.ops.segment_max
+                if kind[0] == "p":
+                    w = jnp.where(matched, pvb[ch[1]], ident)
+                else:
+                    m = _seg_scan_extremum(
+                        svb[ch[1]], new_key, jnp.minimum if is_min else jnp.maximum
+                    )
+                    w = jnp.where(matched, m[jnp.maximum(en - 1, 0)], ident)
+                outs.append(seg_red(w, gidb, num_segments))
+        return jnp.stack(outs)
 
     per_bucket = jax.vmap(one)(pk, sk, pvals.transpose(1, 0, 2), svals.transpose(1, 0, 2), gid)
-    return jnp.sum(per_bucket, axis=0)  # [C, num_segments]
+    # Combine across buckets per channel kind (a group's rows can span
+    # buckets only via the primary side's bucketing; sums add, extrema
+    # fold with their own op).
+    combined = []
+    for c, ch in enumerate(channels):
+        if ch[0] == "pmin" or ch[0] == "smin":
+            combined.append(jnp.min(per_bucket[:, c], axis=0))
+        elif ch[0] == "pmax" or ch[0] == "smax":
+            combined.append(jnp.max(per_bucket[:, c], axis=0))
+        else:
+            combined.append(jnp.sum(per_bucket[:, c], axis=0))
+    return jnp.stack(combined)  # [C, num_segments]
 
 
 def fused_join_aggregate(

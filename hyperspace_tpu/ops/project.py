@@ -1,0 +1,113 @@
+"""Computed projection: evaluate named select-list expressions.
+
+The reference gets computed select lists (SELECT a*b AS x) from
+Catalyst's Project operator for free; our IR carries (alias, Expr)
+entries and this op materializes them over a ColumnTable. Numeric
+expressions ride the same (values, validity) evaluation the aggregate
+inputs use (ops/aggregate._numeric_input — 3-valued nulls, CASE with
+branch-following validity); boolean expressions ride the fused filter
+mask machinery; SUBSTRING over a string column maps the (small, sorted)
+dictionary and re-sorts so the engine's order-preserving-codes invariant
+holds for downstream comparisons.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu.execution.table import ColumnTable
+from hyperspace_tpu.plan.expr import (
+    And,
+    BinOp,
+    Col,
+    Expr,
+    InList,
+    IsNull,
+    Like,
+    Not,
+    Or,
+    Substr,
+    expr_dtype,
+)
+
+
+def _bool_column(table: ColumnTable, e: Expr) -> tuple[np.ndarray, np.ndarray | None]:
+    """SQL boolean value of a predicate: True / False / NULL(unknown).
+    The filter machinery computes true-masks only (unknown folds to
+    False — correct for WHERE); a projected boolean additionally needs
+    the false-mask to tell False from NULL."""
+    from hyperspace_tpu.ops.filter import eval_predicate_mask
+
+    tmask = eval_predicate_mask(table, e)
+    fmask = eval_predicate_mask(table, Not(e))
+    known = tmask | fmask
+    return tmask, None if known.all() else known
+
+
+def _substr_column(
+    table: ColumnTable, e: Substr
+) -> tuple[np.ndarray, np.ndarray, np.ndarray | None]:
+    """(codes, sorted dictionary, validity) for SUBSTRING(col, s, l)."""
+    if not isinstance(e.child, Col):
+        raise HyperspaceError("SUBSTRING projection requires a string column input")
+    f = table.schema.field(e.child.name)
+    if not f.is_string:
+        raise HyperspaceError(f"SUBSTRING over non-string column {f.name!r}")
+    d = table.dictionaries[f.name]
+    lo = e.start - 1
+    sub = np.array([s[lo : lo + e.length] for s in d], dtype=object)
+    new_dict, inverse = np.unique(sub.astype(str), return_inverse=True)
+    codes = inverse.astype(np.int32)[table.columns[f.name]]
+    return codes, new_dict, table.valid_mask(f.name)
+
+
+def compute_column(
+    table: ColumnTable, e: Expr, dtype: str
+) -> tuple[np.ndarray, np.ndarray | None, np.ndarray | None]:
+    """Evaluate one computed projection entry.
+
+    Returns (values, dictionary or None, validity or None); values are
+    physical (codes when a dictionary is returned).
+    """
+    from hyperspace_tpu.ops.aggregate import _numeric_input
+    from hyperspace_tpu.schema import Field
+
+    if isinstance(e, Substr):
+        codes, d, valid = _substr_column(table, e)
+        return codes, d, valid
+    if dtype == "bool" and isinstance(e, (And, Or, Not, IsNull, InList, Like)) or (
+        isinstance(e, BinOp) and e.is_comparison
+    ):
+        vals, valid = _bool_column(table, e)
+        return vals, None, valid
+    if dtype == "string":
+        raise HyperspaceError(
+            f"cannot project string-typed expression {type(e).__name__}"
+        )
+    vals, valid = _numeric_input(table, e)
+    phys = Field("_", dtype).device_dtype
+    return np.asarray(vals).astype(phys, copy=False), None, valid
+
+
+def project_table(table: ColumnTable, columns: list, out_schema) -> ColumnTable:
+    """Execute a Project with computed entries over a host table."""
+    cols: dict[str, np.ndarray] = {}
+    dicts: dict[str, np.ndarray] = {}
+    validity: dict[str, np.ndarray] = {}
+    for entry, field in zip(columns, out_schema.fields):
+        if isinstance(entry, str):
+            f = table.schema.field(entry)
+            cols[field.name] = table.columns[f.name]
+            if f.name in table.dictionaries:
+                dicts[field.name] = table.dictionaries[f.name]
+            if f.name in table.validity:
+                validity[field.name] = table.validity[f.name]
+            continue
+        vals, d, valid = compute_column(table, entry[1], field.dtype)
+        cols[field.name] = vals
+        if d is not None:
+            dicts[field.name] = d
+        if valid is not None and not valid.all():
+            validity[field.name] = np.asarray(valid, dtype=bool)
+    return ColumnTable(out_schema, cols, dicts, validity)

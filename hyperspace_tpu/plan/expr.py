@@ -385,6 +385,46 @@ def expr_from_json(d: dict[str, Any]) -> Expr:
     raise ValueError(f"unknown expr type {t!r}")
 
 
+def expr_dtype(e: Expr, schema) -> str:
+    """Engine dtype an expression produces when evaluated over `schema`.
+    The projection analog of Catalyst's expression type resolution (the
+    reference leans on Spark for it; our Project carries named computed
+    expressions, so the IR must type them itself)."""
+    if isinstance(e, Col):
+        return schema.field(e.name).dtype
+    if isinstance(e, Lit):
+        if isinstance(e.value, bool):
+            return "bool"
+        if isinstance(e.value, int):
+            return "int64"
+        if isinstance(e.value, float):
+            return "float64"
+        return "string"
+    if isinstance(e, BinOp):
+        if e.op in _CMP_OPS:
+            return "bool"
+        lt, rt = expr_dtype(e.left, schema), expr_dtype(e.right, schema)
+        if e.op == "div" or "float64" in (lt, rt) or "float32" in (lt, rt):
+            return "float64"
+        return "int64"
+    if isinstance(e, (And, Or, Not, IsNull, InList, Like)):
+        return "bool"
+    if isinstance(e, Case):
+        ts = [expr_dtype(v, schema) for _, v in e.branches] + [expr_dtype(e.default, schema)]
+        if all(t == ts[0] for t in ts):
+            return ts[0]
+        if any(t in ("float64", "float32") for t in ts):
+            return "float64"
+        if all(t in ("int32", "int64", "bool", "date") for t in ts):
+            return "int64"
+        raise ValueError(f"CASE branches mix incompatible types {ts}")
+    if isinstance(e, DatePart):
+        return "int64"
+    if isinstance(e, Substr):
+        return "string"
+    raise ValueError(f"cannot type expression {type(e).__name__}")
+
+
 def split_conjuncts(e: Expr) -> list[Expr]:
     """Flatten a conjunction into its factors (CNF top level).
 

@@ -29,8 +29,21 @@ class LogicalPlan:
     def filter(self, predicate: Expr) -> "Filter":
         return Filter(self, predicate)
 
-    def select(self, *columns: str) -> "Project":
+    def select(self, *columns) -> "Project":
+        """Project columns. Entries are names (passthrough) or
+        ``(alias, Expr)`` pairs for computed output columns."""
         return Project(self, list(columns))
+
+    def with_column(self, alias: str, expression) -> "Project":
+        """Add one computed column, or replace an existing column of the
+        same name (Spark withColumn semantics)."""
+        entries = [
+            (alias, expression) if c.lower() == alias.lower() else c
+            for c in self.schema.names
+        ]
+        if not any(c.lower() == alias.lower() for c in self.schema.names):
+            entries.append((alias, expression))
+        return Project(self, entries)
 
     def join(
         self,
@@ -41,11 +54,52 @@ class LogicalPlan:
     ) -> "Join":
         return Join(self, other, list(left_on), list(right_on or left_on), how)
 
-    def aggregate(self, group_by: list[str], aggs: list) -> "Aggregate":
+    def aggregate(
+        self, group_by: list[str], aggs: list, grouping_sets: list[list[str]] | None = None
+    ) -> "Aggregate":
         """Grouped aggregation. `aggs` entries are AggSpec or
-        (fn, expr|column|None, alias) tuples; fn ∈ sum/count/min/max/mean."""
+        (fn, expr|column|None, alias) tuples; fn ∈ sum/count/min/max/mean
+        (+ count_distinct, and grouping with grouping_sets)."""
         specs = [a if isinstance(a, AggSpec) else AggSpec.of(*a) for a in aggs]
-        return Aggregate(self, list(group_by), specs)
+        return Aggregate(self, list(group_by), specs, grouping_sets=grouping_sets)
+
+    def rollup(self, group_by: list[str], aggs: list) -> "Aggregate":
+        """SQL GROUP BY ROLLUP(c1..cn): grouping sets are the prefixes
+        (c1..cn), (c1..cn-1), ..., () — subtotals at every level plus the
+        grand total."""
+        sets = [list(group_by[:i]) for i in range(len(group_by), -1, -1)]
+        return self.aggregate(group_by, aggs, grouping_sets=sets)
+
+    def cube(self, group_by: list[str], aggs: list) -> "Aggregate":
+        """SQL GROUP BY CUBE(c1..cn): all 2^n column subsets."""
+        import itertools
+
+        sets = [
+            [c for c in group_by if c in chosen]
+            for r in range(len(group_by), -1, -1)
+            for chosen in map(set, itertools.combinations(group_by, r))
+        ]
+        return self.aggregate(group_by, aggs, grouping_sets=sets)
+
+    def window(
+        self,
+        partition_by: list[str],
+        order_by: list | None = None,
+        funcs: list | None = None,
+        frame: str | None = None,
+    ) -> "Window":
+        """Window functions. `funcs` entries are WindowSpec or
+        (fn, expr|column|None, alias) tuples; `order_by` entries are
+        names or (name, asc) pairs. Default frame: SQL's — "range"
+        (peers share) when an ORDER BY is present, else the whole
+        partition."""
+        ob = []
+        for b in order_by or []:
+            ob.append((b[0], bool(b[1])) if isinstance(b, tuple) else (b, True))
+        specs = [f if isinstance(f, WindowSpec) else WindowSpec.of(*f) for f in funcs or []]
+        if frame is None:
+            frame = "range" if ob else "partition"
+        return Window(self, list(partition_by), ob, specs, frame)
 
     def sort(self, by: list, ascending: bool | list[bool] = True) -> "Sort":
         """Order by columns. `by` entries are names or (name, asc) pairs."""
@@ -152,18 +206,62 @@ class Filter(LogicalPlan):
 
 @dataclasses.dataclass
 class Project(LogicalPlan):
+    """Projection with optional named computed expressions. Entries of
+    `columns` are either a column name (passthrough) or an
+    ``(alias, Expr)`` pair (`SELECT a*b AS x` — the reference gets
+    computed select lists from Catalyst's Project for free; our IR
+    carries them explicitly and types them via expr_dtype)."""
+
     child: LogicalPlan
-    columns: list[str]
+    columns: list
+
+    @property
+    def is_simple(self) -> bool:
+        """True iff every entry is a plain passthrough column name."""
+        return all(isinstance(c, str) for c in self.columns)
+
+    @property
+    def output_names(self) -> list[str]:
+        return [c if isinstance(c, str) else c[0] for c in self.columns]
+
+    def input_columns(self) -> set[str]:
+        """Lowercased child columns the projection reads (what index
+        coverage checks and column pruning need)."""
+        out: set[str] = set()
+        for c in self.columns:
+            if isinstance(c, str):
+                out.add(c.lower())
+            else:
+                out |= c[1].references()
+        return out
 
     @property
     def schema(self) -> Schema:
-        return self.child.schema.select(self.columns)
+        from hyperspace_tpu.plan.expr import expr_dtype
+        from hyperspace_tpu.schema import Field
+
+        if self.is_simple:
+            return self.child.schema.select(self.columns)
+        child = self.child.schema
+        fields = []
+        for c in self.columns:
+            if isinstance(c, str):
+                fields.append(child.field(c))
+            else:
+                fields.append(Field(c[0], expr_dtype(c[1], child)))
+        return Schema(tuple(fields))
 
     def children(self) -> list[LogicalPlan]:
         return [self.child]
 
     def to_json(self) -> dict[str, Any]:
-        return {"type": "project", "child": self.child.to_json(), "columns": self.columns}
+        if self.is_simple:
+            return {"type": "project", "child": self.child.to_json(), "columns": self.columns}
+        cols = [
+            c if isinstance(c, str) else {"alias": c[0], "expr": c[1].to_json()}
+            for c in self.columns
+        ]
+        return {"type": "project", "child": self.child.to_json(), "columns": cols}
 
 
 @dataclasses.dataclass
@@ -263,17 +361,23 @@ class AggSpec:
     count_distinct counts distinct non-null values of a column and
     executes as a two-phase re-aggregation (the executor desugars it)."""
 
-    fn: str  # sum | count | min | max | mean | count_distinct
+    fn: str  # sum | count | min | max | mean | count_distinct | grouping
     expr: Expr | None
     alias: str
 
-    _FNS = ("sum", "count", "min", "max", "mean", "count_distinct")
+    _FNS = ("sum", "count", "min", "max", "mean", "count_distinct", "grouping")
 
     def __post_init__(self):
+        from hyperspace_tpu.plan.expr import Col
+
         if self.fn not in self._FNS:
             raise ValueError(f"unknown aggregate fn {self.fn!r}")
         if self.expr is None and self.fn != "count":
             raise ValueError(f"{self.fn} requires an input expression")
+        if self.fn == "grouping" and not isinstance(self.expr, Col):
+            # SQL GROUPING(col): 1 when the output row aggregates the
+            # column away (a coarser grouping set), else 0.
+            raise ValueError("grouping() takes a single group-by column")
 
     @staticmethod
     def of(fn: str, expr=None, alias: str | None = None) -> "AggSpec":
@@ -313,6 +417,12 @@ class Aggregate(LogicalPlan):
     child: LogicalPlan
     group_by: list[str]
     aggs: list[AggSpec]
+    # GROUPING SETS: each entry is a subset of group_by; the output is
+    # the union of re-groupings (ROLLUP/CUBE desugar to this). None =
+    # plain GROUP BY. Executes as ONE finest-grain aggregate + cheap
+    # re-aggregations of its partials (the two-phase machinery that
+    # count_distinct pioneered, generalized).
+    grouping_sets: list[list[str]] | None = None
 
     def __post_init__(self):
         seen: set[str] = set()
@@ -320,6 +430,17 @@ class Aggregate(LogicalPlan):
             if name in seen:
                 raise ValueError(f"duplicate output column {name!r} in aggregate")
             seen.add(name)
+        gset = {c.lower() for c in self.group_by}
+        if self.grouping_sets is not None:
+            for s in self.grouping_sets:
+                if not {c.lower() for c in s} <= gset:
+                    raise ValueError(f"grouping set {s} is not a subset of group_by")
+        for a in self.aggs:
+            if a.fn == "grouping":
+                if self.grouping_sets is None:
+                    raise ValueError("grouping() requires grouping sets / rollup")
+                if a.expr.name.lower() not in gset:
+                    raise ValueError(f"grouping({a.expr.name}) is not a group-by column")
 
     @property
     def schema(self) -> Schema:
@@ -329,7 +450,7 @@ class Aggregate(LogicalPlan):
         child = self.child.schema
         fields = [child.field(c) for c in self.group_by]
         for a in self.aggs:
-            if a.fn in ("count", "count_distinct"):
+            if a.fn in ("count", "count_distinct", "grouping"):
                 dtype = "int64"
             elif a.fn == "mean":
                 dtype = "float64"
@@ -348,11 +469,138 @@ class Aggregate(LogicalPlan):
         return [self.child]
 
     def to_json(self) -> dict[str, Any]:
-        return {
+        d = {
             "type": "aggregate",
             "child": self.child.to_json(),
             "groupBy": self.group_by,
             "aggs": [a.to_json() for a in self.aggs],
+        }
+        if self.grouping_sets is not None:
+            d["groupingSets"] = [list(s) for s in self.grouping_sets]
+        return d
+
+
+@dataclasses.dataclass
+class WindowSpec:
+    """One window function: fn over an expression (None for the ranking
+    family and count(*))."""
+
+    fn: str  # row_number | rank | dense_rank | sum | count | mean | min | max
+    expr: Expr | None
+    alias: str
+
+    _FNS = ("row_number", "rank", "dense_rank", "sum", "count", "mean", "min", "max")
+    RANKING = ("row_number", "rank", "dense_rank")
+
+    def __post_init__(self):
+        if self.fn not in self._FNS:
+            raise ValueError(f"unknown window fn {self.fn!r}")
+        if self.expr is None and self.fn not in (*self.RANKING, "count"):
+            raise ValueError(f"{self.fn} requires an input expression")
+        if self.expr is not None and self.fn in self.RANKING:
+            raise ValueError(f"{self.fn} takes no input expression")
+
+    @staticmethod
+    def of(fn: str, expr=None, alias: str | None = None) -> "WindowSpec":
+        from hyperspace_tpu.plan.expr import Col
+
+        if isinstance(expr, str):
+            expr = Col(expr)
+        if alias is None:
+            base = expr.name if isinstance(expr, Col) else ("star" if expr is None else "expr")
+            alias = f"{fn}_{base}" if expr is not None else fn
+        return WindowSpec(fn, expr, alias)
+
+    def references(self) -> set[str]:
+        return self.expr.references() if self.expr is not None else set()
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "fn": self.fn,
+            "expr": self.expr.to_json() if self.expr is not None else None,
+            "alias": self.alias,
+        }
+
+    @staticmethod
+    def from_json(d: dict[str, Any]) -> "WindowSpec":
+        e = expr_from_json(d["expr"]) if d.get("expr") is not None else None
+        return WindowSpec(d["fn"], e, d["alias"])
+
+
+WINDOW_FRAMES = ("partition", "rows", "range")
+
+
+@dataclasses.dataclass
+class Window(LogicalPlan):
+    """Window functions over partitions: every child row passes through
+    with one extra column per WindowSpec. The reference's environment gets
+    Spark's Window exec; the TPU build formulates it as sorted segments
+    over the engine's order-preserving key lanes (ops/window.py).
+
+    `frame` applies to the aggregate functions:
+      - "partition": the whole partition (no ORDER BY needed);
+      - "rows":  ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW;
+      - "range": RANGE ... CURRENT ROW (peer rows by the order key share
+        the frame result — SQL's default frame when ORDER BY is present).
+    Ranking functions always need an ORDER BY and ignore the frame."""
+
+    child: LogicalPlan
+    partition_by: list[str]
+    order_by: list[tuple[str, bool]]
+    funcs: list["WindowSpec"]
+    frame: str = "partition"
+
+    def __post_init__(self):
+        if not self.funcs:
+            raise ValueError("window requires at least one function")
+        if self.frame not in WINDOW_FRAMES:
+            raise ValueError(f"unknown window frame {self.frame!r}; one of {WINDOW_FRAMES}")
+        if self.frame != "partition" and not self.order_by:
+            raise ValueError(f"window frame {self.frame!r} requires an ORDER BY")
+        if not self.order_by and any(f.fn in WindowSpec.RANKING for f in self.funcs):
+            raise ValueError("ranking window functions require an ORDER BY")
+        child_names = {n.lower() for n in self.child.schema.names}
+        seen = set(child_names)
+        for f in self.funcs:
+            low = f.alias.lower()
+            if low in seen:
+                raise ValueError(f"window output column {f.alias!r} collides")
+            seen.add(low)
+
+    @property
+    def schema(self) -> Schema:
+        from hyperspace_tpu.plan.expr import Col
+        from hyperspace_tpu.schema import Field
+
+        child = self.child.schema
+        fields = list(child.fields)
+        for f in self.funcs:
+            if f.fn in (*WindowSpec.RANKING, "count"):
+                dtype = "int64"
+            elif f.fn == "mean":
+                dtype = "float64"
+            elif isinstance(f.expr, Col):
+                src = child.field(f.expr.name)
+                if f.fn in ("min", "max"):
+                    dtype = src.dtype
+                else:  # sum widens integers
+                    dtype = "int64" if src.dtype in ("int32", "int64", "bool", "date") else "float64"
+            else:
+                dtype = "float64"
+            fields.append(Field(f.alias, dtype))
+        return Schema(tuple(fields))
+
+    def children(self) -> list[LogicalPlan]:
+        return [self.child]
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "type": "window",
+            "child": self.child.to_json(),
+            "partitionBy": self.partition_by,
+            "orderBy": [[c, bool(a)] for c, a in self.order_by],
+            "funcs": [f.to_json() for f in self.funcs],
+            "frame": self.frame,
         }
 
 
@@ -415,7 +663,11 @@ def plan_from_json(d: dict[str, Any]) -> LogicalPlan:
     if t == "filter":
         return Filter(plan_from_json(d["child"]), expr_from_json(d["predicate"]))
     if t == "project":
-        return Project(plan_from_json(d["child"]), list(d["columns"]))
+        cols = [
+            c if isinstance(c, str) else (c["alias"], expr_from_json(c["expr"]))
+            for c in d["columns"]
+        ]
+        return Project(plan_from_json(d["child"]), cols)
     if t == "union":
         return Union([plan_from_json(c) for c in d["inputs"]])
     if t == "join":
@@ -427,10 +679,20 @@ def plan_from_json(d: dict[str, Any]) -> LogicalPlan:
             d.get("how", "inner"),
         )
     if t == "aggregate":
+        gs = d.get("groupingSets")
         return Aggregate(
             plan_from_json(d["child"]),
             list(d["groupBy"]),
             [AggSpec.from_json(a) for a in d["aggs"]],
+            grouping_sets=[list(s) for s in gs] if gs is not None else None,
+        )
+    if t == "window":
+        return Window(
+            plan_from_json(d["child"]),
+            list(d["partitionBy"]),
+            [(c, bool(a)) for c, a in d["orderBy"]],
+            [WindowSpec.from_json(f) for f in d["funcs"]],
+            d.get("frame", "partition"),
         )
     if t == "sort":
         return Sort(plan_from_json(d["child"]), [(c, bool(a)) for c, a in d["by"]])

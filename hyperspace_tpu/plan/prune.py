@@ -24,6 +24,7 @@ from hyperspace_tpu.plan.nodes import (
     Scan,
     Sort,
     Union,
+    Window,
 )
 
 
@@ -45,12 +46,23 @@ def prune_columns(plan: LogicalPlan, needed: set[str] | None = None) -> LogicalP
     if isinstance(plan, Project):
         # Inner projections narrow to what ancestors need (the top-level
         # call has needed=None, so the user-visible schema never changes);
-        # narrowing keeps Union branches consistently aligned.
+        # narrowing keeps Union branches consistently aligned. Entries
+        # are names or (alias, Expr) — a kept computed entry needs every
+        # column its expression references.
         if needed is None:
             keep = list(plan.columns)
         else:
-            keep = [c for c in plan.columns if c.lower() in needed]
-        child_needed = {c.lower() for c in keep}
+            keep = [
+                c
+                for c in plan.columns
+                if (c if isinstance(c, str) else c[0]).lower() in needed
+            ]
+        child_needed: set[str] = set()
+        for c in keep:
+            if isinstance(c, str):
+                child_needed.add(c.lower())
+            else:
+                child_needed |= c[1].references()
         return Project(prune_columns(plan.child, child_needed), keep)
     if isinstance(plan, Filter):
         if needed is None:
@@ -83,6 +95,27 @@ def prune_columns(plan: LogicalPlan, needed: set[str] | None = None) -> LogicalP
             if names:
                 pick = next((c for c in names if not plan.child.schema.field(c).is_string), names[0])
                 child_needed = {pick.lower()}
+        return dataclasses.replace(plan, child=prune_columns(plan.child, child_needed))
+    if isinstance(plan, Window):
+        aliases = {f.alias.lower() for f in plan.funcs}
+        if needed is None:
+            child_needed = None
+        else:
+            child_needed = {c for c in needed if c not in aliases}
+            child_needed |= {c.lower() for c in plan.partition_by}
+            child_needed |= {c.lower() for c, _ in plan.order_by}
+            for f in plan.funcs:
+                child_needed |= f.references()
+            if not child_needed:
+                # count(*)-style window over no keys: keep one cheap
+                # column so the child's row count survives pruning.
+                names = plan.child.schema.names
+                if names:
+                    pick = next(
+                        (c for c in names if not plan.child.schema.field(c).is_string),
+                        names[0],
+                    )
+                    child_needed = {pick.lower()}
         return dataclasses.replace(plan, child=prune_columns(plan.child, child_needed))
     if isinstance(plan, Sort):
         if needed is None:

@@ -21,7 +21,7 @@ from __future__ import annotations
 import dataclasses
 
 from hyperspace_tpu.metadata.log_entry import IndexLogEntry
-from hyperspace_tpu.plan.nodes import Aggregate, Filter, Limit, LogicalPlan, Project, Scan, Sort
+from hyperspace_tpu.plan.nodes import Aggregate, Filter, Limit, LogicalPlan, Project, Scan, Sort, Window
 from hyperspace_tpu.rules.base import Rule, SignatureMatcher, hybrid_scan_for, index_scan_for
 
 
@@ -35,7 +35,7 @@ class FilterIndexRule(Rule):
     def _rewrite(self, plan: LogicalPlan, indexes, matcher) -> LogicalPlan:
         if isinstance(plan, Project) and isinstance(plan.child, Filter) and isinstance(plan.child.child, Scan):
             scan = plan.child.child
-            new_scan = self._replacement(scan, plan.child.predicate, plan.columns, indexes, matcher)
+            new_scan = self._replacement(scan, plan.child.predicate, plan.input_columns(), indexes, matcher)
             if new_scan is not None:
                 return Project(Filter(new_scan, plan.child.predicate), plan.columns)
             return plan
@@ -51,7 +51,7 @@ class FilterIndexRule(Rule):
             return Project(self._rewrite(plan.child, indexes, matcher), plan.columns)
         if isinstance(plan, Filter):
             return Filter(self._rewrite(plan.child, indexes, matcher), plan.predicate)
-        if isinstance(plan, (Aggregate, Sort, Limit)):
+        if isinstance(plan, (Aggregate, Sort, Limit, Window)):
             return dataclasses.replace(plan, child=self._rewrite(plan.child, indexes, matcher))
         if hasattr(plan, "left") and hasattr(plan, "right"):
             new = dataclasses.replace(plan)

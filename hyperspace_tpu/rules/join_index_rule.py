@@ -24,7 +24,7 @@ shape. What remains:
 from __future__ import annotations
 
 from hyperspace_tpu.metadata.log_entry import IndexLogEntry
-from hyperspace_tpu.plan.nodes import Aggregate, Filter, Join, Limit, LogicalPlan, Project, Scan, Sort
+from hyperspace_tpu.plan.nodes import Aggregate, Filter, Join, Limit, LogicalPlan, Project, Scan, Sort, Window
 from hyperspace_tpu.rules.base import Rule, SignatureMatcher, hybrid_scan_for, index_scan_for
 from hyperspace_tpu.rules.ranker import JoinIndexRanker
 
@@ -43,14 +43,21 @@ def _side_scan(plan: LogicalPlan) -> Scan | None:
 
 def _side_required_columns(plan: LogicalPlan, join_cols: list[str]) -> set[str]:
     """Columns the side must produce: its output + its own predicates +
-    the join keys (analog of JoinIndexRule.scala:399-457)."""
+    the join keys (analog of JoinIndexRule.scala:399-457). The outermost
+    Project defines the side's output; computed entries require their
+    INPUT references (the alias itself is not a scan column)."""
     required = {c.lower() for c in join_cols}
     node = plan
-    required |= {c.lower() for c in plan.schema.names}
+    saw_project = False
     while not isinstance(node, Scan):
         if isinstance(node, Filter):
             required |= {c.lower() for c in node.predicate.references()}
+        elif isinstance(node, Project) and not saw_project:
+            required |= node.input_columns()
+            saw_project = True
         node = node.child
+    if not saw_project:
+        required |= {c.lower() for c in plan.schema.names}
     return required
 
 
@@ -88,7 +95,7 @@ class JoinIndexRule(Rule):
             return Project(self._rewrite(plan.child, indexes, matcher), plan.columns)
         if isinstance(plan, Filter):
             return Filter(self._rewrite(plan.child, indexes, matcher), plan.predicate)
-        if isinstance(plan, (Aggregate, Sort, Limit)):
+        if isinstance(plan, (Aggregate, Sort, Limit, Window)):
             import dataclasses
 
             return dataclasses.replace(plan, child=self._rewrite(plan.child, indexes, matcher))

@@ -267,11 +267,11 @@ def test_fused_join_agg_group_by_left_side(tmp_path, join_tables):
 
 
 def test_join_agg_minmax(tmp_path, join_tables):
-    """min/max over a join: the HOST venue fuses them as run-extremum
-    channels (per-key extrema of the sorted secondary side); the device
-    venue falls back to the materialized join. Results identical either
-    way, covering secondary-side (amount), primary-side (weight), and
-    mixed sibling aggregates."""
+    """min/max over a join fuse on BOTH venues: the host C++ pass walks
+    per-key runs; the device kernel's run-extremum channels take the
+    segmented prefix scan at each run end. Results identical either way,
+    covering secondary-side (amount), primary-side (weight), and mixed
+    sibling aggregates."""
     from hyperspace_tpu import native
     from hyperspace_tpu.config import JOIN_VENUE
 
@@ -306,11 +306,11 @@ def test_join_agg_minmax(tmp_path, join_tables):
             ],
         )
         got = session.to_pandas(q).sort_values("cat").reset_index(drop=True)
-        if venue == "host":
-            assert session.last_query_stats["agg_path"] == "fused-join-agg"
-            assert session.last_query_stats["join_kernel"] == "host-native-merge-accumulate"
-        else:
-            assert session.last_query_stats["agg_path"].startswith("segment-reduce")
+        assert session.last_query_stats["agg_path"] == "fused-join-agg"
+        expected_kernel = (
+            "host-native-merge-accumulate" if venue == "host" else "device-run-prefix"
+        )
+        assert session.last_query_stats["join_kernel"] == expected_kernel
         outs[venue] = got
         assert list(got["cat"]) == list(exp["cat"])
         for c in ("mx", "mn", "wmx", "sa"):
@@ -320,15 +320,17 @@ def test_join_agg_minmax(tmp_path, join_tables):
         pd.testing.assert_frame_equal(outs["host"], outs["device"])
 
 
-def test_fused_minmax_with_nulls_and_unmatched(tmp_path):
-    """Fused min/max null semantics: null measure values are ignored, a
-    group whose matched rows are all-null yields NULL, multiplicity does
-    not skew extrema (duplicate keys), results equal the materialized
-    join."""
+@pytest.mark.parametrize("venue", ["host", "device"])
+def test_fused_minmax_with_nulls_and_unmatched(tmp_path, venue):
+    """Fused min/max null semantics on BOTH venues (the device venue
+    runs the segmented-prefix-scan run-extremum channels): null measure
+    values are ignored, a group whose matched rows are all-null yields
+    NULL, multiplicity does not skew extrema (duplicate keys), results
+    equal the materialized join."""
     from hyperspace_tpu import native
     from hyperspace_tpu.config import JOIN_VENUE
 
-    if not native.available():
+    if venue == "host" and not native.available():
         pytest.skip("native library not built")
     rng = np.random.default_rng(51)
     n = 4_000
@@ -351,18 +353,32 @@ def test_fused_minmax_with_nulls_and_unmatched(tmp_path):
     pq.write_table(fact, tmp_path / "f" / "p.parquet")
     pq.write_table(dim, tmp_path / "d" / "p.parquet")
     session = _session(tmp_path)
-    session.conf.set(JOIN_VENUE, "host")
+    session.conf.set(JOIN_VENUE, venue)
     fs, ds = session.parquet(tmp_path / "f"), session.parquet(tmp_path / "d")
     q = fs.join(ds, ["k"]).aggregate(
-        ["cat"], [AggSpec.of("min", "amount", "mn"), AggSpec.of("max", "amount", "mx")]
+        ["cat"],
+        [
+            AggSpec.of("min", "amount", "mn"),
+            AggSpec.of("max", "amount", "mx"),
+            AggSpec.of("sum", "amount", "sm"),
+        ],
     )
     got = session.to_pandas(q).sort_values("cat").reset_index(drop=True)
     assert session.last_query_stats["agg_path"] == "fused-join-agg"
+    expected_kernel = (
+        "host-native-merge-accumulate" if venue == "host" else "device-run-prefix"
+    )
+    assert session.last_query_stats["join_kernel"] == expected_kernel
     fpd = fact.to_pandas()
     jm = fpd.merge(dim.to_pandas(), on="k")
-    exp = jm.groupby("cat").agg(mn=("amount", "min"), mx=("amount", "max")).reset_index()
+    exp = (
+        jm.groupby("cat")
+        .agg(mn=("amount", "min"), mx=("amount", "max"), sm=("amount", "sum"))
+        .reset_index()
+    )
     np.testing.assert_allclose(got["mn"].astype(float), exp["mn"].astype(float), rtol=1e-9)
     np.testing.assert_allclose(got["mx"].astype(float), exp["mx"].astype(float), rtol=1e-9)
+    np.testing.assert_allclose(got["sm"].astype(float), exp["sm"].astype(float), rtol=1e-9)
 
 
 def test_aggregate_over_index_rewrite_and_explain(tmp_path, sales):
@@ -877,25 +893,70 @@ def test_count_distinct(tmp_path, venue):
     assert int(got.loc[0, "ns"]) == int(df.supp.nunique())
 
 
-def test_count_distinct_restrictions(tmp_path):
-    from hyperspace_tpu.exceptions import HyperspaceError
-
-    t = pa.table({"g": [1, 2], "a": [1, 2], "b": [3, 4]})
-    root = tmp_path / "cdr"
+def test_multi_distinct_and_mean_share_aggregate(tmp_path):
+    """TPC-DS q38/q87 shapes: several distinct columns AND mean in ONE
+    aggregate, via the distinct-expansion path (Spark's Expand analog):
+    one child execution, one group factorization, pair-factorized
+    distinct counts — no join, no re-execution."""
+    rng = np.random.default_rng(31)
+    n = 6_000
+    null_a = rng.random(n) < 0.08
+    df = pd.DataFrame(
+        {
+            "g": rng.integers(0, 9, n).astype(np.int64),
+            "a": pd.array(np.where(null_a, 0, rng.integers(0, 40, n)), dtype="Int64"),
+            "b": rng.integers(0, 25, n).astype(np.int64),
+            "v": np.round(rng.normal(size=n) * 10, 3),
+        }
+    )
+    df.loc[null_a, "a"] = pd.NA
+    root = tmp_path / "md"
     root.mkdir()
-    pq.write_table(t, root / "p.parquet")
+    pq.write_table(pa.Table.from_pandas(df, preserve_index=False), root / "p.parquet")
     session = _session(tmp_path)
     ds = session.parquet(root)
-    with pytest.raises(HyperspaceError, match="single distinct column"):
-        session.run(ds.aggregate([], [
+    q = ds.aggregate(
+        ["g"],
+        [
             AggSpec.of("count_distinct", "a", "na"),
             AggSpec.of("count_distinct", "b", "nb"),
-        ]))
-    with pytest.raises(HyperspaceError, match="mean cannot share"):
-        session.run(ds.aggregate([], [
-            AggSpec.of("count_distinct", "a", "na"),
-            AggSpec.of("mean", "b", "mb"),
-        ]))
+            AggSpec.of("mean", "v", "mv"),
+            AggSpec.of("sum", "v", "sv"),
+            AggSpec.of("count", None, "rows"),
+        ],
+    )
+    got = session.to_pandas(q).sort_values("g").reset_index(drop=True)
+    assert "DistinctExpandAggregate" in repr(session.last_physical_plan)
+    exp = (
+        df.groupby("g")
+        .agg(
+            na=("a", "nunique"),
+            nb=("b", "nunique"),
+            mv=("v", "mean"),
+            sv=("v", "sum"),
+            rows=("g", "size"),
+        )
+        .reset_index()
+    )
+    np.testing.assert_array_equal(got["g"], exp["g"])
+    np.testing.assert_array_equal(got["na"], exp["na"])
+    np.testing.assert_array_equal(got["nb"], exp["nb"])
+    np.testing.assert_allclose(got["mv"], exp["mv"], rtol=1e-12)
+    np.testing.assert_allclose(got["sv"], exp["sv"], rtol=1e-12)
+    np.testing.assert_array_equal(got["rows"], exp["rows"])
+
+    # Global multi-distinct (no groups).
+    got = session.to_pandas(
+        ds.aggregate(
+            [],
+            [
+                AggSpec.of("count_distinct", "a", "na"),
+                AggSpec.of("mean", "b", "mb"),
+            ],
+        )
+    )
+    assert int(got.loc[0, "na"]) == int(df.a.nunique())
+    assert np.isclose(got.loc[0, "mb"], df.b.mean())
 
 
 def test_count_distinct_empty_input_counts_are_zero(tmp_path):

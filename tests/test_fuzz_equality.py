@@ -107,7 +107,9 @@ def pandas_tri(df, p):
         _, op, c, v = p
         s = df[c]
         known = s.notna().to_numpy()
-        sv = s.fillna(0 if s.dtype != object else "").to_numpy()
+        # pandas 3 infers the new ``str`` dtype for string columns (no longer
+        # ``object``), so pick the fill by string-ness, not object-ness.
+        sv = s.fillna("" if pd.api.types.is_string_dtype(s) else 0).to_numpy()
         fn = {"eq": np.equal, "ne": np.not_equal, "lt": np.less,
               "le": np.less_equal, "gt": np.greater, "ge": np.greater_equal}[op]
         with np.errstate(all="ignore"):

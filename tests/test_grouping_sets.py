@@ -1,0 +1,151 @@
+"""ROLLUP / CUBE / GROUPING SETS: two-phase re-aggregation (one finest
+aggregate + partial re-folds per set), checked against pandas groupby
+unions. Covers mean recomposition from sum+count partials, grouping()
+flags, null group values vs subtotal nulls, and count semantics."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import HyperspaceSession, col
+from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu.plan.nodes import plan_from_json
+
+
+@pytest.fixture(scope="module")
+def data(tmp_path_factory):
+    tmp_path = tmp_path_factory.mktemp("gsdata")
+    rng = np.random.default_rng(5)
+    n = 4_000
+    null_v = rng.random(n) < 0.1
+    df = pd.DataFrame(
+        {
+            "state": np.array(["CA", "NY", "TX", "WA"], dtype=object)[rng.integers(0, 4, n)],
+            "cat": np.array(["food", "toys", "tools"], dtype=object)[rng.integers(0, 3, n)],
+            "q": pd.array(np.where(null_v, 0, rng.integers(1, 30, n)), dtype="Int64"),
+            "amt": np.round(rng.normal(size=n) * 50 + 100, 2),
+        }
+    )
+    df.loc[null_v, "q"] = pd.NA
+    root = tmp_path / "t"
+    root.mkdir()
+    pq.write_table(pa.Table.from_pandas(df, preserve_index=False), root / "p.parquet")
+    session = HyperspaceSession(system_path=str(tmp_path / "idx"), num_buckets=4)
+    ds = session.parquet(root)
+    return session, ds, df
+
+
+def rollup_oracle(df, levels, aggfn):
+    parts = []
+    for i in range(len(levels), 0, -1):
+        keys = levels[:i]
+        g = aggfn(df.groupby(keys)).reset_index()
+        for c in levels[i:]:
+            g[c] = None
+        parts.append(g)
+    total = aggfn(df.groupby(lambda _: 0)).reset_index(drop=True)
+    for c in levels:
+        total[c] = None
+    parts.append(total)
+    return pd.concat(parts, ignore_index=True)
+
+
+def norm(frame, cols):
+    rows = [
+        tuple(None if pd.isna(v) else (round(v, 6) if isinstance(v, float) else v) for v in row)
+        for row in frame[cols].itertuples(index=False)
+    ]
+    return sorted(rows, key=lambda r: tuple((v is None, str(v)) for v in r))
+
+
+def test_rollup_matches_pandas(data):
+    session, ds, df = data
+    q = ds.rollup(
+        ["state", "cat"],
+        [("sum", "amt", "s"), ("count", None, "n"), ("mean", "q", "mq")],
+    )
+    got = session.to_pandas(q)
+    exp = rollup_oracle(
+        df,
+        ["state", "cat"],
+        lambda g: g.agg(s=("amt", "sum"), n=("amt", "size"), mq=("q", "mean")),
+    )
+    assert len(got) == len(exp)
+    assert norm(got, ["state", "cat", "s", "n", "mq"]) == norm(
+        exp, ["state", "cat", "s", "n", "mq"]
+    )
+
+
+def test_grouping_flags_and_min_max(data):
+    session, ds, df = data
+    q = ds.rollup(
+        ["state", "cat"],
+        [
+            ("min", "amt", "lo"),
+            ("max", "amt", "hi"),
+            ("grouping", "cat", "g_cat"),
+            ("grouping", "state", "g_state"),
+        ],
+    )
+    got = session.to_pandas(q)
+    # Finest rows: both flags 0; mid (cat rolled away): g_cat=1 g_state=0;
+    # grand total: both 1.
+    finest = got[(got.g_cat == 0) & (got.g_state == 0)]
+    mid = got[(got.g_cat == 1) & (got.g_state == 0)]
+    top = got[(got.g_cat == 1) & (got.g_state == 1)]
+    assert len(finest) == df.groupby(["state", "cat"]).ngroups
+    assert len(mid) == df.state.nunique()
+    assert len(top) == 1
+    assert np.isclose(top.lo.iloc[0], df.amt.min()) and np.isclose(top.hi.iloc[0], df.amt.max())
+    m = mid.set_index("state")
+    exp = df.groupby("state").amt.agg(["min", "max"])
+    np.testing.assert_allclose(m.lo[exp.index].to_numpy(), exp["min"].to_numpy())
+    np.testing.assert_allclose(m.hi[exp.index].to_numpy(), exp["max"].to_numpy())
+
+
+def test_cube_set_count(data):
+    session, ds, df = data
+    q = ds.cube(["state", "cat"], [("count", None, "n")])
+    got = session.to_pandas(q)
+    expected_rows = (
+        df.groupby(["state", "cat"]).ngroups + df.state.nunique() + df.cat.nunique() + 1
+    )
+    assert len(got) == expected_rows
+    assert got.n.sum() == 4 * len(df)  # every row counted once per subset level
+
+
+def test_explicit_grouping_sets_and_json(data):
+    session, ds, df = data
+    q = ds.aggregate(
+        ["state", "cat"],
+        [("sum", "amt", "s")],
+        grouping_sets=[["state"], ["cat"]],
+    )
+    d = q.to_json()
+    assert plan_from_json(d).to_json() == d
+    got = session.to_pandas(q)
+    assert len(got) == df.state.nunique() + df.cat.nunique()
+    by_state = got[got.cat.isna()].set_index("state").s
+    exp = df.groupby("state").amt.sum()
+    np.testing.assert_allclose(by_state[exp.index].to_numpy(), exp.to_numpy(), rtol=1e-9)
+
+
+def test_rollup_over_filter_and_validation(data):
+    session, ds, df = data
+    q = ds.filter(col("state") == "CA").rollup(["cat"], [("sum", "q", "sq")])
+    got = session.to_pandas(q)
+    dfx = df[df.state == "CA"]
+    exp_total = dfx.q.sum()
+    total_row = got[got.cat.isna()]
+    assert len(total_row) == 1
+    assert int(total_row.sq.iloc[0]) == int(exp_total)
+    with pytest.raises(ValueError):
+        ds.aggregate(["state"], [("sum", "amt", "s")], grouping_sets=[["cat"]])
+    with pytest.raises(ValueError):
+        ds.aggregate(["state"], [("grouping", "state", "g")])  # no sets
+    with pytest.raises(HyperspaceError):
+        session.run(
+            ds.rollup(["state"], [("count_distinct", "cat", "cd")])
+        )

@@ -1,0 +1,148 @@
+"""Computed projections: SELECT <expr> AS x with 3-valued null
+semantics, typed via expr_dtype, JSON round-trip, and optimizer
+integration (column pruning keeps only what the expressions reference;
+index rules cover computed entries by their input references). The
+reference gets all of this from Catalyst's Project for free — here the
+IR owns it (plan/nodes.py Project, ops/project.py)."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col, lit, when
+from hyperspace_tpu.plan import expr as E
+from hyperspace_tpu.plan.nodes import plan_from_json
+
+
+@pytest.fixture(scope="module")
+def data(tmp_path_factory):
+    tmp_path = tmp_path_factory.mktemp("projdata")
+    rng = np.random.default_rng(7)
+    n = 2_000
+    null_a = rng.random(n) < 0.1
+    df = pd.DataFrame(
+        {
+            "k": rng.integers(0, 40, n).astype(np.int64),
+            "a": pd.array(np.where(null_a, 0, rng.integers(1, 90, n)), dtype="Int64"),
+            "f": np.round(rng.normal(size=n) * 5, 3),
+            "s": np.array(["AIR", "MAIL", "RAIL", "SHIP"], dtype=object)[
+                rng.integers(0, 4, n)
+            ],
+        }
+    )
+    df.loc[null_a, "a"] = pd.NA
+    root = tmp_path / "t"
+    root.mkdir()
+    pq.write_table(pa.Table.from_pandas(df, preserve_index=False), root / "p.parquet")
+    session = HyperspaceSession(system_path=str(tmp_path / "idx"), num_buckets=4)
+    ds = session.parquet(root)
+    return session, ds, df
+
+
+def test_arithmetic_projection_nulls(data):
+    session, ds, df = data
+    q = ds.select("k", ("x", col("a") * lit(2) + col("k")), ("r", col("f") / lit(2.0)))
+    got = session.to_pandas(q).sort_values(["k", "x", "r"]).reset_index(drop=True)
+    exp = pd.DataFrame(
+        {
+            "k": df.k,
+            "x": df.a * 2 + df.k,  # null propagates
+            "r": df.f / 2.0,
+        }
+    ).sort_values(["k", "x", "r"]).reset_index(drop=True)
+    assert got.x.isna().sum() == exp.x.isna().sum() > 0
+    np.testing.assert_allclose(
+        got.x.fillna(-1).to_numpy(dtype=np.float64),
+        exp.x.fillna(-1).to_numpy(dtype=np.float64),
+    )
+    np.testing.assert_allclose(got.r.to_numpy(), exp.r.to_numpy())
+
+
+def test_case_and_bool_projection(data):
+    session, ds, df = data
+    q = ds.select(
+        ("big", col("a") > 40),
+        ("bucket", when(col("a") > 40, 1).otherwise(0)),
+    )
+    got = session.to_pandas(q)
+    known = df.a.notna()
+    # Boolean projection: NULL where the comparison is unknown.
+    assert got.big.isna().sum() == int((~known).sum())
+    exp_big = (df.a > 40)[known].to_numpy(dtype=bool)
+    np.testing.assert_array_equal(got.big[known.to_numpy()].to_numpy(dtype=bool), exp_big)
+    # CASE with a null condition takes the ELSE leg (never null here).
+    assert got.bucket.isna().sum() == 0
+    exp_bucket = np.where(df.a.fillna(0) > 40, 1, 0)
+    np.testing.assert_array_equal(got.bucket.to_numpy(dtype=np.int64), exp_bucket)
+
+
+def test_substr_projection_keeps_sorted_codes(data):
+    session, ds, df = data
+    q = ds.select(("pfx", col("s").substr(1, 2)), "s").filter(col("pfx") == "MA")
+    got = session.to_pandas(q)
+    assert set(got.s) == {"MAIL"}
+    assert len(got) == int((df.s == "MAIL").sum())
+
+
+def test_projection_json_roundtrip(data):
+    _, ds, _ = data
+    q = ds.select("k", ("x", (col("a") + lit(1)) * col("k")))
+    d = q.to_json()
+    back = plan_from_json(d)
+    assert back.schema.names == q.schema.names
+    assert back.to_json() == d
+
+
+def test_projection_over_index_join(data, tmp_path):
+    """Computed projection above an indexed join still answers correctly
+    (the aligned path falls back when it cannot absorb the expression)."""
+    session, ds, df = data
+    hs = Hyperspace(session)
+    hs.create_index(ds, IndexConfig("pj_k", ["k"], ["a"]))
+    other = ds.select("k", "f").aggregate(["k"], [("sum", "f", "sf")])
+    q = ds.join(other, ["k"]).select("k", ("score", col("a") + col("sf")))
+    session.enable_hyperspace()
+    got = session.to_pandas(q)
+    merged = df.merge(df.groupby("k").f.sum().rename("sf").reset_index(), on="k")
+    exp = (merged.a + merged.sf).astype(np.float64)
+    assert len(got) == len(merged)
+    assert got.score.isna().sum() == merged.a.isna().sum()
+    np.testing.assert_allclose(
+        np.sort(got.score.dropna().to_numpy(dtype=np.float64)),
+        np.sort(exp.dropna().to_numpy()),
+        rtol=1e-9,
+    )
+
+
+def test_with_column_and_pruning(data):
+    session, ds, df = data
+    q = ds.with_column("half", col("f") / lit(2.0)).select("half")
+    got = session.to_pandas(q)
+    np.testing.assert_allclose(np.sort(got.half.to_numpy()), np.sort(df.f.to_numpy() / 2))
+    # Pruning: the executed scan read only f (the expression's input).
+    phys = repr(session.last_physical_plan)
+    assert "half" in phys
+
+
+def test_aggregate_over_computed_projection(data):
+    session, ds, df = data
+    q = ds.select("k", ("ab", col("a") * col("f"))).aggregate(
+        ["k"], [("sum", "ab", "s_ab"), ("count", None, "n")]
+    )
+    got = session.to_pandas(q).sort_values("k").reset_index(drop=True)
+    dfx = df.assign(ab=df.a.astype("Float64") * df.f)
+    exp = (
+        dfx.groupby("k")
+        .agg(s_ab=("ab", "sum"), n=("ab", "size"))
+        .reset_index()
+        .sort_values("k")
+        .reset_index(drop=True)
+    )
+    np.testing.assert_allclose(
+        got.s_ab.to_numpy(dtype=np.float64),
+        exp.s_ab.to_numpy(dtype=np.float64),
+        rtol=1e-9,
+    )
+    np.testing.assert_array_equal(got.n.to_numpy(), exp.n.to_numpy())
