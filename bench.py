@@ -48,6 +48,9 @@ def build_once(session_path: Path, data_root: Path, num_buckets: int):
     t0 = time.perf_counter()
     hs.create_index(df, IndexConfig("lineitem_orderkey", INDEXED, INCLUDED))
     build_s = time.perf_counter() - t0
+    phases = session.last_build_stats.get("phases_s")
+    if phases:
+        log(f"  build phases (s): {phases}")
     return session, hs, df, sel_bytes, build_s
 
 

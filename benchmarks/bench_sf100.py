@@ -1,0 +1,141 @@
+"""BASELINE config 2 at SF100: the shuffle-free orders ⋈ lineitem join.
+
+Generates TPC-H SF100 (~600M-row lineitem, 150M orders) chunk by chunk
+(bounded memory), builds both covering indexes through the STREAMING
+out-of-core path, and times the join with an aggregate consumer (sum of
+revenue by order priority — the fused join-aggregate never materializes
+the ~600M joined rows) indexed vs raw. Emits one JSON line and is meant
+to be captured into BENCH_SF100.json. Times are single-shot (a run costs
+minutes); the build GB/s extends the BENCH_SCALE curve to SF100.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+from benchmarks.harness import log  # noqa: E402
+
+
+def main(sf: float = 100.0):
+    from benchmarks.datagen import cached_tpch
+    from hyperspace_tpu import AggSpec, Hyperspace, HyperspaceSession, IndexConfig
+    from hyperspace_tpu.execution import io as hio
+    from hyperspace_tpu.dataset import list_data_files
+
+    t0 = time.perf_counter()
+    li_root, o_root = cached_tpch(sf=sf)
+    t_gen = time.perf_counter() - t0
+    log(f"datagen (cached ok) sf={sf:g}: {t_gen:.1f}s")
+
+    tmp = Path(tempfile.mkdtemp(prefix="hs_sf100_"))
+    out: dict = {"metric": "tpch_sf100_shuffle_free_join", "sf": sf}
+    try:
+        session = HyperspaceSession(system_path=str(tmp / "indexes"), num_buckets=64)
+        hs = Hyperspace(session)
+        li = session.parquet(li_root)
+        orders = session.parquet(o_root)
+
+        li_cols = ["l_orderkey", "l_extendedprice", "l_discount"]
+        li_bytes = hio.estimate_uncompressed_bytes(
+            [fi.path for fi in list_data_files(li_root)], li_cols
+        )
+        t0 = time.perf_counter()
+        hs.create_index(li, IndexConfig("li_ok", ["l_orderkey"], li_cols[1:]))
+        t_li = time.perf_counter() - t0
+        li_stats = session.last_build_stats
+        log(
+            f"lineitem index: {t_li:.1f}s  {li_bytes/1e9:.2f} GB selected -> "
+            f"{li_bytes/1e9/t_li:.4f} GB/s/chip  path={li_stats.get('path')} "
+            f"phases={li_stats.get('phases_s')}"
+        )
+
+        o_cols = ["o_orderkey", "o_orderpriority"]
+        o_bytes = hio.estimate_uncompressed_bytes(
+            [fi.path for fi in list_data_files(o_root)], o_cols
+        )
+        t0 = time.perf_counter()
+        hs.create_index(orders, IndexConfig("o_ok", ["o_orderkey"], ["o_orderpriority"]))
+        t_o = time.perf_counter() - t0
+        o_stats = session.last_build_stats
+        log(
+            f"orders index:   {t_o:.1f}s  {o_bytes/1e9:.2f} GB selected -> "
+            f"{o_bytes/1e9/t_o:.4f} GB/s/chip  path={o_stats.get('path')}"
+        )
+
+        # The join, consumed by an aggregation (5 priority groups): the
+        # fused join-aggregate path never materializes the joined rows.
+        q = (
+            li.select("l_orderkey", "l_extendedprice", "l_discount")
+            .join(
+                orders.select("o_orderkey", "o_orderpriority"),
+                ["l_orderkey"], ["o_orderkey"],
+            )
+            .aggregate(
+                ["o_orderpriority"],
+                [
+                    AggSpec.of("sum", "l_extendedprice", "rev"),
+                    AggSpec.of("count", None, "n"),
+                ],
+            )
+        )
+
+        session.enable_hyperspace()
+        t0 = time.perf_counter()
+        r_idx = session.run(q)
+        t_indexed = time.perf_counter() - t0
+        stats = dict(session.last_query_stats)
+        log(
+            f"indexed: {t_indexed:.1f}s  join={stats['join_path']} "
+            f"agg={stats['agg_path']} kernel={stats.get('join_kernel')}"
+        )
+
+        session.disable_hyperspace()
+        t0 = time.perf_counter()
+        r_raw = session.run(q)
+        t_raw = time.perf_counter() - t0
+        log(f"raw:     {t_raw:.1f}s")
+
+        import numpy as np
+
+        gi = {k: v for k, v in zip(r_idx.decode()["o_orderpriority"], r_idx.columns["n"])}
+        gr = {k: v for k, v in zip(r_raw.decode()["o_orderpriority"], r_raw.columns["n"])}
+        assert gi == gr, f"result mismatch: {gi} vs {gr}"
+        total_rows = int(np.sum(r_idx.columns["n"]))
+
+        out.update({
+            "value": round(t_raw / t_indexed, 3),
+            "unit": "x",
+            "vs_baseline": round(t_raw / t_indexed, 3),
+            "joined_rows": total_rows,
+            "indexed_s": round(t_indexed, 2),
+            "raw_s": round(t_raw, 2),
+            "build": {
+                "lineitem_s": round(t_li, 2),
+                "lineitem_selected_gb": round(li_bytes / 1e9, 3),
+                "lineitem_gbps": round(li_bytes / 1e9 / t_li, 4),
+                "lineitem_phases_s": li_stats.get("phases_s"),
+                "lineitem_path": li_stats.get("path"),
+                "orders_s": round(t_o, 2),
+                "orders_gbps": round(o_bytes / 1e9 / t_o, 4),
+                "orders_path": o_stats.get("path"),
+            },
+            "datagen_s": round(t_gen, 1),
+            "notes": (
+                "single-shot wall times on the 1-core bench host; the "
+                "aggregate consumer keeps the ~4-lines-per-order join "
+                "from materializing its output"
+            ),
+        })
+        print(json.dumps(out))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main(float(sys.argv[1]) if len(sys.argv) > 1 else 100.0)

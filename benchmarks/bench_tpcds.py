@@ -38,11 +38,25 @@ def main(sf: float = 1.0):
 
         queries = tpcds_queries(scans)
         speedups = []
+
+        def best_of(fn, reps=2):
+            """One untimed warmup (populates the decode/compile caches —
+            the serving steady state BOTH sides enjoy), then the best of
+            `reps` timed runs; the spread distinguishes contention noise
+            from real regressions (single-core hosts)."""
+            fn()
+            times = []
+            out = None
+            for _ in range(reps):
+                t, out = _timed(fn)
+                times.append(t)
+            return min(times), times, out
+
         for name, plan in queries.items():
             session.disable_hyperspace()
-            t_raw, r_raw = _timed(lambda p=plan: session.run(p))
+            t_raw, raw_times, r_raw = best_of(lambda p=plan: session.run(p))
             session.enable_hyperspace()
-            t_idx, r_idx = _timed(lambda p=plan: session.run(p))
+            t_idx, idx_times, r_idx = best_of(lambda p=plan: session.run(p))
             stats = dict(session.last_query_stats)
 
             assert_same_results(name, r_raw, r_idx)
@@ -52,9 +66,14 @@ def main(sf: float = 1.0):
             log(
                 f"{name}: raw {t_raw:.3f}s  indexed {t_idx:.3f}s  {sp:.2f}x  "
                 f"(rows={r_idx.num_rows}, join={stats['join_path']}, "
-                f"agg={stats['agg_path']})"
+                f"agg={stats['agg_path']}, rows_pruned={stats.get('rows_pruned', 0)})"
             )
-            results.append({"query": name, "speedup": round(sp, 3)})
+            results.append({
+                "query": name,
+                "speedup": round(sp, 3),
+                "raw_s": [round(t, 4) for t in raw_times],
+                "indexed_s": [round(t, 4) for t in idx_times],
+            })
 
         geo = float(np.exp(np.mean(np.log(speedups))))
         print(json.dumps({

@@ -79,7 +79,19 @@ def main(n_rows: int = 4_000_000):
                        .aggregate([], [AggSpec.of("count", None, "n")]),
             "join_agg": f.join(d, ["k"]).aggregate([], [AggSpec.of("sum", "w", "sw"),
                                                         AggSpec.of("count", None, "n")]),
+            "group_agg": f.aggregate(["k"], [AggSpec.of("sum", "a", "sa"),
+                                             AggSpec.of("count", None, "n")]),
             "point": f.filter(col("k") == 54_321),
+        }
+        # Logical input bytes each class must touch (the achieved-rate
+        # denominators; these kernels are bandwidth-bound, so bytes/s is
+        # the honest utilization figure — the ANN bench reports FLOP/s
+        # where FLOPs dominate).
+        n_dim = 100_000
+        logical_bytes = {
+            "filter": n_rows * (4 + 8),                 # k int32 + b f64
+            "join_agg": n_rows * 4 + n_dim * (4 + 8),   # fact k + dim k,w
+            "group_agg": n_rows * (4 + 4),              # k codes + a f32
         }
 
         table: dict[str, dict] = {}
@@ -127,6 +139,16 @@ def main(n_rows: int = 4_000_000):
 
         import numpy as np
 
+        # Achieved bytes/s per flagship kernel, warm, both venues.
+        kernel_rates = {}
+        for name, nbytes in logical_bytes.items():
+            row = table.get(name, {})
+            for venue in ("device", "host"):
+                t = row.get(f"{venue}_warm_s")
+                if t:
+                    kernel_rates[f"{name}_{venue}_warm_GBps"] = round(nbytes / 1e9 / t, 3)
+        log(f"kernel_rates: {kernel_rates}")
+
         geo = float(np.exp(np.mean(np.log([max(s, 1e-9) for s in warm_speedups]))))
         print(json.dumps({
             "metric": "device_venue_warm_speedup",
@@ -134,6 +156,7 @@ def main(n_rows: int = 4_000_000):
             "unit": "x",
             "vs_baseline": round(geo, 3),
             "classes": table,
+            "kernel_rates": kernel_rates,
         }))
     finally:
         shutil.rmtree(tmp, ignore_errors=True)

@@ -45,6 +45,13 @@ FILTER_VENUE = "hyperspace.filter.venue"
 # merge (the analog of Spark's BroadcastExchange fallback the reference
 # environment counts, PhysicalOperatorAnalyzer.scala:46-50). 0 disables.
 JOIN_BROADCAST_MAX_ROWS = "hyperspace.join.broadcast.maxRows"
+# Query-time re-bucketing exchange: when exactly one join side is an index
+# bucketed on its join keys, the OTHER side can re-bucketize on the fly
+# (hash + counting sort / device sort) so the merge stays bucket-parallel.
+# "auto" engages it when the broadcast probe does not apply; "force"
+# always re-bucketizes (bucket-aligned evidence for chained star joins);
+# "off" keeps the single-partition fallback.
+JOIN_REBUCKETIZE = "hyperspace.join.rebucketize"
 
 # Directory-layout constants (reference index/IndexConstants.scala:38-39).
 HYPERSPACE_LOG_DIR = "_hyperspace_log"
@@ -58,6 +65,7 @@ DEFAULT_BUILD_MEMORY_BUDGET = 4 << 30
 DEFAULT_JOIN_VENUE = "auto"
 DEFAULT_JOIN_VENUE_MIN_MBPS = 200.0
 DEFAULT_JOIN_BROADCAST_MAX_ROWS = 4_000_000
+DEFAULT_JOIN_REBUCKETIZE = "auto"
 
 
 @dataclasses.dataclass
@@ -78,6 +86,7 @@ class HyperspaceConf:
     sort_venue: str = DEFAULT_JOIN_VENUE
     filter_venue: str = DEFAULT_JOIN_VENUE
     join_broadcast_max_rows: int = DEFAULT_JOIN_BROADCAST_MAX_ROWS
+    join_rebucketize: str = DEFAULT_JOIN_REBUCKETIZE
     overrides: dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def __post_init__(self):
@@ -114,6 +123,8 @@ class HyperspaceConf:
             self.filter_venue = str(value)
         elif key == JOIN_BROADCAST_MAX_ROWS:
             self.join_broadcast_max_rows = int(value)
+        elif key == JOIN_REBUCKETIZE:
+            self.join_rebucketize = str(value)
 
     def get(self, key: str, default: Any = None) -> Any:
         if key in self.overrides:
@@ -146,4 +157,6 @@ class HyperspaceConf:
             return self.filter_venue
         if key == JOIN_BROADCAST_MAX_ROWS:
             return self.join_broadcast_max_rows
+        if key == JOIN_REBUCKETIZE:
+            return self.join_rebucketize
         return default

@@ -21,6 +21,7 @@ compactor seam.
 from __future__ import annotations
 
 import math
+import time
 from pathlib import Path
 
 import numpy as np
@@ -147,6 +148,7 @@ class DeviceIndexBuilder:
         self.venue = venue
         self.venue_min_mbps = venue_min_mbps
         self.last_build_stats: dict = {}
+        self._last_phases: dict = {}
         enable_compile_cache()
 
     def _sort_venue(self, mesh) -> str:
@@ -211,9 +213,18 @@ class DeviceIndexBuilder:
                     dest_path, est, fmt=plan.format,
                 )
                 return
+        t0 = time.perf_counter()
         table = hio.read_table_files(files, plan.format, columns=columns, schema=plan.schema)
+        t_decode = time.perf_counter() - t0
         self.write_table(table, indexed_columns, num_buckets, dest_path)
-        self.last_build_stats = {"path": "in-memory", "bytes_estimate": est, "rows": table.num_rows}
+        phases = dict(self._last_phases)
+        phases["decode"] = round(t_decode, 4)
+        self.last_build_stats = {
+            "path": "in-memory",
+            "bytes_estimate": est,
+            "rows": table.num_rows,
+            "phases_s": phases,
+        }
 
     def write_table(
         self,
@@ -228,6 +239,7 @@ class DeviceIndexBuilder:
         mesh = self._mesh_for(num_buckets)
         d = mesh_size(mesh)
         n = table.num_rows
+        t0 = time.perf_counter()
 
         # Host: bucket assignment from the canonical row hash.
         row_hash = compute_row_hashes(table, indexed_columns)
@@ -239,6 +251,7 @@ class DeviceIndexBuilder:
         # row-id permutation and the host gathers columns by it.
         key_names = [table.schema.field(c).name for c in indexed_columns]
         lanes = key_lanes(table, indexed_columns)
+        t_hash = time.perf_counter()
 
         sort_fn = None
         if self._sort_venue(mesh) == "host":
@@ -273,6 +286,7 @@ class DeviceIndexBuilder:
             raise HyperspaceError(
                 f"row count changed through exchange: {n} → {len(order)}"
             )
+        t_exchange = time.perf_counter()
         compact_bucket = np.repeat(
             np.arange(num_buckets, dtype=np.int32), bucket_rows
         )
@@ -290,6 +304,15 @@ class DeviceIndexBuilder:
             compact_bucket, num_buckets, indexed_columns,
             order=order, sort_fn=sort_fn,
         )
+        t_done = time.perf_counter()
+        # Phase wall times. On the host venue the per-bucket KEY sort
+        # runs inside the carve tasks (pipelined with parquet encode), so
+        # it lands in carve_encode_write by design.
+        self._last_phases = {
+            "hash_lanes": round(t_hash - t0, 4),
+            "partition_exchange": round(t_exchange - t_hash, 4),
+            "carve_encode_write": round(t_done - t_exchange, 4),
+        }
 
     # -- streaming out-of-core build -------------------------------------
     def _write_streaming(
@@ -328,9 +351,18 @@ class DeviceIndexBuilder:
             # Phase 1: stream decoded chunks (format-aware iterator);
             # decode of chunk i+1 overlaps the hash/partition/spill of
             # chunk i via the one-ahead prefetcher.
-            for at in _prefetched(
+            t_p1 = time.perf_counter()
+            decode_wait = 0.0
+            gen = _prefetched(
                 self._decoded_chunks(files, fmt, columns, schema, footers=footers)
-            ):
+            )
+            _SENTINEL = object()
+            while True:
+                tw = time.perf_counter()
+                at = next(gen, _SENTINEL)
+                decode_wait += time.perf_counter() - tw
+                if at is _SENTINEL:
+                    break
                 n_chunks += 1
                 ct = ColumnTable.from_arrow(at, sub_schema).select(ordered)
                 total_rows += ct.num_rows
@@ -354,6 +386,7 @@ class DeviceIndexBuilder:
                     w.write_table(arrow_sorted.slice(lo, hi - lo))
             for w in writers.values():
                 w.close()
+            t_p2 = time.perf_counter()
 
             # Phase 2: per-bucket key sort. Batches are planned from the
             # SPILL FOOTERS (uncompressed bytes per bucket), so at most
@@ -385,6 +418,12 @@ class DeviceIndexBuilder:
                 batches.append(cur)
 
             key_stats: list = [None] * num_buckets
+            col_stats: list = [None] * num_buckets
+            stat_cols = [
+                f.name
+                for f in sub_schema.select(ordered).fields
+                if not f.is_vector and f.name != sub_schema.field(indexed_columns[0]).name
+            ]
             sort_venue = self._sort_venue(self._mesh_for(num_buckets))
             with ThreadPoolExecutor(max_workers=8) as pool:
                 empty = ColumnTable.empty(sub_schema.select(ordered))
@@ -404,20 +443,32 @@ class DeviceIndexBuilder:
                     for b, t in zip(ids, tables):
                         bucket_rows[b] = t.num_rows
                         key_stats[b] = hio.bucket_key_stats(t, indexed_columns[0])
+                        if stat_cols:
+                            col_stats[b] = hio.bucket_column_stats(t, stat_cols)
                     for f in futs:
                         f.result()
             hio.write_manifest(
                 dest, num_buckets, indexed_columns, bucket_rows,
                 key_stats if any(s is not None for s in key_stats) else None,
+                col_stats if any(s is not None for s in col_stats) else None,
             )
         finally:
             shutil.rmtree(spill, ignore_errors=True)
+        t_end = time.perf_counter()
         self.last_build_stats = {
             "path": "streaming",
             "format": fmt,
             "bytes_estimate": est_bytes,
             "chunks": n_chunks,
             "rows": total_rows,
+            # Phase walls: p1 = decode→hash→partition→spill (decode_wait
+            # is the NON-overlapped decode stall inside it — the prefetch
+            # hides the rest); p2 = spill read→key sort→final write.
+            "phases_s": {
+                "p1_decode_hash_spill": round(t_p2 - t_p1, 4),
+                "p1_decode_wait": round(decode_wait, 4),
+                "p2_sort_encode_write": round(t_end - t_p2, 4),
+            },
         }
 
     def _decoded_chunks(self, files, fmt: str, columns, schema, footers=None):

@@ -46,12 +46,34 @@ from hyperspace_tpu.plan.nodes import (
 
 
 @dataclasses.dataclass
+class _TableLeaf(LogicalPlan):
+    """Executor-internal leaf wrapping an already-materialized table
+    (partial-aggregation pushdown splices one under a Join). Never
+    serialized; never seen by the rules."""
+
+    table: ColumnTable
+
+    @property
+    def schema(self):
+        return self.table.schema
+
+    def children(self) -> list[LogicalPlan]:
+        return []
+
+    def to_json(self):
+        raise HyperspaceError("_TableLeaf is executor-internal")
+
+
+@dataclasses.dataclass
 class AlignedSide:
     scan: Scan
     project: list[str] | None  # columns to keep after the join gather
-    # Hybrid scan: an unbucketed delta scan whose rows are bucketized
+    # Hybrid scan: unbucketed delta scans whose rows are bucketized
     # on the fly and merged into the index buckets before the SMJ.
-    delta: Scan | None = None
+    # Any number of deltas is accepted (a Union of the index scan with
+    # several appended-file scans, not just the canonical two-input
+    # shape the rewrite rule emits today).
+    deltas: tuple[Scan, ...] = ()
     # Side-local filter (JoinIndexRule keeps linear sides with filters):
     # applied per bucket BEFORE the merge, preserving bucket grouping and
     # within-bucket sort order (a filtered subsequence stays sorted).
@@ -66,6 +88,21 @@ class SideData:
     table: ColumnTable
     offsets: np.ndarray  # [B+1] int64
     sorted_within: bool  # buckets key-sorted (index files are)?
+    # Fields defining the bucket hash domain (the dtypes the row hash was
+    # computed in) — two bucketings pair only when these are compatible.
+    hash_fields: tuple | None = None
+
+
+def _hash_fields_compatible(a, b) -> bool:
+    """Equal key values bucket identically under both domains."""
+    if a is None or b is None or len(a) != len(b):
+        return False
+    for fa, fb in zip(a, b):
+        if fa.is_string != fb.is_string:
+            return False
+        if not fa.is_string and np.dtype(fa.device_dtype) != np.dtype(fb.device_dtype):
+            return False
+    return True
 
 
 def _filter_side(side: SideData, predicate, mesh, venue: str = "auto") -> SideData:
@@ -380,6 +417,42 @@ class Executor:
         # the reference diffing executedPlans, PlanAnalyzer.scala:163-178).
         self.physical_plan = None
         self._cur_phys = None
+        # Bucket-preserving join outputs: id(table) -> (weakref, offsets,
+        # lowered key names, hash-domain fields). Bounded; weakrefs keep
+        # id-reuse from matching a dead table.
+        self._bucketed_outputs: dict[int, tuple] = {}
+
+    def _stash_bucketed(self, table: ColumnTable, offsets, keys, hash_fields) -> None:
+        import weakref
+
+        if len(self._bucketed_outputs) >= 16:
+            self._bucketed_outputs.clear()
+        self._bucketed_outputs[id(table)] = (
+            weakref.ref(table),
+            offsets,
+            tuple(k.lower() for k in keys),
+            hash_fields,
+        )
+
+    def _preserved_sidedata(self, table: ColumnTable, join_on: list[str]) -> "SideData | None":
+        e = self._bucketed_outputs.get(id(table))
+        if e is None or e[0]() is not table:
+            return None
+        if e[2] != tuple(k.lower() for k in join_on):
+            return None
+        return SideData(table, e[1], False, hash_fields=e[3])
+
+    def _propagate_stash(self, src: ColumnTable, dst: ColumnTable) -> ColumnTable:
+        """Row-preserving transforms (column selection) keep a stashed
+        bucket grouping valid — carry it to the derived table so chained
+        star joins still find it (select() builds a NEW ColumnTable, so
+        identity lookups would otherwise go dead)."""
+        e = self._bucketed_outputs.get(id(src))
+        if e is not None and e[0]() is src and dst is not src:
+            names = {n.lower() for n in dst.schema.names}
+            if all(k in names for k in e[2]):  # bucket keys survived
+                self._stash_bucketed(dst, e[1], list(e[2]), e[3])
+        return dst
 
     def execute(self, plan: LogicalPlan) -> ColumnTable:
         from hyperspace_tpu.plan.prune import prune_columns
@@ -427,7 +500,7 @@ class Executor:
             self._cur_phys.detail["columns"] = list(plan.output_names)
             child = self._execute(plan.child)
             if plan.is_simple:
-                return child.select(plan.columns)
+                return self._propagate_stash(child, child.select(plan.columns))
             from hyperspace_tpu.ops.project import project_table
 
             self._phys(
@@ -457,6 +530,8 @@ class Executor:
             )
         if isinstance(plan, Sort):
             return self._sort(plan)
+        if isinstance(plan, _TableLeaf):
+            return plan.table
         if isinstance(plan, Limit):
             self._cur_phys.detail["n"] = plan.n
             if isinstance(plan.child, Sort):
@@ -570,6 +645,9 @@ class Executor:
                 return out
             return self._distinct_aggregate(plan, sorted(dcols))
         venue = self._agg_venue()
+        pushed = self._try_partial_agg_pushdown(plan)
+        if pushed is not None:
+            return pushed
         # Fuse Aggregate(Join) on both venues: the device run-prefix
         # kernel avoids the match-pair readback; the host C++
         # merge+accumulate avoids materializing the pairs at all.
@@ -597,8 +675,149 @@ class Executor:
             devices=self.stats.get("agg_devices", 1),
         )
         return aggregate_table(
-            table, plan.group_by, plan.aggs, plan.schema, venue=venue, mesh=mesh
+            table, plan.group_by, plan.aggs, plan.schema, venue=venue, mesh=mesh,
+            # Identity-cached factorization: repeat aggregations over a
+            # stable index version skip re-factorizing the keys.
+            groups=_group_ids_cached(table, plan.group_by),
         )
+
+    def _try_partial_agg_pushdown(self, plan: "Aggregate") -> ColumnTable | None:
+        """Partial aggregation pushdown (Spark's PartialAggregate /
+        aggregate-through-join analog): for Aggregate(Join(L, R)) where
+        every aggregate reads only the L side — optionally inside a
+        CASE whose CONDITION reads only the R side (the q43/q59 weekly
+        pivot shape; R attributes are constant per join-key run, so the
+        case splits into the outer re-aggregation) — pre-aggregate L by
+        (join keys + L group columns), join the FEW partial rows, and
+        re-fold. Adaptive: bails when the partial grouping would not
+        actually shrink L (measured, not guessed), in which case the
+        normal fused path re-executes the (cheap, cached) L side."""
+        from hyperspace_tpu.ops.aggregate import aggregate_table
+        from hyperspace_tpu.plan.expr import Case, Lit
+        from hyperspace_tpu.plan.nodes import AggSpec
+
+        child = plan.child
+        if not isinstance(child, Join) or child.how != "inner":
+            return None
+        if isinstance(child.left, _TableLeaf) or isinstance(child.right, _TableLeaf):
+            return None  # already pushed (recursion guard)
+        lnames = {n.lower() for n in child.left.schema.names}
+        rnames = {n.lower() for n in child.right.schema.names}
+        g_l = [c for c in plan.group_by if c.lower() in lnames]
+        g_r = [c for c in plan.group_by if c.lower() not in lnames]
+        if any(c.lower() not in rnames for c in g_r):
+            return None
+
+        partial_specs: list[AggSpec] = []
+        outer_specs: list[AggSpec] = []
+        mean_parts: dict[str, tuple[str, str]] = {}  # alias -> (sum, cnt) temp names
+        count_aliases: list[str] = []
+        uses_r = bool(g_r)
+        for i, a in enumerate(plan.aggs):
+            refs = {r.lower() for r in a.references()}
+            if a.fn == "count" and a.expr is None:
+                partial_specs.append(AggSpec("count", None, f"__pp{i}"))
+                outer_specs.append(AggSpec("sum", Col(f"__pp{i}"), a.alias))
+                count_aliases.append(a.alias)
+                continue
+            if a.fn in ("sum", "count", "min", "max") and refs and refs <= lnames:
+                partial_specs.append(AggSpec(a.fn, a.expr, f"__pp{i}"))
+                fn2 = "sum" if a.fn in ("sum", "count") else a.fn
+                outer_specs.append(AggSpec(fn2, Col(f"__pp{i}"), a.alias))
+                if a.fn == "count":
+                    count_aliases.append(a.alias)
+                continue
+            if a.fn == "mean" and refs and refs <= lnames:
+                partial_specs.append(AggSpec("sum", a.expr, f"__pp{i}s"))
+                partial_specs.append(AggSpec("count", a.expr, f"__pp{i}c"))
+                outer_specs.append(AggSpec("sum", Col(f"__pp{i}s"), f"__po{i}s"))
+                outer_specs.append(AggSpec("sum", Col(f"__pp{i}c"), f"__po{i}c"))
+                mean_parts[a.alias] = (f"__po{i}s", f"__po{i}c")
+                continue
+            if (
+                a.fn == "sum"
+                and isinstance(a.expr, Case)
+                and len(a.expr.branches) == 1
+                and isinstance(a.expr.default, Lit)
+                and a.expr.default.value in (0, 0.0)
+            ):
+                cond, val = a.expr.branches[0]
+                crefs = {r.lower() for r in cond.references()}
+                vrefs = {r.lower() for r in val.references()}
+                if crefs and crefs <= rnames and vrefs <= lnames:
+                    uses_r = True
+                    partial_specs.append(AggSpec("sum", val, f"__pp{i}"))
+                    from hyperspace_tpu.plan.expr import when as _when
+
+                    outer_specs.append(
+                        AggSpec("sum", _when(cond, Col(f"__pp{i}")).otherwise(0.0), a.alias)
+                    )
+                    continue
+            return None
+        if not uses_r:
+            # The aggregate never needs R beyond the join's filtering
+            # effect — the fused path already handles that shape better.
+            return None
+
+        pkeys: list[str] = list(child.left_on)
+        pk_low = {c.lower() for c in pkeys}
+        for c in g_l:
+            if c.lower() not in pk_low:
+                pkeys.append(c)
+                pk_low.add(c.lower())
+
+        lt = self._execute(child.left)
+        gid, k, rep = _group_ids_cached(lt, pkeys)
+        if k > max(64, lt.num_rows // 8):
+            # Less than ~8x shrink: the extra factorize + re-fold beats
+            # nothing the fused path doesn't already do better.
+            return None
+
+        from hyperspace_tpu.plan.nodes import Aggregate as _Agg
+
+        pschema = _Agg(_TableLeaf(lt), pkeys, partial_specs).schema
+        venue = self._agg_venue()
+        partial = aggregate_table(
+            lt, pkeys, partial_specs, pschema, venue=venue, groups=(gid, k, rep)
+        )
+        self._phys(
+            "PartialAggPushdown",
+            partial_rows=partial.num_rows,
+            input_rows=lt.num_rows,
+            keys=pkeys,
+        )
+        outer_plan: LogicalPlan = _Agg(
+            Join(_TableLeaf(partial), child.right, child.left_on, child.right_on, "inner"),
+            list(plan.group_by),
+            outer_specs,
+        )
+        out = self._execute(outer_plan)
+        # Re-shape to the original output: means recompose from their
+        # sum/count partials (NULL when no valid input), counts restore
+        # SQL's never-NULL zero, columns return in declared order.
+        cols: dict[str, np.ndarray] = {}
+        dicts: dict[str, np.ndarray] = {}
+        validity: dict[str, np.ndarray] = {}
+        for f in plan.schema.fields:
+            low = f.name.lower()
+            if low in {c.lower() for c in plan.group_by}:
+                _copy_field(f, out, f.name, cols, dicts, validity)
+                continue
+            if f.name in mean_parts or low in {a.lower() for a in mean_parts}:
+                s_name, c_name = mean_parts[f.name]
+                s = out.column(s_name).astype(np.float64)
+                c = out.column(c_name).astype(np.float64)
+                with np.errstate(invalid="ignore", divide="ignore"):
+                    cols[f.name] = np.where(c > 0, s / np.maximum(c, 1), 0.0)
+                if (c == 0).any():
+                    validity[f.name] = c > 0
+                continue
+            _copy_field(f, out, f.name, cols, dicts, validity)
+            if f.name in count_aliases:
+                v = validity.pop(f.name, None)
+                if v is not None:
+                    cols[f.name] = np.where(v, cols[f.name], 0)
+        return ColumnTable(plan.schema, cols, dicts, validity)
 
     def _distinct_aggregate(self, plan: "Aggregate", dcols: list[str]) -> ColumnTable:
         """General distinct expansion (the Spark planner's Expand analog
@@ -614,7 +833,7 @@ class Executor:
 
         ct = self._execute(plan.child)
         venue = self._agg_venue()
-        gid, k, rep = group_ids(ct, plan.group_by)
+        gid, k, rep = _group_ids_cached(ct, plan.group_by)
         self._phys(
             "DistinctExpandAggregate",
             distinct_cols=dcols,
@@ -1037,25 +1256,54 @@ class Executor:
         literal bounds or no stats exist."""
         key = scan.bucket_spec[1][0]
         bounds = key_bounds(predicate, key)
-        if bounds is None:
-            return None
         files = self._scan_files(scan)
-        stats = hio.file_key_stats(files)
-        if not stats:
+        stats = hio.file_key_stats(files) if bounds is not None else {}
+        if bounds is not None and stats:
+            bounds, stat_conv = _convert_bounds(scan.scan_schema.field(key), bounds)
+        else:
+            stat_conv = None
+        # Included-column pruning: any OTHER referenced column with
+        # manifest columnStats and literal bounds prunes too (the
+        # reference gets this from parquet per-column min/max via
+        # FileSourceScanExec, SURVEY.md §2.2).
+        refs = {r.lower() for r in predicate.references()}
+        extra: list[tuple[KeyBounds, object, dict]] = []
+        for c in scan.scan_schema.names:
+            if c.lower() == key.lower() or c.lower() not in refs:
+                continue
+            b = key_bounds(predicate, c)
+            if b is None:
+                continue
+            cstats = hio.file_column_stats(files, c)
+            if not cstats:
+                continue
+            cb, cconv = _convert_bounds(scan.scan_schema.field(c), b)
+            extra.append((cb, cconv, cstats))
+        if stat_conv is None and not extra:
             return None
-        bounds, stat_conv = _convert_bounds(scan.scan_schema.field(key), bounds)
         kept: list[str] = []
         for f in files:
-            if f not in stats:
-                kept.append(f)  # no stats recorded: must read it
-                continue
-            s = stats[f]
-            # s is None ⇔ bucket empty or all-null key: no row can satisfy
-            # a literal comparison (3-valued logic), safe to skip.
-            if s is not None and _stats_overlap(bounds, stat_conv(s[0]), stat_conv(s[1])):
+            keep = True
+            if stat_conv is not None and f in stats:
+                s = stats[f]
+                # s is None ⇔ bucket empty or all-null key: no row can
+                # satisfy a literal comparison (3VL), safe to skip.
+                keep = s is not None and _stats_overlap(bounds, stat_conv(s[0]), stat_conv(s[1]))
+            for cb, cconv, cstats in extra:
+                if not keep:
+                    break
+                if f in cstats:
+                    s = cstats[f]
+                    keep = s is not None and _stats_overlap(cb, cconv(s[0]), cconv(s[1]))
+            if keep:
                 kept.append(f)
+        if stat_conv is None and len(kept) == len(files):
+            # Included-column stats pruned nothing and the key gives no
+            # slicing bounds: stay on the plain scan path (whole cached
+            # bucket files — the device upload cache keys on them).
+            return None
         self.stats["files_pruned"] += len(files) - len(kept)
-        return kept, bounds, stats
+        return kept, (bounds if stat_conv is not None else None), stats
 
     def _range_read(self, scan: Scan, predicate: Expr) -> tuple[ColumnTable, bool] | None:
         """File-level range pruning + within-file searchsorted slicing
@@ -1088,13 +1336,15 @@ class Executor:
         parts: list[ColumnTable] = []
         # Float keys can hold NaN VALUES (sorted last by the build); a
         # lower-bound-only slice would include them while the mask drops
-        # them — never claim exactness for float key columns.
-        exact = field.device_dtype.kind != "f"
+        # them — never claim exactness for float key columns. bounds is
+        # None when only included-column stats pruned: no key slicing.
+        exact = bounds is not None and field.device_dtype.kind != "f"
         for fp, t in zip(kept, tables):
             if t.num_rows == 0:
                 continue
             sliceable = (
-                not field.is_string
+                bounds is not None
+                and not field.is_string
                 and t.valid_mask(field.name) is None
                 and fp in stats_files  # stats-backed ⇒ written key-sorted
             )
@@ -1122,11 +1372,11 @@ class Executor:
     # -- join ------------------------------------------------------------
     def _join(self, plan: Join) -> ColumnTable:
         lside, rside, left_side, right_side = self._join_sides(plan)
-        # Path from THIS frame's decision, not the global stat — a nested
-        # join executed inside _join_sides overwrites the latter. buckets/
+        # Path from THIS frame's decision (the _join_sides call above
+        # sets it LAST, after any nested joins it executed ran). buckets/
         # devices are read after _partition_join, which sets them for the
         # kernel that just ran (this join's own).
-        path = "zero-exchange-aligned" if left_side is not None else "single-partition"
+        path = self.stats["join_path"]
         if left_side is not None:
             out = self._aligned_join(plan, left_side, right_side, lside, rside)
         else:
@@ -1143,36 +1393,190 @@ class Executor:
         )
         return out
 
+    @staticmethod
+    def _bucket_hash_dtypes(scan: Scan) -> tuple[str, ...]:
+        """The hash domain of a scan's bucket columns. The canonical row
+        hash is dtype-sensitive (an int64 mixes two words; an int32 one),
+        so two bucketings agree on equal key VALUES only when the bucket
+        column dtypes agree."""
+        out = []
+        for c in scan.bucket_spec[1]:
+            f = scan.scan_schema.field(c)
+            out.append("string" if f.is_string else str(np.dtype(f.device_dtype)))
+        return tuple(out)
+
+    def _keyed_on_buckets(self, side: AlignedSide | None, join_on: list[str]) -> bool:
+        """True iff the side is an index scan bucketed exactly on its
+        join keys (the precondition for any bucket-parallel pairing)."""
+        return (
+            side is not None
+            and side.scan.bucket_spec is not None
+            and [c.lower() for c in side.scan.bucket_spec[1]]
+            == [c.lower() for c in join_on]
+        )
+
     def _join_sides(
         self, plan: Join
     ) -> tuple["SideData", "SideData", AlignedSide | None, AlignedSide | None]:
         """Per-side bucket data for a join — the one place that decides
         between the zero-exchange aligned path (both sides bucketed with
-        equal counts on the join keys) and the single-partition fallback.
-        Returns the AlignedSides (None, None) on the fallback."""
+        equal counts on the join keys), the re-bucketing exchange (one
+        side bucketed, the other re-bucketized on the fly to match), a
+        bucket-preserving reuse of an inner join's output grouping, and
+        the single-partition fallback. Returns the AlignedSides
+        (None, None) on every non-both-aligned path."""
         left_side = self._aligned_side(plan.left)
         right_side = self._aligned_side(plan.right)
         if (
-            left_side is not None
-            and right_side is not None
-            and left_side.scan.bucket_spec is not None
-            and right_side.scan.bucket_spec is not None
+            self._keyed_on_buckets(left_side, plan.left_on)
+            and self._keyed_on_buckets(right_side, plan.right_on)
             and left_side.scan.bucket_spec[0] == right_side.scan.bucket_spec[0]
-            and [c.lower() for c in left_side.scan.bucket_spec[1]] == [c.lower() for c in plan.left_on]
-            and [c.lower() for c in right_side.scan.bucket_spec[1]] == [c.lower() for c in plan.right_on]
+            # Equal VALUES hash identically only in equal dtype domains.
+            and self._bucket_hash_dtypes(left_side.scan)
+            == self._bucket_hash_dtypes(right_side.scan)
         ):
             self.stats["join_path"] = "zero-exchange-aligned"
             num_buckets = left_side.scan.bucket_spec[0]
-            return (
-                self._side_data(left_side, num_buckets),
-                self._side_data(right_side, num_buckets),
-                left_side,
-                right_side,
+            # Dynamic partition pruning (the analog of Spark 3's DPP,
+            # which post-dates the reference's engine): build the
+            # predicate-bearing side FIRST, bound its surviving join
+            # keys, and skip the other side's bucket files whose
+            # manifest key stats cannot overlap — a dimension filtered
+            # to one month reads ~1/60th of a date-bucketed fact index.
+            producer = None
+            if plan.how == "inner":
+                if left_side.predicate is not None and right_side.predicate is None:
+                    producer = "left"
+                elif right_side.predicate is not None and left_side.predicate is None:
+                    producer = "right"
+                elif left_side.predicate is not None and right_side.predicate is not None:
+                    producer = (
+                        "left"
+                        if self._base_rows(left_side) <= self._base_rows(right_side)
+                        else "right"
+                    )
+            if producer == "left":
+                lside = self._side_data(left_side, num_buckets)
+                bounds = self._side_key_bounds(lside, left_side)
+                rside = self._side_data(right_side, num_buckets, dpp_bounds=bounds)
+            elif producer == "right":
+                rside = self._side_data(right_side, num_buckets)
+                bounds = self._side_key_bounds(rside, right_side)
+                lside = self._side_data(left_side, num_buckets, dpp_bounds=bounds)
+            else:
+                lside = self._side_data(left_side, num_buckets)
+                rside = self._side_data(right_side, num_buckets)
+            return lside, rside, left_side, right_side
+        # One side bucketed on its join keys: the other side can ride a
+        # query-time re-bucketing exchange (hash + counting sort on host,
+        # device sort on the device venue) so the merge stays
+        # bucket-parallel — SURVEY §2.3's "single re-bucketing all-to-all
+        # when bucket counts don't match" and the ranker's
+        # mismatched-pair case (JoinIndexRanker.scala:31-34).
+        mode = self.conf.join_rebucketize if self.conf is not None else "auto"
+        lt = rt = None
+        l_keyed = self._keyed_on_buckets(left_side, plan.left_on)
+        r_keyed = self._keyed_on_buckets(right_side, plan.right_on)
+        if mode != "off" and (l_keyed != r_keyed):
+            if l_keyed:
+                idx_side, other_plan, other_on = left_side, plan.right, plan.right_on
+            else:
+                idx_side, other_plan, other_on = right_side, plan.left, plan.left_on
+            num_buckets = idx_side.scan.bucket_spec[0]
+            idx_fields = [
+                idx_side.scan.scan_schema.field(c) for c in idx_side.scan.bucket_spec[1]
+            ]
+            t_other = self._execute(other_plan)
+            preserved = self._preserved_sidedata(t_other, other_on)
+            if preserved is not None and not (
+                len(preserved.offsets) - 1 == num_buckets
+                and _hash_fields_compatible(preserved.hash_fields, idx_fields)
+            ):
+                preserved = None
+            engage = (
+                preserved is not None  # reuse is free — always take it
+                or mode == "force"
+                or not self._should_broadcast(t_other.num_rows, self._base_rows(idx_side))
             )
-        # General path: single partition (bucket count 1).
+            if engage:
+                sd_other = preserved or self._rebucketize_side(
+                    t_other, other_on, idx_fields, num_buckets
+                )
+                if sd_other is not None:
+                    # The materialized side doubles as the DPP producer
+                    # when dropping unmatched INDEXED-side rows early is
+                    # sound for this join type (the indexed side must not
+                    # be a preserved outer side).
+                    idx_is_right = not l_keyed
+                    prune_ok = (
+                        plan.how == "inner"
+                        or (idx_is_right and plan.how in ("left", "semi", "anti"))
+                        or (not idx_is_right and plan.how == "right")
+                    )
+                    dpp = None
+                    if prune_ok:
+                        dpp = self._table_key_bounds(t_other, other_on[0])
+                    sd_idx = self._side_data(idx_side, num_buckets, dpp_bounds=dpp)
+                    self.stats["join_path"] = (
+                        "bucket-preserved-aligned" if preserved is not None else "rebucketized-aligned"
+                    )
+                    self._phys(
+                        exchange="preserved" if preserved is not None else "rebucketize",
+                        buckets=num_buckets,
+                    )
+                    if l_keyed:
+                        return sd_idx, sd_other, None, None
+                    return sd_other, sd_idx, None, None
+            if l_keyed:
+                rt = t_other
+            else:
+                lt = t_other
+        if mode != "off" and not l_keyed and not r_keyed:
+            # Neither side indexed: a child inner join's preserved bucket
+            # grouping can still pair — directly against another
+            # preserved side, or by re-bucketizing the other side into
+            # its domain.
+            lt = lt if lt is not None else self._execute(plan.left)
+            rt = rt if rt is not None else self._execute(plan.right)
+            pl = self._preserved_sidedata(lt, plan.left_on)
+            pr = self._preserved_sidedata(rt, plan.right_on)
+            if (
+                pl is not None
+                and pr is not None
+                and len(pl.offsets) == len(pr.offsets)
+                and _hash_fields_compatible(pl.hash_fields, pr.hash_fields)
+            ):
+                self.stats["join_path"] = "bucket-preserved-aligned"
+                self._phys(exchange="preserved-both", buckets=len(pl.offsets) - 1)
+                return pl, pr, None, None
+            keyed = pl or pr
+            if keyed is not None and (
+                mode == "force" or not self._should_broadcast(lt.num_rows, rt.num_rows)
+            ):
+                if pl is not None:
+                    other = self._rebucketize_side(
+                        rt, plan.right_on, list(pl.hash_fields), len(pl.offsets) - 1
+                    )
+                    pair = (pl, other)
+                else:
+                    other = self._rebucketize_side(
+                        lt, plan.left_on, list(pr.hash_fields), len(pr.offsets) - 1
+                    )
+                    pair = (other, pr)
+                if pair[0] is not None and pair[1] is not None:
+                    self.stats["join_path"] = "rebucketized-aligned"
+                    self._phys(
+                        exchange="preserved+rebucketize", buckets=len(keyed.offsets) - 1
+                    )
+                    return pair[0], pair[1], None, None
+        # General path: single partition (bucket count 1). The path stat
+        # is set AFTER the children run — a nested join inside them sets
+        # its own path and must not leak into this frame's label.
+        if lt is None:
+            lt = self._execute(plan.left)
+        if rt is None:
+            rt = self._execute(plan.right)
         self.stats["join_path"] = "single-partition"
-        lt = self._execute(plan.left)
-        rt = self._execute(plan.right)
         one = lambda t: SideData(t, np.array([0, t.num_rows], dtype=np.int64), False)  # noqa: E731
         return one(lt), one(rt), None, None
 
@@ -1193,57 +1597,227 @@ class Executor:
             else:
                 predicate = node.predicate if predicate is None else And(predicate, node.predicate)
                 node = node.child
-        if isinstance(node, Union) and len(node.inputs) == 2:
-            base, delta = node.inputs
-            if isinstance(delta, Project) and isinstance(delta.child, Scan):
-                delta = delta.child
-            if (
-                isinstance(base, Scan)
-                and base.bucket_spec is not None
-                and isinstance(delta, Scan)
-                and delta.bucket_spec is None
-            ):
-                return AlignedSide(base, project, delta=delta, predicate=predicate)
-            return None
+        if isinstance(node, Union):
+            # Hybrid scan of ANY width: exactly one bucketed index scan
+            # plus unbucketed delta scans (appended files). The rewrite
+            # rule emits the two-input shape; refresh chains or manual
+            # unions may widen it.
+            base = None
+            deltas: list[Scan] = []
+            for inp in node.inputs:
+                if isinstance(inp, Project) and inp.is_simple and isinstance(inp.child, Scan):
+                    inp = inp.child
+                if not isinstance(inp, Scan):
+                    return None
+                if inp.bucket_spec is not None:
+                    if base is not None:
+                        return None  # two index scans: not a hybrid side
+                    base = inp
+                else:
+                    deltas.append(inp)
+            if base is None:
+                return None
+            return AlignedSide(base, project, deltas=tuple(deltas), predicate=predicate)
         if isinstance(node, Scan):
             return AlignedSide(node, project, predicate=predicate)
         return None
 
-    def _side_data(self, side: AlignedSide, num_buckets: int) -> "SideData":
+    def _base_rows(self, side: AlignedSide) -> int:
+        """Total indexed rows from the side's manifest (for picking the
+        smaller DPP producer); large sentinel when unknown."""
+        from pathlib import Path as _P
+
+        files = self._scan_files(side.scan)
+        if files:
+            m = hio.read_manifest_cached(_P(files[0]).parent)
+            if m and "bucketRows" in m:
+                return int(sum(m["bucketRows"]))
+        return 1 << 60
+
+    # Set-based DPP only materializes the producer's distinct keys below
+    # these sizes (the semi-join/bloom reduction; beyond them the range
+    # alone applies).
+    _DPP_SET_MAX_ROWS = 4_000_000
+    _DPP_SET_MAX_KEYS = 262_144
+
+    def _side_key_bounds(self, sdata: "SideData", side: AlignedSide):
+        """DPP producer info of an aligned side (see _table_key_bounds)."""
+        return self._table_key_bounds(sdata.table, side.scan.bucket_spec[1][0])
+
+    def _table_key_bounds(self, t: ColumnTable, key: str):
+        """(lo, hi, key_set | None) of the surviving join-key values
+        (nulls excluded — they never match). lo/hi are value-domain
+        (strings decoded via the dictionary); key_set is the SORTED
+        distinct int keys when small enough to enumerate — the consumer
+        filters its rows by membership (the semi-join reduction half of
+        DPP: a 1/70-selective demographics filter cuts the fact side 70x
+        BEFORE any pairing). (None, None, None) = empty."""
+        f = t.schema.field(key)
+        vals = t.columns[f.name]
+        valid = t.valid_mask(key)
+        if valid is not None:
+            vals = vals[valid]
+        if len(vals) == 0:
+            return (None, None, None)  # empty producer: skip everything
+        if f.device_dtype.kind == "f" and bool(np.isnan(vals).any()):
+            # NaN keys are real joinable values in the float domain but
+            # poison min/max (NaN bounds would slice every finite row
+            # away) — disable DPP for this producer entirely.
+            return None
+        lo, hi = vals.min(), vals.max()
+        if f.name in t.dictionaries:
+            d = t.dictionaries[f.name]
+            return (d[int(lo)], d[int(hi)], None)
+        kset = None
+        if (
+            f.device_dtype.kind in "iu"
+            and len(vals) <= self._DPP_SET_MAX_ROWS
+        ):
+            u = np.unique(vals)
+            if len(u) <= self._DPP_SET_MAX_KEYS:
+                kset = u
+        return (lo, hi, kset)
+
+    def _rebucketize_side(
+        self, table: ColumnTable, key_cols: list[str], idx_fields, num_buckets: int
+    ) -> "SideData | None":
+        """Query-time re-bucketing exchange: group an arbitrary
+        materialized table into the SAME bucket layout an index side
+        uses, by recomputing the canonical row hash with each key column
+        cast into the index side's dtype domain (equal values then hash
+        identically; values unrepresentable on the index side have no
+        partner there, so their placement cannot matter). Host venue:
+        native counting sort; device venue: one device sort of the
+        bucket ids. None when the key shapes cannot share a hash domain
+        (string vs non-string)."""
+        from hyperspace_tpu.execution.builder import NULL_HASH
+        from hyperspace_tpu.ops.hashing import (
+            combine_hashes,
+            hash_int_column,
+            string_dict_hashes,
+        )
+
+        hs = []
+        for c, fi in zip(key_cols, idx_fields):
+            f = table.schema.field(c)
+            if f.is_string != fi.is_string:
+                return None
+            arr = table.columns[f.name]
+            if f.is_string:
+                dh = string_dict_hashes(table.dictionaries[f.name])
+                h = dh[arr] if len(dh) else np.zeros(len(arr), np.uint32)
+            else:
+                if arr.dtype != fi.device_dtype:
+                    arr = arr.astype(fi.device_dtype)
+                h = hash_int_column(arr, np)
+            valid = table.valid_mask(c)
+            if valid is not None:
+                h = np.where(valid, h, NULL_HASH)
+            hs.append(h)
+        bucket = np.asarray(bucket_ids(combine_hashes(hs, np), num_buckets, np), dtype=np.int32)
+        venue = self._join_venue()
+        kernel = None
+        if venue == "device":
+            import jax
+            import jax.numpy as jnp
+
+            order = np.asarray(jax.device_get(jnp.argsort(jnp.asarray(bucket))))
+            counts = np.bincount(bucket, minlength=num_buckets).astype(np.int64)
+            kernel = "device-sort-exchange"
+        else:
+            from hyperspace_tpu import native
+
+            res = native.bucket_perm(bucket, num_buckets)
+            if res is not None:
+                order, counts = res
+                kernel = "host-counting-sort-exchange"
+            else:
+                order = np.argsort(bucket, kind="stable")
+                counts = np.bincount(bucket, minlength=num_buckets).astype(np.int64)
+                kernel = "host-argsort-exchange"
+        self.stats["exchange_kernel"] = kernel
+        offsets = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+        return SideData(table.take(order), offsets, False, hash_fields=tuple(idx_fields))
+
+    def _side_data(
+        self, side: AlignedSide, num_buckets: int, dpp_bounds=None
+    ) -> "SideData":
         """One concatenated bucket-grouped table per join side (bucket
         files read in parallel through the decoded-table cache), plus
         (hybrid scan) delta rows bucketized on the fly with the same
-        canonical row hash the build used."""
+        canonical row hash the build used. `dpp_bounds` (lo, hi) is the
+        other side's surviving key range (dynamic partition pruning): an
+        enumerable span skips whole bucket FILES by hashing the span to
+        its bucket set, and every surviving sorted bucket slices to the
+        one contiguous ROW run inside the bounds."""
         from concurrent.futures import ThreadPoolExecutor
 
         schema = side.scan.scan_schema
+        hf = tuple(schema.field(c) for c in side.scan.bucket_spec[1])
         groups = self._bucket_files_in_order(side.scan, num_buckets)
+        if dpp_bounds is not None:
+            keep = self._dpp_bucket_set(side, dpp_bounds, num_buckets)
+            if keep is not None:
+                pruned = sum(len(g) for b, g in enumerate(groups) if b not in keep)
+                if pruned:
+                    groups = [g if b in keep else [] for b, g in enumerate(groups)]
+                    self.stats["files_pruned"] += pruned
+                    self._phys(dpp_files_pruned=pruned)
         before = hio.table_cache_stats()["miss_files"]
+        empty = ColumnTable.empty(schema)
         with ThreadPoolExecutor(max_workers=8) as pool:
             tables = list(
-                pool.map(lambda g: hio.read_parquet_cached(g, columns=schema.names, schema=schema), groups)
+                pool.map(
+                    lambda g: hio.read_parquet_cached(g, columns=schema.names, schema=schema)
+                    if g
+                    else empty,
+                    groups,
+                )
             )
+        if dpp_bounds is not None and dpp_bounds[0] is not None:
+            import hashlib
+
+            key_field = schema.field(side.scan.bucket_spec[1][0])
+            kset_digest = (
+                hashlib.md5(dpp_bounds[2].tobytes()).hexdigest()
+                if dpp_bounds[2] is not None
+                else None  # one digest per SIDE, not per bucket
+            )
+            rows_before = sum(t.num_rows for t in tables)
+            tables = [
+                self._dpp_cut_cached(
+                    t, key_field, dpp_bounds, sliceable=len(g) <= 1, kset_digest=kset_digest
+                )
+                for g, t in zip(groups, tables)
+            ]
+            cut = rows_before - sum(t.num_rows for t in tables)
+            if cut:
+                self.stats["rows_pruned"] += cut
+                self._phys(dpp_rows_pruned=cut)
         self.stats["files_read"] += hio.table_cache_stats()["miss_files"] - before
         counts = np.array([t.num_rows for t in tables], dtype=np.int64)
         base = _concat_side_cached(tables)
         offsets = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
-        sorted_within = all(len(g) == 1 for g in groups)
-        if side.delta is not None:
-            dt = self._scan(side.delta, columns=list(schema.names))
+        # Empty (fully pruned) groups are trivially sorted.
+        sorted_within = all(len(g) <= 1 for g in groups)
+        if side.deltas:
+            dts = [self._scan(d, columns=list(schema.names)) for d in side.deltas]
             # Hash on the bucket columns in BUILD order (not join-key
             # order) so delta rows land in the same buckets the index used.
-            row_hash = compute_row_hashes(dt, side.scan.bucket_spec[1])
-            db = bucket_ids(row_hash, num_buckets, np)
+            dbs = [
+                bucket_ids(compute_row_hashes(dt, side.scan.bucket_spec[1]), num_buckets, np)
+                for dt in dts
+            ]
             all_bucket = np.concatenate(
-                [np.repeat(np.arange(num_buckets, dtype=np.int32), counts), db]
+                [np.repeat(np.arange(num_buckets, dtype=np.int32), counts), *dbs]
             )
-            combined = ColumnTable.concat([base, dt])
+            combined = ColumnTable.concat([base, *dts])
             order = np.argsort(all_bucket, kind="stable")
             counts2 = np.bincount(all_bucket, minlength=num_buckets)
             offsets = np.concatenate([[0], np.cumsum(counts2)]).astype(np.int64)
-            out = SideData(combined.take(order), offsets, False)
+            out = SideData(combined.take(order), offsets, False, hash_fields=hf)
         else:
-            out = SideData(base, offsets, sorted_within)
+            out = SideData(base, offsets, sorted_within, hash_fields=hf)
         if side.predicate is not None:
             out = _filter_side(out, side.predicate, self.mesh, self._filter_venue())
         return out
@@ -1272,7 +1846,122 @@ class Executor:
                 if c.lower() not in rkeys and c.lower() not in {k.lower() for k in keep}:
                     keep.append(c)
             cols = keep
-        return out.select(cols) if cols is not None else out
+        if cols is None:
+            return out
+        return self._propagate_stash(out, out.select(cols))
+
+    # DPP only enumerates the producer's key span when it is this small
+    # (a year of dates is 366 hashes; demographic keys spanning millions
+    # stay un-enumerated and fall back to row slicing only).
+    _DPP_SPAN_LIMIT = 8192
+
+    def _dpp_bucket_set(self, side: AlignedSide, bounds, num_buckets: int):
+        """The set of bucket ids the producer's surviving keys can hash
+        into, or None when not enumerable (wide span / non-int / multi-
+        column bucket key). Keys are hash-distributed across buckets, so
+        file [min, max] stats cannot prune — but a small ENUMERABLE key
+        span (or exact key set) hashes to a concrete bucket subset (31
+        dates touch at most 31 of 64 buckets; a point key exactly one)."""
+        lo, hi, kset = bounds
+        if lo is None:  # empty producer: nothing joins
+            return set()
+        if len(side.scan.bucket_spec[1]) != 1:
+            return None
+        key = side.scan.bucket_spec[1][0]
+        f = side.scan.scan_schema.field(key)
+        if f.is_string or f.device_dtype.kind not in "iu":
+            return None
+        if kset is not None and len(kset) <= self._DPP_SPAN_LIMIT:
+            vals = kset.astype(f.device_dtype, copy=False)
+        else:
+            span = int(hi) - int(lo) + 1
+            if span > self._DPP_SPAN_LIMIT:
+                return None
+            vals = np.arange(int(lo), int(hi) + 1, dtype=f.device_dtype)
+        probe = ColumnTable(
+            side.scan.scan_schema.select([key]), {f.name: vals}, {}, {}
+        )
+        h = compute_row_hashes(probe, [key])
+        return set(np.unique(bucket_ids(h, num_buckets, np)).tolist())
+
+    def _dpp_cut_cached(
+        self, t: ColumnTable, key_field, dpp_bounds, sliceable: bool, kset_digest=None
+    ) -> ColumnTable:
+        """Range-slice + set-membership cut of one bucket table, memoized
+        on (stable table identity, bounds) so a REPEATED query serves the
+        same frozen sliced tables — keeping the whole downstream identity
+        chain (concat, factorize, channels, pads, HBM uploads) warm. A
+        per-query (unstable) table just computes the cut directly."""
+        from hyperspace_tpu.execution import device_cache as dc
+
+        lo, hi, kset = dpp_bounds
+
+        def cut() -> ColumnTable:
+            s = (
+                self._dpp_slice_table(t, key_field, lo, hi)
+                if sliceable and t.num_rows
+                else None
+            )
+            if s is None:
+                s = t
+            if (
+                kset is not None
+                and s.num_rows
+                and not key_field.is_string
+                and key_field.device_dtype.kind in "iu"
+            ):
+                # Semi-join reduction: keep only rows whose key is in the
+                # producer's distinct set (sorted-membership probe; nulls
+                # can't match). A sorted subsequence stays sorted.
+                colv = s.columns[key_field.name]
+                pos = np.minimum(np.searchsorted(kset, colv), len(kset) - 1)
+                hit = kset[pos] == colv
+                kvalid = s.valid_mask(key_field.name)
+                if kvalid is not None:
+                    hit = hit & kvalid
+                if not hit.all():
+                    s = s.filter_mask(hit)
+            return s
+
+        if t.num_rows == 0:
+            return t
+        if kset is not None and kset_digest is None:
+            return cut()  # no digest supplied: never key a cache on part of the cut
+        refs, parts = _stable_table_refs(t, {n.lower() for n in t.schema.names})
+        if not refs:
+            return cut()
+
+        def scalar(v):
+            return v.item() if hasattr(v, "item") else v
+
+        key = ("dppcut", parts, scalar(lo), scalar(hi), kset_digest)
+
+        def build():
+            s = cut()
+            if s is t:
+                return s, 0  # uncut: pass the (already stable) base through
+            for arr in (*s.columns.values(), *s.validity.values()):
+                dc.freeze(arr)
+            size = int(sum(a.nbytes for a in s.columns.values()))
+            return s, size
+
+        return dc.HOST_DERIVED.get_or_build(key, refs, build)
+
+    @staticmethod
+    def _dpp_slice_table(table: ColumnTable, field, lo, hi) -> ColumnTable | None:
+        """Rows of one KEY-SORTED bucket table inside [lo, hi] — one
+        contiguous searchsorted run (the within-file analog of range
+        pruning; hash bucketing scatters the key domain across files,
+        but WITHIN a file the build's sort makes any value range one
+        slice). None when the table isn't safely sliceable."""
+        if field.is_string or table.valid_mask(field.name) is not None:
+            return None
+        colv = table.columns[field.name]
+        lo_i = int(np.searchsorted(colv, lo, side="left"))
+        hi_i = int(np.searchsorted(colv, hi, side="right"))
+        if lo_i == 0 and hi_i == table.num_rows:
+            return table
+        return table.take(np.arange(lo_i, hi_i))
 
     def _bucket_files_in_order(self, scan: Scan, num_buckets: int) -> list[list[str]]:
         """Per-bucket file groups. A bucket can have several files (base
@@ -1651,10 +2340,26 @@ class Executor:
             out = lt.filter_mask(matched if how == "semi" else ~matched)
             return ColumnTable(plan.schema, out.columns, out.dictionaries, out.validity)
 
-        lidx, ridx = self._match_pairs(plan, lside, rside)
+        lidx, ridx, totals = self._match_pairs(plan, lside, rside)
 
         inner = self._gather_pairs(plan, lt, rt, lidx, ridx)
         if how == "inner":
+            # Bucket-preserving output: an inner join over B>1 buckets
+            # emits pairs bucket-major, so the result STAYS bucket-
+            # grouped on the (merged, left-named) join keys — a later
+            # join on the same keys reuses the grouping with no exchange
+            # (SURVEY §2.3: chained star joins stay bucket-parallel).
+            if (
+                totals is not None
+                and len(totals) > 1
+                and lside.hash_fields is not None
+            ):
+                self._stash_bucketed(
+                    inner,
+                    np.concatenate([[0], np.cumsum(totals)]).astype(np.int64),
+                    plan.left_on,
+                    lside.hash_fields,
+                )
             return inner
         parts = [inner]
         if how in ("left", "full"):
@@ -1718,7 +2423,7 @@ class Executor:
             if res is not None:
                 self.stats["num_buckets"] = 1
                 self.stats["join_kernel"] = "host-broadcast-hash"
-                return res
+                return res[0], res[1], None
 
         lcodes, lperm = _bucket_sorted_codes(lcodes, lside)
         rcodes, rperm = _bucket_sorted_codes(rcodes, rside)
@@ -1761,7 +2466,9 @@ class Executor:
             lidx = lperm[lidx]
         if rperm is not None:
             ridx = rperm[ridx]
-        return lidx, ridx
+        # Pair order stays bucket-major through the perm mapping, so
+        # `totals` doubles as the OUTPUT's bucket grouping.
+        return lidx, ridx, np.asarray(totals, dtype=np.int64)
 
     def _should_broadcast(self, n_l: int, n_r: int) -> bool:
         """Small-enough and asymmetric-enough for the broadcast probe."""

@@ -331,6 +331,20 @@ def bucket_key_stats(table: ColumnTable, key: str, sel: np.ndarray | None = None
     return [_json_scalar(vals.min()), _json_scalar(vals.max())]
 
 
+def bucket_column_stats(
+    table: ColumnTable, columns: list[str], sel: np.ndarray | None = None
+) -> dict:
+    """Per-column [min, max] stats over rows `sel` for every named scalar
+    column — the included-column analog of bucket_key_stats (Spark's
+    parquet reader gives the reference min/max on EVERY column; the
+    manifest carries ours so non-leading predicates prune files too)."""
+    out = {}
+    for c in columns:
+        s = bucket_key_stats(table, c, sel)
+        out[c] = s
+    return out
+
+
 def write_bucket(dest_dir: Path, bucket: int, table: ColumnTable) -> None:
     dest_dir.mkdir(parents=True, exist_ok=True)
     # Dictionary-encode ONLY string columns: for numeric index data,
@@ -349,6 +363,7 @@ def write_manifest(
     indexed_columns: list[str],
     bucket_rows: list[int],
     key_stats: list | None = None,
+    column_stats: list | None = None,
 ) -> None:
     dest_dir.mkdir(parents=True, exist_ok=True)
     manifest = {
@@ -360,6 +375,10 @@ def write_manifest(
         # Per-bucket [min, max] of the first indexed column (None when the
         # bucket is empty or all-null) — enables file-level range pruning.
         manifest["keyStats"] = key_stats
+    if column_stats is not None:
+        # Per-bucket {column: [min, max] | None} for the remaining scalar
+        # columns — file pruning on included-column predicates.
+        manifest["columnStats"] = column_stats
     (dest_dir / MANIFEST_NAME).write_text(json.dumps(manifest, indent=2))
 
 
@@ -415,6 +434,31 @@ def file_key_stats(files: list[str]) -> dict[str, list | None]:
     return out
 
 
+def file_column_stats(files: list[str], column: str) -> dict[str, list | None]:
+    """Per-file [min, max] of a NON-leading column from the manifests'
+    columnStats (case-insensitive name match). Same present/None contract
+    as file_key_stats."""
+    out: dict[str, list | None] = {}
+    by_dir: dict[Path, list[str]] = {}
+    low = column.lower()
+    for f in files:
+        by_dir.setdefault(Path(f).parent, []).append(f)
+    for d, fs in by_dir.items():
+        m = read_manifest_cached(d)
+        cs = (m or {}).get("columnStats")
+        if not cs:
+            continue
+        for f in fs:
+            b = bucket_of_file_name(Path(f).name)
+            if b is None or b >= len(cs) or cs[b] is None:
+                continue
+            for name, s in cs[b].items():
+                if name.lower() == low:
+                    out[f] = s
+                    break
+    return out
+
+
 def carve_and_write(
     dest: Path,
     table: "ColumnTable",
@@ -442,6 +486,13 @@ def carve_and_write(
     rows = [int(starts[p + 1] - starts[p]) for p in range(num_partitions)]
     key_stats: list = [None] * num_partitions
 
+    col_stats: list = [None] * num_partitions
+    other_cols = [
+        f.name
+        for f in table.schema.fields
+        if not f.is_vector and (not indexed_columns or f.name != table.schema.field(indexed_columns[0]).name)
+    ]
+
     def write_one(p: int) -> None:
         lo, hi = int(starts[p]), int(starts[p + 1])
         sel = np.arange(lo, hi) if order is None else order[lo:hi]
@@ -449,10 +500,16 @@ def carve_and_write(
             sel = sort_fn(p, sel)
         if indexed_columns:
             key_stats[p] = bucket_key_stats(table, indexed_columns[0], sel)
+        if other_cols:
+            col_stats[p] = bucket_column_stats(table, other_cols, sel)
         write_bucket(dest, p, table.take(sel))
 
     with ThreadPoolExecutor(max_workers=min(16, max(1, num_partitions))) as ex:
         list(ex.map(write_one, range(num_partitions)))
     has_stats = any(s is not None for s in key_stats)
-    write_manifest(dest, num_partitions, indexed_columns, rows, key_stats if has_stats else None)
+    write_manifest(
+        dest, num_partitions, indexed_columns, rows,
+        key_stats if has_stats else None,
+        col_stats if any(s is not None for s in col_stats) else None,
+    )
     return rows

@@ -87,16 +87,25 @@ class HyperspaceSession:
             def writer_factory():
                 from hyperspace_tpu.execution.builder import DeviceIndexBuilder
 
-                return DeviceIndexBuilder(
+                w = DeviceIndexBuilder(
                     mesh=self.mesh,
                     memory_budget_bytes=self.conf.build_memory_budget_bytes,
                     chunk_bytes=self.conf.build_chunk_bytes or None,
                     venue=self.conf.build_venue,
                     venue_min_mbps=self.conf.join_venue_min_mbps,
                 )
+                self._last_writer = w
+                return w
 
             self._manager = CachingIndexCollectionManager(self.conf, writer_factory)
         return self._manager
+
+    @property
+    def last_build_stats(self) -> dict:
+        """Stats of the most recent index build in this session,
+        including the per-phase wall-time breakdown (decode / hash+lanes
+        / partition+exchange / carve+encode+write)."""
+        return dict(getattr(getattr(self, "_last_writer", None), "last_build_stats", {}) or {})
 
     # -- data access ------------------------------------------------------
     def parquet(self, root: str | Path) -> Scan:

@@ -62,6 +62,66 @@ def _substr_column(
     return codes, new_dict, table.valid_mask(f.name)
 
 
+def _string_case_column(table: ColumnTable, e: Expr):
+    """String-valued CASE of the TPC-DS q36/q70 shape: every branch value
+    is the SAME string column or a string literal (the 'masked parent
+    key' idiom — `case when grouping(x)=0 then cat end`). The dictionary
+    extends with the literals (re-sorted to keep the order-preserving
+    codes invariant) and branches select in code space."""
+    from hyperspace_tpu.plan.expr import Case, Lit
+    from hyperspace_tpu.ops.filter import eval_predicate_mask
+
+    if not isinstance(e, Case):
+        raise HyperspaceError(
+            f"cannot project string-typed expression {type(e).__name__}"
+        )
+    src: str | None = None
+    lits: set[str] = set()
+    for v in [*(v for _, v in e.branches), e.default]:
+        if isinstance(v, Col):
+            f = table.schema.field(v.name)
+            if not f.is_string:
+                raise HyperspaceError("string CASE branches must be string-typed")
+            if src is not None and f.name != src:
+                raise HyperspaceError(
+                    "string CASE supports one source column (plus literals)"
+                )
+            src = f.name
+        elif isinstance(v, Lit) and isinstance(v.value, str):
+            lits.add(v.value)
+        else:
+            raise HyperspaceError(
+                "string CASE branches must be a string column or string literals"
+            )
+    base = table.dictionaries[src] if src is not None else np.zeros(0, dtype=object)
+    merged = np.unique(np.concatenate([base.astype(str), np.array(sorted(lits), dtype=str)]))
+    old_to_new = np.searchsorted(merged, base.astype(str)).astype(np.int32)
+    lit_code = {s: int(np.searchsorted(merged, s)) for s in lits}
+    n = table.num_rows
+
+    def branch_codes(v) -> np.ndarray:
+        if isinstance(v, Col):
+            return old_to_new[table.columns[src]]
+        return np.full(n, lit_code[v.value], np.int32)
+
+    def branch_valid(v) -> np.ndarray | None:
+        if isinstance(v, Col):
+            return table.validity.get(src)
+        return None
+
+    codes = branch_codes(e.default)
+    valid = branch_valid(e.default)
+    for cond, v in reversed(e.branches):
+        m = eval_predicate_mask(table, cond)
+        codes = np.where(m, branch_codes(v), codes)
+        bv = branch_valid(v)
+        if valid is not None or bv is not None:
+            va = np.ones(n, bool) if valid is None else valid
+            vb = np.ones(n, bool) if bv is None else bv
+            valid = np.where(m, vb, va)
+    return codes.astype(np.int32), merged.astype(object), valid
+
+
 def compute_column(
     table: ColumnTable, e: Expr, dtype: str
 ) -> tuple[np.ndarray, np.ndarray | None, np.ndarray | None]:
@@ -73,6 +133,14 @@ def compute_column(
     from hyperspace_tpu.ops.aggregate import _numeric_input
     from hyperspace_tpu.schema import Field
 
+    if isinstance(e, Col):
+        # Column rename (SELECT c AS x) — carries codes/dict/validity.
+        f = table.schema.field(e.name)
+        return (
+            table.columns[f.name],
+            table.dictionaries.get(f.name),
+            table.validity.get(f.name),
+        )
     if isinstance(e, Substr):
         codes, d, valid = _substr_column(table, e)
         return codes, d, valid
@@ -82,9 +150,7 @@ def compute_column(
         vals, valid = _bool_column(table, e)
         return vals, None, valid
     if dtype == "string":
-        raise HyperspaceError(
-            f"cannot project string-typed expression {type(e).__name__}"
-        )
+        return _string_case_column(table, e)
     vals, valid = _numeric_input(table, e)
     phys = Field("_", dtype).device_dtype
     return np.asarray(vals).astype(phys, copy=False), None, valid

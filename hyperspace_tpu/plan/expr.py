@@ -446,6 +446,15 @@ def evaluate(e: Expr, resolve: Callable[[str], Any], xp) -> Any:
     if isinstance(e, BinOp):
         a = evaluate(e.left, resolve, xp)
         b = evaluate(e.right, resolve, xp)
+        if e.op in ("div", "mod"):
+            import numpy as _np
+
+            if xp is _np:
+                # SQL division by zero yields NULL via the validity
+                # masks upstream; the raw IEEE result here is inf/nan by
+                # design — don't leak the numpy warning to users.
+                with _np.errstate(divide="ignore", invalid="ignore"):
+                    return a / b if e.op == "div" else a % b
         return {
             "eq": lambda: a == b,
             "ne": lambda: a != b,

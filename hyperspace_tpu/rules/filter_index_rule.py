@@ -46,6 +46,22 @@ class FilterIndexRule(Rule):
             if new_scan is not None:
                 return Filter(new_scan, plan.predicate)
             return plan
+        if (
+            isinstance(plan, Filter)
+            and isinstance(plan.child, Project)
+            and plan.child.is_simple
+            and isinstance(plan.child.child, Scan)
+        ):
+            # Filter(Project(Scan)) — the select-then-filter spelling of
+            # the same shape (the filter can only reference projected
+            # columns, so coverage over the projection's inputs suffices).
+            proj = plan.child
+            new_scan = self._replacement(
+                proj.child, plan.predicate, proj.input_columns(), indexes, matcher
+            )
+            if new_scan is not None:
+                return Filter(Project(new_scan, proj.columns), plan.predicate)
+            return plan
         # Recurse into children.
         if isinstance(plan, Project):
             return Project(self._rewrite(plan.child, indexes, matcher), plan.columns)

@@ -110,20 +110,40 @@ class JoinIndexRule(Rule):
 
         lscan = _side_scan(plan.left)
         rscan = _side_scan(plan.right)
-        if lscan is None or rscan is None or lscan is rscan:
+        if (lscan is None and rscan is None) or lscan is rscan:
             return None
 
-        lreq = _side_required_columns(plan.left, plan.left_on)
-        rreq = _side_required_columns(plan.right, plan.right_on)
-
-        lcands = self._usable(indexes, lscan, plan.left_on, lreq, matcher)
-        rcands = self._usable(indexes, rscan, plan.right_on, rreq, matcher)
-        if not lcands or not rcands:
+        lcands = rcands = []
+        if lscan is not None:
+            lreq = _side_required_columns(plan.left, plan.left_on)
+            lcands = self._usable(indexes, lscan, plan.left_on, lreq, matcher)
+        if rscan is not None:
+            rreq = _side_required_columns(plan.right, plan.right_on)
+            rcands = self._usable(indexes, rscan, plan.right_on, rreq, matcher)
+        if not lcands and not rcands:
             return None
 
-        pairs = self._compatible_pairs(lcands, rcands, plan.left_on, plan.right_on)
+        pairs = (
+            self._compatible_pairs(lcands, rcands, plan.left_on, plan.right_on)
+            if lcands and rcands
+            else []
+        )
         if not pairs:
-            return None
+            # One-sided rewrite: a lone usable index still serves the
+            # join — the executor's re-bucketing exchange groups the
+            # other side into the index's bucket layout on the fly
+            # (the ranker's mismatched-pair fallback generalized,
+            # JoinIndexRanker.scala:31-34). Prefer more buckets (more
+            # parallelism), like the ranker's second criterion.
+            if lcands:
+                m = max(lcands, key=lambda c: c.entry.num_buckets)
+                new_left = _replace_scan(plan.left, self._side_plan(m, lscan))
+                return Join(new_left, self._rewrite(plan.right, indexes, matcher),
+                            plan.left_on, plan.right_on, plan.how)
+            m = max(rcands, key=lambda c: c.entry.num_buckets)
+            new_right = _replace_scan(plan.right, self._side_plan(m, rscan))
+            return Join(self._rewrite(plan.left, indexes, matcher), new_right,
+                        plan.left_on, plan.right_on, plan.how)
         best_l, best_r = JoinIndexRanker.rank(
             [(lm.entry, rm.entry) for lm, rm in pairs],
         )[0]

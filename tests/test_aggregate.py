@@ -663,15 +663,59 @@ def test_case_aggregate_takes_fused_join_path(tmp_path, join_tables):
     session.conf.set(AGG_VENUE, "device")
     fact = session.parquet(fact_root)
     dim = session.parquet(dim_root)
+    # The condition reads the FACT side so the partial-agg pushdown
+    # (which owns the dim-condition shape) stays out of the way.
     q = fact.join(dim, ["k"]).aggregate(
-        [], [AggSpec.of("sum", when(col("cat") == L("c1"), 1.0).otherwise(0.0), "c1s")]
+        [], [AggSpec.of("sum", when(col("units") >= L(5), 1.0).otherwise(0.0), "big")]
     )
     got = session.to_pandas(q)
     assert session.last_query_stats["agg_path"] == "fused-join-agg"
     f = pq.read_table(fact_root).to_pandas()
     d = pq.read_table(dim_root).to_pandas()
     j = f.merge(d, on="k")
-    np.testing.assert_allclose(got["c1s"][0], float((j.cat == "c1").sum()))
+    np.testing.assert_allclose(got["big"][0], float((j.units >= 5).sum()))
+
+
+def test_partial_agg_pushdown_dim_case_matches_pandas(tmp_path, join_tables):
+    """The q43/q59 shape — SUM(CASE WHEN <dim attr> THEN <fact measure>
+    ELSE 0) grouped by dim attributes — pre-aggregates the fact side by
+    the join key and re-folds (PartialAggPushdown), matching pandas."""
+    from hyperspace_tpu import when
+    from hyperspace_tpu.plan.expr import lit as L
+
+    fact_root, dim_root = join_tables
+    session = _session(tmp_path)
+    fact = session.parquet(fact_root)
+    dim = session.parquet(dim_root)
+    q = fact.join(dim, ["k"]).aggregate(
+        ["cat"],
+        [
+            AggSpec.of("sum", when(col("weight") > L(0.5), col("amount")).otherwise(0.0), "hv"),
+            AggSpec.of("sum", "amount", "tot"),
+            AggSpec.of("count", None, "n"),
+            AggSpec.of("mean", "units", "mu"),
+            AggSpec.of("min", "amount", "lo"),
+        ],
+    )
+    got = session.to_pandas(q).sort_values("cat").reset_index(drop=True)
+    assert "PartialAggPushdown" in repr(session.last_physical_plan)
+    f = pq.read_table(fact_root).to_pandas()
+    d = pq.read_table(dim_root).to_pandas()
+    j = f.merge(d, on="k")
+    j["hv"] = np.where(j.weight > 0.5, j.amount, 0.0)
+    exp = (
+        j.groupby("cat")
+        .agg(hv=("hv", "sum"), tot=("amount", "sum"), n=("amount", "size"),
+             mu=("units", "mean"), lo=("amount", "min"))
+        .reset_index()
+        .sort_values("cat")
+        .reset_index(drop=True)
+    )
+    np.testing.assert_allclose(got.hv.to_numpy(), exp.hv.to_numpy(), rtol=1e-9)
+    np.testing.assert_allclose(got.tot.to_numpy(), exp.tot.to_numpy(), rtol=1e-9)
+    np.testing.assert_array_equal(got.n.to_numpy(), exp.n.to_numpy())
+    np.testing.assert_allclose(got.mu.to_numpy(), exp.mu.to_numpy(), rtol=1e-12)
+    np.testing.assert_allclose(got.lo.to_numpy(), exp.lo.to_numpy(), rtol=1e-12)
 
 
 def test_top_n_matches_full_sort(tmp_path):

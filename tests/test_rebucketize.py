@@ -1,0 +1,150 @@
+"""Query-time re-bucketing exchange + bucket-preserving join outputs.
+
+SURVEY §2.3's "single re-bucketing all-to-all when bucket counts don't
+match" and the ranker's mismatched-pair case
+(index/rankers/JoinIndexRanker.scala:31-34): one side bucketed on its
+join keys pairs with an arbitrary materialized side via an on-the-fly
+hash + counting-sort exchange (host) / device sort (device venue); an
+inner join's bucket-major output reuses its grouping in a later join on
+the same keys with no exchange at all.
+"""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import AggSpec, Hyperspace, HyperspaceSession, IndexConfig, col, lit
+from hyperspace_tpu.config import JOIN_REBUCKETIZE, JOIN_VENUE
+
+NB = 8
+
+
+@pytest.fixture
+def tables(tmp_path):
+    rng = np.random.default_rng(23)
+    n = 30_000
+    fact = pd.DataFrame(
+        {
+            "k": rng.integers(0, 900, n).astype(np.int64),
+            "v": rng.normal(size=n).round(4),
+        }
+    )
+    dim = pd.DataFrame(
+        {
+            "k": np.arange(900, dtype=np.int64),
+            "g": (np.arange(900) % 7).astype(np.int64),
+            "tag": np.array(["a", "b", "c"], dtype=object)[np.arange(900) % 3],
+        }
+    )
+    for name, df in (("fact", fact), ("dim", dim)):
+        (tmp_path / name).mkdir()
+        pq.write_table(pa.Table.from_pandas(df, preserve_index=False), tmp_path / name / "p.parquet")
+    session = HyperspaceSession(system_path=str(tmp_path / "idx"), num_buckets=NB)
+    hs = Hyperspace(session)
+    f = session.parquet(tmp_path / "fact")
+    d = session.parquet(tmp_path / "dim")
+    hs.create_index(f, IndexConfig("f_k", ["k"], ["v"]))
+    session.enable_hyperspace()
+    return session, f, d, fact, dim
+
+
+@pytest.mark.parametrize("venue", ["host", "device"])
+def test_rebucketize_one_indexed_side(tables, venue):
+    """The dim side is NOT indexed (an aggregate output, so no scan to
+    rewrite): forcing the exchange pairs it bucket-parallel against the
+    fact index on both venues, results equal pandas."""
+    session, f, d, fact, dim = tables
+    session.conf.set(JOIN_REBUCKETIZE, "force")
+    session.conf.set(JOIN_VENUE, venue)
+    dim_agg = d.aggregate(["k"], [AggSpec.of("sum", "g", "sg")])  # non-scan side
+    q = f.join(dim_agg, ["k"]).aggregate([], [
+        AggSpec.of("sum", "v", "sv"), AggSpec.of("count", None, "n"),
+        AggSpec.of("sum", "sg", "ssg"),
+    ])
+    got = session.to_pandas(q)
+    stats = session.last_query_stats
+    assert stats["join_path"] in ("rebucketized-aligned",), stats
+    exp = fact.merge(dim.groupby("k").g.sum().rename("sg").reset_index(), on="k")
+    assert int(got.loc[0, "n"]) == len(exp)
+    np.testing.assert_allclose(got.loc[0, "sv"], exp.v.sum(), rtol=1e-9)
+    np.testing.assert_allclose(got.loc[0, "ssg"], exp.sg.sum(), rtol=1e-9)
+    kern = stats.get("exchange_kernel", "")
+    if venue == "device":
+        assert kern == "device-sort-exchange"
+    else:
+        assert kern.startswith("host-")
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "semi", "anti"])
+def test_rebucketize_join_types_match_pandas(tables, how):
+    session, f, d, fact, dim = tables
+    session.conf.set(JOIN_REBUCKETIZE, "force")
+    half = d.filter(col("k") < lit(450)).aggregate(
+        ["k"], [AggSpec.of("count", None, "dn")]
+    )
+    q = f.join(half, ["k"], how=how)
+    got = session.to_pandas(q)
+    assert session.last_query_stats["join_path"] == "rebucketized-aligned"
+    dk = set(range(450))
+    if how == "semi":
+        exp_n = int(fact.k.isin(dk).sum())
+    elif how == "anti":
+        exp_n = int((~fact.k.isin(dk)).sum())
+    else:  # inner and left: dim keys unique, so inner = matched fact rows
+        matched = int(fact.k.isin(dk).sum())
+        exp_n = matched if how == "inner" else len(fact)
+    assert len(got) == exp_n, (how, len(got), exp_n)
+
+
+def test_bucket_preserved_chain_same_key(tables):
+    """Join(Join(fact, dim1), dim2) on the SAME key: the inner aligned
+    join's bucket-major output re-pairs against the second index side
+    with NO exchange (preserved grouping)."""
+    session, f, d, fact, dim = tables
+    session.conf.set(JOIN_REBUCKETIZE, "force")
+    d1 = d.select("k", "g").aggregate(["k"], [AggSpec.of("sum", "g", "sg")])
+    inner = f.join(d1, ["k"])  # rebucketized-aligned, inner => preserved
+    d2 = d.select("k", "tag").aggregate(["k"], [AggSpec.of("count", None, "c2")])
+    q = inner.join(d2, ["k"]).aggregate([], [AggSpec.of("count", None, "n")])
+    got = session.to_pandas(q)
+    phys = repr(session.last_physical_plan)
+    assert "preserved" in phys, phys
+    assert int(got.loc[0, "n"]) == len(fact)  # dim keys cover all fact keys
+
+
+def test_rebucketize_off_keeps_single_partition(tables):
+    session, f, d, fact, dim = tables
+    session.conf.set(JOIN_REBUCKETIZE, "off")
+    session.conf.set("hyperspace.join.broadcast.maxRows", 0)
+    dim_agg = d.aggregate(["k"], [AggSpec.of("sum", "g", "sg")])
+    q = f.join(dim_agg, ["k"]).aggregate([], [AggSpec.of("count", None, "n")])
+    got = session.to_pandas(q)
+    assert session.last_query_stats["join_path"] == "single-partition"
+    assert int(got.loc[0, "n"]) == len(fact)
+
+
+def test_dtype_mismatched_indexes_fall_back_not_wrong(tmp_path):
+    """Two indexes bucketed on int32 vs int64 key columns hash equal
+    values into DIFFERENT buckets — the aligned path must refuse the
+    pairing (correctness guard), falling back to a general join with
+    identical results."""
+    n = 5_000
+    rng = np.random.default_rng(5)
+    left = pd.DataFrame({"k": rng.integers(0, 300, n).astype(np.int32), "a": rng.normal(size=n)})
+    right = pd.DataFrame({"k2": np.arange(300, dtype=np.int64), "b": np.arange(300) * 2.0})
+    for name, df in (("l", left), ("r", right)):
+        (tmp_path / name).mkdir()
+        pq.write_table(pa.Table.from_pandas(df, preserve_index=False), tmp_path / name / "p.parquet")
+    session = HyperspaceSession(system_path=str(tmp_path / "idx"), num_buckets=4)
+    hs = Hyperspace(session)
+    l = session.parquet(tmp_path / "l")
+    r = session.parquet(tmp_path / "r")
+    hs.create_index(l, IndexConfig("l_k", ["k"], ["a"]))
+    hs.create_index(r, IndexConfig("r_k", ["k2"], ["b"]))
+    session.enable_hyperspace()
+    q = l.join(r, ["k"], ["k2"]).aggregate([], [AggSpec.of("count", None, "n")])
+    got = session.to_pandas(q)
+    assert session.last_query_stats["join_path"] != "zero-exchange-aligned"
+    assert int(got.loc[0, "n"]) == len(left)  # every key matches

@@ -116,9 +116,15 @@ class TestJoinIndexRule:
         )
         assert len(rewritten_sides(out)) == 2
 
-    def test_no_rewrite_without_candidates_for_one_side(self):
+    def test_lone_candidate_rewrites_one_side_for_the_exchange(self):
+        # Only the left side has a usable index: the rule rewrites THAT
+        # side alone — the executor's re-bucketing exchange pairs it
+        # with the arbitrary right side (the ranker's mismatched-pair
+        # fallback generalized, JoinIndexRanker.scala:31-34).
         out = self.run(join_plan(), [entry("l", "/nonexistent/t1", T1, ["a"], ["v"])])
-        assert not rewritten_sides(out)
+        sides = rewritten_sides(out)
+        assert len(sides) == 1
+        assert sides[0].bucket_spec[1] == ["a"]
 
     def test_indexed_columns_must_be_set_equal_to_join_cols(self):
         # Index on (a, b) but join only on a — superset is NOT usable
@@ -130,7 +136,10 @@ class TestJoinIndexRule:
                 entry("r", "/nonexistent/t2", T2, ["c"], ["w"]),
             ],
         )
-        assert not rewritten_sides(out)
+        # The (a, b) superset index is unusable; the right side still
+        # rewrites one-sided for the exchange.
+        sides = rewritten_sides(out)
+        assert len(sides) == 1 and sides[0].bucket_spec[1] == ["c"]
 
     def test_index_must_cover_required_columns(self):
         out = self.run(
@@ -140,7 +149,8 @@ class TestJoinIndexRule:
                 entry("r", "/nonexistent/t2", T2, ["c"], ["w"]),
             ],
         )
-        assert not rewritten_sides(out)
+        sides = rewritten_sides(out)
+        assert len(sides) == 1 and sides[0].bucket_spec[1] == ["c"]
 
     def test_signature_mismatch_blocks_side(self):
         out = self.run(
@@ -150,7 +160,8 @@ class TestJoinIndexRule:
                 entry("r", "/nonexistent/t2", T2, ["c"], ["w"]),
             ],
         )
-        assert not rewritten_sides(out)
+        sides = rewritten_sides(out)
+        assert len(sides) == 1 and sides[0].bucket_spec[1] == ["c"]
 
     def test_compound_keys_compatible_order_rewrites(self):
         plan = Join(scan1(), scan2(), ["a", "b"], ["c", "d"])
@@ -174,7 +185,10 @@ class TestJoinIndexRule:
                 entry("r", "/nonexistent/t2", T2, ["d", "c"], ["w"]),
             ],
         )
-        assert not rewritten_sides(out)
+        # No compatible PAIR — a one-sided rewrite still applies (the
+        # executor re-buckets or falls back safely; ordered
+        # compatibility only gates the paired zero-exchange claim).
+        assert len(rewritten_sides(out)) == 1
 
     def test_repeated_join_column_blocks(self):
         plan = Join(scan1(), scan2(), ["a", "a"], ["c", "d"])
@@ -207,7 +221,8 @@ class TestJoinIndexRule:
                 entry("r", "/nonexistent/t2", T2, ["c"], ["w"]),
             ],
         )
-        assert not rewritten_sides(out)
+        sides = rewritten_sides(out)
+        assert len(sides) == 1 and sides[0].bucket_spec[1] == ["c"]
 
     def test_ranker_prefers_equal_bucket_pair(self):
         e_l8 = entry("l8", "/nonexistent/t1", T1, ["a"], ["v", "b"], buckets=8)
