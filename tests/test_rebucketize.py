@@ -114,6 +114,52 @@ def test_bucket_preserved_chain_same_key(tables):
     assert int(got.loc[0, "n"]) == len(fact)  # dim keys cover all fact keys
 
 
+def test_star_chain_every_join_bucket_parallel(tmp_path):
+    """A 3-table star chain (the q27 shape) where every dimension is
+    indexed: the innermost join rides the both-aligned zero-exchange
+    path; the SECOND dimension join re-bucketizes the (differently
+    keyed) join output into that dimension's bucket layout — no join
+    falls back to single-partition."""
+    rng = np.random.default_rng(41)
+    n = 20_000
+    fact = pd.DataFrame(
+        {
+            "k1": rng.integers(0, 400, n).astype(np.int64),
+            "k2": rng.integers(0, 300, n).astype(np.int64),
+            "v": rng.normal(size=n).round(4),
+        }
+    )
+    dima = pd.DataFrame({"k1": np.arange(400, dtype=np.int64), "a": np.arange(400) % 5})
+    dimb = pd.DataFrame({"k2": np.arange(300, dtype=np.int64), "b": np.arange(300) % 7})
+    for name, df in (("fact", fact), ("dima", dima), ("dimb", dimb)):
+        (tmp_path / name).mkdir()
+        pq.write_table(pa.Table.from_pandas(df, preserve_index=False), tmp_path / name / "p.parquet")
+    session = HyperspaceSession(system_path=str(tmp_path / "idx"), num_buckets=NB)
+    hs = Hyperspace(session)
+    f = session.parquet(tmp_path / "fact")
+    da = session.parquet(tmp_path / "dima")
+    db = session.parquet(tmp_path / "dimb")
+    hs.create_index(f, IndexConfig("f_k1", ["k1"], ["k2", "v"]))
+    hs.create_index(da, IndexConfig("da_k1", ["k1"], ["a"]))
+    hs.create_index(db, IndexConfig("db_k2", ["k2"], ["b"]))
+    session.enable_hyperspace()
+    session.conf.set(JOIN_REBUCKETIZE, "force")
+    q = (
+        f.join(da.filter(col("a") == lit(2)), ["k1"])
+        .join(db, ["k2"])
+        .aggregate(["b"], [AggSpec.of("sum", "v", "sv"), AggSpec.of("count", None, "n")])
+    )
+    got = session.to_pandas(q).sort_values("b").reset_index(drop=True)
+    phys = repr(session.last_physical_plan)
+    assert "zero-exchange-aligned" in phys, phys
+    assert "rebucketized-aligned" in phys, phys
+    assert "single-partition" not in phys, phys
+    j = fact.merge(dima[dima.a == 2], on="k1").merge(dimb, on="k2")
+    exp = j.groupby("b").agg(sv=("v", "sum"), n=("v", "size")).reset_index()
+    np.testing.assert_allclose(got.sv.to_numpy(), exp.sv.to_numpy(), rtol=1e-9)
+    np.testing.assert_array_equal(got.n.to_numpy(), exp.n.to_numpy())
+
+
 def test_rebucketize_off_keeps_single_partition(tables):
     session, f, d, fact, dim = tables
     session.conf.set(JOIN_REBUCKETIZE, "off")
