@@ -379,8 +379,18 @@ class DeviceIndexBuilder:
                         continue
                     w = writers.get(b)
                     if w is None:
+                        # Spill is engine-private scratch: the cheap codec
+                        # (see io.INDEX_WRITE_COMPRESSION) beats snappy on
+                        # encode CPU, which bounds phase 1 on small hosts,
+                        # and dictionary encoding stays strings-only for
+                        # the same reason write_bucket's does.
                         w = pq.ParquetWriter(
-                            spill / hio.bucket_file_name(b), arrow_sorted.schema
+                            spill / hio.bucket_file_name(b),
+                            arrow_sorted.schema,
+                            compression=hio.INDEX_WRITE_COMPRESSION,
+                            use_dictionary=[
+                                f.name for f in sub_schema.select(ordered).fields if f.is_string
+                            ],
                         )
                         writers[b] = w
                     w.write_table(arrow_sorted.slice(lo, hi - lo))

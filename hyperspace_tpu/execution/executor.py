@@ -697,7 +697,7 @@ class Executor:
         from hyperspace_tpu.plan.nodes import AggSpec
 
         child = plan.child
-        if not isinstance(child, Join) or child.how != "inner":
+        if not isinstance(child, Join) or child.how != "inner" or child.condition is not None:
             return None
         if isinstance(child.left, _TableLeaf) or isinstance(child.right, _TableLeaf):
             return None  # already pushed (recursion guard)
@@ -1384,6 +1384,18 @@ class Executor:
         if self.stats["join_kernel"] == "host-broadcast-hash":
             path = "broadcast-hash"
             self.stats["join_path"] = path
+        if plan.condition is not None:
+            # Non-equi ON residual: 3-valued mask over the matched rows
+            # (inner joins only — the node validates), venue- and
+            # mesh-aware like every other predicate site. The filtered
+            # table deliberately does NOT inherit any preserved bucket
+            # grouping (per-bucket counts changed).
+            before = out.num_rows
+            mask = eval_predicate_mask(
+                out, plan.condition, mesh=self.mesh, venue=self._filter_venue()
+            )
+            out = out.filter_mask(mask)
+            self._phys(residual_condition=True, residual_rows_dropped=before - out.num_rows)
         self._phys(
             "BroadcastHashJoin" if path == "broadcast-hash" else "SortMergeJoin",
             path=path,
@@ -1996,7 +2008,7 @@ class Executor:
         child = plan.child
         if isinstance(child, Project):
             child = child.child
-        if not isinstance(child, Join) or child.how != "inner":
+        if not isinstance(child, Join) or child.how != "inner" or child.condition is not None:
             return None
         join = child
         lnames = {n.lower() for n in join.left.schema.names}

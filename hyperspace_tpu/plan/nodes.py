@@ -51,8 +51,17 @@ class LogicalPlan:
         left_on: list[str],
         right_on: list[str] | None = None,
         how: str = "inner",
+        condition: "Expr | None" = None,
     ) -> "Join":
-        return Join(self, other, list(left_on), list(right_on or left_on), how)
+        """Equi-join on key lists; `condition` adds a non-equi residual
+        (`ON a.k = b.k AND a.lo <= b.hi` shapes) evaluated over the
+        matched rows — inner joins only (in an outer join the ON
+        residual changes MATCHING, not filtering, which this engine does
+        not model)."""
+        return Join(
+            self, other, list(left_on), list(right_on or left_on), how,
+            condition=condition,
+        )
 
     def aggregate(
         self, group_by: list[str], aggs: list, grouping_sets: list[list[str]] | None = None
@@ -311,12 +320,35 @@ class Join(LogicalPlan):
     left_on: list[str]
     right_on: list[str]
     how: str = "inner"
+    # Non-equi residual of the ON clause (equality stays structural):
+    # evaluated with full 3-valued semantics over the matched rows.
+    # Inner joins only — in outer joins the ON residual alters matching
+    # (null-extension) rather than filtering, which is not modeled.
+    condition: Expr | None = None
 
     def __post_init__(self):
         if len(self.left_on) != len(self.right_on):
             raise ValueError("join key lists must have equal length")
         if self.how not in JOIN_TYPES:
             raise ValueError(f"unknown join type {self.how!r}; one of {JOIN_TYPES}")
+        if self.condition is not None:
+            if self.how != "inner":
+                raise ValueError(
+                    "a non-equi join condition is supported for INNER joins only"
+                )
+            # Validate references against the OUTPUT schema now (right
+            # key names merge into the left-named column), so a typo or
+            # a merged-away key fails here, not mid-execution.
+            out_names = {n.lower() for n in self.schema.names}
+            missing = sorted(
+                r for r in self.condition.references() if r not in out_names
+            )
+            if missing:
+                raise ValueError(
+                    f"join condition references {missing} not present in the "
+                    f"join output (right-side key columns merge into the "
+                    f"left-named key)"
+                )
 
     @property
     def schema(self) -> Schema:
@@ -345,7 +377,7 @@ class Join(LogicalPlan):
         return [self.left, self.right]
 
     def to_json(self) -> dict[str, Any]:
-        return {
+        d = {
             "type": "join",
             "left": self.left.to_json(),
             "right": self.right.to_json(),
@@ -353,6 +385,9 @@ class Join(LogicalPlan):
             "rightOn": self.right_on,
             "how": self.how,
         }
+        if self.condition is not None:
+            d["condition"] = self.condition.to_json()
+        return d
 
 
 @dataclasses.dataclass
@@ -677,6 +712,7 @@ def plan_from_json(d: dict[str, Any]) -> LogicalPlan:
             list(d["leftOn"]),
             list(d["rightOn"]),
             d.get("how", "inner"),
+            condition=expr_from_json(d["condition"]) if "condition" in d else None,
         )
     if t == "aggregate":
         gs = d.get("groupingSets")

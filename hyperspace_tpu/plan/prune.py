@@ -74,9 +74,22 @@ def prune_columns(plan: LogicalPlan, needed: set[str] | None = None) -> LogicalP
         if needed is None:
             lneed = rneed = None
         else:
-            lneed = {c.lower() for c in plan.left.schema.names if c.lower() in needed}
+            cond_refs = (
+                {c.lower() for c in plan.condition.references()}
+                if plan.condition is not None
+                else set()
+            )
+            lneed = {
+                c.lower()
+                for c in plan.left.schema.names
+                if c.lower() in needed or c.lower() in cond_refs
+            }
             lneed |= {c.lower() for c in plan.left_on}
-            rneed = {c.lower() for c in plan.right.schema.names if c.lower() in needed}
+            rneed = {
+                c.lower()
+                for c in plan.right.schema.names
+                if c.lower() in needed or c.lower() in cond_refs
+            }
             rneed |= {c.lower() for c in plan.right_on}
         return dataclasses.replace(
             plan, left=prune_columns(plan.left, lneed), right=prune_columns(plan.right, rneed)

@@ -60,7 +60,8 @@ def push_down_filters(plan: LogicalPlan) -> LogicalPlan:
                     else child.right
                 )
                 out: LogicalPlan = Join(
-                    new_left, new_right, child.left_on, child.right_on, child.how
+                    new_left, new_right, child.left_on, child.right_on, child.how,
+                    condition=child.condition,
                 )
                 return Filter(out, _conjoin(residual)) if residual else out
         return Filter(child, plan.predicate)

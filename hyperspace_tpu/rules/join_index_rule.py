@@ -89,6 +89,7 @@ class JoinIndexRule(Rule):
                 plan.left_on,
                 plan.right_on,
                 plan.how,
+                condition=plan.condition,
             )
             return new
         if isinstance(plan, Project):
@@ -139,11 +140,13 @@ class JoinIndexRule(Rule):
                 m = max(lcands, key=lambda c: c.entry.num_buckets)
                 new_left = _replace_scan(plan.left, self._side_plan(m, lscan))
                 return Join(new_left, self._rewrite(plan.right, indexes, matcher),
-                            plan.left_on, plan.right_on, plan.how)
+                            plan.left_on, plan.right_on, plan.how,
+                            condition=plan.condition)
             m = max(rcands, key=lambda c: c.entry.num_buckets)
             new_right = _replace_scan(plan.right, self._side_plan(m, rscan))
             return Join(self._rewrite(plan.left, indexes, matcher), new_right,
-                        plan.left_on, plan.right_on, plan.how)
+                        plan.left_on, plan.right_on, plan.how,
+                        condition=plan.condition)
         best_l, best_r = JoinIndexRanker.rank(
             [(lm.entry, rm.entry) for lm, rm in pairs],
         )[0]
@@ -152,7 +155,8 @@ class JoinIndexRule(Rule):
 
         new_left = _replace_scan(plan.left, self._side_plan(lmatch, lscan))
         new_right = _replace_scan(plan.right, self._side_plan(rmatch, rscan))
-        return Join(new_left, new_right, plan.left_on, plan.right_on, plan.how)
+        return Join(new_left, new_right, plan.left_on, plan.right_on, plan.how,
+                    condition=plan.condition)
 
     @staticmethod
     def _side_plan(match, scan: Scan) -> LogicalPlan:

@@ -171,3 +171,53 @@ def test_semi_anti_schema_is_left_only(setup):
     assert [f.name for f in semi.schema.fields] == ["k", "lv", "ls"]
     full = ls.join(rs, ["k"], ["k2"], how="full")
     assert [f.name for f in full.schema.fields] == ["k", "lv", "ls", "rv", "rs"]
+
+
+def test_non_equi_join_condition(tmp_path):
+    """ON a.k = b.k AND <theta>: the non-equi residual evaluates over
+    the matched rows with 3-valued semantics (inner joins only); the
+    rewritten index path returns the same rows as raw."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from hyperspace_tpu import AggSpec, Hyperspace, HyperspaceSession, IndexConfig, col
+    from hyperspace_tpu.plan.nodes import plan_from_json
+
+    rng = np.random.default_rng(77)
+    n = 12_000
+    left = pd.DataFrame(
+        {
+            "k": rng.integers(0, 300, n).astype(np.int64),
+            "lo": rng.integers(0, 50, n).astype(np.int64),
+        }
+    )
+    right = pd.DataFrame(
+        {
+            "k2": np.arange(300, dtype=np.int64),
+            "hi": rng.integers(10, 60, 300).astype(np.int64),
+        }
+    )
+    for name, df in (("l", left), ("r", right)):
+        (tmp_path / name).mkdir()
+        pq.write_table(pa.Table.from_pandas(df, preserve_index=False), tmp_path / name / "p.parquet")
+    session = HyperspaceSession(system_path=str(tmp_path / "idx"), num_buckets=4)
+    hs = Hyperspace(session)
+    l = session.parquet(tmp_path / "l")
+    r = session.parquet(tmp_path / "r")
+    hs.create_index(l, IndexConfig("ne_l", ["k"], ["lo"]))
+    hs.create_index(r, IndexConfig("ne_r", ["k2"], ["hi"]))
+
+    q = l.join(r, ["k"], ["k2"], condition=col("lo") < col("hi")).aggregate(
+        [], [AggSpec.of("count", None, "n")]
+    )
+    assert plan_from_json(q.to_json()).to_json() == q.to_json()
+    session.enable_hyperspace()
+    n_idx = int(session.to_pandas(q).loc[0, "n"])
+    assert "residual_condition" in repr(session.last_physical_plan)
+    session.disable_hyperspace()
+    n_raw = int(session.to_pandas(q).loc[0, "n"])
+    exp = len(left.merge(right, left_on="k", right_on="k2").query("lo < hi"))
+    assert n_idx == n_raw == exp
+
+    with pytest.raises(ValueError, match="INNER joins only"):
+        l.join(r, ["k"], ["k2"], how="left", condition=col("lo") < col("hi"))
