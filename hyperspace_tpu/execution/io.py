@@ -511,11 +511,14 @@ def carve_and_write(
         sel = np.arange(lo, hi) if order is None else order[lo:hi]
         if sort_fn is not None:
             sel = sort_fn(p, sel)
+        # Gather ONCE; stats read the gathered bucket (a second full
+        # per-column fancy-index here measurably slows the carve phase).
+        sub = table.take(sel)
         if indexed_columns:
-            key_stats[p] = bucket_key_stats(table, indexed_columns[0], sel)
+            key_stats[p] = bucket_key_stats(sub, indexed_columns[0])
         if other_cols:
-            col_stats[p] = bucket_column_stats(table, other_cols, sel)
-        write_bucket(dest, p, table.take(sel))
+            col_stats[p] = bucket_column_stats(sub, other_cols)
+        write_bucket(dest, p, sub)
 
     with ThreadPoolExecutor(max_workers=min(16, max(1, num_partitions))) as ex:
         list(ex.map(write_one, range(num_partitions)))
