@@ -8,9 +8,9 @@ cartesian product, store_sales rows grouped into multi-item tickets) and
 synthetic value distributions. SF1 store_sales = 2,879,987 rows.
 
 The queries are the store-channel subset of the published 99 — q3, q6,
-q7, q13, q27 (real ROLLUP form), q34, q36, q42, q43, q46, q48, q52,
-q53, q55, q59, q63, q65, q67, q68, q70, q73, q79, q89, q96, q98 plus
-the q88 time-band pivot — expressed in the plan IR with computed
+q7, q13, q19, q27 (real ROLLUP form), q34, q36, q42, q43, q44, q46,
+q48, q52, q53, q55, q59, q63, q65, q67, q68, q70, q73, q79, q89, q96,
+q98 plus the q88 time-band pivot — expressed in the plan IR with computed
 projections, window functions, grouping sets, and (for the published
 scalar subqueries) explicit two-step scalar evaluation. Each star join
 is written with the most selective dimension innermost so the index
@@ -1133,6 +1133,99 @@ def tpcds_queries(t: dict) -> dict:
         .limit(100)
     )
 
+    # q46 / q68: per-ticket amounts for weekend/high-dependency trips in
+    # probe cities, joined to the customer and their CURRENT address,
+    # keeping trips bought in a DIFFERENT city (string col<>col — the
+    # two city columns carry different dictionaries and compare through
+    # a merged domain). q46 filters weekends; q68 the published
+    # month-start days, with this dataset's measures
+    # (ss_ext_list_price/ss_ext_tax are not generated).
+    def city_trips(hd_pred, date_pred, cities, measures):
+        return (
+            ss.select(
+                "ss_sold_date_sk", "ss_store_sk", "ss_hdemo_sk", "ss_addr_sk",
+                "ss_customer_sk", "ss_ticket_number", "ss_coupon_amt",
+                "ss_net_profit", "ss_ext_sales_price",
+            )
+            .join(
+                dd.select("d_date_sk", "d_dow", "d_dom", "d_year").filter(
+                    date_pred & col("d_year").isin([1999, 2000, 2001])
+                ),
+                ["ss_sold_date_sk"], ["d_date_sk"],
+            )
+            .join(
+                store.select("s_store_sk", "s_city").filter(col("s_city").isin(cities)),
+                ["ss_store_sk"], ["s_store_sk"],
+            )
+            .join(hd.select("hd_demo_sk", "hd_dep_count", "hd_vehicle_count").filter(hd_pred),
+                  ["ss_hdemo_sk"], ["hd_demo_sk"])
+            .join(ca.select("ca_address_sk", ("bought_city", col("ca_city"))),
+                  ["ss_addr_sk"], ["ca_address_sk"])
+            .aggregate(
+                ["ss_ticket_number", "ss_customer_sk", "ss_addr_sk", "bought_city"],
+                measures,
+            )
+            .join(
+                cust.select("c_customer_sk", "c_current_addr_sk", "c_last_name", "c_first_name"),
+                ["ss_customer_sk"], ["c_customer_sk"],
+            )
+            .join(ca.select(("cur_addr_sk", col("ca_address_sk")), "ca_city"),
+                  ["c_current_addr_sk"], ["cur_addr_sk"])
+            .filter(col("ca_city") != col("bought_city"))
+        )
+
+    q46 = (
+        city_trips(
+            (col("hd_dep_count") == lit(4)) | (col("hd_vehicle_count") == lit(3)),
+            col("d_dow").isin([6, 0]),  # weekend trips
+            ["Midway", "Fairview", "Oak Grove", "Five Points", "Centerville"],
+            [AggSpec.of("sum", "ss_coupon_amt", "amt"), AggSpec.of("sum", "ss_net_profit", "profit")],
+        )
+        .select("c_last_name", "c_first_name", "ca_city", "bought_city", "ss_ticket_number", "amt", "profit")
+        .sort([("c_last_name", True), ("c_first_name", True), ("ca_city", True), ("bought_city", True), ("ss_ticket_number", True)])
+        .limit(100)
+    )
+    q68 = (
+        city_trips(
+            (col("hd_dep_count") == lit(5)) | (col("hd_vehicle_count") == lit(3)),
+            col("d_dom").between(1, 2),  # the published q68 month-start filter
+            ["Midway", "Fairview"],
+            [AggSpec.of("sum", "ss_ext_sales_price", "extended_price"),
+             AggSpec.of("sum", "ss_coupon_amt", "amt")],
+        )
+        .select("c_last_name", "c_first_name", "ca_city", "bought_city", "ss_ticket_number", "extended_price", "amt")
+        .sort([("c_last_name", True), ("ss_ticket_number", True)])
+        .limit(100)
+    )
+
+    # q19: brand revenue from customers shopping OUTSIDE their home zip
+    # prefix (SUBSTRING col <> SUBSTRING col across two dictionaries);
+    # i_manufact (string) is this dataset's i_manufact_id.
+    q19 = (
+        ss.select("ss_sold_date_sk", "ss_item_sk", "ss_customer_sk", "ss_store_sk", "ss_ext_sales_price")
+        .join(
+            dd.select("d_date_sk", "d_moy", "d_year").filter(
+                (col("d_moy") == lit(11)) & (col("d_year") == lit(1998))
+            ),
+            ["ss_sold_date_sk"], ["d_date_sk"],
+        )
+        .join(
+            item.select("i_item_sk", "i_brand_id", "i_brand", "i_manufact_id", "i_manager_id")
+            .filter(col("i_manager_id") == lit(8)),
+            ["ss_item_sk"], ["i_item_sk"],
+        )
+        .join(cust.select("c_customer_sk", "c_current_addr_sk"), ["ss_customer_sk"], ["c_customer_sk"])
+        .join(ca.select("ca_address_sk", "ca_zip"), ["c_current_addr_sk"], ["ca_address_sk"])
+        .join(store.select("s_store_sk", "s_zip"), ["ss_store_sk"], ["s_store_sk"])
+        .filter(col("ca_zip").substr(1, 5) != col("s_zip").substr(1, 5))
+        .aggregate(
+            ["i_brand", "i_brand_id", "i_manufact_id"],
+            [AggSpec.of("sum", "ss_ext_sales_price", "ext_price")],
+        )
+        .sort([("ext_price", False), ("i_brand", True), ("i_brand_id", True), ("i_manufact_id", True)])
+        .limit(100)
+    )
+
     # q88: the 8 half-hour store-traffic counts 8:30-12:30 — the
     # published cross-join of 8 scalar subqueries computed in ONE pass
     # as conditional counts over the union of their time bands.
@@ -1204,11 +1297,12 @@ def tpcds_queries(t: dict) -> dict:
     )
 
     return {
-        "q3": q3, "q6": q6, "q7": q7, "q13": q13, "q27": q27, "q34": q34,
-        "q36": q36, "q42": q42, "q43": q43, "q44": q44, "q48": q48,
-        "q52": q52, "q53": q53, "q55": q55, "q59": q59, "q63": q63,
-        "q65": q65, "q67": q67, "q70": q70, "q73": q73, "q79": q79,
-        "q88": q88, "q89": q89, "q96": q96, "q98": q98,
+        "q3": q3, "q6": q6, "q7": q7, "q13": q13, "q19": q19, "q27": q27,
+        "q34": q34, "q36": q36, "q42": q42, "q43": q43, "q44": q44,
+        "q46": q46, "q48": q48, "q52": q52, "q53": q53, "q55": q55,
+        "q59": q59, "q63": q63, "q65": q65, "q67": q67, "q68": q68,
+        "q70": q70, "q73": q73, "q79": q79, "q88": q88, "q89": q89,
+        "q96": q96, "q98": q98,
     }
 
 

@@ -109,6 +109,45 @@ class _DictLut(Expr):
         return self.col.references()
 
 
+@dataclasses.dataclass(eq=False, repr=True)
+class _StrColCmp(Expr):
+    """Internal leaf: comparison between two STRING-VALUED sides (columns
+    or substrings of columns) whose dictionaries differ. Each side's codes
+    map through `lmap`/`rmap` into one MERGED sorted dictionary, where
+    integer comparison equals string comparison. Raw code comparison
+    across two dictionaries is meaningless — this leaf is what
+    translate_predicate rewrites it into. Host-evaluated (the lowering
+    pass falls back)."""
+
+    op: str
+    left: Col
+    right: Col
+    lmap: "np.ndarray"  # [left dict size] int32 positions in the merged dict
+    rmap: "np.ndarray"
+
+    def references(self):
+        return self.left.references() | self.right.references()
+
+
+def _string_valued(table: ColumnTable, e: Expr):
+    """(column name, per-code string values) when `e` is a string column
+    or SUBSTRING of one; None otherwise."""
+    if isinstance(e, Col):
+        try:
+            f = table.schema.field(e.name)
+        except Exception:
+            return None
+        if f.is_string:
+            return f.name, np.asarray(table.dictionaries[f.name], dtype=object)
+        return None
+    if isinstance(e, Substr) and isinstance(e.child, Col):
+        f = table.schema.field(e.child.name)
+        if f.is_string:
+            name, vals = _substr_values(table, e)
+            return name, np.asarray(vals, dtype=object)
+    return None
+
+
 def _codes_runs_expr(col: Col, codes: "np.ndarray", dict_size: int) -> Expr:
     """Matched dictionary codes (sorted int array) → the equivalent
     predicate in the code domain: an OR of contiguous code ranges (a
@@ -192,6 +231,28 @@ def translate_predicate(table: ColumnTable, e: Expr) -> Expr:
     the plan's predicate."""
     if isinstance(e, BinOp) and e.is_comparison:
         l, r = e.left, e.right
+        ls, rs = _string_valued(table, l), _string_valued(table, r)
+        if ls is not None and rs is not None:
+            # String-valued vs string-valued: codes from two different
+            # dictionaries must NOT compare directly — remap both into
+            # one merged sorted dictionary first (q19/q46's
+            # city/zip-prefix inequality shapes).
+            lname, lvals = ls
+            rname, rvals = rs
+            ls_str = lvals.astype(str)
+            rs_str = rvals.astype(str)
+            merged = np.unique(np.concatenate([ls_str, rs_str]))
+            lmap = np.searchsorted(merged, ls_str).astype(np.int32)
+            rmap = np.searchsorted(merged, rs_str).astype(np.int32)
+            return _StrColCmp(e.op, Col(lname), Col(rname), lmap, rmap)
+        if (ls is None) != (rs is None):
+            other = r if ls is not None else l
+            if not isinstance(other, Lit):
+                from hyperspace_tpu.exceptions import HyperspaceError
+
+                raise HyperspaceError(
+                    "cannot compare a string column with a non-string expression"
+                )
         if isinstance(r, (Substr, DatePart)) and isinstance(l, Lit):
             l, r = r, l
             e = BinOp(_FLIP[e.op], l, r)
@@ -708,6 +769,14 @@ def _host_mask(table: ColumnTable, predicate: Expr) -> np.ndarray:
             return ~known, known  # IS NULL is never UNKNOWN
         if isinstance(e, _DictLut):
             v = e.lut[resolve(e.col.name)]
+            known = known_mask(e)
+            return v & known, ~v & known
+        if isinstance(e, _StrColCmp):
+            fn = {"eq": np.equal, "ne": np.not_equal, "lt": np.less,
+                  "le": np.less_equal, "gt": np.greater, "ge": np.greater_equal}[e.op]
+            lv = e.lmap[resolve(e.left.name)]
+            rv = e.rmap[resolve(e.right.name)]
+            v = fn(lv, rv)
             known = known_mask(e)
             return v & known, ~v & known
         # Leaf comparison/expression: any null input makes it unknown.

@@ -1,0 +1,74 @@
+"""String column-vs-column comparisons.
+
+Codes from two different dictionaries are not comparable — the
+translation layer remaps both sides into one merged sorted dictionary
+(filter.py _StrColCmp), covering Col<>Col and SUBSTRING(col)<>
+SUBSTRING(col) shapes (TPC-DS q19/q46/q68) with 3-valued null
+semantics on both venues. Before this leaf existed the engine silently
+compared raw codes and returned wrong rows.
+"""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import HyperspaceSession, col
+from hyperspace_tpu.config import FILTER_VENUE
+from hyperspace_tpu.exceptions import HyperspaceError
+
+
+@pytest.fixture(scope="module")
+def data(tmp_path_factory):
+    tmp_path = tmp_path_factory.mktemp("strcc")
+    rng = np.random.default_rng(13)
+    n = 4_000
+    words = np.array(["apple", "pear", "kiwi", "fig", "plum"], dtype=object)
+    df = pd.DataFrame(
+        {
+            "a": words[rng.integers(0, 5, n)],
+            "b": words[rng.integers(0, 5, n)],
+            "z1": [f"{x:05d}" for x in rng.integers(0, 99_999, n)],
+            "z2": [f"{x:05d}" for x in rng.integers(0, 99_999, n)],
+            "num": rng.integers(0, 9, n).astype(np.int64),
+        }
+    )
+    df.loc[rng.random(n) < 0.06, "a"] = None
+    root = tmp_path / "t"
+    root.mkdir()
+    pq.write_table(pa.Table.from_pandas(df, preserve_index=False), root / "p.parquet")
+    session = HyperspaceSession(system_path=str(tmp_path / "idx"), num_buckets=2)
+    return session, session.parquet(root), df
+
+
+@pytest.mark.parametrize("venue", ["host", "device"])
+def test_col_col_comparisons_match_pandas(data, venue):
+    session, ds, df = data
+    session.conf.set(FILTER_VENUE, venue)
+    known = df.a.notna()
+    cases = {
+        "eq": ((df.a == df.b) & known, col("a") == col("b")),
+        "ne": ((df.a != df.b) & known, col("a") != col("b")),
+        "lt": ((df.a < df.b) & known, col("a") < col("b")),
+        "ge": ((df.a >= df.b) & known, col("a") >= col("b")),
+    }
+    for name, (exp_mask, pred) in cases.items():
+        got = session.run(ds.filter(pred)).num_rows
+        assert got == int(exp_mask.sum()), (venue, name, got, int(exp_mask.sum()))
+
+
+@pytest.mark.parametrize("venue", ["host", "device"])
+def test_substr_col_col(data, venue):
+    session, ds, df = data
+    session.conf.set(FILTER_VENUE, venue)
+    got = session.run(ds.filter(col("z1").substr(1, 2) != col("z2").substr(1, 2))).num_rows
+    assert got == int((df.z1.str[:2] != df.z2.str[:2]).sum())
+    got2 = session.run(ds.filter(col("z1").substr(1, 5) == col("z2").substr(1, 5))).num_rows
+    assert got2 == int((df.z1 == df.z2).sum())
+
+
+def test_string_vs_numeric_column_raises(data):
+    session, ds, _ = data
+    with pytest.raises(HyperspaceError, match="string column with a non-string"):
+        session.run(ds.filter(col("a") == col("num")))
