@@ -1,6 +1,6 @@
-"""TPC-DS slice benchmark: nine real star-join queries (q3, q7, q27,
-q42, q43, q48, q52, q55, q96) with and without indexes, results REQUIRED
-identical both ways. Prints one JSON line with the geomean speedup —
+"""TPC-DS slice benchmark: the 30 published queries of benchmarks/tpcds.py
+with and without indexes, results REQUIRED identical both ways, timed
+warm best-of-2 per side. Prints one JSON line with the geomean speedup —
 the artifact building toward BASELINE config 3 (SF1000 99-query
 geomean)."""
 

@@ -1,4 +1,4 @@
-"""TPC-DS star-schema slice: datagen + 26 real queries in the plan IR.
+"""TPC-DS star-schema slice: datagen + 30 published queries in the plan IR.
 
 Tables follow the TPC-DS schema (store_sales fact + date_dim / item /
 store / customer / customer_demographics / household_demographics /
@@ -8,9 +8,9 @@ cartesian product, store_sales rows grouped into multi-item tickets) and
 synthetic value distributions. SF1 store_sales = 2,879,987 rows.
 
 The queries are the store-channel subset of the published 99 — q3, q6,
-q7, q13, q19, q27 (real ROLLUP form), q34, q36, q42, q43, q44, q46,
-q48, q52, q53, q55, q59, q63, q65, q67, q68, q70, q73, q79, q89, q96,
-q98 plus the q88 time-band pivot — expressed in the plan IR with computed
+q7, q13, q19, q27 (real ROLLUP form), q33, q34, q36, q42, q43, q44,
+q46, q48, q52, q53, q55, q59, q60, q63, q65, q67, q68, q70, q73, q79,
+q89, q96, q98 plus the q88 time-band pivot — expressed in the plan IR with computed
 projections, window functions, grouping sets, and (for the published
 scalar subqueries) explicit two-step scalar evaluation. Each star join
 is written with the most selective dimension innermost so the index
@@ -37,6 +37,10 @@ HD_ROWS = 7_200
 DD_ROWS = 73_049  # 1900-01-02 .. 2100-01-01
 DD_SK0 = 2_415_022  # julian day number of the first date_dim row
 STORE_ROWS = 12
+# Sold-date window every sales channel draws from (julian d_date_sk for
+# 1998-01-01 .. 2002-12-31 — the years the published queries probe).
+SOLD_DATE_LO = DD_SK0 + int((np.datetime64("1998-01-01") - np.datetime64("1900-01-02")) // np.timedelta64(1, "D"))
+SOLD_DATE_HI = DD_SK0 + int((np.datetime64("2002-12-31") - np.datetime64("1900-01-02")) // np.timedelta64(1, "D"))
 
 _CATEGORIES = np.array(
     ["Books", "Children", "Electronics", "Home", "Jewelry",
@@ -309,9 +313,7 @@ def gen_store_sales(root: Path, sf: float = 1.0, seed: int = 60, files: int = 8,
     / address — the grain q34/q46/q68/q73/q79 aggregate on."""
     n = int(SS_SF1_ROWS * sf)
     rng = np.random.default_rng(seed)
-    # d_date_sk for 1998-01-01..2002-12-31 in julian numbering.
-    lo = DD_SK0 + int((np.datetime64("1998-01-01") - np.datetime64("1900-01-02")) // np.timedelta64(1, "D"))
-    hi = DD_SK0 + int((np.datetime64("2002-12-31") - np.datetime64("1900-01-02")) // np.timedelta64(1, "D"))
+    lo, hi = SOLD_DATE_LO, SOLD_DATE_HI
     n_items = n_items if n_items is not None else item_rows(sf)
     n_ca = n_ca if n_ca is not None else ca_rows(sf)
     # Ticket runs: ~9 items per ticket in expectation.
@@ -354,8 +356,39 @@ def gen_store_sales(root: Path, sf: float = 1.0, seed: int = 60, files: int = 8,
     return _parts(t, root, files)
 
 
+CS_SF1_ROWS = 1_441_548
+WS_SF1_ROWS = 719_384
+
+
+def _gen_channel_sales(root: Path, prefix: str, n: int, sf: float, seed: int) -> int:
+    """catalog_sales / web_sales: the non-store channels' columns the
+    multi-channel queries touch (sold date, item, bill customer/address,
+    extended sales price)."""
+    rng = np.random.default_rng(seed)
+    t = pa.table(
+        {
+            f"{prefix}_sold_date_sk": rng.integers(SOLD_DATE_LO, SOLD_DATE_HI + 1, n).astype(np.int64),
+            f"{prefix}_item_sk": rng.integers(1, item_rows(sf) + 1, n).astype(np.int64),
+            f"{prefix}_bill_customer_sk": rng.integers(1, customer_rows(sf) + 1, n).astype(np.int64),
+            f"{prefix}_bill_addr_sk": rng.integers(1, ca_rows(sf) + 1, n).astype(np.int64),
+            f"{prefix}_ext_sales_price": np.round(rng.random(n) * 200 * rng.integers(1, 101, n), 2),
+        }
+    )
+    return _parts(t, root, 4)
+
+
+def gen_catalog_sales(root: Path, sf: float = 1.0, seed: int = 65) -> int:
+    return _gen_channel_sales(root, "cs", int(CS_SF1_ROWS * sf), sf, seed)
+
+
+def gen_web_sales(root: Path, sf: float = 1.0, seed: int = 66) -> int:
+    return _gen_channel_sales(root, "ws", int(WS_SF1_ROWS * sf), sf, seed)
+
+
 _GENS = {
     "store_sales": gen_store_sales,
+    "catalog_sales": gen_catalog_sales,
+    "web_sales": gen_web_sales,
     "date_dim": lambda root, sf=1.0: gen_date_dim(root),
     "item": gen_item,
     "store": lambda root, sf=1.0: gen_store(root),
@@ -374,9 +407,9 @@ def cached_tpcds(sf: float = 1.0, cache_root: Path | None = None) -> dict[str, P
     import shutil
     import tempfile
 
-    # v2: ticket-grouped store_sales + customer/promotion tables (bump
-    # the suffix whenever datagen changes, or stale /tmp data is reused).
-    base = cache_root or Path(tempfile.gettempdir()) / f"hs_tpcds_v2_sf{sf:g}"
+    # v3: + catalog_sales/web_sales channels (bump the suffix whenever
+    # datagen changes, or stale /tmp data is silently reused).
+    base = cache_root or Path(tempfile.gettempdir()) / f"hs_tpcds_v3_sf{sf:g}"
     roots = {}
     for name, gen in _GENS.items():
         root = base / name
@@ -398,6 +431,7 @@ def cached_tpcds(sf: float = 1.0, cache_root: Path | None = None) -> dict[str, P
 
 def tpcds_queries(t: dict) -> dict:
     from hyperspace_tpu import AggSpec, col, date_lit, lit, when
+    from hyperspace_tpu.plan.nodes import Union
 
     ss, dd, item, store = t["store_sales"], t["date_dim"], t["item"], t["store"]
     cd, hd, td, ca = (
@@ -1296,13 +1330,84 @@ def tpcds_queries(t: dict) -> dict:
         .limit(100)
     )
 
+    # q33 / q60: total extended sales price per manufacturer / item
+    # across ALL THREE channels — each channel aggregates independently
+    # (store / catalog / web facts, bill-or-store address in the -5 GMT
+    # band, one month), the channel partials UNION, and an outer
+    # aggregate folds them (the published UNION ALL + re-group shape).
+    # The probed item sets come from semi joins against the
+    # category-filtered ids, as the published subqueries do.
+    cs, ws = t["catalog_sales"], t["web_sales"]
+
+    def channel_sum(fact, dk, ik, ak, price, item_side, group_col):
+        return (
+            fact.select(dk, ik, ak, price)
+            .join(
+                dd.select("d_date_sk", "d_year", "d_moy").filter(
+                    (col("d_year") == lit(2000)) & (col("d_moy") == lit(1))
+                ),
+                [dk], ["d_date_sk"],
+            )
+            .join(
+                ca.select("ca_address_sk", "ca_gmt_offset").filter(
+                    col("ca_gmt_offset") == lit(-5.0)
+                ),
+                [ak], ["ca_address_sk"],
+            )
+            .join(item_side, [ik], ["i_item_sk"])
+            .aggregate([group_col], [AggSpec.of("sum", price, "total_sales")])
+        )
+
+    def three_channel(item_side, group_col, order_by):
+        parts = [
+            channel_sum(ss, "ss_sold_date_sk", "ss_item_sk", "ss_addr_sk",
+                        "ss_ext_sales_price", item_side, group_col),
+            channel_sum(cs, "cs_sold_date_sk", "cs_item_sk", "cs_bill_addr_sk",
+                        "cs_ext_sales_price", item_side, group_col),
+            channel_sum(ws, "ws_sold_date_sk", "ws_item_sk", "ws_bill_addr_sk",
+                        "ws_ext_sales_price", item_side, group_col),
+        ]
+        return (
+            Union(parts)
+            .aggregate([group_col], [AggSpec.of("sum", "total_sales", "total_sales2")])
+            .select(group_col, ("total_sales", col("total_sales2")))
+            .sort(order_by)
+            .limit(100)
+        )
+
+    electronics_mf = (
+        item.select("i_manufact_id", "i_category")
+        .filter(col("i_category") == lit("Electronics"))
+        .select("i_manufact_id")
+        .distinct()
+    )
+    q33 = three_channel(
+        item.select("i_item_sk", "i_manufact_id").join(
+            electronics_mf, ["i_manufact_id"], how="semi"
+        ),
+        "i_manufact_id",
+        [("total_sales", True), ("i_manufact_id", True)],
+    )
+    music_ids = (
+        item.select("i_item_id", "i_category")
+        .filter(col("i_category") == lit("Music"))
+        .select("i_item_id")
+        .distinct()
+    )
+    q60 = three_channel(
+        item.select("i_item_sk", "i_item_id").join(music_ids, ["i_item_id"], how="semi"),
+        "i_item_id",
+        # Published q60 orders by the item id FIRST, then total sales.
+        [("i_item_id", True), ("total_sales", True)],
+    )
+
     return {
         "q3": q3, "q6": q6, "q7": q7, "q13": q13, "q19": q19, "q27": q27,
         "q34": q34, "q36": q36, "q42": q42, "q43": q43, "q44": q44,
-        "q46": q46, "q48": q48, "q52": q52, "q53": q53, "q55": q55,
-        "q59": q59, "q63": q63, "q65": q65, "q67": q67, "q68": q68,
-        "q70": q70, "q73": q73, "q79": q79, "q88": q88, "q89": q89,
-        "q96": q96, "q98": q98,
+        "q33": q33, "q46": q46, "q48": q48, "q52": q52, "q53": q53,
+        "q55": q55, "q59": q59, "q60": q60, "q63": q63, "q65": q65,
+        "q67": q67, "q68": q68, "q70": q70, "q73": q73, "q79": q79,
+        "q88": q88, "q89": q89, "q96": q96, "q98": q98,
     }
 
 
@@ -1331,6 +1436,14 @@ def tpcds_indexes(hs, scans: dict) -> None:
     ))
     hs.create_index(ss, IndexConfig(
         "ss_by_store", ["ss_store_sk"], ["ss_item_sk", "ss_net_profit"],
+    ))
+    hs.create_index(scans["catalog_sales"], IndexConfig(
+        "cs_by_date", ["cs_sold_date_sk"],
+        ["cs_item_sk", "cs_bill_addr_sk", "cs_ext_sales_price"],
+    ))
+    hs.create_index(scans["web_sales"], IndexConfig(
+        "ws_by_date", ["ws_sold_date_sk"],
+        ["ws_item_sk", "ws_bill_addr_sk", "ws_ext_sales_price"],
     ))
     hs.create_index(dd, IndexConfig(
         "dd_by_sk", ["d_date_sk"],

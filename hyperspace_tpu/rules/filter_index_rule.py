@@ -22,6 +22,7 @@ import dataclasses
 
 from hyperspace_tpu.metadata.log_entry import IndexLogEntry
 from hyperspace_tpu.plan.nodes import Aggregate, Filter, Limit, LogicalPlan, Project, Scan, Sort, Window
+from hyperspace_tpu.plan.nodes import Union
 from hyperspace_tpu.rules.base import Rule, SignatureMatcher, hybrid_scan_for, index_scan_for
 
 
@@ -69,6 +70,9 @@ class FilterIndexRule(Rule):
             return Filter(self._rewrite(plan.child, indexes, matcher), plan.predicate)
         if isinstance(plan, (Aggregate, Sort, Limit, Window)):
             return dataclasses.replace(plan, child=self._rewrite(plan.child, indexes, matcher))
+        if isinstance(plan, Union):
+            # User-written UNION ALL branches each get their own rewrite.
+            return Union([self._rewrite(c, indexes, matcher) for c in plan.inputs])
         if hasattr(plan, "left") and hasattr(plan, "right"):
             new = dataclasses.replace(plan)
             new.left = self._rewrite(plan.left, indexes, matcher)

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 from hyperspace_tpu.metadata.log_entry import IndexLogEntry
 from hyperspace_tpu.plan.nodes import Aggregate, Filter, Join, Limit, LogicalPlan, Project, Scan, Sort, Window
+from hyperspace_tpu.plan.nodes import Union
 from hyperspace_tpu.rules.base import Rule, SignatureMatcher, hybrid_scan_for, index_scan_for
 from hyperspace_tpu.rules.ranker import JoinIndexRanker
 
@@ -100,6 +101,11 @@ class JoinIndexRule(Rule):
             import dataclasses
 
             return dataclasses.replace(plan, child=self._rewrite(plan.child, indexes, matcher))
+        if isinstance(plan, Union):
+            # A USER-written union (multi-channel UNION ALL queries) —
+            # rewrite each branch. Hybrid-scan unions the rules emit are
+            # harmless to revisit: their scans are index scans already.
+            return Union([self._rewrite(c, indexes, matcher) for c in plan.inputs])
         return plan
 
     def _try_rewrite_join(self, plan: Join, indexes, matcher) -> LogicalPlan | None:
