@@ -880,7 +880,12 @@ class Executor:
         from hyperspace_tpu.schema import Field, Schema
 
         if any(a.fn == "count_distinct" for a in plan.aggs):
-            raise HyperspaceError("count_distinct inside grouping sets is not supported")
+            # Distinct counts do not compose from partials (the same value
+            # in two finest groups of one coarser group would double
+            # count), so the re-fold below cannot serve them: materialize
+            # the child ONCE and aggregate each set directly over it —
+            # the plain-aggregate path owns the distinct machinery.
+            return self._grouping_sets_distinct(plan)
 
         # Phase 1: finest grain over the full group_by, means split into
         # sum+count partials so coarser sets can recompose them exactly.
@@ -926,25 +931,8 @@ class Executor:
                 fields.append(Field(sp.alias, dtype))
             sub = aggregate_table(bt, list(s), specs2, Schema(tuple(fields)), venue=venue)
 
-            in_set = {c.lower() for c in s}
-            cols: dict[str, np.ndarray] = {}
-            dicts: dict[str, np.ndarray] = {}
-            validity: dict[str, np.ndarray] = {}
-            nrows = sub.num_rows
-            for f in out_schema.fields:
-                low = f.name.lower()
-                if low in {c.lower() for c in plan.group_by}:
-                    if low in in_set:
-                        _copy_field(f, sub, f.name, cols, dicts, validity)
-                    else:
-                        _null_field(f, nrows, bt if f.is_string else None, cols, dicts, validity)
-                    continue
-                spec = next(a for a in plan.aggs if a.alias.lower() == low)
-                if spec.fn == "grouping":
-                    cols[f.name] = np.full(
-                        nrows, 0 if spec.expr.name.lower() in in_set else 1, np.int64
-                    )
-                elif spec.fn == "mean":
+            def agg_col(f, spec, cols, dicts, validity, sub=sub):
+                if spec.fn == "mean":
                     ssum = sub.column(f"__gs_sum_{spec.alias}").astype(np.float64)
                     scnt = sub.column(f"__gs_cnt_{spec.alias}").astype(np.float64)
                     sv = sub.valid_mask(f"__gs_sum_{spec.alias}")
@@ -962,7 +950,68 @@ class Executor:
                     cols[f.name] = np.where(v, c, 0) if v is not None else c
                 else:
                     _copy_field(f, sub, spec.alias, cols, dicts, validity)
-            parts.append(ColumnTable(out_schema, cols, dicts, validity))
+
+            parts.append(self._gs_assemble(plan, out_schema, sub, s, bt, agg_col))
+        return ColumnTable.concat(parts)
+
+    def _gs_assemble(
+        self, plan: "Aggregate", out_schema, sub: ColumnTable, s, dict_src, agg_col
+    ) -> ColumnTable:
+        """One grouping set's output part, shared by the re-fold and
+        distinct grouping-set paths: group columns in `s` copy through,
+        group columns aggregated away null-extend, grouping() flags
+        derive from set membership, and `agg_col(field, spec, cols,
+        dicts, validity)` fills the aggregate columns."""
+        in_set = {c.lower() for c in s}
+        gb_low = {c.lower() for c in plan.group_by}
+        cols: dict[str, np.ndarray] = {}
+        dicts: dict[str, np.ndarray] = {}
+        validity: dict[str, np.ndarray] = {}
+        nrows = sub.num_rows
+        for f in out_schema.fields:
+            low = f.name.lower()
+            if low in gb_low:
+                if low in in_set:
+                    _copy_field(f, sub, f.name, cols, dicts, validity)
+                else:
+                    _null_field(
+                        f, nrows, dict_src if f.is_string else None, cols, dicts, validity
+                    )
+                continue
+            spec = next(a for a in plan.aggs if a.alias.lower() == low)
+            if spec.fn == "grouping":
+                cols[f.name] = np.full(
+                    nrows, 0 if spec.expr.name.lower() in in_set else 1, np.int64
+                )
+            else:
+                agg_col(f, spec, cols, dicts, validity)
+        return ColumnTable(out_schema, cols, dicts, validity)
+
+    def _grouping_sets_distinct(self, plan: "Aggregate") -> ColumnTable:
+        """GROUPING SETS with count_distinct aggregates (q14/q18 shapes):
+        the child materializes once, then every set aggregates it
+        directly — per-set work instead of the partial re-fold, because
+        distinct counts cannot be composed from finer partials."""
+
+        ct = self._execute(plan.child)
+        leaf = _TableLeaf(ct)
+        out_schema = plan.schema
+        self._phys(
+            "GroupingSetsDistinct",
+            sets=[list(s) for s in plan.grouping_sets],
+            distinct_cols=sorted(
+                a.expr.name.lower() for a in plan.aggs if a.fn == "count_distinct"
+            ),
+        )
+        parts: list[ColumnTable] = []
+        for s in plan.grouping_sets:
+            specs = [a for a in plan.aggs if a.fn != "grouping"]
+            sub = self._execute(Aggregate(leaf, list(s), specs))
+
+            def agg_col(f, spec, cols, dicts, validity, sub=sub):
+                _copy_field(f, sub, spec.alias, cols, dicts, validity)
+
+            parts.append(self._gs_assemble(plan, out_schema, sub, s, ct, agg_col))
         return ColumnTable.concat(parts)
 
     def _venue(self, conf_attr: str, what: str, prefer_device: bool, needs_native: bool) -> str:
@@ -1384,10 +1433,11 @@ class Executor:
         if self.stats["join_kernel"] == "host-broadcast-hash":
             path = "broadcast-hash"
             self.stats["join_path"] = path
-        if plan.condition is not None:
-            # Non-equi ON residual: 3-valued mask over the matched rows
-            # (inner joins only — the node validates), venue- and
-            # mesh-aware like every other predicate site. The filtered
+        if plan.condition is not None and plan.how == "inner":
+            # Inner-join ON residual: a plain 3-valued filter over the
+            # matched rows, venue- and mesh-aware like every other
+            # predicate site. (Outer/semi/anti residuals alter MATCHING
+            # and are applied inside _partition_join.) The filtered
             # table deliberately does NOT inherit any preserved bucket
             # grouping (per-bucket counts changed).
             before = out.num_rows
@@ -1676,10 +1726,14 @@ class Executor:
             # poison min/max (NaN bounds would slice every finite row
             # away) — disable DPP for this producer entirely.
             return None
-        lo, hi = vals.min(), vals.max()
         if f.name in t.dictionaries:
-            d = t.dictionaries[f.name]
-            return (d[int(lo)], d[int(hi)], None)
+            # Decoded-string bounds have no consumer: string keys disable
+            # the bucket set, row slicing, and kset reduction alike — a
+            # non-None result here would only churn the derived cache
+            # with dead no-op cut entries (pinning base refs per distinct
+            # producer filter). Report "no DPP" instead.
+            return None
+        lo, hi = vals.min(), vals.max()
         kset = None
         if (
             f.device_dtype.kind in "iu"
@@ -2344,7 +2398,7 @@ class Executor:
         lt, rt = lside.table, rside.table
         how = plan.how
 
-        if how in ("semi", "anti"):
+        if how in ("semi", "anti") and plan.condition is None:
             # Existence is a membership probe, not a join: never expand the
             # match pairs (a hot key repeated k×k ways would materialize k²
             # pairs only to collapse into |L| bits).
@@ -2354,7 +2408,54 @@ class Executor:
 
         lidx, ridx, totals = self._match_pairs(plan, lside, rside)
 
+        if how in ("semi", "anti"):
+            # Residual existence (EXISTS with extra conditions): a left
+            # row matches iff SOME equi-pair also passes the residual —
+            # gather ONLY the columns the condition reads (the pairs are
+            # k x k expanded; none of the payload survives the |L|-bit
+            # reduction), evaluate, and reduce surviving lidx to bits.
+            from hyperspace_tpu.schema import Schema as _Schema
+
+            refs = {r.lower() for r in plan.condition.references()}
+            rkeys_low = {rt.schema.field(c).name.lower() for c in plan.right_on}
+            lkeep = [f.name for f in lt.schema.fields if f.name.lower() in refs]
+            if not lkeep:  # keep one cheap key lane so row count survives
+                lkeep = [lt.schema.field(plan.left_on[0]).name]
+            rkeep = [rt.schema.field(c).name for c in plan.right_on] + [
+                f.name
+                for f in rt.schema.fields
+                if f.name.lower() in refs and f.name.lower() not in rkeys_low
+            ]
+            sub_schema = _Schema(
+                tuple(lt.schema.select(lkeep).fields)
+                + tuple(
+                    f for f in rt.schema.select(rkeep).fields
+                    if f.name.lower() not in rkeys_low
+                )
+            )
+            pairs = self._gather_pairs(
+                plan, lt.select(lkeep), rt.select(rkeep), lidx, ridx, schema=sub_schema
+            )
+            pmask = eval_predicate_mask(
+                pairs, plan.condition, mesh=self.mesh, venue=self._filter_venue()
+            )
+            matched = np.zeros(lt.num_rows, dtype=bool)
+            matched[lidx[pmask]] = True
+            self._phys(residual_condition=True, residual_pairs_dropped=int((~pmask).sum()))
+            out = lt.filter_mask(matched if how == "semi" else ~matched)
+            return ColumnTable(plan.schema, out.columns, out.dictionaries, out.validity)
+
         inner = self._gather_pairs(plan, lt, rt, lidx, ridx)
+        if plan.condition is not None and how != "inner":
+            # Outer-join ON residual alters MATCHING: a pair failing it
+            # is no match, so its rows fall through to the null-extended
+            # unmatched parts below (computed from the SURVIVING pairs).
+            pmask = eval_predicate_mask(
+                inner, plan.condition, mesh=self.mesh, venue=self._filter_venue()
+            )
+            inner = inner.filter_mask(pmask)
+            lidx, ridx = lidx[pmask], ridx[pmask]
+            self._phys(residual_condition=True, residual_pairs_dropped=int((~pmask).sum()))
         if how == "inner":
             # Bucket-preserving output: an inner join over B>1 buckets
             # emits pairs bucket-major, so the result STAYS bucket-
@@ -2497,9 +2598,12 @@ class Executor:
         return 0 < small <= cap and large >= 4 * small
 
     def _gather_pairs(
-        self, plan: Join, lt: ColumnTable, rt: ColumnTable, lidx, ridx
+        self, plan: Join, lt: ColumnTable, rt: ColumnTable, lidx, ridx, schema=None
     ) -> ColumnTable:
-        """Materialize matched rows: left columns + right non-key columns."""
+        """Materialize matched rows: left columns + right non-key columns.
+        `schema` overrides the output schema (semi/anti residual
+        evaluation gathers in the inner-join shape)."""
+        schema = schema if schema is not None else plan.schema
         rkeys_low = {rt.schema.field(c).name.lower() for c in plan.right_on}
         lgather = lt.take(lidx)
         cols = dict(lgather.columns)
@@ -2510,7 +2614,7 @@ class Executor:
         cols.update(rgather.columns)
         dicts.update(rgather.dictionaries)
         val.update(rgather.validity)
-        return ColumnTable(plan.schema, cols, dicts, val)
+        return ColumnTable(schema, cols, dicts, val)
 
     def _left_unmatched(self, plan: Join, lt: ColumnTable, rt: ColumnTable, mask) -> ColumnTable:
         """Unmatched left rows, right-side fields null-extended."""

@@ -121,6 +121,46 @@ def window_table(
         return run[last[pg]]
 
     for spec, field in zip(funcs, out_schema.fields[len(table.schema.fields) :]):
+        if spec.fn in ("lag", "lead"):
+            # Partition-bounded shift along the ORDER BY: row i takes the
+            # value `offset` rows before (lag) / after (lead) it within
+            # its segment, NULL past the segment edge (SQL's default).
+            from hyperspace_tpu.plan.expr import Col
+
+            src_dict = None
+            if isinstance(spec.expr, Col):
+                src_f = table.schema.field(spec.expr.name)
+                vals = np.asarray(table.columns[src_f.name])
+                valid = table.validity.get(src_f.name)
+                src_dict = table.dictionaries.get(src_f.name)
+            else:
+                vals, valid = _numeric_input(table, spec.expr)
+                vals = np.full(n, vals) if np.ndim(vals) == 0 else vals
+            sv = vals[perm]
+            svalid = None if valid is None else np.asarray(valid)[perm]
+            if spec.fn == "lag":
+                src = idx - spec.offset
+                in_seg = src >= start_idx
+            else:
+                # Last index of each segment, broadcast per row.
+                seg = np.cumsum(new_seg) - 1
+                seg_last = np.zeros(int(seg[-1]) + 1, dtype=np.int64)
+                seg_last[seg] = idx  # ascending: last write per segment wins
+                src = idx + spec.offset
+                in_seg = src <= seg_last[seg]
+            src_c = np.clip(src, 0, n - 1)
+            shifted = sv[src_c]
+            ok = in_seg if svalid is None else (in_seg & svalid[src_c])
+            if field.is_string:
+                # Codes shift with the source dictionary carried over.
+                cols[field.name] = scatter(shifted)
+                if src_dict is not None:
+                    dicts[field.name] = src_dict
+            else:
+                cols[field.name] = scatter(shifted).astype(field.device_dtype, copy=False)
+            if not ok.all():
+                validity[field.name] = scatter(ok)
+            continue
         if spec.fn == "row_number":
             vals = idx - start_idx + 1
             cols[field.name] = scatter(vals)
@@ -157,12 +197,18 @@ def window_table(
             if spec.fn == "count":
                 res, res_valid = cnt, None
             elif spec.fn in ("sum", "mean"):
-                s = np.bincount(seg, weights=contrib.astype(np.float64), minlength=k)
-                if spec.fn == "mean":
-                    with np.errstate(invalid="ignore", divide="ignore"):
-                        res = s / cnt
+                if spec.fn == "sum" and is_int:
+                    # Exact int64 accumulation (contrib is already in
+                    # segment order): float64 bincount weights would lose
+                    # integer exactness above 2^53.
+                    res = np.add.reduceat(contrib, np.flatnonzero(new_seg))
                 else:
-                    res = s.astype(acc_dtype) if is_int else s
+                    s = np.bincount(seg, weights=contrib.astype(np.float64), minlength=k)
+                    if spec.fn == "mean":
+                        with np.errstate(invalid="ignore", divide="ignore"):
+                            res = s / cnt
+                    else:
+                        res = s
                 res_valid = cnt > 0
             else:  # min / max
                 identity = np.inf if spec.fn == "min" else -np.inf

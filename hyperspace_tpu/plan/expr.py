@@ -404,18 +404,39 @@ def expr_dtype(e: Expr, schema) -> str:
         if e.op in _CMP_OPS:
             return "bool"
         lt, rt = expr_dtype(e.left, schema), expr_dtype(e.right, schema)
+        if "date" in (lt, rt):
+            # Dates are day numbers on device: date ± days stays a date,
+            # date - date is the day count; anything else is undefined
+            # rather than silently an int.
+            if e.op == "sub" and lt == "date" and rt == "date":
+                return "int64"
+            if e.op in ("add", "sub") and lt == "date" and rt in ("int32", "int64", "bool"):
+                return "date"
+            if e.op == "add" and rt == "date" and lt in ("int32", "int64", "bool"):
+                return "date"
+            raise ValueError(f"unsupported date arithmetic {lt} {e.op} {rt}")
         if e.op == "div" or "float64" in (lt, rt) or "float32" in (lt, rt):
             return "float64"
         return "int64"
     if isinstance(e, (And, Or, Not, IsNull, InList, Like)):
         return "bool"
     if isinstance(e, Case):
-        ts = [expr_dtype(v, schema) for _, v in e.branches] + [expr_dtype(e.default, schema)]
+        vals = [v for _, v in e.branches] + [e.default]
+        ts = [expr_dtype(v, schema) for v in vals]
         if all(t == ts[0] for t in ts):
             return ts[0]
+        nonlit = [t for v, t in zip(vals, ts) if not isinstance(v, Lit)]
+        if (
+            nonlit
+            and all(t == "date" for t in nonlit)
+            and all(t in ("int32", "int64", "bool", "date") for t in ts)
+        ):
+            # CASE over date columns with integer literal defaults keeps
+            # the date dtype (literals are day numbers).
+            return "date"
         if any(t in ("float64", "float32") for t in ts):
             return "float64"
-        if all(t in ("int32", "int64", "bool", "date") for t in ts):
+        if all(t in ("int32", "int64", "bool") for t in ts):
             return "int64"
         raise ValueError(f"CASE branches mix incompatible types {ts}")
     if isinstance(e, DatePart):

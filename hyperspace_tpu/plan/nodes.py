@@ -54,10 +54,11 @@ class LogicalPlan:
         condition: "Expr | None" = None,
     ) -> "Join":
         """Equi-join on key lists; `condition` adds a non-equi residual
-        (`ON a.k = b.k AND a.lo <= b.hi` shapes) evaluated over the
-        matched rows — inner joins only (in an outer join the ON
-        residual changes MATCHING, not filtering, which this engine does
-        not model)."""
+        (`ON a.k = b.k AND a.lo <= b.hi` shapes). For inner joins it
+        filters the matched rows; for outer/semi/anti joins it alters
+        MATCHING — a pair failing the residual does not count as a
+        match, so the left/right row null-extends (outer) or flips its
+        existence verdict (semi/anti), per SQL ON-clause semantics."""
         return Join(
             self, other, list(left_on), list(right_on or left_on), how,
             condition=condition,
@@ -123,6 +124,41 @@ class LogicalPlan:
 
     def limit(self, n: int) -> "Limit":
         return Limit(self, int(n))
+
+    def intersect(self, other: "LogicalPlan") -> "Join":
+        """SQL INTERSECT (set semantics, positional columns like the
+        reference round-trips via Catalyst's Intersect node,
+        LogicalPlanSerDeUtils.scala:82-145): distinct left rows that also
+        appear in `other`. Desugars to DISTINCT + SEMI JOIN on every
+        column — so rows whose compared columns contain NULL follow the
+        engine's join NULL semantics (never equal) rather than SQL's
+        null-safe set comparison."""
+        return self._set_op(other, "semi")
+
+    def except_(self, other: "LogicalPlan") -> "Join":
+        """SQL EXCEPT: distinct left rows absent from `other`. Desugars
+        to DISTINCT + ANTI JOIN on every column (same NULL caveat as
+        intersect: left NULL-bearing rows never match, so they are
+        kept)."""
+        return self._set_op(other, "anti")
+
+    def _set_op(self, other: "LogicalPlan", how: str) -> "Join":
+        if len(self.schema.names) != len(other.schema.names):
+            raise ValueError(
+                f"set operation inputs must have equal width: "
+                f"{self.schema.names} vs {other.schema.names}"
+            )
+        for lf, rf in zip(self.schema.fields, other.schema.fields):
+            # Positional pairs must share a comparison domain — a silent
+            # string/number coercion would "match" 1 with '1'.
+            if lf.is_string != rf.is_string:
+                raise ValueError(
+                    f"set operation column types are incompatible: "
+                    f"{lf.name} ({lf.dtype}) vs {rf.name} ({rf.dtype})"
+                )
+        return self.distinct().join(
+            other, list(self.schema.names), list(other.schema.names), how=how
+        )
 
     def distinct(self) -> "Aggregate":
         """Distinct rows = group by every column with no aggregates.
@@ -321,9 +357,9 @@ class Join(LogicalPlan):
     right_on: list[str]
     how: str = "inner"
     # Non-equi residual of the ON clause (equality stays structural):
-    # evaluated with full 3-valued semantics over the matched rows.
-    # Inner joins only — in outer joins the ON residual alters matching
-    # (null-extension) rather than filtering, which is not modeled.
+    # evaluated with full 3-valued semantics over the equi-matched
+    # pairs. Inner joins filter; outer/semi/anti joins treat a failing
+    # pair as NO MATCH (null-extension / existence semantics).
     condition: Expr | None = None
 
     def __post_init__(self):
@@ -332,33 +368,28 @@ class Join(LogicalPlan):
         if self.how not in JOIN_TYPES:
             raise ValueError(f"unknown join type {self.how!r}; one of {JOIN_TYPES}")
         if self.condition is not None:
-            if self.how != "inner":
-                raise ValueError(
-                    "a non-equi join condition is supported for INNER joins only"
-                )
-            # Validate references against the OUTPUT schema now (right
-            # key names merge into the left-named column), so a typo or
-            # a merged-away key fails here, not mid-execution.
-            out_names = {n.lower() for n in self.schema.names}
+            # Validate references against the MATCH schema now (right
+            # key names merge into the left-named column; semi/anti
+            # conditions may read right non-key columns even though the
+            # output is left-only), so a typo or a merged-away key fails
+            # here, not mid-execution.
+            out_names = {n.lower() for n in self.match_schema.names}
             missing = sorted(
                 r for r in self.condition.references() if r not in out_names
             )
             if missing:
                 raise ValueError(
                     f"join condition references {missing} not present in the "
-                    f"join output (right-side key columns merge into the "
-                    f"left-named key)"
+                    f"join match schema (right-side key columns merge into "
+                    f"the left-named key)"
                 )
 
     @property
-    def schema(self) -> Schema:
-        """Join key columns appear once (equal for matches; outer joins
-        coalesce the surviving side's key into the left-named column); a
-        non-key name collision is ambiguous and rejected. Semi/anti
-        produce the left side's schema only."""
+    def match_schema(self) -> Schema:
+        """The schema an ON residual evaluates over: left columns plus
+        right non-key columns — the inner-join shape, whatever `how` is.
+        A non-key name collision is ambiguous and rejected."""
         lf = self.left.schema.fields
-        if self.how in ("semi", "anti"):
-            return Schema(tuple(lf))
         left_names = {f.name.lower() for f in lf}
         keys = {k.lower() for k in self.right_on}
         rf = []
@@ -372,6 +403,15 @@ class Join(LogicalPlan):
                 )
             rf.append(f)
         return Schema(tuple(lf) + tuple(rf))
+
+    @property
+    def schema(self) -> Schema:
+        """Join key columns appear once (equal for matches; outer joins
+        coalesce the surviving side's key into the left-named column).
+        Semi/anti produce the left side's schema only."""
+        if self.how in ("semi", "anti"):
+            return Schema(tuple(self.left.schema.fields))
+        return self.match_schema
 
     def children(self) -> list[LogicalPlan]:
         return [self.left, self.right]
@@ -518,14 +558,18 @@ class Aggregate(LogicalPlan):
 @dataclasses.dataclass
 class WindowSpec:
     """One window function: fn over an expression (None for the ranking
-    family and count(*))."""
+    family and count(*)). lag/lead shift the value within the partition
+    by `offset` rows of the ORDER BY (SQL LAG/LEAD with a NULL default);
+    they ignore the frame."""
 
-    fn: str  # row_number | rank | dense_rank | sum | count | mean | min | max
+    fn: str  # row_number | rank | dense_rank | sum | count | mean | min | max | lag | lead
     expr: Expr | None
     alias: str
+    offset: int = 1  # lag/lead only
 
-    _FNS = ("row_number", "rank", "dense_rank", "sum", "count", "mean", "min", "max")
+    _FNS = ("row_number", "rank", "dense_rank", "sum", "count", "mean", "min", "max", "lag", "lead")
     RANKING = ("row_number", "rank", "dense_rank")
+    SHIFT = ("lag", "lead")
 
     def __post_init__(self):
         if self.fn not in self._FNS:
@@ -534,9 +578,11 @@ class WindowSpec:
             raise ValueError(f"{self.fn} requires an input expression")
         if self.expr is not None and self.fn in self.RANKING:
             raise ValueError(f"{self.fn} takes no input expression")
+        if self.fn in self.SHIFT and self.offset < 1:
+            raise ValueError(f"{self.fn} offset must be >= 1")
 
     @staticmethod
-    def of(fn: str, expr=None, alias: str | None = None) -> "WindowSpec":
+    def of(fn: str, expr=None, alias: str | None = None, offset: int = 1) -> "WindowSpec":
         from hyperspace_tpu.plan.expr import Col
 
         if isinstance(expr, str):
@@ -544,22 +590,25 @@ class WindowSpec:
         if alias is None:
             base = expr.name if isinstance(expr, Col) else ("star" if expr is None else "expr")
             alias = f"{fn}_{base}" if expr is not None else fn
-        return WindowSpec(fn, expr, alias)
+        return WindowSpec(fn, expr, alias, offset)
 
     def references(self) -> set[str]:
         return self.expr.references() if self.expr is not None else set()
 
     def to_json(self) -> dict[str, Any]:
-        return {
+        d = {
             "fn": self.fn,
             "expr": self.expr.to_json() if self.expr is not None else None,
             "alias": self.alias,
         }
+        if self.fn in self.SHIFT:
+            d["offset"] = self.offset
+        return d
 
     @staticmethod
     def from_json(d: dict[str, Any]) -> "WindowSpec":
         e = expr_from_json(d["expr"]) if d.get("expr") is not None else None
-        return WindowSpec(d["fn"], e, d["alias"])
+        return WindowSpec(d["fn"], e, d["alias"], d.get("offset", 1))
 
 
 WINDOW_FRAMES = ("partition", "rows", "range")
@@ -592,8 +641,10 @@ class Window(LogicalPlan):
             raise ValueError(f"unknown window frame {self.frame!r}; one of {WINDOW_FRAMES}")
         if self.frame != "partition" and not self.order_by:
             raise ValueError(f"window frame {self.frame!r} requires an ORDER BY")
-        if not self.order_by and any(f.fn in WindowSpec.RANKING for f in self.funcs):
-            raise ValueError("ranking window functions require an ORDER BY")
+        if not self.order_by and any(
+            f.fn in (*WindowSpec.RANKING, *WindowSpec.SHIFT) for f in self.funcs
+        ):
+            raise ValueError("ranking and lag/lead window functions require an ORDER BY")
         child_names = {n.lower() for n in self.child.schema.names}
         seen = set(child_names)
         for f in self.funcs:
@@ -616,8 +667,8 @@ class Window(LogicalPlan):
                 dtype = "float64"
             elif isinstance(f.expr, Col):
                 src = child.field(f.expr.name)
-                if f.fn in ("min", "max"):
-                    dtype = src.dtype
+                if f.fn in ("min", "max", "lag", "lead"):
+                    dtype = src.dtype  # extremum / shift preserve the input type
                 else:  # sum widens integers
                     dtype = "int64" if src.dtype in ("int32", "int64", "bool", "date") else "float64"
             else:

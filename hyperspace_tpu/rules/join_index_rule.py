@@ -142,13 +142,18 @@ class JoinIndexRule(Rule):
             # (the ranker's mismatched-pair fallback generalized,
             # JoinIndexRanker.scala:31-34). Prefer more buckets (more
             # parallelism), like the ranker's second criterion.
-            if lcands:
-                m = max(lcands, key=lambda c: c.entry.num_buckets)
-                new_left = _replace_scan(plan.left, self._side_plan(m, lscan))
+            # Compare across BOTH sides — a higher-bucket-count right
+            # index beats the best left candidate.
+            best_l = max(lcands, key=lambda c: c.entry.num_buckets) if lcands else None
+            best_r = max(rcands, key=lambda c: c.entry.num_buckets) if rcands else None
+            if best_l is not None and (
+                best_r is None or best_l.entry.num_buckets >= best_r.entry.num_buckets
+            ):
+                new_left = _replace_scan(plan.left, self._side_plan(best_l, lscan))
                 return Join(new_left, self._rewrite(plan.right, indexes, matcher),
                             plan.left_on, plan.right_on, plan.how,
                             condition=plan.condition)
-            m = max(rcands, key=lambda c: c.entry.num_buckets)
+            m = best_r
             new_right = _replace_scan(plan.right, self._side_plan(m, rscan))
             return Join(self._rewrite(plan.left, indexes, matcher), new_right,
                         plan.left_on, plan.right_on, plan.how,

@@ -10,7 +10,6 @@ import pyarrow.parquet as pq
 import pytest
 
 from hyperspace_tpu import HyperspaceSession, col
-from hyperspace_tpu.exceptions import HyperspaceError
 from hyperspace_tpu.plan.nodes import plan_from_json
 
 
@@ -145,7 +144,53 @@ def test_rollup_over_filter_and_validation(data):
         ds.aggregate(["state"], [("sum", "amt", "s")], grouping_sets=[["cat"]])
     with pytest.raises(ValueError):
         ds.aggregate(["state"], [("grouping", "state", "g")])  # no sets
-    with pytest.raises(HyperspaceError):
-        session.run(
-            ds.rollup(["state"], [("count_distinct", "cat", "cd")])
+    # count_distinct under rollup executes (dedicated tests below).
+    session.run(ds.rollup(["state"], [("count_distinct", "cat", "cd")]))
+
+
+def test_rollup_count_distinct(data):
+    session, ds, df = data
+    q = ds.rollup(
+        ["state"],
+        [
+            ("count_distinct", "cat", "dcat"),
+            ("count_distinct", "q", "dq"),
+            ("sum", "amt", "s"),
+            ("grouping", "state", "g"),
+        ],
+    )
+    got = session.to_pandas(q)
+
+    def agg(g):
+        return g.agg(
+            dcat=("cat", "nunique"),
+            dq=("q", "nunique"),
+            s=("amt", "sum"),
         )
+
+    exp = rollup_oracle(df, ["state"], agg)
+    exp["g"] = [0] * (len(exp) - 1) + [1]
+    assert norm(got, ["state", "dcat", "dq", "s", "g"]) == norm(
+        exp, ["state", "dcat", "dq", "s", "g"]
+    )
+    assert "GroupingSetsDistinct" in repr(session.last_physical_plan)
+
+
+def test_grouping_sets_count_distinct_with_null_group(data):
+    session, ds, df = data
+    # An explicit set list incl. the empty set; distinct counts at every
+    # grain computed over the same child materialization.
+    q = ds.aggregate(
+        ["state", "cat"],
+        [("count_distinct", "q", "dq"), ("count", None, "n")],
+        grouping_sets=[["state", "cat"], ["cat"], []],
+    )
+    got = session.to_pandas(q)
+    p1 = df.groupby(["state", "cat"]).agg(dq=("q", "nunique"), n=("q", "size")).reset_index()
+    p2 = df.groupby(["cat"]).agg(dq=("q", "nunique"), n=("q", "size")).reset_index()
+    p2["state"] = None
+    p3 = pd.DataFrame(
+        {"state": [None], "cat": [None], "dq": [df.q.nunique()], "n": [len(df)]}
+    )
+    exp = pd.concat([p1, p2, p3], ignore_index=True)
+    assert norm(got, ["state", "cat", "dq", "n"]) == norm(exp, ["state", "cat", "dq", "n"])

@@ -219,5 +219,97 @@ def test_non_equi_join_condition(tmp_path):
     exp = len(left.merge(right, left_on="k", right_on="k2").query("lo < hi"))
     assert n_idx == n_raw == exp
 
-    with pytest.raises(ValueError, match="INNER joins only"):
-        l.join(r, ["k"], ["k2"], how="left", condition=col("lo") < col("hi"))
+    # Outer joins accept residuals too (matching semantics —
+    # test_on_residual_alters_matching pins the behavior).
+    l.join(r, ["k"], ["k2"], how="left", condition=col("lo") < col("hi"))
+    with pytest.raises(ValueError, match="match schema"):
+        l.join(r, ["k"], ["k2"], condition=col("nope") < col("hi"))
+
+
+@pytest.mark.parametrize("how", ["left", "right", "full", "semi", "anti"])
+def test_on_residual_alters_matching(tmp_path, how):
+    """Outer/semi/anti ON residual: a pair failing the residual is NOT a
+    match — left rows null-extend / flip existence, per SQL ON-clause
+    semantics. Oracle: pandas inner merge + residual, then recompose."""
+    from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col
+
+    rng = np.random.default_rng(91)
+    n = 6_000
+    left = pd.DataFrame(
+        {
+            "k": rng.integers(0, 250, n).astype(np.int64),
+            "lo": rng.integers(0, 50, n).astype(np.int64),
+        }
+    )
+    right = pd.DataFrame(
+        {
+            "k2": rng.integers(100, 350, 900).astype(np.int64),
+            "hi": rng.integers(10, 60, 900).astype(np.int64),
+        }
+    )
+    for name, df in (("l", left), ("r", right)):
+        (tmp_path / name).mkdir()
+        pq.write_table(pa.Table.from_pandas(df, preserve_index=False), tmp_path / name / "p.parquet")
+    session = HyperspaceSession(system_path=str(tmp_path / "idx"), num_buckets=4)
+    hs = Hyperspace(session)
+    l = session.parquet(tmp_path / "l")
+    r = session.parquet(tmp_path / "r")
+    hs.create_index(l, IndexConfig("or_l", ["k"], ["lo"]))
+    hs.create_index(r, IndexConfig("or_r", ["k2"], ["hi"]))
+
+    q = l.join(r, ["k"], ["k2"], how=how, condition=col("lo") < col("hi"))
+
+    surv = left.reset_index().merge(right.reset_index(), left_on="k", right_on="k2",
+                                    suffixes=("_l", "_r")).query("lo < hi")
+    if how in ("semi", "anti"):
+        in_l = set(surv.index_l)
+        keep = left.index.isin(in_l)
+        exp = left[keep if how == "semi" else ~keep]
+        cols = ["k", "lo"]
+        exp = exp[cols]
+    else:
+        inner = surv[["k", "lo", "hi"]]
+        parts = [inner]
+        if how in ("left", "full"):
+            lum = left[~left.index.isin(set(surv.index_l))].copy()
+            lum["hi"] = np.nan
+            parts.append(lum[["k", "lo", "hi"]])
+        if how in ("right", "full"):
+            rum = right[~right.index.isin(set(surv.index_r))].copy()
+            rum["k"] = rum["k2"]
+            rum["lo"] = np.nan
+            parts.append(rum[["k", "lo", "hi"]])
+        exp = pd.concat(parts, ignore_index=True)
+        cols = ["k", "lo", "hi"]
+
+    for enabled in (False, True):
+        if enabled:
+            session.enable_hyperspace()
+        else:
+            session.disable_hyperspace()
+        got = session.to_pandas(q)
+        assert norm_rows(got, cols) == norm_rows(exp, cols), (how, enabled)
+
+
+def test_intersect_except_set_semantics(tmp_path):
+    """INTERSECT/EXCEPT desugar to DISTINCT + semi/anti joins on all
+    columns (the set-op nodes the reference round-trips,
+    LogicalPlanSerDeUtils.scala:82-145)."""
+    from hyperspace_tpu import HyperspaceSession
+
+    a = pd.DataFrame({"x": [1, 1, 2, 3, 5], "y": ["a", "a", "b", "c", "e"]})
+    b = pd.DataFrame({"u": [1, 3, 3, 4], "v": ["a", "c", "c", "d"]})
+    for name, df in (("a", a), ("b", b)):
+        (tmp_path / name).mkdir()
+        pq.write_table(pa.Table.from_pandas(df, preserve_index=False), tmp_path / name / "p.parquet")
+    session = HyperspaceSession(system_path=str(tmp_path / "idx"), num_buckets=2)
+    da, db = session.parquet(tmp_path / "a"), session.parquet(tmp_path / "b")
+
+    inter = session.to_pandas(da.intersect(db)).sort_values("x")
+    assert list(map(tuple, inter.to_numpy())) == [(1, "a"), (3, "c")]
+    exc = session.to_pandas(da.except_(db)).sort_values("x")
+    assert list(map(tuple, exc.to_numpy())) == [(2, "b"), (5, "e")]
+    with pytest.raises(ValueError, match="equal width"):
+        da.intersect(db.select("u"))
+    with pytest.raises(ValueError, match="incompatible"):
+        da.intersect(db.select("v", "u"))  # int vs string positionally

@@ -209,3 +209,69 @@ def test_window_validation(data):
         ds.window(["g"], funcs=[("sum", "v", "g")])  # collides with child col
     with pytest.raises(ValueError):
         ds.window(["g"], order_by=["o"], funcs=[("sum", "v", "s")], frame="bogus")
+
+
+def test_lag_lead_against_pandas_shift(data):
+    session, ds, df = data
+    q = ds.window(
+        ["g"],
+        order_by=[("o", True)],
+        funcs=[
+            ("lag", "v", "lag_v"),
+            ("lead", "f", "lead_f", 2),
+            ("lag", "o", "lag3_o", 3),
+        ],
+    )
+    got = session.to_pandas(q)
+    # Stable sort by o then partition-shift mirrors the engine's stable
+    # lexsort with input-order tie-break; shift keeps the index so the
+    # oracle lands back in input order automatically.
+    sdf = df.sort_values("o", kind="stable")
+    exp_lag = sdf.groupby("g").v.shift(1).astype("Float64").sort_index()
+    exp_lead = sdf.groupby("g").f.shift(-2).sort_index()
+    exp_lag3 = sdf.groupby("g").o.shift(3).sort_index()
+    pd.testing.assert_series_equal(
+        got.lag_v.astype("Float64"), exp_lag, check_names=False
+    )
+    np.testing.assert_allclose(
+        got.lead_f.to_numpy(dtype=np.float64),
+        exp_lead.to_numpy(dtype=np.float64),
+        equal_nan=True,
+    )
+    np.testing.assert_allclose(
+        got.lag3_o.astype("Float64").to_numpy(dtype=np.float64, na_value=np.nan),
+        exp_lag3.to_numpy(dtype=np.float64, na_value=np.nan),
+        equal_nan=True,
+    )
+
+
+def test_lag_lead_strings_and_json(tmp_path):
+    df = pd.DataFrame(
+        {
+            "g": [0, 0, 0, 1, 1],
+            "o": [1, 2, 3, 1, 2],
+            "s": ["a", "b", "c", "x", "y"],
+        }
+    )
+    root = tmp_path / "t"
+    root.mkdir()
+    pq.write_table(pa.Table.from_pandas(df, preserve_index=False), root / "p.parquet")
+    session = HyperspaceSession(system_path=str(tmp_path / "idx"), num_buckets=2)
+    ds = session.parquet(root)
+    q = ds.window(
+        ["g"], order_by=[("o", True)],
+        funcs=[("lag", "s", "prev_s"), ("lead", "s", "next_s")],
+    )
+    d = q.to_json()
+    assert plan_from_json(d).to_json() == d  # offset round-trips
+    got = session.to_pandas(q).sort_values(["g", "o"])
+    assert list(got.prev_s.fillna("-")) == ["-", "a", "b", "-", "x"]
+    assert list(got.next_s.fillna("-")) == ["b", "c", "-", "y", "-"]
+
+
+def test_lag_lead_validation(data):
+    _, ds, _ = data
+    with pytest.raises(ValueError):
+        ds.window(["g"], funcs=[("lag", "v", "lv")])  # needs ORDER BY
+    with pytest.raises(ValueError):
+        ds.window(["g"], order_by=["o"], funcs=[("lag", "v", "lv", 0)])  # offset >= 1
