@@ -147,6 +147,16 @@ def gen_item(root: Path, sf: float = 1.0, seed: int = 61) -> int:
             "i_item_desc": pa.array(
                 np.char.add("desc", (np.arange(n) % 997).astype("U4")).astype(object)
             ),
+            "i_color": pa.array(
+                np.array(["maroon", "burnished", "dim", "sky", "navajo", "chiffon",
+                          "slate", "blanched", "tan", "forest", "lace", "misty",
+                          "cream", "dark", "powder", "frosted", "almond", "smoke"],
+                         dtype=object)[rng.integers(0, 18, n)]
+            ),
+            "i_units": pa.array(
+                np.array(["Each", "Dozen", "Case", "Pallet", "Gross", "Ton",
+                          "Ounce", "Bunch"], dtype=object)[rng.integers(0, 8, n)]
+            ),
         }
     )
     return _parts(t, root, 1)
@@ -213,6 +223,7 @@ def gen_household_demographics(root: Path) -> int:
     t = pa.table(
         {
             "hd_demo_sk": i + 1,
+            "hd_income_band_sk": (i % 20 + 1).astype(np.int64),
             "hd_buy_potential": pa.array(_BUY_POTENTIAL[i % 6]),
             "hd_dep_count": ((i // 6) % 10).astype(np.int32),
             "hd_vehicle_count": ((i // 60) % 5).astype(np.int32),
@@ -223,12 +234,20 @@ def gen_household_demographics(root: Path) -> int:
 
 def gen_time_dim(root: Path) -> int:
     i = np.arange(86_400, dtype=np.int64)
+    hour = (i // 3600).astype(np.int32)
+    meal = np.full(86_400, "", dtype=object)
+    meal[(hour >= 6) & (hour < 9)] = "breakfast"
+    meal[(hour >= 11) & (hour < 13)] = "lunch"
+    meal[(hour >= 17) & (hour < 20)] = "dinner"
     t = pa.table(
         {
             "t_time_sk": i,
-            "t_hour": (i // 3600).astype(np.int32),
+            "t_hour": hour,
             "t_minute": (i % 3600 // 60).astype(np.int32),
             "t_second": (i % 60).astype(np.int32),
+            "t_am_pm": pa.array(np.where(hour < 12, "AM", "PM").astype(object)),
+            # dsdgen leaves t_meal_time NULL outside meal windows.
+            "t_meal_time": pa.array(meal, mask=meal == ""),
         }
     )
     return _parts(t, root, 1)
@@ -248,6 +267,13 @@ def gen_customer_address(root: Path, sf: float = 1.0, seed: int = 62) -> int:
             "ca_zip": pa.array(rng.integers(10000, 99999, n).astype("U5").astype(object)),
             "ca_country": pa.array(np.full(n, "United States", dtype=object)),
             "ca_city": pa.array(_CITIES[rng.integers(0, len(_CITIES), n)]),
+            "ca_county": pa.array(
+                np.array(["Ziebach County", "Williamson County", "Walker County",
+                          "Daviess County", "Luce County", "Fairfield County",
+                          "Dona Ana County", "Barrow County"], dtype=object)[
+                    rng.integers(0, 8, n)
+                ]
+            ),
             "ca_gmt_offset": np.where(rng.random(n) < 0.5, -5.0, -6.0),
         }
     )
@@ -269,17 +295,35 @@ def gen_customer(root: Path, sf: float = 1.0, seed: int = 63) -> int:
         ["Smith", "Jones", "Brown", "Davis", "Miller", "Wilson", "Moore", "Clark"],
         dtype=object,
     )
+    countries = np.array(
+        ["United States", "Canada", "Mexico", "Japan", "Germany",
+         "Brazil", "India", "France"], dtype=object
+    )
     t = pa.table(
         {
             "c_customer_sk": np.arange(1, n + 1, dtype=np.int64),
+            "c_customer_id": pa.array(
+                np.char.add("AAAAAAAA", np.arange(n).astype("U8")).astype(object)
+            ),
             "c_current_addr_sk": rng.integers(1, ca_rows(sf) + 1, n).astype(np.int64),
             "c_current_cdemo_sk": rng.integers(1, cd_rows(sf) + 1, n).astype(np.int64),
+            "c_current_hdemo_sk": rng.integers(1, HD_ROWS + 1, n).astype(np.int64),
             "c_first_name": pa.array(first[rng.integers(0, len(first), n)]),
             "c_last_name": pa.array(last[rng.integers(0, len(last), n)]),
             "c_salutation": pa.array(
                 np.array(["Mr.", "Mrs.", "Ms.", "Dr."], dtype=object)[
                     rng.integers(0, 4, n)
                 ]
+            ),
+            "c_preferred_cust_flag": pa.array(
+                np.array(["N", "Y"], dtype=object)[(rng.random(n) < 0.5).astype(int)]
+            ),
+            "c_birth_year": rng.integers(1924, 1993, n).astype(np.int32),
+            "c_birth_month": rng.integers(1, 13, n).astype(np.int32),
+            "c_birth_day": rng.integers(1, 29, n).astype(np.int32),
+            "c_birth_country": pa.array(countries[rng.integers(0, len(countries), n)]),
+            "c_email_address": pa.array(
+                np.char.add(np.arange(n).astype("U8"), "@example.com").astype(object)
             ),
         }
     )
@@ -299,23 +343,52 @@ def gen_promotion(root: Path, seed: int = 64) -> int:
             "p_channel_email": pa.array(yn[(rng.random(n) < 0.1).astype(int)]),
             "p_channel_event": pa.array(yn[(rng.random(n) < 0.1).astype(int)]),
             "p_channel_dmail": pa.array(yn[(rng.random(n) < 0.5).astype(int)]),
+            "p_channel_tv": pa.array(yn[(rng.random(n) < 0.1).astype(int)]),
         }
     )
     return _parts(t, root, 1)
 
 
-def gen_store_sales(root: Path, sf: float = 1.0, seed: int = 60, files: int = 8,
-                    n_items: int | None = None, n_ca: int | None = None) -> int:
-    """The fact table. Sold dates concentrate in 1998-2002 (the years the
-    published queries probe), store hours 08:00-21:00. Rows group into
-    multi-item TICKETS (dsdgen's structure): all rows of one
+# Sales tables are memoized per (channel, sf) for the duration of one
+# cached_tpcds() pass so the RETURNS channels can derive from the exact
+# sold rows (dsdgen links returns to sales items the same way); cleared
+# after datagen so SF10+ tables don't pin memory.
+_SALES_TABLES: dict = {}
+
+WAREHOUSE_ROWS = 5
+CC_ROWS = 6
+WEB_SITE_ROWS = 30
+WEB_PAGE_ROWS = 60
+CATALOG_PAGE_ROWS = 11_718
+REASON_ROWS = 35
+SHIP_MODE_ROWS = 20
+
+
+def _null_frac(arr: np.ndarray, frac: float, rng) -> pa.Array:
+    """Arrow column with a `frac` fraction of NULLs (dsdgen emits null
+    FKs; q76 counts the rows whose channel FK IS NULL)."""
+    return pa.array(arr, mask=rng.random(len(arr)) < frac)
+
+
+def _money(rng, n, scale=200.0):
+    return np.round(rng.random(n) * scale, 2)
+
+
+def _ss_table(sf: float, seed: int = 60) -> pa.Table:
+    """The store fact table. Sold dates concentrate in 1998-2002 (the
+    years the published queries probe), store hours 08:00-21:00. Rows
+    group into multi-item TICKETS (dsdgen's structure): all rows of one
     ss_ticket_number share customer / date / time / store / demographics
-    / address — the grain q34/q46/q68/q73/q79 aggregate on."""
+    / address — the grain q34/q46/q68/q73/q79 aggregate on. ss_addr_sk
+    carries ~1% NULLs (q76's store-channel probe)."""
+    key = ("ss", sf, seed)
+    if key in _SALES_TABLES:
+        return _SALES_TABLES[key]
     n = int(SS_SF1_ROWS * sf)
     rng = np.random.default_rng(seed)
     lo, hi = SOLD_DATE_LO, SOLD_DATE_HI
-    n_items = n_items if n_items is not None else item_rows(sf)
-    n_ca = n_ca if n_ca is not None else ca_rows(sf)
+    n_items = item_rows(sf)
+    n_ca = ca_rows(sf)
     # Ticket runs: ~9 items per ticket in expectation.
     start = rng.random(n) < (1.0 / 9.0)
     if n:
@@ -340,7 +413,9 @@ def gen_store_sales(root: Path, sf: float = 1.0, seed: int = 60, files: int = 8,
             ).astype(np.int64),
             "ss_cdemo_sk": per_ticket(rng.integers(1, cd_rows(sf) + 1, n_t)).astype(np.int64),
             "ss_hdemo_sk": per_ticket(rng.integers(1, HD_ROWS + 1, n_t)).astype(np.int64),
-            "ss_addr_sk": per_ticket(rng.integers(1, n_ca + 1, n_t)).astype(np.int64),
+            "ss_addr_sk": _null_frac(
+                per_ticket(rng.integers(1, n_ca + 1, n_t)).astype(np.int64), 0.01, rng
+            ),
             "ss_store_sk": per_ticket(rng.integers(1, STORE_ROWS + 1, n_t)).astype(np.int64),
             "ss_promo_sk": rng.integers(1, 301, n).astype(np.int64),
             "ss_ticket_number": (tid + 1).astype(np.int64),
@@ -353,42 +428,359 @@ def gen_store_sales(root: Path, sf: float = 1.0, seed: int = 60, files: int = 8,
             "ss_net_profit": np.round(quantity * (sales_price - list_price * 0.5), 2),
         }
     )
-    return _parts(t, root, files)
+    _SALES_TABLES[key] = t
+    return t
+
+
+def gen_store_sales(root: Path, sf: float = 1.0, seed: int = 60, files: int = 8) -> int:
+    return _parts(_ss_table(sf, seed), root, files)
 
 
 CS_SF1_ROWS = 1_441_548
 WS_SF1_ROWS = 719_384
 
 
-def _gen_channel_sales(root: Path, prefix: str, n: int, sf: float, seed: int) -> int:
-    """catalog_sales / web_sales: the non-store channels' columns the
-    multi-channel queries touch (sold date, item, bill customer/address,
-    extended sales price)."""
+def _channel_table(prefix: str, sf: float, seed: int) -> pa.Table:
+    """catalog_sales / web_sales at full query width: sold/ship dates and
+    times, bill demographics/address, order numbers (~4-item orders),
+    warehouse / page / site / call-center / ship-mode / promo links, and
+    the quantity+price measure block. cs_ship_addr_sk and
+    ws_ship_customer_sk carry ~2% NULLs (q76's channel probes)."""
+    key = (prefix, sf, seed)
+    if key in _SALES_TABLES:
+        return _SALES_TABLES[key]
+    n = int((CS_SF1_ROWS if prefix == "cs" else WS_SF1_ROWS) * sf)
     rng = np.random.default_rng(seed)
+    n_items, n_cust, n_ca = item_rows(sf), customer_rows(sf), ca_rows(sf)
+    start = rng.random(n) < (1.0 / 4.0)
+    if n:
+        start[0] = True
+    oid = np.cumsum(start, dtype=np.int64) - 1
+    n_o = int(oid[-1]) + 1 if n else 0
+
+    def per_order(vals: np.ndarray) -> np.ndarray:
+        return vals[oid]
+
+    sold = per_order(rng.integers(SOLD_DATE_LO, SOLD_DATE_HI + 1, n_o)).astype(np.int64)
+    quantity = rng.integers(1, 101, n).astype(np.int32)
+    list_price = np.round(rng.random(n) * 190 + 10, 2)
+    sales_price = np.round(list_price * (0.2 + rng.random(n) * 0.8), 2)
+    ext_sales = np.round(quantity * sales_price, 2)
+    cols = {
+        "sold_date_sk": sold,
+        "sold_time_sk": per_order(rng.integers(0, 86_400, n_o)).astype(np.int64),
+        "ship_date_sk": sold + rng.integers(1, 31, n),
+        "item_sk": rng.integers(1, n_items + 1, n).astype(np.int64),
+        "bill_customer_sk": per_order(rng.integers(1, n_cust + 1, n_o)).astype(np.int64),
+        "bill_cdemo_sk": per_order(rng.integers(1, cd_rows(sf) + 1, n_o)).astype(np.int64),
+        "bill_hdemo_sk": per_order(rng.integers(1, HD_ROWS + 1, n_o)).astype(np.int64),
+        "bill_addr_sk": per_order(rng.integers(1, n_ca + 1, n_o)).astype(np.int64),
+        "ship_addr_sk": per_order(rng.integers(1, n_ca + 1, n_o)).astype(np.int64),
+        "warehouse_sk": rng.integers(1, WAREHOUSE_ROWS + 1, n).astype(np.int64),
+        "ship_mode_sk": per_order(rng.integers(1, SHIP_MODE_ROWS + 1, n_o)).astype(np.int64),
+        "promo_sk": rng.integers(1, 301, n).astype(np.int64),
+        "order_number": (oid + 1).astype(np.int64),
+        "quantity": quantity,
+        "list_price": list_price,
+        "sales_price": sales_price,
+        "coupon_amt": np.round(
+            np.where(rng.random(n) < 0.2, rng.random(n) * 50, 0.0), 2
+        ),
+        "ext_discount_amt": np.round(
+            np.where(rng.random(n) < 0.3, rng.random(n) * quantity * 20, 0.0), 2
+        ),
+        "ext_sales_price": ext_sales,
+        "ext_ship_cost": np.round(quantity * rng.random(n) * 10, 2),
+        "ext_list_price": np.round(quantity * list_price, 2),
+        "net_paid": ext_sales,
+        "net_profit": np.round(quantity * (sales_price - list_price * 0.5), 2),
+    }
+    if prefix == "cs":
+        cols["call_center_sk"] = per_order(rng.integers(1, CC_ROWS + 1, n_o)).astype(np.int64)
+        cols["catalog_page_sk"] = rng.integers(1, CATALOG_PAGE_ROWS + 1, n).astype(np.int64)
+        cols["ship_customer_sk"] = per_order(rng.integers(1, n_cust + 1, n_o)).astype(np.int64)
+    else:
+        cols["web_site_sk"] = per_order(rng.integers(1, WEB_SITE_ROWS + 1, n_o)).astype(np.int64)
+        cols["web_page_sk"] = rng.integers(1, WEB_PAGE_ROWS + 1, n).astype(np.int64)
+        cols["ship_hdemo_sk"] = per_order(rng.integers(1, HD_ROWS + 1, n_o)).astype(np.int64)
+    named = {}
+    for name, v in cols.items():
+        named[f"{prefix}_{name}"] = v
+    t_dict = dict(named)
+    # q76's NULL-FK probes: cs_ship_addr_sk / ws_ship_customer_sk.
+    if prefix == "cs":
+        t_dict["cs_ship_addr_sk"] = _null_frac(np.asarray(cols["ship_addr_sk"]), 0.02, rng)
+    else:
+        t_dict[f"{prefix}_ship_customer_sk"] = _null_frac(
+            per_order(rng.integers(1, n_cust + 1, n_o)).astype(np.int64), 0.02, rng
+        )
+    t = pa.table(t_dict)
+    _SALES_TABLES[key] = t
+    return t
+
+
+def gen_catalog_sales(root: Path, sf: float = 1.0, seed: int = 65) -> int:
+    return _parts(_channel_table("cs", sf, seed), root, 4)
+
+
+def gen_web_sales(root: Path, sf: float = 1.0, seed: int = 66) -> int:
+    return _parts(_channel_table("ws", sf, seed), root, 4)
+
+
+def _derive_returns(sales: pa.Table, prefix: str, out_prefix: str, frac: float,
+                    sf: float, seed: int, link_cols: dict, rng_extra=None) -> pa.Table:
+    """Returns derive from a sample of the channel's sold rows (dsdgen's
+    linkage): item + order/ticket keys copy from the sampled sale so
+    sales⋈returns joins land, dates land 1..90 days after the sale, and
+    the measure block scales off the sold quantity."""
+    rng = np.random.default_rng(seed)
+    n_s = sales.num_rows
+    n = int(n_s * frac)
+    idx = np.sort(rng.choice(n_s, size=n, replace=False))
+
+    def take(name):
+        return sales.column(name).take(pa.array(idx)).to_numpy(zero_copy_only=False)
+
+    sold = take(f"{prefix}_sold_date_sk").astype(np.int64)
+    qty = take(f"{prefix}_quantity").astype(np.int64) if f"{prefix}_quantity" in sales.column_names else rng.integers(1, 101, n)
+    price = take(f"{prefix}_sales_price")
+    rqty = np.minimum(rng.integers(1, 101, n), qty).astype(np.int32)
+    ramt = np.round(rqty * price, 2)
+    cols = {
+        f"{out_prefix}_returned_date_sk": sold + rng.integers(1, 91, n),
+        f"{out_prefix}_item_sk": take(f"{prefix}_item_sk").astype(np.int64),
+        f"{out_prefix}_reason_sk": rng.integers(1, REASON_ROWS + 1, n).astype(np.int64),
+        f"{out_prefix}_return_quantity": rqty,
+        f"{out_prefix}_return_amt": ramt,
+        f"{out_prefix}_fee": _money(rng, n, 100.0),
+        f"{out_prefix}_net_loss": np.round(ramt * (0.3 + rng.random(n) * 0.5) + 50, 2),
+    }
+    for out_name, src_name in link_cols.items():
+        cols[f"{out_prefix}_{out_name}"] = take(f"{prefix}_{src_name}").astype(np.int64)
+    if rng_extra is not None:
+        cols.update(rng_extra(rng, n))
+    return pa.table(cols)
+
+
+def gen_store_returns(root: Path, sf: float = 1.0, seed: int = 70) -> int:
+    """~10% of store_sales rows return; linked by (ticket, item) —
+    the q17/q25/q29/q50/q93 join grain."""
+    t = _derive_returns(
+        _ss_table(sf), "ss", "sr", 0.10, sf, seed,
+        {
+            "customer_sk": "customer_sk",
+            "store_sk": "store_sk",
+            "ticket_number": "ticket_number",
+            "cdemo_sk": "cdemo_sk",
+            "hdemo_sk": "hdemo_sk",
+        },
+        rng_extra=lambda rng, n: {
+            "sr_addr_sk": rng.integers(1, ca_rows(sf) + 1, n).astype(np.int64),
+        },
+    )
+    return _parts(t, root, 2)
+
+
+def gen_catalog_returns(root: Path, sf: float = 1.0, seed: int = 71) -> int:
+    t = _derive_returns(
+        _channel_table("cs", sf, 65), "cs", "cr", 0.10, sf, seed,
+        {
+            "returning_customer_sk": "bill_customer_sk",
+            "refunded_customer_sk": "bill_customer_sk",
+            "returning_addr_sk": "bill_addr_sk",
+            "refunded_cdemo_sk": "bill_cdemo_sk",
+            "call_center_sk": "call_center_sk",
+            "catalog_page_sk": "catalog_page_sk",
+            "order_number": "order_number",
+        },
+    )
+    return _parts(t, root, 2)
+
+
+def gen_web_returns(root: Path, sf: float = 1.0, seed: int = 72) -> int:
+    t = _derive_returns(
+        _channel_table("ws", sf, 66), "ws", "wr", 0.08, sf, seed,
+        {
+            "returning_customer_sk": "bill_customer_sk",
+            "refunded_customer_sk": "bill_customer_sk",
+            "returning_addr_sk": "bill_addr_sk",
+            "refunded_addr_sk": "bill_addr_sk",
+            "refunded_cdemo_sk": "bill_cdemo_sk",
+            "refunded_hdemo_sk": "bill_hdemo_sk",
+            "web_page_sk": "web_page_sk",
+            "order_number": "order_number",
+        },
+        rng_extra=lambda rng, n: {
+            # The returner's demographics usually but not always match
+            # the buyer's (q85 compares cd1 vs cd2 attributes).
+            "wr_returning_cdemo_sk": rng.integers(1, cd_rows(sf) + 1, n).astype(np.int64),
+        },
+    )
+    return _parts(t, root, 2)
+
+
+def gen_inventory(root: Path, sf: float = 1.0, seed: int = 73) -> int:
+    """Weekly on-hand quantity per (item, warehouse): Mondays across the
+    1998-2002 probe window x a quarter of items x 3 warehouses — the
+    dsdgen grain thinned to keep the SF1 table near store_sales size
+    (the full cross product would be ~8x; queries probe narrow date
+    bands either way)."""
+    rng = np.random.default_rng(seed)
+    days = np.arange(SOLD_DATE_LO, SOLD_DATE_HI + 1, dtype=np.int64)
+    dows = (days - DD_SK0 + 4) % 7  # same numbering as gen_date_dim
+    mondays = days[dows == 1]
+    items = np.arange(1, item_rows(sf) + 1, 4, dtype=np.int64)
+    whs = np.arange(1, 4, dtype=np.int64)
+    d, i, w = np.meshgrid(mondays, items, whs, indexing="ij")
+    n = d.size
     t = pa.table(
         {
-            f"{prefix}_sold_date_sk": rng.integers(SOLD_DATE_LO, SOLD_DATE_HI + 1, n).astype(np.int64),
-            f"{prefix}_item_sk": rng.integers(1, item_rows(sf) + 1, n).astype(np.int64),
-            f"{prefix}_bill_customer_sk": rng.integers(1, customer_rows(sf) + 1, n).astype(np.int64),
-            f"{prefix}_bill_addr_sk": rng.integers(1, ca_rows(sf) + 1, n).astype(np.int64),
-            f"{prefix}_ext_sales_price": np.round(rng.random(n) * 200 * rng.integers(1, 101, n), 2),
+            "inv_date_sk": d.ravel(),
+            "inv_item_sk": i.ravel(),
+            "inv_warehouse_sk": w.ravel(),
+            "inv_quantity_on_hand": rng.integers(0, 1001, n).astype(np.int32),
         }
     )
     return _parts(t, root, 4)
 
 
-def gen_catalog_sales(root: Path, sf: float = 1.0, seed: int = 65) -> int:
-    return _gen_channel_sales(root, "cs", int(CS_SF1_ROWS * sf), sf, seed)
+def gen_warehouse(root: Path) -> int:
+    n = WAREHOUSE_ROWS
+    i = np.arange(n)
+    t = pa.table(
+        {
+            "w_warehouse_sk": (i + 1).astype(np.int64),
+            "w_warehouse_name": pa.array(
+                np.array(["Conventional childr", "Important issues liv", "Doors canno",
+                          "Bad cards must make.", "Rooms cook "], dtype=object)[:n]
+            ),
+            "w_warehouse_sq_ft": ((i + 1) * 97_312 % 900_000 + 50_000).astype(np.int32),
+            "w_city": pa.array(_CITIES[i % len(_CITIES)]),
+            "w_county": pa.array(
+                np.array(["Ziebach County", "Williamson County", "Walker County",
+                          "Daviess County"], dtype=object)[i % 4]
+            ),
+            "w_state": pa.array(_STATES[i % len(_STATES)]),
+            "w_country": pa.array(np.full(n, "United States", dtype=object)),
+        }
+    )
+    return _parts(t, root, 1)
 
 
-def gen_web_sales(root: Path, sf: float = 1.0, seed: int = 66) -> int:
-    return _gen_channel_sales(root, "ws", int(WS_SF1_ROWS * sf), sf, seed)
+def gen_reason(root: Path) -> int:
+    i = np.arange(REASON_ROWS)
+    t = pa.table(
+        {
+            "r_reason_sk": (i + 1).astype(np.int64),
+            "r_reason_desc": pa.array(
+                np.char.add("reason ", (i + 1).astype("U2")).astype(object)
+            ),
+        }
+    )
+    return _parts(t, root, 1)
+
+
+def gen_ship_mode(root: Path) -> int:
+    i = np.arange(SHIP_MODE_ROWS)
+    types = np.array(["EXPRESS", "NEXT DAY", "OVERNIGHT", "REGULAR", "TWO DAY"], dtype=object)
+    t = pa.table(
+        {
+            "sm_ship_mode_sk": (i + 1).astype(np.int64),
+            "sm_type": pa.array(types[i % 5]),
+            "sm_code": pa.array(
+                np.array(["AIR", "SURFACE", "SEA"], dtype=object)[i % 3]
+            ),
+            "sm_carrier": pa.array(
+                np.char.add("carrier", (i % 7).astype("U1")).astype(object)
+            ),
+        }
+    )
+    return _parts(t, root, 1)
+
+
+def gen_call_center(root: Path) -> int:
+    i = np.arange(CC_ROWS)
+    t = pa.table(
+        {
+            "cc_call_center_sk": (i + 1).astype(np.int64),
+            "cc_call_center_id": pa.array(
+                np.char.add("AAAAAAAA", i.astype("U1")).astype(object)
+            ),
+            "cc_name": pa.array(
+                np.array(["NY Metro", "Mid Atlantic", "Pacific NW", "North Midwest",
+                          "California", "Hawaii/Alaska"], dtype=object)[:CC_ROWS]
+            ),
+            "cc_manager": pa.array(
+                np.char.add("Manager ", (i + 1).astype("U1")).astype(object)
+            ),
+            "cc_county": pa.array(
+                np.array(["Ziebach County", "Williamson County", "Walker County",
+                          "Daviess County"], dtype=object)[i % 4]
+            ),
+        }
+    )
+    return _parts(t, root, 1)
+
+
+def gen_web_site(root: Path) -> int:
+    i = np.arange(WEB_SITE_ROWS)
+    t = pa.table(
+        {
+            "web_site_sk": (i + 1).astype(np.int64),
+            "web_site_id": pa.array(np.char.add("AAAAAAAA", i.astype("U2")).astype(object)),
+            "web_name": pa.array(np.char.add("site_", (i % 10).astype("U1")).astype(object)),
+            "web_company_name": pa.array(
+                np.array(["pri", "able", "ought", "ese", "anti", "cally"], dtype=object)[i % 6]
+            ),
+        }
+    )
+    return _parts(t, root, 1)
+
+
+def gen_web_page(root: Path) -> int:
+    i = np.arange(WEB_PAGE_ROWS)
+    t = pa.table(
+        {
+            "wp_web_page_sk": (i + 1).astype(np.int64),
+            "wp_char_count": (i * 229 % 8000 + 100).astype(np.int32),
+        }
+    )
+    return _parts(t, root, 1)
+
+
+def gen_catalog_page(root: Path) -> int:
+    i = np.arange(CATALOG_PAGE_ROWS)
+    t = pa.table(
+        {
+            "cp_catalog_page_sk": (i + 1).astype(np.int64),
+            "cp_catalog_page_id": pa.array(
+                np.char.add("AAAAAAAA", i.astype("U6")).astype(object)
+            ),
+        }
+    )
+    return _parts(t, root, 1)
+
+
+def gen_income_band(root: Path) -> int:
+    i = np.arange(20)
+    t = pa.table(
+        {
+            "ib_income_band_sk": (i + 1).astype(np.int64),
+            "ib_lower_bound": (i * 10_000 + 1).astype(np.int32),
+            "ib_upper_bound": ((i + 1) * 10_000).astype(np.int32),
+        }
+    )
+    return _parts(t, root, 1)
 
 
 _GENS = {
     "store_sales": gen_store_sales,
     "catalog_sales": gen_catalog_sales,
     "web_sales": gen_web_sales,
+    "store_returns": gen_store_returns,
+    "catalog_returns": gen_catalog_returns,
+    "web_returns": gen_web_returns,
+    "inventory": gen_inventory,
     "date_dim": lambda root, sf=1.0: gen_date_dim(root),
     "item": gen_item,
     "store": lambda root, sf=1.0: gen_store(root),
@@ -398,6 +790,14 @@ _GENS = {
     "time_dim": lambda root, sf=1.0: gen_time_dim(root),
     "customer_address": gen_customer_address,
     "promotion": lambda root, sf=1.0: gen_promotion(root),
+    "warehouse": lambda root, sf=1.0: gen_warehouse(root),
+    "reason": lambda root, sf=1.0: gen_reason(root),
+    "ship_mode": lambda root, sf=1.0: gen_ship_mode(root),
+    "call_center": lambda root, sf=1.0: gen_call_center(root),
+    "web_site": lambda root, sf=1.0: gen_web_site(root),
+    "web_page": lambda root, sf=1.0: gen_web_page(root),
+    "catalog_page": lambda root, sf=1.0: gen_catalog_page(root),
+    "income_band": lambda root, sf=1.0: gen_income_band(root),
 }
 
 TABLES = tuple(_GENS)
@@ -407,17 +807,21 @@ def cached_tpcds(sf: float = 1.0, cache_root: Path | None = None) -> dict[str, P
     import shutil
     import tempfile
 
-    # v3: + catalog_sales/web_sales channels (bump the suffix whenever
-    # datagen changes, or stale /tmp data is silently reused).
-    base = cache_root or Path(tempfile.gettempdir()) / f"hs_tpcds_v3_sf{sf:g}"
+    # v4: + returns channels / inventory / shipping dims, wider
+    # customer/item/channel facts (bump the suffix whenever datagen
+    # changes, or stale /tmp data is silently reused).
+    base = cache_root or Path(tempfile.gettempdir()) / f"hs_tpcds_v4_sf{sf:g}"
     roots = {}
-    for name, gen in _GENS.items():
-        root = base / name
-        if not (root / "_COMPLETE").exists():
-            shutil.rmtree(root, ignore_errors=True)
-            gen(root, sf=sf)
-            (root / "_COMPLETE").touch()
-        roots[name] = root
+    try:
+        for name, gen in _GENS.items():
+            root = base / name
+            if not (root / "_COMPLETE").exists():
+                shutil.rmtree(root, ignore_errors=True)
+                gen(root, sf=sf)
+                (root / "_COMPLETE").touch()
+            roots[name] = root
+    finally:
+        _SALES_TABLES.clear()  # don't pin SF10+ fact tables in memory
     return roots
 
 
@@ -1401,7 +1805,7 @@ def tpcds_queries(t: dict) -> dict:
         [("i_item_id", True), ("total_sales", True)],
     )
 
-    return {
+    out = {
         "q3": q3, "q6": q6, "q7": q7, "q13": q13, "q19": q19, "q27": q27,
         "q34": q34, "q36": q36, "q42": q42, "q43": q43, "q44": q44,
         "q33": q33, "q46": q46, "q48": q48, "q52": q52, "q53": q53,
@@ -1409,6 +1813,10 @@ def tpcds_queries(t: dict) -> dict:
         "q67": q67, "q68": q68, "q70": q70, "q73": q73, "q79": q79,
         "q88": q88, "q89": q89, "q96": q96, "q98": q98,
     }
+    from benchmarks.tpcds_ext import tpcds_extra_queries
+
+    out.update(tpcds_extra_queries(t))
+    return out
 
 
 def tpcds_indexes(hs, scans: dict) -> None:
@@ -1437,13 +1845,64 @@ def tpcds_indexes(hs, scans: dict) -> None:
     hs.create_index(ss, IndexConfig(
         "ss_by_store", ["ss_store_sk"], ["ss_item_sk", "ss_net_profit"],
     ))
+    hs.create_index(ss, IndexConfig(
+        "ss_by_ticket_item", ["ss_ticket_number", "ss_item_sk"],
+        ["ss_customer_sk", "ss_sold_date_sk", "ss_quantity", "ss_sales_price",
+         "ss_store_sk", "ss_net_profit"],
+    ))
     hs.create_index(scans["catalog_sales"], IndexConfig(
         "cs_by_date", ["cs_sold_date_sk"],
-        ["cs_item_sk", "cs_bill_addr_sk", "cs_ext_sales_price"],
+        ["cs_sold_time_sk", "cs_ship_date_sk", "cs_item_sk", "cs_bill_customer_sk",
+         "cs_bill_cdemo_sk", "cs_bill_hdemo_sk", "cs_bill_addr_sk", "cs_warehouse_sk",
+         "cs_call_center_sk", "cs_promo_sk", "cs_order_number", "cs_quantity",
+         "cs_list_price", "cs_sales_price", "cs_coupon_amt", "cs_ext_discount_amt",
+         "cs_ext_sales_price", "cs_net_profit"],
+    ))
+    hs.create_index(scans["catalog_sales"], IndexConfig(
+        "cs_by_ship_date", ["cs_ship_date_sk"],
+        ["cs_sold_date_sk", "cs_ship_addr_sk", "cs_order_number", "cs_warehouse_sk",
+         "cs_ship_mode_sk", "cs_call_center_sk", "cs_ext_ship_cost", "cs_net_profit"],
     ))
     hs.create_index(scans["web_sales"], IndexConfig(
         "ws_by_date", ["ws_sold_date_sk"],
-        ["ws_item_sk", "ws_bill_addr_sk", "ws_ext_sales_price"],
+        ["ws_sold_time_sk", "ws_ship_date_sk", "ws_item_sk", "ws_bill_customer_sk",
+         "ws_bill_addr_sk", "ws_ship_customer_sk", "ws_ship_hdemo_sk",
+         "ws_web_page_sk", "ws_web_site_sk", "ws_quantity", "ws_sales_price",
+         "ws_ext_discount_amt", "ws_ext_sales_price", "ws_net_paid", "ws_net_profit",
+         "ws_order_number"],
+    ))
+    hs.create_index(scans["web_sales"], IndexConfig(
+        "ws_by_ship_date", ["ws_ship_date_sk"],
+        ["ws_sold_date_sk", "ws_ship_addr_sk", "ws_order_number", "ws_warehouse_sk",
+         "ws_ship_mode_sk", "ws_web_site_sk", "ws_ext_ship_cost", "ws_net_profit"],
+    ))
+    hs.create_index(scans["store_returns"], IndexConfig(
+        "sr_by_date", ["sr_returned_date_sk"],
+        ["sr_item_sk", "sr_customer_sk", "sr_store_sk", "sr_ticket_number",
+         "sr_cdemo_sk", "sr_reason_sk", "sr_return_quantity", "sr_return_amt",
+         "sr_fee", "sr_net_loss"],
+    ))
+    hs.create_index(scans["store_returns"], IndexConfig(
+        "sr_by_ticket_item", ["sr_ticket_number", "sr_item_sk"],
+        ["sr_customer_sk", "sr_returned_date_sk", "sr_reason_sk",
+         "sr_return_quantity", "sr_return_amt", "sr_net_loss"],
+    ))
+    hs.create_index(scans["catalog_returns"], IndexConfig(
+        "cr_by_date", ["cr_returned_date_sk"],
+        ["cr_item_sk", "cr_order_number", "cr_returning_customer_sk",
+         "cr_returning_addr_sk", "cr_call_center_sk", "cr_reason_sk",
+         "cr_return_quantity", "cr_return_amt", "cr_net_loss"],
+    ))
+    hs.create_index(scans["web_returns"], IndexConfig(
+        "wr_by_date", ["wr_returned_date_sk"],
+        ["wr_item_sk", "wr_order_number", "wr_returning_customer_sk",
+         "wr_returning_addr_sk", "wr_refunded_cdemo_sk", "wr_returning_cdemo_sk",
+         "wr_refunded_addr_sk", "wr_reason_sk", "wr_web_page_sk",
+         "wr_return_quantity", "wr_return_amt", "wr_fee", "wr_net_loss"],
+    ))
+    hs.create_index(scans["inventory"], IndexConfig(
+        "inv_by_date", ["inv_date_sk"],
+        ["inv_item_sk", "inv_warehouse_sk", "inv_quantity_on_hand"],
     ))
     hs.create_index(dd, IndexConfig(
         "dd_by_sk", ["d_date_sk"],

@@ -11,7 +11,18 @@ sort, gather/filter and merge-join kernels); the host plane is pure Python.
 
 from hyperspace_tpu.exceptions import HyperspaceError
 from hyperspace_tpu.index.index_config import IndexConfig
-from hyperspace_tpu.plan.expr import col, date_lit, day, lit, month, when, year
+from hyperspace_tpu.plan.expr import (
+    abs_,
+    col,
+    date_lit,
+    day,
+    floor,
+    lit,
+    month,
+    sqrt,
+    when,
+    year,
+)
 from hyperspace_tpu.plan.nodes import AggSpec, WindowSpec
 from hyperspace_tpu.schema import Field, Schema
 
@@ -22,6 +33,9 @@ __all__ = [
     "IndexConfig",
     "col",
     "when",
+    "sqrt",
+    "abs_",
+    "floor",
     "AggSpec",
     "WindowSpec",
     "lit",

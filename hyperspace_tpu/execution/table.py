@@ -233,7 +233,10 @@ class ColumnTable:
             else:
                 vals = arr
             valid = self.validity.get(f.name)
-            if valid is not None:
+            if valid is not None and not valid.all():
+                # An all-true mask (e.g. after filtering the null rows
+                # away) keeps the natural dtype — object arrays force
+                # exact comparison on floats downstream.
                 vals = vals.astype(object)
                 vals[~valid] = None
             out[f.name] = vals

@@ -237,6 +237,14 @@ def _expr_input(table: ColumnTable, e) -> tuple[np.ndarray, np.ndarray | None]:
         else:
             valid = av & bv
         return vals, valid
+    from hyperspace_tpu.plan.expr import MathFn as _MathFn
+
+    if isinstance(e, _MathFn):
+        vals, valid = _expr_input(table, e.child)
+        out = evaluate(
+            _MathFn(e.fn, Col("__a__")), lambda name: np.asarray(vals), np
+        )
+        return np.asarray(out), valid
     raise HyperspaceError(f"cannot aggregate over expression {type(e).__name__}")
 
 

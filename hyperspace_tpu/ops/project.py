@@ -150,6 +150,16 @@ def compute_column(
         vals, valid = _bool_column(table, e)
         return vals, None, valid
     if dtype == "string":
+        from hyperspace_tpu.plan.expr import Lit
+
+        if isinstance(e, Lit) and isinstance(e.value, str):
+            # Constant string column (q76's channel labels): one-entry
+            # dictionary, all codes zero.
+            return (
+                np.zeros(table.num_rows, np.int32),
+                np.array([e.value], dtype=object),
+                None,
+            )
         return _string_case_column(table, e)
     vals, valid = _numeric_input(table, e)
     phys = Field("_", dtype).device_dtype

@@ -285,6 +285,37 @@ class Substr(Expr):
 
 
 @dataclasses.dataclass(eq=False, repr=True)
+class MathFn(Expr):
+    """Unary numeric function: sqrt / abs / floor (SQL STDDEV recompose,
+    ABS deviations, FLOOR bucket arithmetic — q17/q39/q54 shapes)."""
+
+    fn: str  # sqrt | abs | floor
+    child: Expr
+
+    def __post_init__(self):
+        if self.fn not in ("sqrt", "abs", "floor"):
+            raise ValueError(f"unknown math fn {self.fn!r}")
+
+    def to_json(self):
+        return {"type": "mathfn", "fn": self.fn, "child": self.child.to_json()}
+
+    def references(self):
+        return self.child.references()
+
+
+def sqrt(e: Expr) -> MathFn:
+    return MathFn("sqrt", e)
+
+
+def abs_(e: Expr) -> MathFn:
+    return MathFn("abs", e)
+
+
+def floor(e: Expr) -> MathFn:
+    return MathFn("floor", e)
+
+
+@dataclasses.dataclass(eq=False, repr=True)
 class DatePart(Expr):
     """Extract year/month/day from a date column (int32 days since
     epoch). Comparisons against literals translate to equivalent day
@@ -382,6 +413,8 @@ def expr_from_json(d: dict[str, Any]) -> Expr:
         return Substr(expr_from_json(d["child"]), int(d["start"]), int(d["length"]))
     if t == "datepart":
         return DatePart(d["part"], expr_from_json(d["child"]))
+    if t == "mathfn":
+        return MathFn(d["fn"], expr_from_json(d["child"]))
     raise ValueError(f"unknown expr type {t!r}")
 
 
@@ -441,6 +474,12 @@ def expr_dtype(e: Expr, schema) -> str:
         raise ValueError(f"CASE branches mix incompatible types {ts}")
     if isinstance(e, DatePart):
         return "int64"
+    if isinstance(e, MathFn):
+        if e.fn == "sqrt":
+            return "float64"
+        if e.fn == "floor":
+            return "int64"
+        return expr_dtype(e.child, schema)  # abs preserves
     if isinstance(e, Substr):
         return "string"
     raise ValueError(f"cannot type expression {type(e).__name__}")
@@ -504,6 +543,18 @@ def evaluate(e: Expr, resolve: Callable[[str], Any], xp) -> Any:
         return out
     if isinstance(e, DatePart):
         return eval_date_part(e.part, evaluate(e.child, resolve, xp), xp)
+    if isinstance(e, MathFn):
+        v = evaluate(e.child, resolve, xp)
+        if e.fn == "sqrt":
+            import numpy as _np
+
+            if xp is _np:
+                with _np.errstate(invalid="ignore"):
+                    return xp.sqrt(v)
+            return xp.sqrt(v)
+        if e.fn == "abs":
+            return xp.abs(v)
+        return xp.floor(v).astype(xp.int64)
     if isinstance(e, InList):
         v = evaluate(e.child, resolve, xp)
         out = None

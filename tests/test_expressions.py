@@ -352,3 +352,20 @@ def test_scattered_like_over_large_dictionary(tmp_path):
     got = run_both_venues(session, ds.filter(col("c").like("%1 text")))
     exp = df[df.c.str.endswith("1 text")]
     assert len(got) == len(exp)
+
+
+def test_mathfn_roundtrip_and_eval():
+    import numpy as np
+
+    from hyperspace_tpu import abs_, col, floor, sqrt
+    from hyperspace_tpu.plan.expr import evaluate, expr_from_json
+
+    e = sqrt((col("x") * col("x") - col("x")) / (col("n") - 1))
+    assert expr_from_json(e.to_json()).to_json() == e.to_json()
+    vals = {"x": np.array([3.0, 5.0]), "n": np.array([3.0, 2.0])}
+    out = evaluate(e, lambda n: vals[n], np)
+    np.testing.assert_allclose(out, np.sqrt([(9 - 3) / 2, (25 - 5) / 1]))
+    assert evaluate(floor(col("x") / 2), lambda n: vals[n], np).dtype == np.int64
+    np.testing.assert_array_equal(
+        evaluate(abs_(col("x") - 4), lambda n: vals[n], np), [1.0, 1.0]
+    )
