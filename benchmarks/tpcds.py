@@ -462,6 +462,24 @@ def _channel_table(prefix: str, sf: float, seed: int) -> pa.Table:
         return vals[oid]
 
     sold = per_order(rng.integers(SOLD_DATE_LO, SOLD_DATE_HI + 1, n_o)).astype(np.int64)
+    # Cross-channel correlation (dsdgen ties catalog/web activity to the
+    # store channel): ~15% of ORDERS belong to a store customer and open
+    # with an item that customer actually bought in store_sales — the
+    # buy-return-rebuy triangles (q17/q25/q29) and cross-channel
+    # customer overlaps depend on this overlap. Order granularity keeps
+    # the one-customer-per-order invariant intact.
+    item_sk = rng.integers(1, n_items + 1, n).astype(np.int64)
+    cust_o = rng.integers(1, n_cust + 1, n_o).astype(np.int64)
+    ss_t = _ss_table(sf)
+    if ss_t.num_rows and n_o:
+        pick_o = rng.random(n_o) < 0.15
+        src_o = rng.integers(0, ss_t.num_rows, n_o)
+        ss_cust = ss_t.column("ss_customer_sk").to_numpy(zero_copy_only=False)
+        ss_item = ss_t.column("ss_item_sk").to_numpy(zero_copy_only=False)
+        cust_o[pick_o] = ss_cust[src_o[pick_o]]
+        first_of_picked = start & pick_o[oid]
+        item_sk[first_of_picked] = ss_item[src_o[oid[first_of_picked]]]
+    bill_customer = cust_o[oid]
     quantity = rng.integers(1, 101, n).astype(np.int32)
     list_price = np.round(rng.random(n) * 190 + 10, 2)
     sales_price = np.round(list_price * (0.2 + rng.random(n) * 0.8), 2)
@@ -470,8 +488,8 @@ def _channel_table(prefix: str, sf: float, seed: int) -> pa.Table:
         "sold_date_sk": sold,
         "sold_time_sk": per_order(rng.integers(0, 86_400, n_o)).astype(np.int64),
         "ship_date_sk": sold + rng.integers(1, 31, n),
-        "item_sk": rng.integers(1, n_items + 1, n).astype(np.int64),
-        "bill_customer_sk": per_order(rng.integers(1, n_cust + 1, n_o)).astype(np.int64),
+        "item_sk": item_sk,
+        "bill_customer_sk": bill_customer,
         "bill_cdemo_sk": per_order(rng.integers(1, cd_rows(sf) + 1, n_o)).astype(np.int64),
         "bill_hdemo_sk": per_order(rng.integers(1, HD_ROWS + 1, n_o)).astype(np.int64),
         "bill_addr_sk": per_order(rng.integers(1, n_ca + 1, n_o)).astype(np.int64),
@@ -558,7 +576,7 @@ def _derive_returns(sales: pa.Table, prefix: str, out_prefix: str, frac: float,
     for out_name, src_name in link_cols.items():
         cols[f"{out_prefix}_{out_name}"] = take(f"{prefix}_{src_name}").astype(np.int64)
     if rng_extra is not None:
-        cols.update(rng_extra(rng, n))
+        cols.update(rng_extra(rng, n, take))
     return pa.table(cols)
 
 
@@ -574,7 +592,7 @@ def gen_store_returns(root: Path, sf: float = 1.0, seed: int = 70) -> int:
             "cdemo_sk": "cdemo_sk",
             "hdemo_sk": "hdemo_sk",
         },
-        rng_extra=lambda rng, n: {
+        rng_extra=lambda rng, n, take: {
             "sr_addr_sk": rng.integers(1, ca_rows(sf) + 1, n).astype(np.int64),
         },
     )
@@ -610,10 +628,14 @@ def gen_web_returns(root: Path, sf: float = 1.0, seed: int = 72) -> int:
             "web_page_sk": "web_page_sk",
             "order_number": "order_number",
         },
-        rng_extra=lambda rng, n: {
-            # The returner's demographics usually but not always match
-            # the buyer's (q85 compares cd1 vs cd2 attributes).
-            "wr_returning_cdemo_sk": rng.integers(1, cd_rows(sf) + 1, n).astype(np.int64),
+        rng_extra=lambda rng, n, take: {
+            # The returner's demographics usually (80%) match the
+            # buyer's (q85 equates cd1/cd2 attributes over these keys).
+            "wr_returning_cdemo_sk": np.where(
+                rng.random(n) < 0.8,
+                take("ws_bill_cdemo_sk").astype(np.int64),
+                rng.integers(1, cd_rows(sf) + 1, n),
+            ).astype(np.int64),
         },
     )
     return _parts(t, root, 2)
@@ -807,10 +829,10 @@ def cached_tpcds(sf: float = 1.0, cache_root: Path | None = None) -> dict[str, P
     import shutil
     import tempfile
 
-    # v4: + returns channels / inventory / shipping dims, wider
-    # customer/item/channel facts (bump the suffix whenever datagen
-    # changes, or stale /tmp data is silently reused).
-    base = cache_root or Path(tempfile.gettempdir()) / f"hs_tpcds_v4_sf{sf:g}"
+    # v5: cross-channel (customer, item) correlation + returner-cdemo
+    # agreement (bump the suffix whenever datagen changes, or stale /tmp
+    # data is silently reused).
+    base = cache_root or Path(tempfile.gettempdir()) / f"hs_tpcds_v5_sf{sf:g}"
     roots = {}
     try:
         for name, gen in _GENS.items():
@@ -1903,6 +1925,32 @@ def tpcds_indexes(hs, scans: dict) -> None:
     hs.create_index(scans["inventory"], IndexConfig(
         "inv_by_date", ["inv_date_sk"],
         ["inv_item_sk", "inv_warehouse_sk", "inv_quantity_on_hand"],
+    ))
+    hs.create_index(scans["catalog_sales"], IndexConfig(
+        "cs_by_cdemo", ["cs_bill_cdemo_sk"],
+        ["cs_sold_date_sk", "cs_item_sk", "cs_bill_customer_sk", "cs_quantity",
+         "cs_list_price", "cs_coupon_amt", "cs_sales_price", "cs_net_profit"],
+    ))
+    hs.create_index(scans["web_sales"], IndexConfig(
+        "ws_by_hdemo", ["ws_ship_hdemo_sk"], ["ws_sold_time_sk", "ws_web_page_sk"],
+    ))
+    hs.create_index(scans["catalog_sales"], IndexConfig(
+        "cs_by_order_item", ["cs_order_number", "cs_item_sk"],
+        ["cs_sold_date_sk", "cs_ship_date_sk", "cs_warehouse_sk", "cs_quantity",
+         "cs_sales_price", "cs_promo_sk", "cs_bill_cdemo_sk", "cs_bill_hdemo_sk"],
+    ))
+    hs.create_index(scans["catalog_returns"], IndexConfig(
+        "cr_by_order_item", ["cr_order_number", "cr_item_sk"], ["cr_return_amt"],
+    ))
+    hs.create_index(scans["web_sales"], IndexConfig(
+        "ws_by_order_item", ["ws_order_number", "ws_item_sk"],
+        ["ws_web_page_sk", "ws_sold_date_sk", "ws_quantity", "ws_sales_price",
+         "ws_net_profit"],
+    ))
+    hs.create_index(scans["web_returns"], IndexConfig(
+        "wr_by_order_item", ["wr_order_number", "wr_item_sk"],
+        ["wr_refunded_cdemo_sk", "wr_returning_cdemo_sk", "wr_reason_sk",
+         "wr_refunded_addr_sk", "wr_return_amt", "wr_fee"],
     ))
     hs.create_index(dd, IndexConfig(
         "dd_by_sk", ["d_date_sk"],

@@ -620,8 +620,10 @@ def tpcds_extra_queries(t: dict) -> dict:
         .join(
             sr.select("sr_item_sk", "sr_ticket_number", "sr_customer_sk",
                       "sr_returned_date_sk"),
-            ["ss_item_sk", "ss_ticket_number", "ss_customer_sk"],
-            ["sr_item_sk", "sr_ticket_number", "sr_customer_sk"],
+            # Same (ticket, item) + customer-residual shape as q17.
+            ["ss_ticket_number", "ss_item_sk"],
+            ["sr_ticket_number", "sr_item_sk"],
+            condition=col("ss_customer_sk") == col("sr_customer_sk"),
         )
         .join(
             dd.select("d_date_sk", "d_year", "d_moy").filter(
@@ -665,8 +667,12 @@ def tpcds_extra_queries(t: dict) -> dict:
             .join(
                 sr.select("sr_item_sk", "sr_ticket_number", "sr_customer_sk",
                           "sr_returned_date_sk", "sr_return_quantity", "sr_net_loss"),
-                ["ss_customer_sk", "ss_item_sk", "ss_ticket_number"],
-                ["sr_customer_sk", "sr_item_sk", "sr_ticket_number"],
+                # (ticket, item) rides the bucketed ticket+item indexes;
+                # the published third equi-key (customer) stays an ON
+                # residual — same matches, aligned execution.
+                ["ss_ticket_number", "ss_item_sk"],
+                ["sr_ticket_number", "sr_item_sk"],
+                condition=col("ss_customer_sk") == col("sr_customer_sk"),
             )
             .join(
                 dd.select(("d2_sk", col("d_date_sk")), ("d2_year", col("d_year")),
@@ -888,7 +894,7 @@ def tpcds_extra_queries(t: dict) -> dict:
             wr.select("wr_item_sk", "wr_order_number", "wr_refunded_cdemo_sk",
                       "wr_returning_cdemo_sk", "wr_reason_sk", "wr_refunded_addr_sk",
                       "wr_return_amt", "wr_fee"),
-            ["ws_item_sk", "ws_order_number"], ["wr_item_sk", "wr_order_number"],
+            ["ws_order_number", "ws_item_sk"], ["wr_order_number", "wr_item_sk"],
         )
         .join(dd.select("d_date_sk", "d_year").filter(col("d_year") == lit(2000)),
               ["ws_sold_date_sk"], ["d_date_sk"])
@@ -1505,7 +1511,7 @@ def tpcds_extra_queries(t: dict) -> dict:
               ["cs_promo_sk"], ["p_promo_sk"], how="left")
         .join(
             cr.select("cr_item_sk", "cr_order_number"),
-            ["cs_item_sk", "cs_order_number"], ["cr_item_sk", "cr_order_number"],
+            ["cs_order_number", "cs_item_sk"], ["cr_order_number", "cr_item_sk"],
             how="left",
         )
         .aggregate(

@@ -1,0 +1,634 @@
+"""Join side preparation: aligned-side detection, bucket data, the
+re-bucketing exchange, and dynamic partition pruning (Executor mixin)."""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+
+import numpy as np
+
+from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu.execution import io as hio
+from hyperspace_tpu.execution.builder import compute_row_hashes, hash_scalar_key
+from hyperspace_tpu.execution.table import ColumnTable
+from hyperspace_tpu.dataset import format_suffix, list_data_files
+from hyperspace_tpu.ops.filter import apply_filter, eval_predicate_mask
+from hyperspace_tpu.ops.hashing import bucket_ids
+from hyperspace_tpu.ops import join as join_ops
+from hyperspace_tpu.plan.expr import And, BinOp, Col, Expr, Lit, evaluate, split_conjuncts
+from hyperspace_tpu.plan.nodes import (
+    Aggregate,
+    Filter,
+    Join,
+    Limit,
+    LogicalPlan,
+    Project,
+    Scan,
+    Sort,
+    Union,
+    Window,
+)
+
+from hyperspace_tpu.execution.exec_common import (
+    AlignedSide,
+    SideData,
+    _concat_side_cached,
+    _filter_side,
+    _hash_fields_compatible,
+    _stable_table_refs,
+)
+
+
+class JoinSidesMixin:
+    @staticmethod
+    def _bucket_hash_dtypes(scan: Scan) -> tuple[str, ...]:
+        """The hash domain of a scan's bucket columns. The canonical row
+        hash is dtype-sensitive (an int64 mixes two words; an int32 one),
+        so two bucketings agree on equal key VALUES only when the bucket
+        column dtypes agree."""
+        out = []
+        for c in scan.bucket_spec[1]:
+            f = scan.scan_schema.field(c)
+            out.append("string" if f.is_string else str(np.dtype(f.device_dtype)))
+        return tuple(out)
+
+    def _keyed_on_buckets(self, side: AlignedSide | None, join_on: list[str]) -> bool:
+        """True iff the side is an index scan bucketed exactly on its
+        join keys (the precondition for any bucket-parallel pairing)."""
+        return (
+            side is not None
+            and side.scan.bucket_spec is not None
+            and [c.lower() for c in side.scan.bucket_spec[1]]
+            == [c.lower() for c in join_on]
+        )
+
+    def _join_sides(
+        self, plan: Join
+    ) -> tuple["SideData", "SideData", AlignedSide | None, AlignedSide | None]:
+        """Per-side bucket data for a join — the one place that decides
+        between the zero-exchange aligned path (both sides bucketed with
+        equal counts on the join keys), the re-bucketing exchange (one
+        side bucketed, the other re-bucketized on the fly to match), a
+        bucket-preserving reuse of an inner join's output grouping, and
+        the single-partition fallback. Returns the AlignedSides
+        (None, None) on every non-both-aligned path."""
+        left_side = self._aligned_side(plan.left)
+        right_side = self._aligned_side(plan.right)
+        if (
+            self._keyed_on_buckets(left_side, plan.left_on)
+            and self._keyed_on_buckets(right_side, plan.right_on)
+            and left_side.scan.bucket_spec[0] == right_side.scan.bucket_spec[0]
+            # Equal VALUES hash identically only in equal dtype domains.
+            and self._bucket_hash_dtypes(left_side.scan)
+            == self._bucket_hash_dtypes(right_side.scan)
+        ):
+            self.stats["join_path"] = "zero-exchange-aligned"
+            num_buckets = left_side.scan.bucket_spec[0]
+            # Dynamic partition pruning (the analog of Spark 3's DPP,
+            # which post-dates the reference's engine): build the
+            # predicate-bearing side FIRST, bound its surviving join
+            # keys, and skip the other side's bucket files whose
+            # manifest key stats cannot overlap — a dimension filtered
+            # to one month reads ~1/60th of a date-bucketed fact index.
+            producer = None
+            if plan.how == "inner":
+                if left_side.predicate is not None and right_side.predicate is None:
+                    producer = "left"
+                elif right_side.predicate is not None and left_side.predicate is None:
+                    producer = "right"
+                elif left_side.predicate is not None and right_side.predicate is not None:
+                    producer = (
+                        "left"
+                        if self._base_rows(left_side) <= self._base_rows(right_side)
+                        else "right"
+                    )
+            if producer == "left":
+                lside = self._side_data(left_side, num_buckets)
+                bounds = self._side_key_bounds(lside, left_side)
+                rside = self._side_data(right_side, num_buckets, dpp_bounds=bounds)
+            elif producer == "right":
+                rside = self._side_data(right_side, num_buckets)
+                bounds = self._side_key_bounds(rside, right_side)
+                lside = self._side_data(left_side, num_buckets, dpp_bounds=bounds)
+            else:
+                lside = self._side_data(left_side, num_buckets)
+                rside = self._side_data(right_side, num_buckets)
+            return lside, rside, left_side, right_side
+        # One side bucketed on its join keys: the other side can ride a
+        # query-time re-bucketing exchange (hash + counting sort on host,
+        # device sort on the device venue) so the merge stays
+        # bucket-parallel — SURVEY §2.3's "single re-bucketing all-to-all
+        # when bucket counts don't match" and the ranker's
+        # mismatched-pair case (JoinIndexRanker.scala:31-34).
+        mode = self.conf.join_rebucketize if self.conf is not None else "auto"
+        lt = rt = None
+        l_keyed = self._keyed_on_buckets(left_side, plan.left_on)
+        r_keyed = self._keyed_on_buckets(right_side, plan.right_on)
+        if mode != "off" and (l_keyed != r_keyed):
+            if l_keyed:
+                idx_side, other_plan, other_on = left_side, plan.right, plan.right_on
+            else:
+                idx_side, other_plan, other_on = right_side, plan.left, plan.left_on
+            num_buckets = idx_side.scan.bucket_spec[0]
+            idx_fields = [
+                idx_side.scan.scan_schema.field(c) for c in idx_side.scan.bucket_spec[1]
+            ]
+            t_other = self._execute(other_plan)
+            preserved = self._preserved_sidedata(t_other, other_on)
+            if preserved is not None and not (
+                len(preserved.offsets) - 1 == num_buckets
+                and _hash_fields_compatible(preserved.hash_fields, idx_fields)
+            ):
+                preserved = None
+            engage = (
+                preserved is not None  # reuse is free — always take it
+                or mode == "force"
+                or not self._should_broadcast(t_other.num_rows, self._base_rows(idx_side))
+            )
+            if engage:
+                sd_other = preserved or self._rebucketize_side(
+                    t_other, other_on, idx_fields, num_buckets
+                )
+                if sd_other is not None:
+                    # The materialized side doubles as the DPP producer
+                    # when dropping unmatched INDEXED-side rows early is
+                    # sound for this join type (the indexed side must not
+                    # be a preserved outer side).
+                    idx_is_right = not l_keyed
+                    prune_ok = (
+                        plan.how == "inner"
+                        or (idx_is_right and plan.how in ("left", "semi", "anti"))
+                        or (not idx_is_right and plan.how == "right")
+                    )
+                    dpp = None
+                    if prune_ok:
+                        dpp = self._table_key_bounds(t_other, other_on[0])
+                    sd_idx = self._side_data(idx_side, num_buckets, dpp_bounds=dpp)
+                    self.stats["join_path"] = (
+                        "bucket-preserved-aligned" if preserved is not None else "rebucketized-aligned"
+                    )
+                    self._phys(
+                        exchange="preserved" if preserved is not None else "rebucketize",
+                        buckets=num_buckets,
+                    )
+                    if l_keyed:
+                        return sd_idx, sd_other, None, None
+                    return sd_other, sd_idx, None, None
+            if l_keyed:
+                rt = t_other
+            else:
+                lt = t_other
+        if mode != "off" and not l_keyed and not r_keyed:
+            # Neither side indexed: a child inner join's preserved bucket
+            # grouping can still pair — directly against another
+            # preserved side, or by re-bucketizing the other side into
+            # its domain.
+            lt = lt if lt is not None else self._execute(plan.left)
+            rt = rt if rt is not None else self._execute(plan.right)
+            pl = self._preserved_sidedata(lt, plan.left_on)
+            pr = self._preserved_sidedata(rt, plan.right_on)
+            if (
+                pl is not None
+                and pr is not None
+                and len(pl.offsets) == len(pr.offsets)
+                and _hash_fields_compatible(pl.hash_fields, pr.hash_fields)
+            ):
+                self.stats["join_path"] = "bucket-preserved-aligned"
+                self._phys(exchange="preserved-both", buckets=len(pl.offsets) - 1)
+                return pl, pr, None, None
+            keyed = pl or pr
+            if keyed is not None and (
+                mode == "force" or not self._should_broadcast(lt.num_rows, rt.num_rows)
+            ):
+                if pl is not None:
+                    other = self._rebucketize_side(
+                        rt, plan.right_on, list(pl.hash_fields), len(pl.offsets) - 1
+                    )
+                    pair = (pl, other)
+                else:
+                    other = self._rebucketize_side(
+                        lt, plan.left_on, list(pr.hash_fields), len(pr.offsets) - 1
+                    )
+                    pair = (other, pr)
+                if pair[0] is not None and pair[1] is not None:
+                    self.stats["join_path"] = "rebucketized-aligned"
+                    self._phys(
+                        exchange="preserved+rebucketize", buckets=len(keyed.offsets) - 1
+                    )
+                    return pair[0], pair[1], None, None
+        # General path: single partition (bucket count 1). The path stat
+        # is set AFTER the children run — a nested join inside them sets
+        # its own path and must not leak into this frame's label.
+        if lt is None:
+            lt = self._execute(plan.left)
+        if rt is None:
+            rt = self._execute(plan.right)
+        self.stats["join_path"] = "single-partition"
+        one = lambda t: SideData(t, np.array([0, t.num_rows], dtype=np.int64), False)  # noqa: E731
+        return one(lt), one(rt), None, None
+
+    def _aligned_side(self, plan: LogicalPlan) -> AlignedSide | None:
+        node, project, predicate = plan, None, None
+        # Linear chain the join rule preserves: Project / Filter over the
+        # (possibly hybrid) index scan, in any order.
+        while isinstance(node, (Project, Filter)):
+            if isinstance(node, Project):
+                if not node.is_simple:
+                    # Computed entries can't be absorbed into the scan
+                    # column list; fall back to the general path (which
+                    # executes the Project node itself).
+                    return None
+                if project is None:  # outermost projection defines output
+                    project = node.columns
+                node = node.child
+            else:
+                predicate = node.predicate if predicate is None else And(predicate, node.predicate)
+                node = node.child
+        if isinstance(node, Union):
+            # Hybrid scan of ANY width: exactly one bucketed index scan
+            # plus unbucketed delta scans (appended files). The rewrite
+            # rule emits the two-input shape; refresh chains or manual
+            # unions may widen it.
+            base = None
+            deltas: list[Scan] = []
+            for inp in node.inputs:
+                if isinstance(inp, Project) and inp.is_simple and isinstance(inp.child, Scan):
+                    inp = inp.child
+                if not isinstance(inp, Scan):
+                    return None
+                if inp.bucket_spec is not None:
+                    if base is not None:
+                        return None  # two index scans: not a hybrid side
+                    base = inp
+                else:
+                    deltas.append(inp)
+            if base is None:
+                return None
+            return AlignedSide(base, project, deltas=tuple(deltas), predicate=predicate)
+        if isinstance(node, Scan):
+            return AlignedSide(node, project, predicate=predicate)
+        return None
+
+    def _base_rows(self, side: AlignedSide) -> int:
+        """Total indexed rows from the side's manifest (for picking the
+        smaller DPP producer); large sentinel when unknown."""
+        from pathlib import Path as _P
+
+        files = self._scan_files(side.scan)
+        if files:
+            m = hio.read_manifest_cached(_P(files[0]).parent)
+            if m and "bucketRows" in m:
+                return int(sum(m["bucketRows"]))
+        return 1 << 60
+
+    # Set-based DPP only materializes the producer's distinct keys below
+    # these sizes (the semi-join/bloom reduction; beyond them the range
+    # alone applies).
+    _DPP_SET_MAX_ROWS = 4_000_000
+    _DPP_SET_MAX_KEYS = 262_144
+
+    def _side_key_bounds(self, sdata: "SideData", side: AlignedSide):
+        """DPP producer info of an aligned side (see _table_key_bounds)."""
+        return self._table_key_bounds(sdata.table, side.scan.bucket_spec[1][0])
+
+    def _table_key_bounds(self, t: ColumnTable, key: str):
+        """(lo, hi, key_set | None) of the surviving join-key values
+        (nulls excluded — they never match). lo/hi are value-domain
+        (strings decoded via the dictionary); key_set is the SORTED
+        distinct int keys when small enough to enumerate — the consumer
+        filters its rows by membership (the semi-join reduction half of
+        DPP: a 1/70-selective demographics filter cuts the fact side 70x
+        BEFORE any pairing). (None, None, None) = empty."""
+        f = t.schema.field(key)
+        vals = t.columns[f.name]
+        valid = t.valid_mask(key)
+        if valid is not None:
+            vals = vals[valid]
+        if len(vals) == 0:
+            return (None, None, None)  # empty producer: skip everything
+        if f.device_dtype.kind == "f" and bool(np.isnan(vals).any()):
+            # NaN keys are real joinable values in the float domain but
+            # poison min/max (NaN bounds would slice every finite row
+            # away) — disable DPP for this producer entirely.
+            return None
+        if f.name in t.dictionaries:
+            # Decoded-string bounds have no consumer: string keys disable
+            # the bucket set, row slicing, and kset reduction alike — a
+            # non-None result here would only churn the derived cache
+            # with dead no-op cut entries (pinning base refs per distinct
+            # producer filter). Report "no DPP" instead.
+            return None
+        lo, hi = vals.min(), vals.max()
+        kset = None
+        if (
+            f.device_dtype.kind in "iu"
+            and len(vals) <= self._DPP_SET_MAX_ROWS
+        ):
+            u = np.unique(vals)
+            if len(u) <= self._DPP_SET_MAX_KEYS:
+                kset = u
+        return (lo, hi, kset)
+
+    def _rebucketize_side(
+        self, table: ColumnTable, key_cols: list[str], idx_fields, num_buckets: int
+    ) -> "SideData | None":
+        """Query-time re-bucketing exchange: group an arbitrary
+        materialized table into the SAME bucket layout an index side
+        uses, by recomputing the canonical row hash with each key column
+        cast into the index side's dtype domain (equal values then hash
+        identically; values unrepresentable on the index side have no
+        partner there, so their placement cannot matter). Host venue:
+        native counting sort; device venue: one device sort of the
+        bucket ids. None when the key shapes cannot share a hash domain
+        (string vs non-string)."""
+        from hyperspace_tpu.execution.builder import NULL_HASH
+        from hyperspace_tpu.ops.hashing import (
+            combine_hashes,
+            hash_int_column,
+            string_dict_hashes,
+        )
+
+        hs = []
+        for c, fi in zip(key_cols, idx_fields):
+            f = table.schema.field(c)
+            if f.is_string != fi.is_string:
+                return None
+            arr = table.columns[f.name]
+            if f.is_string:
+                dh = string_dict_hashes(table.dictionaries[f.name])
+                h = dh[arr] if len(dh) else np.zeros(len(arr), np.uint32)
+            else:
+                if arr.dtype != fi.device_dtype:
+                    arr = arr.astype(fi.device_dtype)
+                h = hash_int_column(arr, np)
+            valid = table.valid_mask(c)
+            if valid is not None:
+                h = np.where(valid, h, NULL_HASH)
+            hs.append(h)
+        bucket = np.asarray(bucket_ids(combine_hashes(hs, np), num_buckets, np), dtype=np.int32)
+        venue = self._join_venue()
+        kernel = None
+        if venue == "device":
+            import jax
+            import jax.numpy as jnp
+
+            order = np.asarray(jax.device_get(jnp.argsort(jnp.asarray(bucket))))
+            counts = np.bincount(bucket, minlength=num_buckets).astype(np.int64)
+            kernel = "device-sort-exchange"
+        else:
+            from hyperspace_tpu import native
+
+            res = native.bucket_perm(bucket, num_buckets)
+            if res is not None:
+                order, counts = res
+                kernel = "host-counting-sort-exchange"
+            else:
+                order = np.argsort(bucket, kind="stable")
+                counts = np.bincount(bucket, minlength=num_buckets).astype(np.int64)
+                kernel = "host-argsort-exchange"
+        self.stats["exchange_kernel"] = kernel
+        offsets = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+        return SideData(table.take(order), offsets, False, hash_fields=tuple(idx_fields))
+
+    def _side_data(
+        self, side: AlignedSide, num_buckets: int, dpp_bounds=None
+    ) -> "SideData":
+        """One concatenated bucket-grouped table per join side (bucket
+        files read in parallel through the decoded-table cache), plus
+        (hybrid scan) delta rows bucketized on the fly with the same
+        canonical row hash the build used. `dpp_bounds` (lo, hi) is the
+        other side's surviving key range (dynamic partition pruning): an
+        enumerable span skips whole bucket FILES by hashing the span to
+        its bucket set, and every surviving sorted bucket slices to the
+        one contiguous ROW run inside the bounds."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        schema = side.scan.scan_schema
+        hf = tuple(schema.field(c) for c in side.scan.bucket_spec[1])
+        groups = self._bucket_files_in_order(side.scan, num_buckets)
+        if dpp_bounds is not None:
+            keep = self._dpp_bucket_set(side, dpp_bounds, num_buckets)
+            if keep is not None:
+                pruned = sum(len(g) for b, g in enumerate(groups) if b not in keep)
+                if pruned:
+                    groups = [g if b in keep else [] for b, g in enumerate(groups)]
+                    self.stats["files_pruned"] += pruned
+                    self._phys(dpp_files_pruned=pruned)
+        before = hio.table_cache_stats()["miss_files"]
+        empty = ColumnTable.empty(schema)
+        with ThreadPoolExecutor(max_workers=8) as pool:
+            tables = list(
+                pool.map(
+                    lambda g: hio.read_parquet_cached(g, columns=schema.names, schema=schema)
+                    if g
+                    else empty,
+                    groups,
+                )
+            )
+        if dpp_bounds is not None and dpp_bounds[0] is not None:
+            import hashlib
+
+            key_field = schema.field(side.scan.bucket_spec[1][0])
+            kset_digest = (
+                hashlib.md5(dpp_bounds[2].tobytes()).hexdigest()
+                if dpp_bounds[2] is not None
+                else None  # one digest per SIDE, not per bucket
+            )
+            rows_before = sum(t.num_rows for t in tables)
+            tables = [
+                self._dpp_cut_cached(
+                    t, key_field, dpp_bounds, sliceable=len(g) <= 1, kset_digest=kset_digest
+                )
+                for g, t in zip(groups, tables)
+            ]
+            cut = rows_before - sum(t.num_rows for t in tables)
+            if cut:
+                self.stats["rows_pruned"] += cut
+                self._phys(dpp_rows_pruned=cut)
+        self.stats["files_read"] += hio.table_cache_stats()["miss_files"] - before
+        counts = np.array([t.num_rows for t in tables], dtype=np.int64)
+        base = _concat_side_cached(tables)
+        offsets = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+        # Empty (fully pruned) groups are trivially sorted.
+        sorted_within = all(len(g) <= 1 for g in groups)
+        if side.deltas:
+            dts = [self._scan(d, columns=list(schema.names)) for d in side.deltas]
+            # Hash on the bucket columns in BUILD order (not join-key
+            # order) so delta rows land in the same buckets the index used.
+            dbs = [
+                bucket_ids(compute_row_hashes(dt, side.scan.bucket_spec[1]), num_buckets, np)
+                for dt in dts
+            ]
+            all_bucket = np.concatenate(
+                [np.repeat(np.arange(num_buckets, dtype=np.int32), counts), *dbs]
+            )
+            combined = ColumnTable.concat([base, *dts])
+            order = np.argsort(all_bucket, kind="stable")
+            counts2 = np.bincount(all_bucket, minlength=num_buckets)
+            offsets = np.concatenate([[0], np.cumsum(counts2)]).astype(np.int64)
+            out = SideData(combined.take(order), offsets, False, hash_fields=hf)
+        else:
+            out = SideData(base, offsets, sorted_within, hash_fields=hf)
+        if side.predicate is not None:
+            out = _filter_side(out, side.predicate, self.mesh, self._filter_venue())
+        return out
+
+    def _aligned_join(
+        self,
+        plan: Join,
+        left: AlignedSide,
+        right: AlignedSide,
+        lside: "SideData",
+        rside: "SideData",
+    ) -> ColumnTable:
+        """Bucket-aligned zero-exchange SMJ: both sides arrive grouped by
+        the same bucket function, so per-bucket merge joins concatenated
+        equal the global join."""
+        out = self._partition_join(plan, lside, rside)
+        cols = None
+        if plan.how in ("semi", "anti"):
+            # Left-only output; the right side contributes no columns.
+            if left.project is not None:
+                cols = list(left.project)
+        elif left.project is not None or right.project is not None:
+            keep = list(left.project if left.project is not None else left.scan.scan_schema.names)
+            rkeys = {k.lower() for k in plan.right_on}
+            for c in right.project if right.project is not None else right.scan.scan_schema.names:
+                if c.lower() not in rkeys and c.lower() not in {k.lower() for k in keep}:
+                    keep.append(c)
+            cols = keep
+        if cols is None:
+            return out
+        return self._propagate_stash(out, out.select(cols))
+
+    # DPP only enumerates the producer's key span when it is this small
+    # (a year of dates is 366 hashes; demographic keys spanning millions
+    # stay un-enumerated and fall back to row slicing only).
+    _DPP_SPAN_LIMIT = 8192
+
+    def _dpp_bucket_set(self, side: AlignedSide, bounds, num_buckets: int):
+        """The set of bucket ids the producer's surviving keys can hash
+        into, or None when not enumerable (wide span / non-int / multi-
+        column bucket key). Keys are hash-distributed across buckets, so
+        file [min, max] stats cannot prune — but a small ENUMERABLE key
+        span (or exact key set) hashes to a concrete bucket subset (31
+        dates touch at most 31 of 64 buckets; a point key exactly one)."""
+        lo, hi, kset = bounds
+        if lo is None:  # empty producer: nothing joins
+            return set()
+        if len(side.scan.bucket_spec[1]) != 1:
+            return None
+        key = side.scan.bucket_spec[1][0]
+        f = side.scan.scan_schema.field(key)
+        if f.is_string or f.device_dtype.kind not in "iu":
+            return None
+        if kset is not None and len(kset) <= self._DPP_SPAN_LIMIT:
+            vals = kset.astype(f.device_dtype, copy=False)
+        else:
+            span = int(hi) - int(lo) + 1
+            if span > self._DPP_SPAN_LIMIT:
+                return None
+            vals = np.arange(int(lo), int(hi) + 1, dtype=f.device_dtype)
+        probe = ColumnTable(
+            side.scan.scan_schema.select([key]), {f.name: vals}, {}, {}
+        )
+        h = compute_row_hashes(probe, [key])
+        return set(np.unique(bucket_ids(h, num_buckets, np)).tolist())
+
+    def _dpp_cut_cached(
+        self, t: ColumnTable, key_field, dpp_bounds, sliceable: bool, kset_digest=None
+    ) -> ColumnTable:
+        """Range-slice + set-membership cut of one bucket table, memoized
+        on (stable table identity, bounds) so a REPEATED query serves the
+        same frozen sliced tables — keeping the whole downstream identity
+        chain (concat, factorize, channels, pads, HBM uploads) warm. A
+        per-query (unstable) table just computes the cut directly."""
+        from hyperspace_tpu.execution import device_cache as dc
+
+        lo, hi, kset = dpp_bounds
+
+        def cut() -> ColumnTable:
+            s = (
+                self._dpp_slice_table(t, key_field, lo, hi)
+                if sliceable and t.num_rows
+                else None
+            )
+            if s is None:
+                s = t
+            if (
+                kset is not None
+                and s.num_rows
+                and not key_field.is_string
+                and key_field.device_dtype.kind in "iu"
+            ):
+                # Semi-join reduction: keep only rows whose key is in the
+                # producer's distinct set (sorted-membership probe; nulls
+                # can't match). A sorted subsequence stays sorted.
+                colv = s.columns[key_field.name]
+                pos = np.minimum(np.searchsorted(kset, colv), len(kset) - 1)
+                hit = kset[pos] == colv
+                kvalid = s.valid_mask(key_field.name)
+                if kvalid is not None:
+                    hit = hit & kvalid
+                if not hit.all():
+                    s = s.filter_mask(hit)
+            return s
+
+        if t.num_rows == 0:
+            return t
+        if kset is not None and kset_digest is None:
+            return cut()  # no digest supplied: never key a cache on part of the cut
+        refs, parts = _stable_table_refs(t, {n.lower() for n in t.schema.names})
+        if not refs:
+            return cut()
+
+        def scalar(v):
+            return v.item() if hasattr(v, "item") else v
+
+        key = ("dppcut", parts, scalar(lo), scalar(hi), kset_digest)
+
+        def build():
+            s = cut()
+            if s is t:
+                return s, 0  # uncut: pass the (already stable) base through
+            for arr in (*s.columns.values(), *s.validity.values()):
+                dc.freeze(arr)
+            size = int(sum(a.nbytes for a in s.columns.values()))
+            return s, size
+
+        return dc.HOST_DERIVED.get_or_build(key, refs, build)
+
+    @staticmethod
+    def _dpp_slice_table(table: ColumnTable, field, lo, hi) -> ColumnTable | None:
+        """Rows of one KEY-SORTED bucket table inside [lo, hi] — one
+        contiguous searchsorted run (the within-file analog of range
+        pruning; hash bucketing scatters the key domain across files,
+        but WITHIN a file the build's sort makes any value range one
+        slice). None when the table isn't safely sliceable."""
+        if field.is_string or table.valid_mask(field.name) is not None:
+            return None
+        colv = table.columns[field.name]
+        lo_i = int(np.searchsorted(colv, lo, side="left"))
+        hi_i = int(np.searchsorted(colv, hi, side="right"))
+        if lo_i == 0 and hi_i == table.num_rows:
+            return table
+        return table.take(np.arange(lo_i, hi_i))
+
+    def _bucket_files_in_order(self, scan: Scan, num_buckets: int) -> list[list[str]]:
+        """Per-bucket file groups. A bucket can have several files (base
+        version + incremental-refresh deltas); order within a group is the
+        sorted file-path order."""
+        files = self._scan_files(scan)
+        by_name: dict[str, list[str]] = {}
+        for f in sorted(files):
+            by_name.setdefault(Path(f).name, []).append(f)
+        out = []
+        for b in range(num_buckets):
+            name = hio.bucket_file_name(b)
+            if name not in by_name:
+                raise HyperspaceError(f"missing bucket file {name} in {scan.root}")
+            out.append(by_name[name])
+        return out
+
+    # -- fused join + aggregation ----------------------------------------

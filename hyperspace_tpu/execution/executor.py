@@ -1,7 +1,7 @@
 """Plan executor: runs logical plans against the device plane.
 
-The analog of Spark's physical planning + execution for the four node types
-our IR has (SURVEY.md §7 design stance). What matters for TPU performance:
+The analog of Spark's physical planning + execution for the IR's node
+types (SURVEY.md §7 design stance). What matters for TPU performance:
 
 - **bucket pruning** (Filter over an index scan with equality literals on
   every bucket column): recompute the canonical row hash on the literal
@@ -13,6 +13,11 @@ our IR has (SURVEY.md §7 design stance). What matters for TPU performance:
   in one vmapped device kernel (ops/join.py) — the analog of the
   reference's shuffle-free SortMergeJoin;
 - predicates evaluate as one fused XLA computation (ops/filter.py).
+
+Round-5 layout: this module owns dispatch, venue selection, and the
+order/limit/union operators; the heavy operator families live in
+per-operator mixins (exec_scan / exec_side / exec_join / exec_join_agg /
+exec_agg) over the shared support layer (exec_common).
 """
 
 from __future__ import annotations
@@ -45,352 +50,43 @@ from hyperspace_tpu.plan.nodes import (
 )
 
 
-@dataclasses.dataclass
-class _TableLeaf(LogicalPlan):
-    """Executor-internal leaf wrapping an already-materialized table
-    (partial-aggregation pushdown splices one under a Join). Never
-    serialized; never seen by the rules."""
-
-    table: ColumnTable
-
-    @property
-    def schema(self):
-        return self.table.schema
-
-    def children(self) -> list[LogicalPlan]:
-        return []
-
-    def to_json(self):
-        raise HyperspaceError("_TableLeaf is executor-internal")
-
-
-@dataclasses.dataclass
-class AlignedSide:
-    scan: Scan
-    project: list[str] | None  # columns to keep after the join gather
-    # Hybrid scan: unbucketed delta scans whose rows are bucketized
-    # on the fly and merged into the index buckets before the SMJ.
-    # Any number of deltas is accepted (a Union of the index scan with
-    # several appended-file scans, not just the canonical two-input
-    # shape the rewrite rule emits today).
-    deltas: tuple[Scan, ...] = ()
-    # Side-local filter (JoinIndexRule keeps linear sides with filters):
-    # applied per bucket BEFORE the merge, preserving bucket grouping and
-    # within-bucket sort order (a filtered subsequence stays sorted).
-    predicate: Expr | None = None
+from hyperspace_tpu.execution.exec_agg import AggregateMixin
+from hyperspace_tpu.execution.exec_common import (  # noqa: F401  (re-exports)
+    AlignedSide,
+    KeyBounds,
+    SideData,
+    _TableLeaf,
+    _broadcast_probe,
+    _bucket_sorted_codes,
+    _composite_keys,
+    _concat_side_cached,
+    _copy_field,
+    _desugar_count_distinct,
+    _factorize_keys,
+    _factorize_keys_cached,
+    _filter_side,
+    _group_ids_cached,
+    _hash_fields_compatible,
+    _logical_key,
+    _null_field,
+    _pad_bucket_major,
+    _stable_table_refs,
+    key_bounds,
+    predicate_all_key_bounds,
+)
+from hyperspace_tpu.execution.exec_join import JoinMixin
+from hyperspace_tpu.execution.exec_join_agg import FusedJoinAggMixin
+from hyperspace_tpu.execution.exec_scan import ScanFilterMixin
+from hyperspace_tpu.execution.exec_side import JoinSidesMixin
 
 
-@dataclasses.dataclass
-class SideData:
-    """One join side in concatenated bucket-grouped layout: rows of bucket
-    b occupy [offsets[b], offsets[b+1])."""
-
-    table: ColumnTable
-    offsets: np.ndarray  # [B+1] int64
-    sorted_within: bool  # buckets key-sorted (index files are)?
-    # Fields defining the bucket hash domain (the dtypes the row hash was
-    # computed in) — two bucketings pair only when these are compatible.
-    hash_fields: tuple | None = None
-
-
-def _hash_fields_compatible(a, b) -> bool:
-    """Equal key values bucket identically under both domains."""
-    if a is None or b is None or len(a) != len(b):
-        return False
-    for fa, fb in zip(a, b):
-        if fa.is_string != fb.is_string:
-            return False
-        if not fa.is_string and np.dtype(fa.device_dtype) != np.dtype(fb.device_dtype):
-            return False
-    return True
-
-
-def _filter_side(side: SideData, predicate, mesh, venue: str = "auto") -> SideData:
-    """Apply a side-local filter to bucket-grouped data, recomputing the
-    bucket offsets over the surviving rows (grouping and within-bucket
-    order are preserved — a filtered subsequence stays sorted)."""
-    t = side.table
-    if t.num_rows == 0:
-        return side
-    mask = eval_predicate_mask(t, predicate, mesh=mesh, venue=venue)
-    counts = np.diff(side.offsets)
-    bucket_of = np.repeat(np.arange(len(counts), dtype=np.int64), counts)
-    new_counts = np.bincount(bucket_of[mask], minlength=len(counts))
-    offsets = np.concatenate([[0], np.cumsum(new_counts)]).astype(np.int64)
-    return SideData(t.filter_mask(mask), offsets, side.sorted_within)
-
-
-def _bucket_sorted_codes(codes: np.ndarray, side: SideData):
-    """Ensure codes are non-decreasing within each bucket. Returns
-    (sorted codes, perm) where perm maps sorted positions back to the
-    side's row order (None when already sorted — the index-file case,
-    verified with one vectorized pass, memoized for stable codes)."""
-    from hyperspace_tpu.execution import device_cache as dc
-
-    n = len(codes)
-    if n == 0:
-        return codes, None
-    if side.sorted_within:
-
-        def check() -> bool:
-            counts0 = np.diff(side.offsets)
-            b_of = np.repeat(np.arange(len(counts0), dtype=np.int64), counts0)
-            d = np.diff(codes)
-            return not np.any(d[b_of[:-1] == b_of[1:]] < 0)
-
-        if dc.is_stable(codes):
-            ok = dc.HOST_DERIVED.get_or_build(
-                ("sortck", id(codes), side.offsets.tobytes()),
-                (codes,),
-                lambda: (check(), 1),
-            )
-        else:
-            ok = check()
-        if ok:
-            return codes, None
-    counts = np.diff(side.offsets)
-    bucket_of = np.repeat(np.arange(len(counts), dtype=np.int64), counts)
-    perm = np.lexsort((codes, bucket_of))  # stable; regroups identically
-    return codes[perm], perm
-
-
-@dataclasses.dataclass
-class KeyBounds:
-    """Conjunct bounds on one column: lo/hi literal (None = unbounded) and
-    whether each bound is strict (< / >) rather than inclusive."""
-
-    lo: object = None
-    lo_strict: bool = False
-    hi: object = None
-    hi_strict: bool = False
-
-
-_FLIP = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}
-
-
-def _conjunct_col_lit(conj) -> tuple[str, str, object] | None:
-    """Destructure one conjunct as (column, op, literal), normalizing
-    `lit op col` by flipping the comparison. NaN literals are rejected
-    (they defeat ordered-bound reasoning: every comparison is False, but
-    searchsorted treats NaN as largest). Returns None otherwise."""
-    if not isinstance(conj, BinOp):
-        return None
-    op = conj.op
-    if isinstance(conj.left, Col) and isinstance(conj.right, Lit):
-        name, v = conj.left.name, conj.right.value
-    elif isinstance(conj.right, Col) and isinstance(conj.left, Lit):
-        name, v = conj.right.name, conj.left.value
-        op = _FLIP.get(op, op)
-    else:
-        return None
-    if v is None:
-        return None
-    if isinstance(v, (float, np.floating)) and np.isnan(v):
-        return None
-    return name, op, v
-
-
-def _like_prefix(pattern: str) -> str | None:
-    """The literal prefix of a prefix-shaped LIKE pattern ('PROMO%'), or
-    None when the pattern isn't prefix-shaped."""
-    if pattern.endswith("%") and len(pattern) > 1:
-        body = pattern[:-1]
-        if "%" not in body and "_" not in body:
-            return body
-    return None
-
-
-def _prefix_upper(prefix: str) -> str | None:
-    """Smallest string ABOVE every string with `prefix` (exclusive upper
-    bound for prefix matching); None when the last char can't increment."""
-    last = ord(prefix[-1])
-    if last >= 0x10FFFF:
-        return None
-    return prefix[:-1] + chr(last + 1)
-
-
-def _conjunct_bound_ops(conj, key: str) -> list[tuple[str, object]] | None:
-    """One conjunct → literal (op, value) bounds it implies on `key`:
-    plain comparisons pass through; IN gives its min/max envelope; a
-    prefix LIKE gives [prefix, next-prefix). The residual filter mask
-    still applies the exact predicate — bounds only need to be a valid
-    superset."""
-    from hyperspace_tpu.plan.expr import InList, Like
-
-    if isinstance(conj, InList) and isinstance(conj.child, Col):
-        if conj.child.name.lower() != key:
-            return None
-        vals = conj.values
-        if any(isinstance(v, (float, np.floating)) and np.isnan(v) for v in vals):
-            return None
-        try:
-            return [("ge", min(vals)), ("le", max(vals))]
-        except TypeError:
-            return None
-    if isinstance(conj, Like) and isinstance(conj.child, Col):
-        if conj.child.name.lower() != key:
-            return None
-        prefix = _like_prefix(conj.pattern)
-        if prefix is None:
-            if "%" not in conj.pattern and "_" not in conj.pattern:
-                return [("eq", conj.pattern)]  # wildcard-free LIKE = equality
-            return None
-        out: list[tuple[str, object]] = [("ge", prefix)]
-        upper = _prefix_upper(prefix)
-        if upper is not None:
-            out.append(("lt", upper))
-        return out
-    if isinstance(conj, BinOp) and conj.is_comparison:
-        from hyperspace_tpu.ops.filter import _translate_date_part_cmp
-        from hyperspace_tpu.plan.expr import DatePart
-
-        l, r, op = conj.left, conj.right, conj.op
-        if isinstance(r, DatePart) and isinstance(l, Lit):
-            l, r, op = r, l, _FLIP.get(op, op)
-        if isinstance(l, DatePart) and isinstance(r, Lit):
-            # year(d) OP lit → the same day-range tree the filter layer
-            # lowers to; recurse so the range feeds pruning too.
-            t = _translate_date_part_cmp(op, l, r.value)
-            if t is None:
-                return None
-            out: list[tuple[str, object]] = []
-            for sub in split_conjuncts(t):
-                pairs = _conjunct_bound_ops(sub, key)
-                if pairs is None:
-                    return None  # ne-shaped (an OR): not a conjunct bound
-                out.extend(pairs)
-            return out
-    dec = _conjunct_col_lit(conj)
-    if dec is None:
-        return None
-    name, op, v = dec
-    if name.lower() != key or op not in ("eq", "lt", "le", "gt", "ge"):
-        return None
-    return [(op, v)]
-
-
-def key_bounds(predicate: Expr, key: str) -> KeyBounds | None:
-    """Extract literal comparison bounds on `key` from the predicate's
-    conjuncts (key op lit / lit op key; eq pins both ends; IN gives its
-    envelope; prefix LIKE gives a string range). Returns None when no
-    conjunct bounds the column. Incomparable literal types are ignored
-    (the residual filter mask still applies them exactly)."""
-    key = key.lower()
-    b = KeyBounds()
-    found = False
-    for conj in split_conjuncts(predicate):
-        pairs = _conjunct_bound_ops(conj, key)
-        if pairs is None:
-            continue
-        for op, v in pairs:
-            try:
-                if op in ("gt", "ge", "eq") and (
-                    b.lo is None or v > b.lo or (v == b.lo and op == "gt")
-                ):
-                    b.lo, b.lo_strict = v, op == "gt"
-                    found = True
-                if op in ("lt", "le", "eq") and (
-                    b.hi is None or v < b.hi or (v == b.hi and op == "lt")
-                ):
-                    b.hi, b.hi_strict = v, op == "lt"
-                    found = True
-            except TypeError:
-                continue
-    return b if found else None
-
-
-def predicate_all_key_bounds(predicate: Expr, key: str) -> bool:
-    """True iff EVERY conjunct is a comparable literal bound on `key`
-    (eq/lt/le/gt/ge) — i.e. an exact searchsorted slice on the sorted key
-    fully implements the predicate and the residual mask is redundant."""
-    key = key.lower()
-    for conj in split_conjuncts(predicate):
-        dec = _conjunct_col_lit(conj)
-        if dec is None:
-            return False
-        name, op, v = dec
-        if name.lower() != key or op not in ("eq", "lt", "le", "gt", "ge"):
-            return False
-        if not isinstance(v, (int, float, bool, np.number)):
-            return False
-    return True
-
-
-def _stats_overlap(bounds: KeyBounds, mn, mx) -> bool:
-    """Can any value in [mn, mx] satisfy the bounds?"""
-    try:
-        if bounds.hi is not None and (mn > bounds.hi or (bounds.hi_strict and mn == bounds.hi)):
-            return False
-        if bounds.lo is not None and (mx < bounds.lo or (bounds.lo_strict and mx == bounds.lo)):
-            return False
-    except TypeError:
-        return True  # incomparable stats: keep the file
-    return True
-
-
-def _bounds_domain(field, bounds: KeyBounds):
-    """Conversion putting pruning comparisons in the SAME numeric domain
-    the filter mask uses (ops/filter.py _lower_col_lit's numpy promotion):
-    float32 columns compare weak scalars in float32 (the literal ROUNDS),
-    and int columns compare float literals in float64. Without this,
-    pruning could drop rows the mask would keep. Returns None when raw
-    comparison already matches (ints vs ints, strings)."""
-    dt = field.device_dtype
-    vals = [v for v in (bounds.lo, bounds.hi) if v is not None]
-    if dt.kind == "f":
-        weak = all(
-            type(v) in (int, float, bool) or isinstance(v, (np.bool_, np.float32))
-            for v in vals
-        )
-        return np.float32 if (dt.itemsize <= 4 and weak) else np.float64
-    if dt.kind in "iu" and any(isinstance(v, (float, np.floating)) for v in vals):
-        return np.float64
-    return None
-
-
-def _convert_bounds(field, bounds: KeyBounds) -> tuple[KeyBounds, object]:
-    """(bounds cast into the comparison domain, stat-value converter)."""
-    conv = _bounds_domain(field, bounds)
-    if conv is None:
-        return bounds, lambda v: v
-    try:
-        cast = KeyBounds(
-            conv(bounds.lo) if bounds.lo is not None else None,
-            bounds.lo_strict,
-            conv(bounds.hi) if bounds.hi is not None else None,
-            bounds.hi_strict,
-        )
-    except (TypeError, ValueError, OverflowError):
-        return bounds, lambda v: v
-    def stat_conv(v):
-        try:
-            return conv(v)
-        except (TypeError, ValueError, OverflowError):
-            return v
-    return cast, stat_conv
-
-
-def _pad_bucket_major(
-    codes: np.ndarray,
-    offsets: np.ndarray,
-    fill=None,
-    width: int | None = None,
-) -> np.ndarray:
-    """[n] bucket-grouped values → [B, L] padded array, built with one
-    vectorized gather. Default fill is the dtype's sort-last sentinel
-    (key codes); value channels pass an explicit fill and width."""
-    counts = np.diff(offsets)
-    b = len(counts)
-    lmax = width if width is not None else max(int(counts.max()) if counts.size else 1, 1)
-    sentinel = join_ops.sentinel_for(codes.dtype) if fill is None else fill
-    if len(codes) == 0:
-        return np.full((b, lmax), sentinel, dtype=codes.dtype)
-    idx = offsets[:-1, None] + np.arange(lmax, dtype=np.int64)[None, :]
-    mask = np.arange(lmax)[None, :] < counts[:, None]
-    return np.where(mask, codes[np.minimum(idx, len(codes) - 1)], sentinel)
-
-
-class Executor:
+class Executor(
+    ScanFilterMixin,
+    JoinSidesMixin,
+    JoinMixin,
+    FusedJoinAggMixin,
+    AggregateMixin,
+):
     """Runs plans on the device plane. With a mesh, the query plane is
     distributed: the bucket-aligned SMJ shards its bucket dimension over
     the mesh (zero collectives — the analog of the reference's
@@ -618,401 +314,6 @@ class Executor:
         self._cur_phys.detail.update(detail)
 
     # -- aggregate / sort -------------------------------------------------
-    def _aggregate(self, plan: "Aggregate") -> ColumnTable:
-        from hyperspace_tpu.ops.aggregate import aggregate_table
-
-        if plan.grouping_sets is not None:
-            return self._grouping_sets_aggregate(plan)
-        if any(a.fn == "count_distinct" for a in plan.aggs):
-            for a in plan.aggs:
-                if a.fn == "count_distinct" and not isinstance(a.expr, Col):
-                    raise HyperspaceError("count_distinct requires a plain column")
-            dcols = {a.expr.name.lower() for a in plan.aggs if a.fn == "count_distinct"}
-            if len(dcols) == 1 and not any(a.fn == "mean" for a in plan.aggs):
-                # Single distinct column, no mean: the plan-level two-phase
-                # desugar keeps the inner aggregate eligible for the fused
-                # Aggregate(Join) path.
-                self._phys("CountDistinctReaggregate")
-                plan2, count_aliases = _desugar_count_distinct(plan)
-                out = self._execute(plan2)
-                # SQL count is never NULL: the outer SUM of count partials
-                # yields NULL over zero inner rows — restore the 0.
-                for alias in count_aliases:
-                    f = out.schema.field(alias)
-                    v = out.validity.pop(f.name, None)
-                    if v is not None:
-                        out.columns[f.name] = np.where(v, out.columns[f.name], 0)
-                return out
-            return self._distinct_aggregate(plan, sorted(dcols))
-        venue = self._agg_venue()
-        pushed = self._try_partial_agg_pushdown(plan)
-        if pushed is not None:
-            return pushed
-        # Fuse Aggregate(Join) on both venues: the device run-prefix
-        # kernel avoids the match-pair readback; the host C++
-        # merge+accumulate avoids materializing the pairs at all.
-        fused = self._try_fused_join_aggregate(plan)
-        if fused is not None:
-            self._phys(
-                "FusedJoinAggregate",
-                join_path=self.stats["join_path"],
-                kernel=self.stats["join_kernel"],
-                buckets=self.stats["num_buckets"],
-            )
-            return fused
-        table = self._execute(plan.child)
-        self.stats["agg_path"] = f"segment-reduce-{venue}"
-        mesh = self.mesh if venue == "device" else None
-        if mesh is not None:
-            from hyperspace_tpu.parallel.mesh import mesh_size
-
-            self.stats["agg_devices"] = mesh_size(mesh)
-        self._phys(
-            "SegmentReduceAggregate",
-            venue=venue,
-            groups=len(plan.group_by),
-            aggs=len(plan.aggs),
-            devices=self.stats.get("agg_devices", 1),
-        )
-        return aggregate_table(
-            table, plan.group_by, plan.aggs, plan.schema, venue=venue, mesh=mesh,
-            # Identity-cached factorization: repeat aggregations over a
-            # stable index version skip re-factorizing the keys.
-            groups=_group_ids_cached(table, plan.group_by),
-        )
-
-    def _try_partial_agg_pushdown(self, plan: "Aggregate") -> ColumnTable | None:
-        """Partial aggregation pushdown (Spark's PartialAggregate /
-        aggregate-through-join analog): for Aggregate(Join(L, R)) where
-        every aggregate reads only the L side — optionally inside a
-        CASE whose CONDITION reads only the R side (the q43/q59 weekly
-        pivot shape; R attributes are constant per join-key run, so the
-        case splits into the outer re-aggregation) — pre-aggregate L by
-        (join keys + L group columns), join the FEW partial rows, and
-        re-fold. Adaptive: bails when the partial grouping would not
-        actually shrink L (measured, not guessed), in which case the
-        normal fused path re-executes the (cheap, cached) L side."""
-        from hyperspace_tpu.ops.aggregate import aggregate_table
-        from hyperspace_tpu.plan.expr import Case, Lit
-        from hyperspace_tpu.plan.nodes import AggSpec
-
-        child = plan.child
-        if not isinstance(child, Join) or child.how != "inner" or child.condition is not None:
-            return None
-        if isinstance(child.left, _TableLeaf) or isinstance(child.right, _TableLeaf):
-            return None  # already pushed (recursion guard)
-        lnames = {n.lower() for n in child.left.schema.names}
-        rnames = {n.lower() for n in child.right.schema.names}
-        g_l = [c for c in plan.group_by if c.lower() in lnames]
-        g_r = [c for c in plan.group_by if c.lower() not in lnames]
-        if any(c.lower() not in rnames for c in g_r):
-            return None
-
-        partial_specs: list[AggSpec] = []
-        outer_specs: list[AggSpec] = []
-        mean_parts: dict[str, tuple[str, str]] = {}  # alias -> (sum, cnt) temp names
-        count_aliases: list[str] = []
-        uses_r = bool(g_r)
-        for i, a in enumerate(plan.aggs):
-            refs = {r.lower() for r in a.references()}
-            if a.fn == "count" and a.expr is None:
-                partial_specs.append(AggSpec("count", None, f"__pp{i}"))
-                outer_specs.append(AggSpec("sum", Col(f"__pp{i}"), a.alias))
-                count_aliases.append(a.alias)
-                continue
-            if a.fn in ("sum", "count", "min", "max") and refs and refs <= lnames:
-                partial_specs.append(AggSpec(a.fn, a.expr, f"__pp{i}"))
-                fn2 = "sum" if a.fn in ("sum", "count") else a.fn
-                outer_specs.append(AggSpec(fn2, Col(f"__pp{i}"), a.alias))
-                if a.fn == "count":
-                    count_aliases.append(a.alias)
-                continue
-            if a.fn == "mean" and refs and refs <= lnames:
-                partial_specs.append(AggSpec("sum", a.expr, f"__pp{i}s"))
-                partial_specs.append(AggSpec("count", a.expr, f"__pp{i}c"))
-                outer_specs.append(AggSpec("sum", Col(f"__pp{i}s"), f"__po{i}s"))
-                outer_specs.append(AggSpec("sum", Col(f"__pp{i}c"), f"__po{i}c"))
-                mean_parts[a.alias] = (f"__po{i}s", f"__po{i}c")
-                continue
-            if (
-                a.fn == "sum"
-                and isinstance(a.expr, Case)
-                and len(a.expr.branches) == 1
-                and isinstance(a.expr.default, Lit)
-                and a.expr.default.value in (0, 0.0)
-            ):
-                cond, val = a.expr.branches[0]
-                crefs = {r.lower() for r in cond.references()}
-                vrefs = {r.lower() for r in val.references()}
-                if crefs and crefs <= rnames and vrefs <= lnames:
-                    uses_r = True
-                    partial_specs.append(AggSpec("sum", val, f"__pp{i}"))
-                    from hyperspace_tpu.plan.expr import when as _when
-
-                    outer_specs.append(
-                        AggSpec("sum", _when(cond, Col(f"__pp{i}")).otherwise(0.0), a.alias)
-                    )
-                    continue
-            return None
-        if not uses_r:
-            # The aggregate never needs R beyond the join's filtering
-            # effect — the fused path already handles that shape better.
-            return None
-
-        pkeys: list[str] = list(child.left_on)
-        pk_low = {c.lower() for c in pkeys}
-        for c in g_l:
-            if c.lower() not in pk_low:
-                pkeys.append(c)
-                pk_low.add(c.lower())
-
-        lt = self._execute(child.left)
-        gid, k, rep = _group_ids_cached(lt, pkeys)
-        if k > max(64, lt.num_rows // 8):
-            # Less than ~8x shrink: the extra factorize + re-fold beats
-            # nothing the fused path doesn't already do better.
-            return None
-
-        from hyperspace_tpu.plan.nodes import Aggregate as _Agg
-
-        pschema = _Agg(_TableLeaf(lt), pkeys, partial_specs).schema
-        venue = self._agg_venue()
-        partial = aggregate_table(
-            lt, pkeys, partial_specs, pschema, venue=venue, groups=(gid, k, rep)
-        )
-        self._phys(
-            "PartialAggPushdown",
-            partial_rows=partial.num_rows,
-            input_rows=lt.num_rows,
-            keys=pkeys,
-        )
-        outer_plan: LogicalPlan = _Agg(
-            Join(_TableLeaf(partial), child.right, child.left_on, child.right_on, "inner"),
-            list(plan.group_by),
-            outer_specs,
-        )
-        out = self._execute(outer_plan)
-        # Re-shape to the original output: means recompose from their
-        # sum/count partials (NULL when no valid input), counts restore
-        # SQL's never-NULL zero, columns return in declared order.
-        cols: dict[str, np.ndarray] = {}
-        dicts: dict[str, np.ndarray] = {}
-        validity: dict[str, np.ndarray] = {}
-        for f in plan.schema.fields:
-            low = f.name.lower()
-            if low in {c.lower() for c in plan.group_by}:
-                _copy_field(f, out, f.name, cols, dicts, validity)
-                continue
-            if f.name in mean_parts or low in {a.lower() for a in mean_parts}:
-                s_name, c_name = mean_parts[f.name]
-                s = out.column(s_name).astype(np.float64)
-                c = out.column(c_name).astype(np.float64)
-                with np.errstate(invalid="ignore", divide="ignore"):
-                    cols[f.name] = np.where(c > 0, s / np.maximum(c, 1), 0.0)
-                if (c == 0).any():
-                    validity[f.name] = c > 0
-                continue
-            _copy_field(f, out, f.name, cols, dicts, validity)
-            if f.name in count_aliases:
-                v = validity.pop(f.name, None)
-                if v is not None:
-                    cols[f.name] = np.where(v, cols[f.name], 0)
-        return ColumnTable(plan.schema, cols, dicts, validity)
-
-    def _distinct_aggregate(self, plan: "Aggregate", dcols: list[str]) -> ColumnTable:
-        """General distinct expansion (the Spark planner's Expand analog
-        for multi-distinct aggregates, q38/q87 shapes): execute the child
-        ONCE, factorize the group keys ONCE, run the non-distinct specs
-        as a normal segment reduce sharing that factorization, and count
-        each distinct column by factorizing (group keys, column) pairs —
-        the representative row of each pair maps back to its outer group,
-        so a bincount over pair representatives IS the distinct count.
-        No join, no per-spec re-execution; mean shares freely."""
-        from hyperspace_tpu.ops.aggregate import aggregate_table, group_ids
-        from hyperspace_tpu.schema import Schema
-
-        ct = self._execute(plan.child)
-        venue = self._agg_venue()
-        gid, k, rep = _group_ids_cached(ct, plan.group_by)
-        self._phys(
-            "DistinctExpandAggregate",
-            distinct_cols=dcols,
-            groups=len(plan.group_by),
-            venue=venue,
-        )
-        out_schema = plan.schema
-        if k == 0 or (ct.num_rows == 0 and plan.group_by):
-            return ColumnTable.empty(out_schema)
-        regular = [a for a in plan.aggs if a.fn != "count_distinct"]
-        reg_fields = [out_schema.field(c) for c in plan.group_by]
-        reg_fields += [out_schema.field(a.alias) for a in regular]
-        base = aggregate_table(
-            ct, plan.group_by, regular, Schema(tuple(reg_fields)),
-            venue=venue, groups=(gid, k, rep),
-        )
-        cols = dict(base.columns)
-        dicts = dict(base.dictionaries)
-        validity = dict(base.validity)
-        pair_counts: dict[str, np.ndarray] = {}
-        for d in dcols:
-            pgid, pk, prep = group_ids(ct, [*plan.group_by, d])
-            del pgid, pk
-            outer = gid[prep]
-            vd = ct.valid_mask(d)
-            if vd is not None:
-                outer = outer[vd[prep]]  # SQL: distinct counts exclude NULL
-            pair_counts[d] = np.bincount(outer, minlength=k).astype(np.int64)
-        for a in plan.aggs:
-            if a.fn == "count_distinct":
-                cols[out_schema.field(a.alias).name] = pair_counts[a.expr.name.lower()]
-        return ColumnTable(out_schema, cols, dicts, validity)
-
-    def _grouping_sets_aggregate(self, plan: "Aggregate") -> ColumnTable:
-        """ROLLUP / CUBE / GROUPING SETS as ONE finest-grain aggregate
-        (which gets the fused Aggregate(Join) path when it applies) plus
-        cheap re-aggregations of its partials per set — the two-phase
-        machinery the count_distinct desugar introduced, generalized.
-        The union null-extends group columns a set aggregates away;
-        grouping() flags tell data NULLs from subtotal NULLs."""
-        from hyperspace_tpu.ops.aggregate import aggregate_table
-        from hyperspace_tpu.plan.expr import Col
-        from hyperspace_tpu.plan.nodes import AggSpec
-        from hyperspace_tpu.schema import Field, Schema
-
-        if any(a.fn == "count_distinct" for a in plan.aggs):
-            # Distinct counts do not compose from partials (the same value
-            # in two finest groups of one coarser group would double
-            # count), so the re-fold below cannot serve them: materialize
-            # the child ONCE and aggregate each set directly over it —
-            # the plain-aggregate path owns the distinct machinery.
-            return self._grouping_sets_distinct(plan)
-
-        # Phase 1: finest grain over the full group_by, means split into
-        # sum+count partials so coarser sets can recompose them exactly.
-        base_specs: list[AggSpec] = []
-        for a in plan.aggs:
-            if a.fn == "grouping":
-                continue
-            if a.fn == "mean":
-                base_specs.append(AggSpec("sum", a.expr, f"__gs_sum_{a.alias}"))
-                base_specs.append(AggSpec("count", a.expr, f"__gs_cnt_{a.alias}"))
-            else:
-                base_specs.append(AggSpec(a.fn, a.expr, a.alias))
-        base = Aggregate(plan.child, plan.group_by, base_specs)
-        bt = self._execute(base)
-
-        out_schema = plan.schema
-        venue = self._agg_venue()
-        self._phys(
-            "GroupingSetsReaggregate",
-            sets=[list(s) for s in plan.grouping_sets],
-            venue=venue,
-        )
-
-        def refold(a: AggSpec) -> list[AggSpec]:
-            """Phase-2 spec(s) re-aggregating a phase-1 partial column."""
-            if a.fn == "mean":
-                return [
-                    AggSpec("sum", Col(f"__gs_sum_{a.alias}"), f"__gs_sum_{a.alias}"),
-                    AggSpec("sum", Col(f"__gs_cnt_{a.alias}"), f"__gs_cnt_{a.alias}"),
-                ]
-            fn2 = "sum" if a.fn in ("sum", "count") else a.fn
-            return [AggSpec(fn2, Col(a.alias), a.alias)]
-
-        parts: list[ColumnTable] = []
-        for s in plan.grouping_sets:
-            specs2 = [sp for a in plan.aggs if a.fn != "grouping" for sp in refold(a)]
-            fields = [bt.schema.field(c) for c in s]
-            for sp in specs2:
-                src = bt.schema.field(sp.expr.name)
-                dtype = src.dtype if sp.fn in ("min", "max") else (
-                    "int64" if src.dtype in ("int32", "int64", "bool", "date") else "float64"
-                )
-                fields.append(Field(sp.alias, dtype))
-            sub = aggregate_table(bt, list(s), specs2, Schema(tuple(fields)), venue=venue)
-
-            def agg_col(f, spec, cols, dicts, validity, sub=sub):
-                if spec.fn == "mean":
-                    ssum = sub.column(f"__gs_sum_{spec.alias}").astype(np.float64)
-                    scnt = sub.column(f"__gs_cnt_{spec.alias}").astype(np.float64)
-                    sv = sub.valid_mask(f"__gs_sum_{spec.alias}")
-                    with np.errstate(invalid="ignore", divide="ignore"):
-                        cols[f.name] = np.where(scnt > 0, ssum / np.maximum(scnt, 1), 0.0)
-                    if sv is not None or (scnt == 0).any():
-                        ok = scnt > 0
-                        validity[f.name] = ok if sv is None else (ok & sv)
-                elif spec.fn == "count":
-                    # COUNT is never NULL: zero-row re-folds yield a NULL
-                    # sum partial — restore 0 (same rule as the
-                    # count_distinct desugar's outer sum).
-                    v = sub.valid_mask(spec.alias)
-                    c = sub.column(spec.alias)
-                    cols[f.name] = np.where(v, c, 0) if v is not None else c
-                else:
-                    _copy_field(f, sub, spec.alias, cols, dicts, validity)
-
-            parts.append(self._gs_assemble(plan, out_schema, sub, s, bt, agg_col))
-        return ColumnTable.concat(parts)
-
-    def _gs_assemble(
-        self, plan: "Aggregate", out_schema, sub: ColumnTable, s, dict_src, agg_col
-    ) -> ColumnTable:
-        """One grouping set's output part, shared by the re-fold and
-        distinct grouping-set paths: group columns in `s` copy through,
-        group columns aggregated away null-extend, grouping() flags
-        derive from set membership, and `agg_col(field, spec, cols,
-        dicts, validity)` fills the aggregate columns."""
-        in_set = {c.lower() for c in s}
-        gb_low = {c.lower() for c in plan.group_by}
-        cols: dict[str, np.ndarray] = {}
-        dicts: dict[str, np.ndarray] = {}
-        validity: dict[str, np.ndarray] = {}
-        nrows = sub.num_rows
-        for f in out_schema.fields:
-            low = f.name.lower()
-            if low in gb_low:
-                if low in in_set:
-                    _copy_field(f, sub, f.name, cols, dicts, validity)
-                else:
-                    _null_field(
-                        f, nrows, dict_src if f.is_string else None, cols, dicts, validity
-                    )
-                continue
-            spec = next(a for a in plan.aggs if a.alias.lower() == low)
-            if spec.fn == "grouping":
-                cols[f.name] = np.full(
-                    nrows, 0 if spec.expr.name.lower() in in_set else 1, np.int64
-                )
-            else:
-                agg_col(f, spec, cols, dicts, validity)
-        return ColumnTable(out_schema, cols, dicts, validity)
-
-    def _grouping_sets_distinct(self, plan: "Aggregate") -> ColumnTable:
-        """GROUPING SETS with count_distinct aggregates (q14/q18 shapes):
-        the child materializes once, then every set aggregates it
-        directly — per-set work instead of the partial re-fold, because
-        distinct counts cannot be composed from finer partials."""
-
-        ct = self._execute(plan.child)
-        leaf = _TableLeaf(ct)
-        out_schema = plan.schema
-        self._phys(
-            "GroupingSetsDistinct",
-            sets=[list(s) for s in plan.grouping_sets],
-            distinct_cols=sorted(
-                a.expr.name.lower() for a in plan.aggs if a.fn == "count_distinct"
-            ),
-        )
-        parts: list[ColumnTable] = []
-        for s in plan.grouping_sets:
-            specs = [a for a in plan.aggs if a.fn != "grouping"]
-            sub = self._execute(Aggregate(leaf, list(s), specs))
-
-            def agg_col(f, spec, cols, dicts, validity, sub=sub):
-                _copy_field(f, sub, spec.alias, cols, dicts, validity)
-
-            parts.append(self._gs_assemble(plan, out_schema, sub, s, ct, agg_col))
-        return ColumnTable.concat(parts)
 
     def _venue(self, conf_attr: str, what: str, prefer_device: bool, needs_native: bool) -> str:
         """One pick_venue wrapper: conf defaults and the shared link floor
@@ -1154,1996 +455,3 @@ class Executor:
         return ColumnTable.concat(parts)
 
     # -- scan ------------------------------------------------------------
-    def _scan_files(self, scan: Scan) -> list[str]:
-        if scan.files is not None:
-            return list(scan.files)
-        return [fi.path for fi in list_data_files(scan.root, suffix=format_suffix(scan.format))]
-
-    def _cached_read(self, files: list[str], columns, schema) -> ColumnTable:
-        """Index-file read through the decoded-table cache; files_read
-        counts only physical (miss) reads."""
-        before = hio.table_cache_stats()["miss_files"]
-        table = hio.read_parquet_cached(files, columns=columns, schema=schema)
-        self.stats["files_read"] += hio.table_cache_stats()["miss_files"] - before
-        return table
-
-    def _scan(self, scan: Scan, columns: list[str] | None = None) -> ColumnTable:
-        files = self._scan_files(scan)
-        cols = columns if columns is not None else scan.scan_schema.names
-        if not files:  # everything pruned away
-            return ColumnTable.empty(scan.scan_schema.select(cols))
-        if scan.bucket_spec is not None:
-            # Index files are immutable per version — cache their decode.
-            return self._cached_read(files, cols, scan.scan_schema)
-        self.stats["files_read"] += len(files)
-        return hio.read_table_files(files, scan.format, columns=cols, schema=scan.scan_schema)
-
-    # -- filter (with index bucket pruning) ------------------------------
-    def _filter(self, plan: Filter) -> ColumnTable:
-        child = plan.child
-        # Per-OPERATOR pruning evidence: deltas of the query-cumulative
-        # counters from this frame's start.
-        fp0, rp0 = self.stats["files_pruned"], self.stats["rows_pruned"]
-        mask_venue = self._filter_venue()
-        mask_kernel = "host-mask" if mask_venue == "host" else "fused-xla-mask"
-        if isinstance(child, Scan) and child.bucket_spec is not None:
-            pruned = self._prune_bucket_files(child, plan.predicate)
-            if pruned is not None:
-                self._phys(
-                    "IndexPointLookup",
-                    files_pruned=self.stats["files_pruned"] - fp0,
-                    kernel=f"bucket-hash-prune + {mask_kernel}",
-                )
-                table = self._cached_read(pruned, child.scan_schema.names, child.scan_schema)
-                return apply_filter(table, plan.predicate, mesh=self.mesh, venue=mask_venue)
-            ranged = self._range_read(child, plan.predicate)
-            if ranged is not None:
-                table, exact = ranged
-                if exact and predicate_all_key_bounds(plan.predicate, child.bucket_spec[1][0]):
-                    # The slice IS the predicate: every conjunct bounds the
-                    # sorted key, so the residual mask would be all-true —
-                    # skip its evaluation (and the device round-trip).
-                    self._phys(
-                        "IndexRangeScan",
-                        files_pruned=self.stats["files_pruned"] - fp0,
-                        rows_pruned=self.stats["rows_pruned"] - rp0,
-                        kernel="minmax-prune + searchsorted-slice (exact, mask skipped)",
-                    )
-                    return table
-                self._phys(
-                    "IndexRangeScan",
-                    files_pruned=self.stats["files_pruned"] - fp0,
-                    rows_pruned=self.stats["rows_pruned"] - rp0,
-                    kernel=f"minmax-prune + searchsorted-slice + {mask_kernel}",
-                )
-                return apply_filter(table, plan.predicate, mesh=self.mesh, venue=mask_venue)
-        if isinstance(child, Union):
-            # Hybrid scan: prune the bucketed input(s), keep deltas whole.
-            new_inputs: list[LogicalPlan] = []
-            for inp in child.inputs:
-                if isinstance(inp, Scan) and inp.bucket_spec is not None:
-                    pruned = self._prune_bucket_files(inp, plan.predicate)
-                    if pruned is None:
-                        ranged = self._range_prune_list(inp, plan.predicate)
-                        pruned = ranged[0] if ranged is not None else None  # (kept, bounds, stats)
-                    if pruned is not None:
-                        inp = dataclasses.replace(inp, files=pruned)
-                new_inputs.append(inp)
-            self._phys(
-                "HybridScanFilter",
-                files_pruned=self.stats["files_pruned"] - fp0,
-                kernel=f"bucket/minmax-prune + {mask_kernel}",
-            )
-            return apply_filter(
-                self._union(Union(new_inputs)), plan.predicate,
-                mesh=self.mesh, venue=mask_venue,
-            )
-        self._phys(kernel=mask_kernel)
-        return apply_filter(self._execute(child), plan.predicate, mesh=self.mesh, venue=mask_venue)
-
-    # Bucket pruning reads at most this many point combinations; above it
-    # the (still-correct) range/mask machinery takes over.
-    _MAX_POINT_COMBOS = 64
-
-    def _prune_bucket_files(self, scan: Scan, predicate: Expr) -> list[str] | None:
-        """If the predicate pins every bucket column with equality
-        literals — single (eq) or multi-point (IN) — return only the
-        owning buckets' files. The analog of partition pruning the
-        reference cannot do (FilterIndexRule keeps a full scan,
-        FilterIndexRule.scala:114-120); IN on the bucket column divides
-        IO by numBuckets/|IN| instead of 1."""
-        import itertools
-        import math
-
-        from hyperspace_tpu.plan.expr import InList
-
-        num_buckets, bucket_cols = scan.bucket_spec
-        cand: dict[str, list] = {}
-        for conj in split_conjuncts(predicate):
-            got: tuple[str, list] | None = None
-            if isinstance(conj, BinOp) and conj.op == "eq":
-                if isinstance(conj.left, Col) and isinstance(conj.right, Lit):
-                    got = (conj.left.name.lower(), [conj.right.value])
-                elif isinstance(conj.right, Col) and isinstance(conj.left, Lit):
-                    got = (conj.right.name.lower(), [conj.left.value])
-            elif isinstance(conj, InList) and isinstance(conj.child, Col):
-                got = (conj.child.name.lower(), list(conj.values))
-            if got is not None:
-                name, vals = got
-                # Conjunctive constraints: any one conjunct's list is a
-                # valid superset of the reachable values — keep the
-                # smallest.
-                if name not in cand or len(vals) < len(cand[name]):
-                    cand[name] = vals
-        try:
-            lists = [cand[c.lower()] for c in bucket_cols]
-        except KeyError:
-            return None
-        if math.prod(len(l) for l in lists) > self._MAX_POINT_COMBOS:
-            return None
-        fields = [scan.scan_schema.field(c) for c in bucket_cols]
-        names = set()
-        for combo in itertools.product(*lists):
-            h = hash_scalar_key(list(combo), fields)
-            names.add(hio.bucket_file_name(int(bucket_ids(h, num_buckets, np)[0])))
-        files = self._scan_files(scan)
-        matches = [f for f in files if Path(f).name in names]
-        if matches:
-            self.stats["files_pruned"] += len(files) - len(matches)
-            return matches
-        return None
-
-    def _range_prune_list(
-        self, scan: Scan, predicate: Expr
-    ) -> tuple[list[str], KeyBounds, dict] | None:
-        """File-level range (min/max) pruning: drop bucket files whose
-        manifest key stats cannot overlap the predicate's bounds on the
-        leading indexed column. The analog of FileSourceScanExec's parquet
-        min/max pruning (SURVEY.md §2.2), which the reference inherits
-        from Spark. Comparisons run in the filter mask's own numeric
-        domain so pruning never disagrees with it. Returns None when no
-        literal bounds or no stats exist."""
-        key = scan.bucket_spec[1][0]
-        bounds = key_bounds(predicate, key)
-        files = self._scan_files(scan)
-        stats = hio.file_key_stats(files) if bounds is not None else {}
-        if bounds is not None and stats:
-            bounds, stat_conv = _convert_bounds(scan.scan_schema.field(key), bounds)
-        else:
-            stat_conv = None
-        # Included-column pruning: any OTHER referenced column with
-        # manifest columnStats and literal bounds prunes too (the
-        # reference gets this from parquet per-column min/max via
-        # FileSourceScanExec, SURVEY.md §2.2).
-        refs = {r.lower() for r in predicate.references()}
-        extra: list[tuple[KeyBounds, object, dict]] = []
-        for c in scan.scan_schema.names:
-            if c.lower() == key.lower() or c.lower() not in refs:
-                continue
-            b = key_bounds(predicate, c)
-            if b is None:
-                continue
-            cstats = hio.file_column_stats(files, c)
-            if not cstats:
-                continue
-            cb, cconv = _convert_bounds(scan.scan_schema.field(c), b)
-            extra.append((cb, cconv, cstats))
-        if stat_conv is None and not extra:
-            return None
-        kept: list[str] = []
-        for f in files:
-            keep = True
-            if stat_conv is not None and f in stats:
-                s = stats[f]
-                # s is None ⇔ bucket empty or all-null key: no row can
-                # satisfy a literal comparison (3VL), safe to skip.
-                keep = s is not None and _stats_overlap(bounds, stat_conv(s[0]), stat_conv(s[1]))
-            for cb, cconv, cstats in extra:
-                if not keep:
-                    break
-                if f in cstats:
-                    s = cstats[f]
-                    keep = s is not None and _stats_overlap(cb, cconv(s[0]), cconv(s[1]))
-            if keep:
-                kept.append(f)
-        if stat_conv is None and len(kept) == len(files):
-            # Included-column stats pruned nothing and the key gives no
-            # slicing bounds: stay on the plain scan path (whole cached
-            # bucket files — the device upload cache keys on them).
-            return None
-        self.stats["files_pruned"] += len(files) - len(kept)
-        return kept, (bounds if stat_conv is not None else None), stats
-
-    def _range_read(self, scan: Scan, predicate: Expr) -> tuple[ColumnTable, bool] | None:
-        """File-level range pruning + within-file searchsorted slicing
-        (each surviving file is key-sorted by construction, so qualifying
-        rows form one contiguous run). Dictionary codes are not
-        value-ordered across files and null prefixes break sortedness —
-        both fall back to reading the file whole (mask handles the rest).
-        Returns (table, exact): exact ⇔ every row returned provably
-        satisfies the key bounds (all parts sliced on a sorted, null-free,
-        stats-backed key)."""
-        from concurrent.futures import ThreadPoolExecutor
-
-        pruned = self._range_prune_list(scan, predicate)
-        if pruned is None:
-            return None
-        kept, bounds, stats_files = pruned
-        schema = scan.scan_schema
-        field = schema.field(scan.bucket_spec[1][0])
-        if not kept:
-            return ColumnTable.empty(schema), True
-        before = hio.table_cache_stats()["miss_files"]
-        with ThreadPoolExecutor(max_workers=min(8, len(kept))) as pool:
-            tables = list(
-                pool.map(
-                    lambda fp: hio.read_parquet_cached([fp], columns=schema.names, schema=schema),
-                    kept,
-                )
-            )
-        self.stats["files_read"] += hio.table_cache_stats()["miss_files"] - before
-        parts: list[ColumnTable] = []
-        # Float keys can hold NaN VALUES (sorted last by the build); a
-        # lower-bound-only slice would include them while the mask drops
-        # them — never claim exactness for float key columns. bounds is
-        # None when only included-column stats pruned: no key slicing.
-        exact = bounds is not None and field.device_dtype.kind != "f"
-        for fp, t in zip(kept, tables):
-            if t.num_rows == 0:
-                continue
-            sliceable = (
-                bounds is not None
-                and not field.is_string
-                and t.valid_mask(field.name) is None
-                and fp in stats_files  # stats-backed ⇒ written key-sorted
-            )
-            if sliceable:
-                colv = t.columns[field.name]
-                lo_i, hi_i = 0, t.num_rows
-                if bounds.lo is not None:
-                    lo_i = int(np.searchsorted(colv, bounds.lo, side="right" if bounds.lo_strict else "left"))
-                if bounds.hi is not None:
-                    hi_i = int(np.searchsorted(colv, bounds.hi, side="left" if bounds.hi_strict else "right"))
-                if hi_i <= lo_i:
-                    self.stats["rows_pruned"] += t.num_rows
-                    continue
-                if lo_i > 0 or hi_i < t.num_rows:
-                    self.stats["rows_pruned"] += t.num_rows - (hi_i - lo_i)
-                    t = t.take(np.arange(lo_i, hi_i))
-            else:
-                exact = False
-            parts.append(t)
-        if not parts:
-            return ColumnTable.empty(schema), True
-        out = ColumnTable.concat(parts) if len(parts) > 1 else parts[0]
-        return out, exact
-
-    # -- join ------------------------------------------------------------
-    def _join(self, plan: Join) -> ColumnTable:
-        lside, rside, left_side, right_side = self._join_sides(plan)
-        # Path from THIS frame's decision (the _join_sides call above
-        # sets it LAST, after any nested joins it executed ran). buckets/
-        # devices are read after _partition_join, which sets them for the
-        # kernel that just ran (this join's own).
-        path = self.stats["join_path"]
-        if left_side is not None:
-            out = self._aligned_join(plan, left_side, right_side, lside, rside)
-        else:
-            out = self._partition_join(plan, lside, rside)
-        if self.stats["join_kernel"] == "host-broadcast-hash":
-            path = "broadcast-hash"
-            self.stats["join_path"] = path
-        if plan.condition is not None and plan.how == "inner":
-            # Inner-join ON residual: a plain 3-valued filter over the
-            # matched rows, venue- and mesh-aware like every other
-            # predicate site. (Outer/semi/anti residuals alter MATCHING
-            # and are applied inside _partition_join.) The filtered
-            # table deliberately does NOT inherit any preserved bucket
-            # grouping (per-bucket counts changed).
-            before = out.num_rows
-            mask = eval_predicate_mask(
-                out, plan.condition, mesh=self.mesh, venue=self._filter_venue()
-            )
-            out = out.filter_mask(mask)
-            self._phys(residual_condition=True, residual_rows_dropped=before - out.num_rows)
-        self._phys(
-            "BroadcastHashJoin" if path == "broadcast-hash" else "SortMergeJoin",
-            path=path,
-            kernel=self.stats["join_kernel"],
-            buckets=self.stats["num_buckets"],
-            devices=self.stats["join_devices"],
-        )
-        return out
-
-    @staticmethod
-    def _bucket_hash_dtypes(scan: Scan) -> tuple[str, ...]:
-        """The hash domain of a scan's bucket columns. The canonical row
-        hash is dtype-sensitive (an int64 mixes two words; an int32 one),
-        so two bucketings agree on equal key VALUES only when the bucket
-        column dtypes agree."""
-        out = []
-        for c in scan.bucket_spec[1]:
-            f = scan.scan_schema.field(c)
-            out.append("string" if f.is_string else str(np.dtype(f.device_dtype)))
-        return tuple(out)
-
-    def _keyed_on_buckets(self, side: AlignedSide | None, join_on: list[str]) -> bool:
-        """True iff the side is an index scan bucketed exactly on its
-        join keys (the precondition for any bucket-parallel pairing)."""
-        return (
-            side is not None
-            and side.scan.bucket_spec is not None
-            and [c.lower() for c in side.scan.bucket_spec[1]]
-            == [c.lower() for c in join_on]
-        )
-
-    def _join_sides(
-        self, plan: Join
-    ) -> tuple["SideData", "SideData", AlignedSide | None, AlignedSide | None]:
-        """Per-side bucket data for a join — the one place that decides
-        between the zero-exchange aligned path (both sides bucketed with
-        equal counts on the join keys), the re-bucketing exchange (one
-        side bucketed, the other re-bucketized on the fly to match), a
-        bucket-preserving reuse of an inner join's output grouping, and
-        the single-partition fallback. Returns the AlignedSides
-        (None, None) on every non-both-aligned path."""
-        left_side = self._aligned_side(plan.left)
-        right_side = self._aligned_side(plan.right)
-        if (
-            self._keyed_on_buckets(left_side, plan.left_on)
-            and self._keyed_on_buckets(right_side, plan.right_on)
-            and left_side.scan.bucket_spec[0] == right_side.scan.bucket_spec[0]
-            # Equal VALUES hash identically only in equal dtype domains.
-            and self._bucket_hash_dtypes(left_side.scan)
-            == self._bucket_hash_dtypes(right_side.scan)
-        ):
-            self.stats["join_path"] = "zero-exchange-aligned"
-            num_buckets = left_side.scan.bucket_spec[0]
-            # Dynamic partition pruning (the analog of Spark 3's DPP,
-            # which post-dates the reference's engine): build the
-            # predicate-bearing side FIRST, bound its surviving join
-            # keys, and skip the other side's bucket files whose
-            # manifest key stats cannot overlap — a dimension filtered
-            # to one month reads ~1/60th of a date-bucketed fact index.
-            producer = None
-            if plan.how == "inner":
-                if left_side.predicate is not None and right_side.predicate is None:
-                    producer = "left"
-                elif right_side.predicate is not None and left_side.predicate is None:
-                    producer = "right"
-                elif left_side.predicate is not None and right_side.predicate is not None:
-                    producer = (
-                        "left"
-                        if self._base_rows(left_side) <= self._base_rows(right_side)
-                        else "right"
-                    )
-            if producer == "left":
-                lside = self._side_data(left_side, num_buckets)
-                bounds = self._side_key_bounds(lside, left_side)
-                rside = self._side_data(right_side, num_buckets, dpp_bounds=bounds)
-            elif producer == "right":
-                rside = self._side_data(right_side, num_buckets)
-                bounds = self._side_key_bounds(rside, right_side)
-                lside = self._side_data(left_side, num_buckets, dpp_bounds=bounds)
-            else:
-                lside = self._side_data(left_side, num_buckets)
-                rside = self._side_data(right_side, num_buckets)
-            return lside, rside, left_side, right_side
-        # One side bucketed on its join keys: the other side can ride a
-        # query-time re-bucketing exchange (hash + counting sort on host,
-        # device sort on the device venue) so the merge stays
-        # bucket-parallel — SURVEY §2.3's "single re-bucketing all-to-all
-        # when bucket counts don't match" and the ranker's
-        # mismatched-pair case (JoinIndexRanker.scala:31-34).
-        mode = self.conf.join_rebucketize if self.conf is not None else "auto"
-        lt = rt = None
-        l_keyed = self._keyed_on_buckets(left_side, plan.left_on)
-        r_keyed = self._keyed_on_buckets(right_side, plan.right_on)
-        if mode != "off" and (l_keyed != r_keyed):
-            if l_keyed:
-                idx_side, other_plan, other_on = left_side, plan.right, plan.right_on
-            else:
-                idx_side, other_plan, other_on = right_side, plan.left, plan.left_on
-            num_buckets = idx_side.scan.bucket_spec[0]
-            idx_fields = [
-                idx_side.scan.scan_schema.field(c) for c in idx_side.scan.bucket_spec[1]
-            ]
-            t_other = self._execute(other_plan)
-            preserved = self._preserved_sidedata(t_other, other_on)
-            if preserved is not None and not (
-                len(preserved.offsets) - 1 == num_buckets
-                and _hash_fields_compatible(preserved.hash_fields, idx_fields)
-            ):
-                preserved = None
-            engage = (
-                preserved is not None  # reuse is free — always take it
-                or mode == "force"
-                or not self._should_broadcast(t_other.num_rows, self._base_rows(idx_side))
-            )
-            if engage:
-                sd_other = preserved or self._rebucketize_side(
-                    t_other, other_on, idx_fields, num_buckets
-                )
-                if sd_other is not None:
-                    # The materialized side doubles as the DPP producer
-                    # when dropping unmatched INDEXED-side rows early is
-                    # sound for this join type (the indexed side must not
-                    # be a preserved outer side).
-                    idx_is_right = not l_keyed
-                    prune_ok = (
-                        plan.how == "inner"
-                        or (idx_is_right and plan.how in ("left", "semi", "anti"))
-                        or (not idx_is_right and plan.how == "right")
-                    )
-                    dpp = None
-                    if prune_ok:
-                        dpp = self._table_key_bounds(t_other, other_on[0])
-                    sd_idx = self._side_data(idx_side, num_buckets, dpp_bounds=dpp)
-                    self.stats["join_path"] = (
-                        "bucket-preserved-aligned" if preserved is not None else "rebucketized-aligned"
-                    )
-                    self._phys(
-                        exchange="preserved" if preserved is not None else "rebucketize",
-                        buckets=num_buckets,
-                    )
-                    if l_keyed:
-                        return sd_idx, sd_other, None, None
-                    return sd_other, sd_idx, None, None
-            if l_keyed:
-                rt = t_other
-            else:
-                lt = t_other
-        if mode != "off" and not l_keyed and not r_keyed:
-            # Neither side indexed: a child inner join's preserved bucket
-            # grouping can still pair — directly against another
-            # preserved side, or by re-bucketizing the other side into
-            # its domain.
-            lt = lt if lt is not None else self._execute(plan.left)
-            rt = rt if rt is not None else self._execute(plan.right)
-            pl = self._preserved_sidedata(lt, plan.left_on)
-            pr = self._preserved_sidedata(rt, plan.right_on)
-            if (
-                pl is not None
-                and pr is not None
-                and len(pl.offsets) == len(pr.offsets)
-                and _hash_fields_compatible(pl.hash_fields, pr.hash_fields)
-            ):
-                self.stats["join_path"] = "bucket-preserved-aligned"
-                self._phys(exchange="preserved-both", buckets=len(pl.offsets) - 1)
-                return pl, pr, None, None
-            keyed = pl or pr
-            if keyed is not None and (
-                mode == "force" or not self._should_broadcast(lt.num_rows, rt.num_rows)
-            ):
-                if pl is not None:
-                    other = self._rebucketize_side(
-                        rt, plan.right_on, list(pl.hash_fields), len(pl.offsets) - 1
-                    )
-                    pair = (pl, other)
-                else:
-                    other = self._rebucketize_side(
-                        lt, plan.left_on, list(pr.hash_fields), len(pr.offsets) - 1
-                    )
-                    pair = (other, pr)
-                if pair[0] is not None and pair[1] is not None:
-                    self.stats["join_path"] = "rebucketized-aligned"
-                    self._phys(
-                        exchange="preserved+rebucketize", buckets=len(keyed.offsets) - 1
-                    )
-                    return pair[0], pair[1], None, None
-        # General path: single partition (bucket count 1). The path stat
-        # is set AFTER the children run — a nested join inside them sets
-        # its own path and must not leak into this frame's label.
-        if lt is None:
-            lt = self._execute(plan.left)
-        if rt is None:
-            rt = self._execute(plan.right)
-        self.stats["join_path"] = "single-partition"
-        one = lambda t: SideData(t, np.array([0, t.num_rows], dtype=np.int64), False)  # noqa: E731
-        return one(lt), one(rt), None, None
-
-    def _aligned_side(self, plan: LogicalPlan) -> AlignedSide | None:
-        node, project, predicate = plan, None, None
-        # Linear chain the join rule preserves: Project / Filter over the
-        # (possibly hybrid) index scan, in any order.
-        while isinstance(node, (Project, Filter)):
-            if isinstance(node, Project):
-                if not node.is_simple:
-                    # Computed entries can't be absorbed into the scan
-                    # column list; fall back to the general path (which
-                    # executes the Project node itself).
-                    return None
-                if project is None:  # outermost projection defines output
-                    project = node.columns
-                node = node.child
-            else:
-                predicate = node.predicate if predicate is None else And(predicate, node.predicate)
-                node = node.child
-        if isinstance(node, Union):
-            # Hybrid scan of ANY width: exactly one bucketed index scan
-            # plus unbucketed delta scans (appended files). The rewrite
-            # rule emits the two-input shape; refresh chains or manual
-            # unions may widen it.
-            base = None
-            deltas: list[Scan] = []
-            for inp in node.inputs:
-                if isinstance(inp, Project) and inp.is_simple and isinstance(inp.child, Scan):
-                    inp = inp.child
-                if not isinstance(inp, Scan):
-                    return None
-                if inp.bucket_spec is not None:
-                    if base is not None:
-                        return None  # two index scans: not a hybrid side
-                    base = inp
-                else:
-                    deltas.append(inp)
-            if base is None:
-                return None
-            return AlignedSide(base, project, deltas=tuple(deltas), predicate=predicate)
-        if isinstance(node, Scan):
-            return AlignedSide(node, project, predicate=predicate)
-        return None
-
-    def _base_rows(self, side: AlignedSide) -> int:
-        """Total indexed rows from the side's manifest (for picking the
-        smaller DPP producer); large sentinel when unknown."""
-        from pathlib import Path as _P
-
-        files = self._scan_files(side.scan)
-        if files:
-            m = hio.read_manifest_cached(_P(files[0]).parent)
-            if m and "bucketRows" in m:
-                return int(sum(m["bucketRows"]))
-        return 1 << 60
-
-    # Set-based DPP only materializes the producer's distinct keys below
-    # these sizes (the semi-join/bloom reduction; beyond them the range
-    # alone applies).
-    _DPP_SET_MAX_ROWS = 4_000_000
-    _DPP_SET_MAX_KEYS = 262_144
-
-    def _side_key_bounds(self, sdata: "SideData", side: AlignedSide):
-        """DPP producer info of an aligned side (see _table_key_bounds)."""
-        return self._table_key_bounds(sdata.table, side.scan.bucket_spec[1][0])
-
-    def _table_key_bounds(self, t: ColumnTable, key: str):
-        """(lo, hi, key_set | None) of the surviving join-key values
-        (nulls excluded — they never match). lo/hi are value-domain
-        (strings decoded via the dictionary); key_set is the SORTED
-        distinct int keys when small enough to enumerate — the consumer
-        filters its rows by membership (the semi-join reduction half of
-        DPP: a 1/70-selective demographics filter cuts the fact side 70x
-        BEFORE any pairing). (None, None, None) = empty."""
-        f = t.schema.field(key)
-        vals = t.columns[f.name]
-        valid = t.valid_mask(key)
-        if valid is not None:
-            vals = vals[valid]
-        if len(vals) == 0:
-            return (None, None, None)  # empty producer: skip everything
-        if f.device_dtype.kind == "f" and bool(np.isnan(vals).any()):
-            # NaN keys are real joinable values in the float domain but
-            # poison min/max (NaN bounds would slice every finite row
-            # away) — disable DPP for this producer entirely.
-            return None
-        if f.name in t.dictionaries:
-            # Decoded-string bounds have no consumer: string keys disable
-            # the bucket set, row slicing, and kset reduction alike — a
-            # non-None result here would only churn the derived cache
-            # with dead no-op cut entries (pinning base refs per distinct
-            # producer filter). Report "no DPP" instead.
-            return None
-        lo, hi = vals.min(), vals.max()
-        kset = None
-        if (
-            f.device_dtype.kind in "iu"
-            and len(vals) <= self._DPP_SET_MAX_ROWS
-        ):
-            u = np.unique(vals)
-            if len(u) <= self._DPP_SET_MAX_KEYS:
-                kset = u
-        return (lo, hi, kset)
-
-    def _rebucketize_side(
-        self, table: ColumnTable, key_cols: list[str], idx_fields, num_buckets: int
-    ) -> "SideData | None":
-        """Query-time re-bucketing exchange: group an arbitrary
-        materialized table into the SAME bucket layout an index side
-        uses, by recomputing the canonical row hash with each key column
-        cast into the index side's dtype domain (equal values then hash
-        identically; values unrepresentable on the index side have no
-        partner there, so their placement cannot matter). Host venue:
-        native counting sort; device venue: one device sort of the
-        bucket ids. None when the key shapes cannot share a hash domain
-        (string vs non-string)."""
-        from hyperspace_tpu.execution.builder import NULL_HASH
-        from hyperspace_tpu.ops.hashing import (
-            combine_hashes,
-            hash_int_column,
-            string_dict_hashes,
-        )
-
-        hs = []
-        for c, fi in zip(key_cols, idx_fields):
-            f = table.schema.field(c)
-            if f.is_string != fi.is_string:
-                return None
-            arr = table.columns[f.name]
-            if f.is_string:
-                dh = string_dict_hashes(table.dictionaries[f.name])
-                h = dh[arr] if len(dh) else np.zeros(len(arr), np.uint32)
-            else:
-                if arr.dtype != fi.device_dtype:
-                    arr = arr.astype(fi.device_dtype)
-                h = hash_int_column(arr, np)
-            valid = table.valid_mask(c)
-            if valid is not None:
-                h = np.where(valid, h, NULL_HASH)
-            hs.append(h)
-        bucket = np.asarray(bucket_ids(combine_hashes(hs, np), num_buckets, np), dtype=np.int32)
-        venue = self._join_venue()
-        kernel = None
-        if venue == "device":
-            import jax
-            import jax.numpy as jnp
-
-            order = np.asarray(jax.device_get(jnp.argsort(jnp.asarray(bucket))))
-            counts = np.bincount(bucket, minlength=num_buckets).astype(np.int64)
-            kernel = "device-sort-exchange"
-        else:
-            from hyperspace_tpu import native
-
-            res = native.bucket_perm(bucket, num_buckets)
-            if res is not None:
-                order, counts = res
-                kernel = "host-counting-sort-exchange"
-            else:
-                order = np.argsort(bucket, kind="stable")
-                counts = np.bincount(bucket, minlength=num_buckets).astype(np.int64)
-                kernel = "host-argsort-exchange"
-        self.stats["exchange_kernel"] = kernel
-        offsets = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
-        return SideData(table.take(order), offsets, False, hash_fields=tuple(idx_fields))
-
-    def _side_data(
-        self, side: AlignedSide, num_buckets: int, dpp_bounds=None
-    ) -> "SideData":
-        """One concatenated bucket-grouped table per join side (bucket
-        files read in parallel through the decoded-table cache), plus
-        (hybrid scan) delta rows bucketized on the fly with the same
-        canonical row hash the build used. `dpp_bounds` (lo, hi) is the
-        other side's surviving key range (dynamic partition pruning): an
-        enumerable span skips whole bucket FILES by hashing the span to
-        its bucket set, and every surviving sorted bucket slices to the
-        one contiguous ROW run inside the bounds."""
-        from concurrent.futures import ThreadPoolExecutor
-
-        schema = side.scan.scan_schema
-        hf = tuple(schema.field(c) for c in side.scan.bucket_spec[1])
-        groups = self._bucket_files_in_order(side.scan, num_buckets)
-        if dpp_bounds is not None:
-            keep = self._dpp_bucket_set(side, dpp_bounds, num_buckets)
-            if keep is not None:
-                pruned = sum(len(g) for b, g in enumerate(groups) if b not in keep)
-                if pruned:
-                    groups = [g if b in keep else [] for b, g in enumerate(groups)]
-                    self.stats["files_pruned"] += pruned
-                    self._phys(dpp_files_pruned=pruned)
-        before = hio.table_cache_stats()["miss_files"]
-        empty = ColumnTable.empty(schema)
-        with ThreadPoolExecutor(max_workers=8) as pool:
-            tables = list(
-                pool.map(
-                    lambda g: hio.read_parquet_cached(g, columns=schema.names, schema=schema)
-                    if g
-                    else empty,
-                    groups,
-                )
-            )
-        if dpp_bounds is not None and dpp_bounds[0] is not None:
-            import hashlib
-
-            key_field = schema.field(side.scan.bucket_spec[1][0])
-            kset_digest = (
-                hashlib.md5(dpp_bounds[2].tobytes()).hexdigest()
-                if dpp_bounds[2] is not None
-                else None  # one digest per SIDE, not per bucket
-            )
-            rows_before = sum(t.num_rows for t in tables)
-            tables = [
-                self._dpp_cut_cached(
-                    t, key_field, dpp_bounds, sliceable=len(g) <= 1, kset_digest=kset_digest
-                )
-                for g, t in zip(groups, tables)
-            ]
-            cut = rows_before - sum(t.num_rows for t in tables)
-            if cut:
-                self.stats["rows_pruned"] += cut
-                self._phys(dpp_rows_pruned=cut)
-        self.stats["files_read"] += hio.table_cache_stats()["miss_files"] - before
-        counts = np.array([t.num_rows for t in tables], dtype=np.int64)
-        base = _concat_side_cached(tables)
-        offsets = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
-        # Empty (fully pruned) groups are trivially sorted.
-        sorted_within = all(len(g) <= 1 for g in groups)
-        if side.deltas:
-            dts = [self._scan(d, columns=list(schema.names)) for d in side.deltas]
-            # Hash on the bucket columns in BUILD order (not join-key
-            # order) so delta rows land in the same buckets the index used.
-            dbs = [
-                bucket_ids(compute_row_hashes(dt, side.scan.bucket_spec[1]), num_buckets, np)
-                for dt in dts
-            ]
-            all_bucket = np.concatenate(
-                [np.repeat(np.arange(num_buckets, dtype=np.int32), counts), *dbs]
-            )
-            combined = ColumnTable.concat([base, *dts])
-            order = np.argsort(all_bucket, kind="stable")
-            counts2 = np.bincount(all_bucket, minlength=num_buckets)
-            offsets = np.concatenate([[0], np.cumsum(counts2)]).astype(np.int64)
-            out = SideData(combined.take(order), offsets, False, hash_fields=hf)
-        else:
-            out = SideData(base, offsets, sorted_within, hash_fields=hf)
-        if side.predicate is not None:
-            out = _filter_side(out, side.predicate, self.mesh, self._filter_venue())
-        return out
-
-    def _aligned_join(
-        self,
-        plan: Join,
-        left: AlignedSide,
-        right: AlignedSide,
-        lside: "SideData",
-        rside: "SideData",
-    ) -> ColumnTable:
-        """Bucket-aligned zero-exchange SMJ: both sides arrive grouped by
-        the same bucket function, so per-bucket merge joins concatenated
-        equal the global join."""
-        out = self._partition_join(plan, lside, rside)
-        cols = None
-        if plan.how in ("semi", "anti"):
-            # Left-only output; the right side contributes no columns.
-            if left.project is not None:
-                cols = list(left.project)
-        elif left.project is not None or right.project is not None:
-            keep = list(left.project if left.project is not None else left.scan.scan_schema.names)
-            rkeys = {k.lower() for k in plan.right_on}
-            for c in right.project if right.project is not None else right.scan.scan_schema.names:
-                if c.lower() not in rkeys and c.lower() not in {k.lower() for k in keep}:
-                    keep.append(c)
-            cols = keep
-        if cols is None:
-            return out
-        return self._propagate_stash(out, out.select(cols))
-
-    # DPP only enumerates the producer's key span when it is this small
-    # (a year of dates is 366 hashes; demographic keys spanning millions
-    # stay un-enumerated and fall back to row slicing only).
-    _DPP_SPAN_LIMIT = 8192
-
-    def _dpp_bucket_set(self, side: AlignedSide, bounds, num_buckets: int):
-        """The set of bucket ids the producer's surviving keys can hash
-        into, or None when not enumerable (wide span / non-int / multi-
-        column bucket key). Keys are hash-distributed across buckets, so
-        file [min, max] stats cannot prune — but a small ENUMERABLE key
-        span (or exact key set) hashes to a concrete bucket subset (31
-        dates touch at most 31 of 64 buckets; a point key exactly one)."""
-        lo, hi, kset = bounds
-        if lo is None:  # empty producer: nothing joins
-            return set()
-        if len(side.scan.bucket_spec[1]) != 1:
-            return None
-        key = side.scan.bucket_spec[1][0]
-        f = side.scan.scan_schema.field(key)
-        if f.is_string or f.device_dtype.kind not in "iu":
-            return None
-        if kset is not None and len(kset) <= self._DPP_SPAN_LIMIT:
-            vals = kset.astype(f.device_dtype, copy=False)
-        else:
-            span = int(hi) - int(lo) + 1
-            if span > self._DPP_SPAN_LIMIT:
-                return None
-            vals = np.arange(int(lo), int(hi) + 1, dtype=f.device_dtype)
-        probe = ColumnTable(
-            side.scan.scan_schema.select([key]), {f.name: vals}, {}, {}
-        )
-        h = compute_row_hashes(probe, [key])
-        return set(np.unique(bucket_ids(h, num_buckets, np)).tolist())
-
-    def _dpp_cut_cached(
-        self, t: ColumnTable, key_field, dpp_bounds, sliceable: bool, kset_digest=None
-    ) -> ColumnTable:
-        """Range-slice + set-membership cut of one bucket table, memoized
-        on (stable table identity, bounds) so a REPEATED query serves the
-        same frozen sliced tables — keeping the whole downstream identity
-        chain (concat, factorize, channels, pads, HBM uploads) warm. A
-        per-query (unstable) table just computes the cut directly."""
-        from hyperspace_tpu.execution import device_cache as dc
-
-        lo, hi, kset = dpp_bounds
-
-        def cut() -> ColumnTable:
-            s = (
-                self._dpp_slice_table(t, key_field, lo, hi)
-                if sliceable and t.num_rows
-                else None
-            )
-            if s is None:
-                s = t
-            if (
-                kset is not None
-                and s.num_rows
-                and not key_field.is_string
-                and key_field.device_dtype.kind in "iu"
-            ):
-                # Semi-join reduction: keep only rows whose key is in the
-                # producer's distinct set (sorted-membership probe; nulls
-                # can't match). A sorted subsequence stays sorted.
-                colv = s.columns[key_field.name]
-                pos = np.minimum(np.searchsorted(kset, colv), len(kset) - 1)
-                hit = kset[pos] == colv
-                kvalid = s.valid_mask(key_field.name)
-                if kvalid is not None:
-                    hit = hit & kvalid
-                if not hit.all():
-                    s = s.filter_mask(hit)
-            return s
-
-        if t.num_rows == 0:
-            return t
-        if kset is not None and kset_digest is None:
-            return cut()  # no digest supplied: never key a cache on part of the cut
-        refs, parts = _stable_table_refs(t, {n.lower() for n in t.schema.names})
-        if not refs:
-            return cut()
-
-        def scalar(v):
-            return v.item() if hasattr(v, "item") else v
-
-        key = ("dppcut", parts, scalar(lo), scalar(hi), kset_digest)
-
-        def build():
-            s = cut()
-            if s is t:
-                return s, 0  # uncut: pass the (already stable) base through
-            for arr in (*s.columns.values(), *s.validity.values()):
-                dc.freeze(arr)
-            size = int(sum(a.nbytes for a in s.columns.values()))
-            return s, size
-
-        return dc.HOST_DERIVED.get_or_build(key, refs, build)
-
-    @staticmethod
-    def _dpp_slice_table(table: ColumnTable, field, lo, hi) -> ColumnTable | None:
-        """Rows of one KEY-SORTED bucket table inside [lo, hi] — one
-        contiguous searchsorted run (the within-file analog of range
-        pruning; hash bucketing scatters the key domain across files,
-        but WITHIN a file the build's sort makes any value range one
-        slice). None when the table isn't safely sliceable."""
-        if field.is_string or table.valid_mask(field.name) is not None:
-            return None
-        colv = table.columns[field.name]
-        lo_i = int(np.searchsorted(colv, lo, side="left"))
-        hi_i = int(np.searchsorted(colv, hi, side="right"))
-        if lo_i == 0 and hi_i == table.num_rows:
-            return table
-        return table.take(np.arange(lo_i, hi_i))
-
-    def _bucket_files_in_order(self, scan: Scan, num_buckets: int) -> list[list[str]]:
-        """Per-bucket file groups. A bucket can have several files (base
-        version + incremental-refresh deltas); order within a group is the
-        sorted file-path order."""
-        files = self._scan_files(scan)
-        by_name: dict[str, list[str]] = {}
-        for f in sorted(files):
-            by_name.setdefault(Path(f).name, []).append(f)
-        out = []
-        for b in range(num_buckets):
-            name = hio.bucket_file_name(b)
-            if name not in by_name:
-                raise HyperspaceError(f"missing bucket file {name} in {scan.root}")
-            out.append(by_name[name])
-        return out
-
-    # -- fused join + aggregation ----------------------------------------
-    def _try_fused_join_aggregate(self, plan: Aggregate) -> ColumnTable | None:
-        """Aggregate(Join) without materializing the joined pairs
-        (ops/join_agg.py). Applies when every aggregate is
-        sum/count/mean/min/max over a single side's numeric expression
-        and the grouping columns (if any) come from one side; cross-side
-        expressions fall back to the materialized join. min/max run as
-        run-extremum channels on BOTH venues (all equal-key secondary
-        rows are one contiguous run of the sorted side, and extrema are
-        multiplicity-independent): the host C++ pass walks runs directly;
-        the device kernel takes the segmented-prefix-scan value at each
-        run end and folds groups with segment_min/max."""
-        from hyperspace_tpu.ops.aggregate import agg_input, finalize_agg_values, group_ids
-
-        child = plan.child
-        if isinstance(child, Project):
-            child = child.child
-        if not isinstance(child, Join) or child.how != "inner" or child.condition is not None:
-            return None
-        join = child
-        lnames = {n.lower() for n in join.left.schema.names}
-        rnames = {n.lower() for n in join.right.schema.names}
-
-        def side_of(cols) -> str | None:
-            cl = {c.lower() for c in cols}
-            if cl and cl <= lnames:
-                return "left"
-            if cl and cl <= rnames:
-                return "right"
-            return None
-
-        gside = None
-        if plan.group_by:
-            gside = side_of(plan.group_by)
-            if gside is None:
-                return None
-        from hyperspace_tpu.plan.expr import Case
-
-        spec_sides: list[str | None] = []
-        for a in plan.aggs:
-            if a.fn not in ("sum", "count", "mean", "min", "max"):
-                return None
-            if a.expr is None:
-                spec_sides.append(None)  # count(*)
-                continue
-            refs = a.references()
-            # Constant expressions (sum(lit(2))) and cross-side expressions
-            # have no single owning side — use the materialized join.
-            s = side_of(refs)
-            if s is None:
-                return None
-            sch = join.left.schema if s == "left" else join.right.schema
-            if any(sch.field(r).is_vector for r in refs):
-                return None
-            # Case conditions handle strings via the predicate machinery;
-            # any other string reference cannot feed a numeric channel.
-            if not isinstance(a.expr, Case) and any(sch.field(r).is_string for r in refs):
-                return None
-            spec_sides.append(s)
-        primary = gside or "left"
-
-        lside, rside, _, _ = self._join_sides(join)
-        data = {"left": lside, "right": rside}
-        self.stats["agg_path"] = "fused-join-agg"
-        self.stats["num_buckets"] = len(data["left"].offsets) - 1
-
-        lkeys = [data["left"].table.schema.field(c).name for c in join.left_on]
-        rkeys = [data["right"].table.schema.field(c).name for c in join.right_on]
-        lc0, rc0 = _factorize_keys_cached(data["left"].table, data["right"].table, lkeys, rkeys)
-        codes = {}
-        perms = {}
-        codes["left"], perms["left"] = _bucket_sorted_codes(lc0, data["left"])
-        codes["right"], perms["right"] = _bucket_sorted_codes(rc0, data["right"])
-        secondary = "right" if primary == "left" else "left"
-
-        # Group ids on the primary table (original row order; memoized
-        # for stable index-backed sides).
-        gid_orig, k, first_idx = _group_ids_cached(data[primary].table, plan.group_by)
-        if k == 0:  # empty primary side
-            if plan.group_by:
-                return ColumnTable.empty(plan.schema)
-            k, gid_orig, first_idx = 1, np.zeros(0, np.int64), np.zeros(0, np.int64)
-
-        def spec_input(side: str, spec):
-            """(masked values, indicator) per original row of `side` with
-            the plain aggregate path's null semantics (ops/aggregate);
-            memoized per (expression, input identity) for stable sides."""
-            return _agg_channels_cached(data[side].table, spec)
-
-        host_res = None
-        if (
-            self._join_venue() == "host"
-            and codes[primary].dtype == np.int32
-            and codes[secondary].dtype == np.int32
-        ):
-            host_res = self._host_fused_channels(
-                plan, data, codes, perms, primary, secondary, spec_sides,
-                gid_orig, k, spec_input,
-            )
-        if host_res is not None:
-            self.stats["join_kernel"] = "host-native-merge-accumulate"
-            out, spec_layout = host_res
-        else:
-            self.stats["join_kernel"] = "device-run-prefix"
-            out, spec_layout = self._device_fused_channels(
-                plan, data, codes, perms, primary, secondary, spec_sides,
-                gid_orig, k, spec_input,
-            )
-        star = out[0]
-
-        keep = star > 0 if plan.group_by else np.ones(k, bool)
-        out_schema = plan.schema
-        cols: dict[str, np.ndarray] = {}
-        dicts: dict[str, np.ndarray] = {}
-        validity: dict[str, np.ndarray] = {}
-        ptable = data[primary].table
-        # first_idx may be empty when the primary side has no rows but a
-        # global (no group_by) aggregate still emits its one k=1 row.
-        kept_first = first_idx[keep[: len(first_idx)]]
-        for c in plan.group_by:
-            f = ptable.schema.field(c)
-            out_f = out_schema.field(c)
-            cols[out_f.name] = ptable.columns[f.name][kept_first]
-            if f.name in ptable.dictionaries:
-                dicts[out_f.name] = ptable.dictionaries[f.name]
-            gv = ptable.valid_mask(c)
-            if gv is not None:
-                validity[out_f.name] = gv[kept_first]
-        for spec, (vi, ci) in zip(plan.aggs, spec_layout):
-            out_f = out_schema.field(spec.alias)
-            cnt = out[ci][keep]
-            if spec.fn == "count":
-                cols[out_f.name] = cnt.astype(np.int64)
-                continue
-            val = out[vi][keep]
-            if spec.fn == "mean":
-                with np.errstate(invalid="ignore", divide="ignore"):
-                    val = val / cnt
-            empty = cnt == 0
-            cols[out_f.name] = finalize_agg_values(val, empty, out_f.device_dtype)
-            if empty.any():
-                validity[out_f.name] = ~empty
-        return ColumnTable(out_schema, cols, dicts, validity)
-
-    def _device_fused_channels(
-        self, plan, data, codes, perms, primary, secondary, spec_sides, gid_orig, k, spec_input
-    ):
-        """Device venue: the run-prefix kernel over bucket-major padded
-        channels (ops/join_agg.py). Pads, the channel stacks, and the
-        uploads all route through the identity caches, so repeat queries
-        over a stable index version serve from HBM."""
-        from hyperspace_tpu.execution import device_cache as dcache
-        from hyperspace_tpu.ops.join_agg import fused_join_aggregate
-
-        pk = _pad_bucket_major_cached(codes[primary], data[primary].offsets)
-        sk = _pad_bucket_major_cached(codes[secondary], data[secondary].offsets)
-        b, lp = pk.shape
-        ls = sk.shape[1]
-
-        def pad_rows(side: str, vals: np.ndarray, fill=0.0) -> np.ndarray:
-            """Per-orig-row values of `side` → bucket-sorted padded [B, L]."""
-            v = np.asarray(vals, np.float64)
-            if perms[side] is not None:
-                v = v[perms[side]]
-            width = lp if side == primary else ls
-            return _pad_bucket_major_cached(v, data[side].offsets, fill=fill, width=width)
-
-        # pad_rows reorders by perm internally — pass the ORIGINAL-order gid;
-        # pads carry group id k (the dead segment).
-        def build_gid():
-            return pad_rows(primary, gid_orig, fill=float(k)).astype(np.int32)
-
-        if dcache.is_stable(gid_orig) and perms[primary] is None:
-            # Cacheable only when NO per-join permutation applies: the
-            # perm depends on the join keys, which this key does not
-            # carry — a different-keyed join sharing gid_orig must not
-            # reuse the other layout's pad.
-            gid_pad = dcache.derived(
-                ("gidpad", id(gid_orig), data[primary].offsets.tobytes(), k, lp),
-                (gid_orig,),
-                build_gid,
-            )
-        else:
-            gid_pad = build_gid()
-
-        channels: list[tuple] = [("star",)]
-        p_arrays: list[np.ndarray] = []
-        s_arrays: list[np.ndarray] = []
-
-        def add_channel(side: str, padded: np.ndarray, fn: str | None = None) -> int:
-            base = "p" if side == primary else "s"
-            kind = base + fn if fn in ("min", "max") else base
-            if side == primary:
-                p_arrays.append(padded)
-                channels.append((kind, len(p_arrays) - 1))
-            else:
-                s_arrays.append(padded)
-                channels.append((kind, len(s_arrays) - 1))
-            return len(channels) - 1
-
-        def mm_values(vals: np.ndarray, ind: np.ndarray, fn: str) -> np.ndarray:
-            """Extremum channel input: nulls (and later pads) carry the
-            ±inf identity instead of the sum channels' zero. Identity-
-            cached so the derived pad/upload caches stay warm for stable
-            sides."""
-            ident = np.inf if fn == "min" else -np.inf
-
-            def build():
-                out = np.where(ind > 0, vals, ident)
-                dcache.freeze(out)
-                return out
-
-            if dcache.is_stable(vals) and dcache.is_stable(ind):
-                return dcache.derived(
-                    ("mmvals", id(vals), id(ind), fn), (vals, ind), build
-                )
-            return np.where(ind > 0, vals, ident)
-
-        spec_layout: list[tuple[int | None, int]] = []  # (value ch, count ch; 0=star)
-        for spec, s in zip(plan.aggs, spec_sides):
-            if s is None:  # count(*)
-                spec_layout.append((None, 0))
-                continue
-            vals, ind = spec_input(s, spec)
-            vi = None
-            if spec.fn in ("sum", "mean"):
-                vi = add_channel(s, pad_rows(s, vals))
-            elif spec.fn in ("min", "max"):
-                ident = np.inf if spec.fn == "min" else -np.inf
-                vi = add_channel(
-                    s, pad_rows(s, mm_values(vals, ind, spec.fn), fill=ident), spec.fn
-                )
-            ci = add_channel(s, pad_rows(s, ind))
-            spec_layout.append((vi, ci))
-
-        pvals = _stack_cached(p_arrays, (0, b, lp))
-        svals = _stack_cached(s_arrays, (0, b, ls))
-        out = fused_join_aggregate(pk, sk, pvals, svals, gid_pad, k, tuple(channels))
-        return out, spec_layout
-
-    def _host_fused_channels(
-        self, plan, data, codes, perms, primary, secondary, spec_sides, gid_orig, k, spec_input
-    ):
-        """Host venue: one C++ merge+accumulate pass computes per-primary-
-        row channel sums and match counts (no pair materialization), then
-        per-group bincounts produce the same [K] channel layout the device
-        kernel emits. Returns None when the native library is missing."""
-        from hyperspace_tpu import native
-
-        if not native.available():
-            return None
-        tbl_s = data[secondary].table
-        sec_arrays: list[np.ndarray] = []  # SORTED secondary order
-        parts: list[tuple] = []
-
-        def sec_sorted(a: np.ndarray) -> np.ndarray:
-            return a[perms[secondary]] if perms[secondary] is not None else a
-
-        for spec, s in zip(plan.aggs, spec_sides):
-            if s is None:
-                parts.append(("star",))
-                continue
-            vals, ind = spec_input(s, spec)
-            if spec.fn in ("min", "max"):
-                # Extremum channels bypass the sum accumulator: per-KEY
-                # run extrema (secondary) / matched-row extrema (primary).
-                parts.append(("mm", spec.fn, s, vals, ind))
-            elif s == secondary:
-                vi = None
-                if spec.fn in ("sum", "mean"):
-                    sec_arrays.append(sec_sorted(vals))
-                    vi = len(sec_arrays) - 1
-                sec_arrays.append(sec_sorted(ind))
-                parts.append(("sec", vi, len(sec_arrays) - 1))
-            else:
-                parts.append(("pri", vals if spec.fn in ("sum", "mean") else None, ind))
-
-        rvals = _stack_cached(sec_arrays, (0, tbl_s.num_rows))
-        res = native.merge_join_accumulate(
-            codes[primary], data[primary].offsets,
-            codes[secondary], data[secondary].offsets, rvals,
-        )
-        if res is None:
-            return None
-        acc_sorted, match_sorted = res
-        n_l = data[primary].table.num_rows
-        pperm = perms[primary]
-        if pperm is not None:
-            matches = np.empty(n_l)
-            matches[pperm] = match_sorted
-            acc = np.empty_like(acc_sorted)
-            acc[:, pperm] = acc_sorted
-        else:
-            matches, acc = match_sorted, acc_sorted
-
-        def greduce(w: np.ndarray) -> np.ndarray:
-            if n_l == 0:
-                return np.zeros(k)
-            return np.bincount(gid_orig, weights=w, minlength=k)
-
-        mm_rows = None
-        if any(p[0] == "mm" for p in parts):
-            mm_rows = _RunExtremum(
-                codes[primary], data[primary].offsets, pperm,
-                codes[secondary], data[secondary].offsets, perms[secondary],
-                matches, n_l,
-            )
-
-        out: list[np.ndarray] = [greduce(matches)]  # star = pairs per group
-        spec_layout: list[tuple[int | None, int]] = []
-        for part in parts:
-            if part[0] == "star":
-                spec_layout.append((None, 0))
-            elif part[0] == "sec":
-                _, vi, ci = part
-                v_idx = None
-                if vi is not None:
-                    out.append(greduce(acc[vi]))
-                    v_idx = len(out) - 1
-                out.append(greduce(acc[ci]))
-                spec_layout.append((v_idx, len(out) - 1))
-            elif part[0] == "mm":
-                from hyperspace_tpu.ops.aggregate import aggregate_arrays_host
-
-                _, fn, s, vals, ind = part
-                row_ext, row_valid = mm_rows.per_primary_row(fn, s, secondary, vals, ind)
-                res, cnt = aggregate_arrays_host([(row_ext, row_valid, fn)], gid_orig, k)
-                out.append(res[0])
-                out.append(cnt[0])
-                spec_layout.append((len(out) - 2, len(out) - 1))
-            else:
-                _, vals, ind = part
-                v_idx = None
-                if vals is not None:
-                    out.append(greduce(vals * matches))
-                    v_idx = len(out) - 1
-                out.append(greduce(ind * matches))
-                spec_layout.append((v_idx, len(out) - 1))
-        return out, spec_layout
-
-    def _partition_join(self, plan: Join, lside: "SideData", rside: "SideData") -> ColumnTable:
-        """Per-bucket merge join over the concatenated bucket-grouped
-        layout: everything host-side is vectorized (pad-gather in, one
-        repeat+add to globalize match indices, ONE native gather per
-        column out) — no per-bucket Python loop (round 1 weakness #4).
-        Non-inner join types derive from the same match pairs: outer
-        variants append the unmatched side's rows null-extended, semi/anti
-        keep left rows by match flag (the join-type surface Spark's
-        SortMergeJoinExec serves over the reference's rewritten bucketed
-        relations, JoinIndexRule.scala:124-153)."""
-        lt, rt = lside.table, rside.table
-        how = plan.how
-
-        if how in ("semi", "anti") and plan.condition is None:
-            # Existence is a membership probe, not a join: never expand the
-            # match pairs (a hot key repeated k×k ways would materialize k²
-            # pairs only to collapse into |L| bits).
-            matched = self._semi_match_mask(plan, lside, rside)
-            out = lt.filter_mask(matched if how == "semi" else ~matched)
-            return ColumnTable(plan.schema, out.columns, out.dictionaries, out.validity)
-
-        lidx, ridx, totals = self._match_pairs(plan, lside, rside)
-
-        if how in ("semi", "anti"):
-            # Residual existence (EXISTS with extra conditions): a left
-            # row matches iff SOME equi-pair also passes the residual —
-            # gather ONLY the columns the condition reads (the pairs are
-            # k x k expanded; none of the payload survives the |L|-bit
-            # reduction), evaluate, and reduce surviving lidx to bits.
-            from hyperspace_tpu.schema import Schema as _Schema
-
-            refs = {r.lower() for r in plan.condition.references()}
-            rkeys_low = {rt.schema.field(c).name.lower() for c in plan.right_on}
-            lkeep = [f.name for f in lt.schema.fields if f.name.lower() in refs]
-            if not lkeep:  # keep one cheap key lane so row count survives
-                lkeep = [lt.schema.field(plan.left_on[0]).name]
-            rkeep = [rt.schema.field(c).name for c in plan.right_on] + [
-                f.name
-                for f in rt.schema.fields
-                if f.name.lower() in refs and f.name.lower() not in rkeys_low
-            ]
-            sub_schema = _Schema(
-                tuple(lt.schema.select(lkeep).fields)
-                + tuple(
-                    f for f in rt.schema.select(rkeep).fields
-                    if f.name.lower() not in rkeys_low
-                )
-            )
-            pairs = self._gather_pairs(
-                plan, lt.select(lkeep), rt.select(rkeep), lidx, ridx, schema=sub_schema
-            )
-            pmask = eval_predicate_mask(
-                pairs, plan.condition, mesh=self.mesh, venue=self._filter_venue()
-            )
-            matched = np.zeros(lt.num_rows, dtype=bool)
-            matched[lidx[pmask]] = True
-            self._phys(residual_condition=True, residual_pairs_dropped=int((~pmask).sum()))
-            out = lt.filter_mask(matched if how == "semi" else ~matched)
-            return ColumnTable(plan.schema, out.columns, out.dictionaries, out.validity)
-
-        inner = self._gather_pairs(plan, lt, rt, lidx, ridx)
-        if plan.condition is not None and how != "inner":
-            # Outer-join ON residual alters MATCHING: a pair failing it
-            # is no match, so its rows fall through to the null-extended
-            # unmatched parts below (computed from the SURVIVING pairs).
-            pmask = eval_predicate_mask(
-                inner, plan.condition, mesh=self.mesh, venue=self._filter_venue()
-            )
-            inner = inner.filter_mask(pmask)
-            lidx, ridx = lidx[pmask], ridx[pmask]
-            self._phys(residual_condition=True, residual_pairs_dropped=int((~pmask).sum()))
-        if how == "inner":
-            # Bucket-preserving output: an inner join over B>1 buckets
-            # emits pairs bucket-major, so the result STAYS bucket-
-            # grouped on the (merged, left-named) join keys — a later
-            # join on the same keys reuses the grouping with no exchange
-            # (SURVEY §2.3: chained star joins stay bucket-parallel).
-            if (
-                totals is not None
-                and len(totals) > 1
-                and lside.hash_fields is not None
-            ):
-                self._stash_bucketed(
-                    inner,
-                    np.concatenate([[0], np.cumsum(totals)]).astype(np.int64),
-                    plan.left_on,
-                    lside.hash_fields,
-                )
-            return inner
-        parts = [inner]
-        if how in ("left", "full"):
-            lmask = np.zeros(lt.num_rows, dtype=bool)
-            lmask[lidx] = True
-            parts.append(self._left_unmatched(plan, lt, rt, ~lmask))
-        if how in ("right", "full"):
-            rmask = np.zeros(rt.num_rows, dtype=bool)
-            rmask[ridx] = True
-            parts.append(self._right_unmatched(plan, lt, rt, ~rmask))
-        parts = [p for p in parts if p.num_rows > 0]
-        if not parts:
-            return inner
-        # Concat builds from plan.schema, so any extra physical columns a
-        # wide index scan carried along are dropped here; the outer-join
-        # output is exactly the declared join schema.
-        return ColumnTable.concat(parts) if len(parts) > 1 else parts[0]
-
-    def _semi_match_mask(self, plan: Join, lside: "SideData", rside: "SideData") -> np.ndarray:
-        """Per-left-row existence of an equi-match in the right side:
-        one sorted membership probe over (bucket, key-code) composites —
-        O((n+m) log m) on host, no pair expansion, no device round-trip
-        (the result is |L| bits the mask filter consumes on host anyway).
-        Null-keyed rows carry side-distinct negative codes and never
-        match (SQL: NULL = NULL is not true), so anti keeps them."""
-        lt, rt = lside.table, rside.table
-        lkeys = [lt.schema.field(c).name for c in plan.left_on]
-        rkeys = [rt.schema.field(c).name for c in plan.right_on]
-        lc0, rc0 = _factorize_keys_cached(lt, rt, lkeys, rkeys)
-        lcodes = lc0.astype(np.int64)
-        rcodes = rc0.astype(np.int64)
-        b = len(lside.offsets) - 1
-        self.stats["num_buckets"] = b
-        self.stats["join_kernel"] = "host-membership-probe"
-        comp_l = _composite_keys(lcodes, lside.offsets)
-        comp_r = np.sort(_composite_keys(rcodes, rside.offsets))
-        pos = np.searchsorted(comp_r, comp_l)
-        matched = np.zeros(lt.num_rows, dtype=bool)
-        in_range = pos < len(comp_r)
-        matched[in_range] = comp_r[pos[in_range]] == comp_l[in_range]
-        return matched
-
-    def _match_pairs(self, plan: Join, lside: "SideData", rside: "SideData"):
-        """(lidx, ridx) global match row indices of the equi-join, from the
-        venue-selected merge kernel over bucket-sorted key codes. A
-        heavily asymmetric single-partition join takes the broadcast hash
-        path instead: only the small side is sorted, the large side
-        probes it — the analog of Spark's BroadcastExchange fallback the
-        reference environment supplies for small sides
-        (PhysicalOperatorAnalyzer.scala:46-50)."""
-        lt, rt = lside.table, rside.table
-        lkeys = [lt.schema.field(c).name for c in plan.left_on]
-        rkeys = [rt.schema.field(c).name for c in plan.right_on]
-
-        # Shared order-preserving factorization of the key tuples.
-        lcodes, rcodes = _factorize_keys_cached(lt, rt, lkeys, rkeys)
-
-        b0 = len(lside.offsets) - 1
-        if b0 == 1 and self._should_broadcast(lt.num_rows, rt.num_rows):
-            res = _broadcast_probe(lcodes, rcodes)
-            if res is not None:
-                self.stats["num_buckets"] = 1
-                self.stats["join_kernel"] = "host-broadcast-hash"
-                return res[0], res[1], None
-
-        lcodes, lperm = _bucket_sorted_codes(lcodes, lside)
-        rcodes, rperm = _bucket_sorted_codes(rcodes, rside)
-        b = len(lside.offsets) - 1
-        self.stats["num_buckets"] = b
-
-        host_res = None
-        if (
-            lcodes.dtype == np.int32
-            and rcodes.dtype == np.int32
-            and self._join_venue() == "host"
-        ):
-            from hyperspace_tpu import native
-
-            host_res = native.merge_join_sorted(
-                lcodes, lside.offsets, rcodes, rside.offsets
-            )
-        if host_res is not None:
-            # Host venue: exact bucket-parallel C++ merge over the already
-            # host-resident sorted runs — no device round-trip (the match
-            # pairs land on host either way; see parallel/bandwidth.py).
-            lidx, ridx, totals = host_res
-            self.stats["join_kernel"] = "host-native-merge"
-        else:
-            lk = _pad_bucket_major_cached(lcodes, lside.offsets)
-            rk = _pad_bucket_major_cached(rcodes, rside.offsets)
-            if self.mesh is not None:
-                from hyperspace_tpu.parallel.mesh import mesh_for_parallelism, mesh_size
-
-                jmesh = mesh_for_parallelism(self.mesh, b)
-                li_flat, ri_flat, totals = join_ops.merge_join_sharded(lk, rk, jmesh)
-                self.stats["join_devices"] = mesh_size(jmesh)
-            else:
-                li_flat, ri_flat, totals = join_ops.merge_join(lk, rk)
-            self.stats["join_kernel"] = "device-searchsorted"
-            # Local (within-bucket) match indices → global row indices.
-            lidx = np.repeat(lside.offsets[:-1], totals) + li_flat
-            ridx = np.repeat(rside.offsets[:-1], totals) + ri_flat
-        if lperm is not None:
-            lidx = lperm[lidx]
-        if rperm is not None:
-            ridx = rperm[ridx]
-        # Pair order stays bucket-major through the perm mapping, so
-        # `totals` doubles as the OUTPUT's bucket grouping.
-        return lidx, ridx, np.asarray(totals, dtype=np.int64)
-
-    def _should_broadcast(self, n_l: int, n_r: int) -> bool:
-        """Small-enough and asymmetric-enough for the broadcast probe."""
-        from hyperspace_tpu.config import DEFAULT_JOIN_BROADCAST_MAX_ROWS
-
-        cap = (
-            self.conf.join_broadcast_max_rows
-            if self.conf is not None
-            else DEFAULT_JOIN_BROADCAST_MAX_ROWS
-        )
-        if cap <= 0:
-            return False
-        small, large = min(n_l, n_r), max(n_l, n_r)
-        return 0 < small <= cap and large >= 4 * small
-
-    def _gather_pairs(
-        self, plan: Join, lt: ColumnTable, rt: ColumnTable, lidx, ridx, schema=None
-    ) -> ColumnTable:
-        """Materialize matched rows: left columns + right non-key columns.
-        `schema` overrides the output schema (semi/anti residual
-        evaluation gathers in the inner-join shape)."""
-        schema = schema if schema is not None else plan.schema
-        rkeys_low = {rt.schema.field(c).name.lower() for c in plan.right_on}
-        lgather = lt.take(lidx)
-        cols = dict(lgather.columns)
-        dicts = dict(lgather.dictionaries)
-        val = dict(lgather.validity)
-        rnames = [f.name for f in rt.schema.fields if f.name.lower() not in rkeys_low]
-        rgather = rt.select(rnames).take(ridx)
-        cols.update(rgather.columns)
-        dicts.update(rgather.dictionaries)
-        val.update(rgather.validity)
-        return ColumnTable(schema, cols, dicts, val)
-
-    def _left_unmatched(self, plan: Join, lt: ColumnTable, rt: ColumnTable, mask) -> ColumnTable:
-        """Unmatched left rows, right-side fields null-extended."""
-        sub = lt.filter_mask(mask)
-        lnames = {x.lower() for x in plan.left.schema.names}
-        cols: dict = {}
-        dicts: dict = {}
-        val: dict = {}
-        for f in plan.schema.fields:
-            if f.name.lower() in lnames:
-                _copy_field(f, sub, f.name, cols, dicts, val)
-            else:
-                _null_field(f, sub.num_rows, rt, cols, dicts, val)
-        return ColumnTable(plan.schema, cols, dicts, val)
-
-    def _right_unmatched(self, plan: Join, lt: ColumnTable, rt: ColumnTable, mask) -> ColumnTable:
-        """Unmatched right rows: key columns coalesce to the RIGHT key's
-        values (under the left-named output column), right non-key fields
-        carry their values, left-only fields are null-extended."""
-        sub = rt.filter_mask(mask)
-        key_src = {l.lower(): r for l, r in zip(plan.left_on, plan.right_on)}
-        rnames = {x.lower() for x in plan.right.schema.names}
-        cols: dict = {}
-        dicts: dict = {}
-        val: dict = {}
-        for f in plan.schema.fields:
-            low = f.name.lower()
-            if low in key_src:
-                _copy_field(f, sub, key_src[low], cols, dicts, val)
-            elif low in rnames:
-                _copy_field(f, sub, f.name, cols, dicts, val)
-            else:
-                _null_field(f, sub.num_rows, lt, cols, dicts, val)
-        return ColumnTable(plan.schema, cols, dicts, val)
-
-
-def _broadcast_probe(lcodes: np.ndarray, rcodes: np.ndarray):
-    """Match pairs via a broadcast hash table: the smaller side builds a
-    dense code -> (start, count) table, every large-side row probes it
-    with ONE vectorized gather (no binary search — random-access
-    searchsorted over millions of probes is ~10x slower than a
-    cache-resident table), and duplicate runs expand vectorized. The
-    large side is never sorted. Null codes are side-distinct negatives
-    and never match. Returns None when the shared code space is too
-    sparse for a table (caller falls back to the merge kernel); else
-    (lidx, ridx) in the merge path's contract."""
-    swap = len(lcodes) < len(rcodes)
-    build, probe = (lcodes, rcodes) if swap else (rcodes, lcodes)
-    top = 0
-    if len(build):
-        top = max(top, int(build.max()) + 1)
-    if len(probe):
-        top = max(top, int(probe.max()) + 1)
-    if top == 0:
-        # Every key on both sides is null-coded: no row can match.
-        empty = np.zeros(0, dtype=np.int64)
-        return empty, empty
-    if top > 8 * len(build) + 65_536:
-        return None  # sparse code space: the table would dwarf the side
-    bvalid = build >= 0
-    counts = np.bincount(build[bvalid], minlength=top)
-    starts = np.concatenate([[0], np.cumsum(counts[:-1])]) if top else np.zeros(0, np.int64)
-    order = np.argsort(build, kind="stable")  # null codes sort first
-    nneg = int((~bvalid).sum())
-    pvalid = probe >= 0
-    pc = np.where(pvalid, probe, 0)
-    cnt = np.where(pvalid, counts[pc], 0)
-    lo = nneg + starts[pc]
-    if not counts.size or counts.max() <= 1:
-        # Unique build keys (the normal dimension-table case): each probe
-        # row matches 0 or 1 build rows — no run expansion at all.
-        matched = cnt > 0
-        probe_idx = np.flatnonzero(matched)
-        build_idx = order[lo[matched]]
-        if swap:
-            return build_idx, probe_idx
-        return probe_idx, build_idx
-    total = int(cnt.sum())
-    probe_idx = np.repeat(np.arange(len(probe), dtype=np.int64), cnt)
-    run_starts = np.cumsum(cnt) - cnt
-    within = np.arange(total, dtype=np.int64) - np.repeat(run_starts, cnt)
-    build_idx = order[np.repeat(lo, cnt) + within]
-    if swap:
-        return build_idx, probe_idx  # build side is the LEFT input
-    return probe_idx, build_idx
-
-
-def _copy_field(out_f, src: ColumnTable, src_name: str, cols, dicts, val) -> None:
-    """Copy src column `src_name` into output field `out_f` (dtype-cast
-    for numeric mismatches — outer-join key coalescing may source the
-    left-named key column from the right side)."""
-    sf = src.schema.field(src_name)
-    arr = src.columns[sf.name]
-    if sf.name in src.dictionaries:
-        dicts[out_f.name] = src.dictionaries[sf.name]
-        cols[out_f.name] = arr
-    else:
-        want = np.dtype(out_f.device_dtype)
-        cols[out_f.name] = arr if arr.ndim > 1 or arr.dtype == want else arr.astype(want)
-    v = src.validity.get(sf.name)
-    if v is not None:
-        val[out_f.name] = v
-
-
-def _null_field(out_f, n: int, dict_src: ColumnTable | None, cols, dicts, val) -> None:
-    """All-null column for output field `out_f` (outer-join null
-    extension). String fields reuse `dict_src`'s dictionary for that
-    field when available, so concat with the matched part needs no
-    dictionary merge."""
-    if out_f.is_vector:
-        raise HyperspaceError(
-            f"outer join cannot null-extend vector column {out_f.name!r}"
-        )
-    if out_f.is_string:
-        d = None
-        if dict_src is not None:
-            try:
-                sf = dict_src.schema.field(out_f.name)
-                d = dict_src.dictionaries.get(sf.name)
-            except Exception:
-                d = None
-        if d is None or len(d) == 0:
-            d = np.array([""], dtype=object)
-        cols[out_f.name] = np.zeros(n, dtype=np.int32)
-        dicts[out_f.name] = d
-    else:
-        cols[out_f.name] = np.zeros(n, dtype=out_f.device_dtype)
-    val[out_f.name] = np.zeros(n, dtype=bool)
-
-
-def _concat_side_cached(tables: list[ColumnTable]) -> ColumnTable:
-    """Concatenated bucket-grouped side table, memoized on the identity
-    of the per-bucket cached tables (the device plane's HBM-resident
-    container rests on this stability: frozen concat => stable codes =>
-    cached pads => cached uploads). Falls through for single groups (the
-    cached table passes through already frozen)."""
-    from hyperspace_tpu.execution import device_cache as dc
-
-    if len(tables) == 1:
-        return tables[0]
-    # Only identity-stable inputs may be memoized (and only then may the
-    # output be frozen): per-query tables too large for the io cache get
-    # fresh ids every time — caching against those would pile dead pinned
-    # entries, and freezing their concat would let every downstream cache
-    # mistake per-query arrays for stable ones.
-    stable = all(
-        all(
-            dc.is_stable(a)
-            for a in (*t.columns.values(), *t.validity.values(), *t.dictionaries.values())
-        )
-        for t in tables
-    )
-    if not stable:
-        return ColumnTable.concat(tables)
-
-    def build():
-        out = ColumnTable.concat(tables)
-        for arr in (*out.columns.values(), *out.validity.values(), *out.dictionaries.values()):
-            dc.freeze(arr)
-        # _table_nbytes counts string payloads, not just object pointers —
-        # the budget must see what the entry actually retains.
-        return out, int(hio._table_nbytes(out))
-
-    return dc.HOST_DERIVED.get_or_build(
-        ("sidecat", tuple(id(t) for t in tables)), tuple(tables), build
-    )
-
-
-def _composite_keys(codes: np.ndarray, offsets: np.ndarray) -> np.ndarray:
-    """(bucket << 33) + code composites: codes span int32 (±2^31) and
-    buckets are small, so the shifted sum is collision-free in int64 and
-    globally SORTED for bucket-major key-sorted inputs. Shared by the
-    semi/anti membership probe and the fused run-extremum channels."""
-    b = np.repeat(np.arange(len(offsets) - 1, dtype=np.int64), np.diff(offsets))
-    return (b << np.int64(33)) + codes.astype(np.int64)
-
-
-class _RunExtremum:
-    """Per-primary-row extrema over the secondary match runs, shared by
-    every min/max channel of one fused join-aggregation. The secondary
-    side is bucket-major key-sorted, so all rows with one key form a
-    contiguous run; the composite key is globally sorted and each
-    primary row's run bounds come from two searchsorteds (built LAZILY —
-    primary-side-only channels never pay for them). Extrema are
-    multiplicity-independent, so the per-KEY extremum stands in for
-    every duplicate primary row with that key."""
-
-    def __init__(self, pri_codes, pri_offsets, pperm, sec_codes, sec_offsets, sperm, matches, n_l):
-        self.sperm = sperm
-        self.pperm = pperm
-        self.matches = matches
-        self.n_l = n_l
-        self._pri = (pri_codes, pri_offsets)
-        self._sec = (sec_codes, sec_offsets)
-        self._runs = None
-
-    def _run_index(self):
-        if self._runs is None:
-            cp = _composite_keys(*self._pri)
-            cs = _composite_keys(*self._sec)
-            st = np.searchsorted(cs, cp, side="left")
-            en = np.searchsorted(cs, cp, side="right")
-            if len(cs):
-                starts = np.concatenate([[0], np.flatnonzero(np.diff(cs) != 0) + 1])
-                ridx = np.clip(
-                    np.searchsorted(starts, st, side="right") - 1, 0, len(starts) - 1
-                )
-            else:
-                starts = np.zeros(0, np.int64)
-                ridx = np.zeros(len(cp), np.int64)
-            self._runs = (st, en, en > st, starts, ridx)
-        return self._runs
-
-    def per_primary_row(self, fn: str, side: str, secondary: str, vals, ind):
-        """(row extremum, row validity) in ORIGINAL primary order for one
-        channel; `vals`/`ind` are the channel's per-orig-row arrays of
-        `side` (invalid slots already zeroed, `ind` marking them)."""
-        identity = np.inf if fn == "min" else -np.inf
-        if side == secondary:
-            _st, _en, has, starts, ridx = self._run_index()
-            sv = vals if self.sperm is None else vals[self.sperm]
-            si = ind if self.sperm is None else ind[self.sperm]
-            if not len(starts):
-                return np.full(self.n_l, identity), np.zeros(self.n_l, bool)
-            op = np.minimum if fn == "min" else np.maximum
-            sv = np.where(si > 0, np.asarray(sv, np.float64), identity)
-            key_ext = op.reduceat(sv, starts)
-            key_validcnt = np.add.reduceat(np.asarray(si, np.float64), starts)
-            ext_sorted = np.where(has, key_ext[ridx], identity)
-            valid_sorted = has & (key_validcnt[ridx] > 0)
-            if self.pperm is not None:
-                ext = np.empty(self.n_l)
-                ext[self.pperm] = ext_sorted
-                valid = np.empty(self.n_l, bool)
-                valid[self.pperm] = valid_sorted
-                return ext, valid
-            return ext_sorted, valid_sorted
-        # Primary-side channel: extremum over the group's MATCHED rows.
-        v = np.where(np.asarray(ind) > 0, np.asarray(vals, np.float64), identity)
-        valid = (self.matches > 0) & (np.asarray(ind) > 0)
-        return v, valid
-
-
-def _desugar_count_distinct(plan: "Aggregate"):
-    """count(distinct col) as a TWO-PHASE re-aggregation: the inner
-    aggregate groups by (group keys, distinct column) — its rows are the
-    distinct (group, value) pairs — and computes partials for every
-    sibling aggregate; the outer counts the distinct column (nulls
-    excluded, SQL semantics) and recombines the partials (sum of sums /
-    counts, min of mins, max of maxes). The Spark analog is the planner's
-    distinct-aggregate Expand rewrite. Returns (desugared plan, aliases
-    of the original count specs — the caller zero-fills their NULLs)."""
-    from hyperspace_tpu.plan.nodes import AggSpec, Aggregate
-
-    # The caller routes multi-distinct / mean-sharing aggregates to
-    # _distinct_aggregate; this fast path sees exactly one distinct
-    # column and no mean.
-    dcol = next(a.expr.name for a in plan.aggs if a.fn == "count_distinct")
-    group_low = {c.lower() for c in plan.group_by}
-    inner_groups = list(plan.group_by) + ([dcol] if dcol.lower() not in group_low else [])
-    inner_aggs: list = []
-    outer_aggs: list = []
-    count_aliases: list[str] = []
-    for i, a in enumerate(plan.aggs):
-        if a.fn == "count_distinct":
-            outer_aggs.append(AggSpec("count", Col(dcol), a.alias))
-            continue
-        part = f"__partial_{i}"
-        if a.fn == "count":
-            inner_aggs.append(AggSpec("count", a.expr, part))
-            outer_aggs.append(AggSpec("sum", Col(part), a.alias))
-            count_aliases.append(a.alias)
-        else:  # sum / min / max recombine with themselves
-            inner_aggs.append(AggSpec(a.fn, a.expr, part))
-            outer_aggs.append(AggSpec(a.fn, Col(part), a.alias))
-    inner = Aggregate(plan.child, inner_groups, inner_aggs)
-    return Aggregate(inner, list(plan.group_by), outer_aggs), count_aliases
-
-
-def _stable_table_refs(table: ColumnTable, names: set[str]):
-    """(refs, id-parts) over every array the named columns touch (data,
-    dictionary, validity), or (None, None) when any is unstable."""
-    from hyperspace_tpu.execution import device_cache as dc
-
-    refs: list = []
-    parts: list = []
-    for nm in sorted(names):
-        f = table.schema.field(nm)
-        for a in (table.columns[f.name], table.dictionaries.get(f.name), table.validity.get(f.name)):
-            if a is None:
-                parts.append(None)
-                continue
-            if not dc.is_stable(a):
-                return None, None
-            refs.append(a)
-            parts.append(id(a))
-    return tuple(refs), tuple(parts)
-
-
-def _group_ids_cached(table: ColumnTable, group_by: list[str]):
-    """group_ids memoized on the identity of the (stable) group-key
-    arrays — repeat aggregations over the same index version skip the
-    factorization of millions of keys."""
-    from hyperspace_tpu.execution import device_cache as dc
-    from hyperspace_tpu.ops.aggregate import group_ids
-
-    if not group_by:
-        return group_ids(table, group_by)
-    refs, parts = _stable_table_refs(table, {c.lower() for c in group_by})
-    if refs is None:
-        return group_ids(table, group_by)
-
-    def build():
-        gid, k, first = group_ids(table, group_by)
-        dc.freeze(gid)
-        dc.freeze(first)
-        return (gid, k, first), int(gid.nbytes + first.nbytes)
-
-    return dc.HOST_DERIVED.get_or_build(
-        ("gid", tuple(c.lower() for c in group_by), parts), refs, build
-    )
-
-
-def _agg_channels_cached(tbl: ColumnTable, spec):
-    """(masked values, indicator) channels for one AggSpec, memoized per
-    (expression, input identity) for stable tables."""
-    import json
-
-    from hyperspace_tpu.execution import device_cache as dc
-    from hyperspace_tpu.ops.aggregate import agg_input
-
-    def raw():
-        vals, valid, _ = agg_input(tbl, spec)
-        vals = np.asarray(vals, dtype=np.float64)
-        if valid is not None:
-            vals = np.where(valid, vals, 0.0)
-        ind = np.ones(tbl.num_rows, np.float64) if valid is None else valid.astype(np.float64)
-        return vals, ind
-
-    refs, parts = _stable_table_refs(tbl, {r.lower() for r in spec.references()})
-    if not refs:  # unstable or constant expression: no identity to key on
-        return raw()
-    key = ("aggin", json.dumps(spec.expr.to_json(), sort_keys=True), parts)
-
-    def build():
-        vals, ind = raw()
-        dc.freeze(vals)
-        dc.freeze(ind)
-        return (vals, ind), int(vals.nbytes + ind.nbytes)
-
-    return dc.HOST_DERIVED.get_or_build(key, refs, build)
-
-
-def _factorize_keys_cached(lt: ColumnTable, rt: ColumnTable, lkeys, rkeys):
-    """Pairwise key factorization memoized on the IDENTITY of every input
-    it reads (key columns, dictionaries, validity) — valid only when all
-    are stable (frozen index-cache arrays). Repeat joins over the same
-    index version skip ranking entirely; codes are frozen so downstream
-    pad/upload caches can key on them. Returns (lcodes, rcodes)."""
-    from hyperspace_tpu.execution import device_cache as dc
-
-    lrefs, lparts = _stable_table_refs(lt, {k.lower() for k in lkeys})
-    rrefs, rparts = _stable_table_refs(rt, {k.lower() for k in rkeys})
-    if lrefs is None or rrefs is None:
-        lc, rc = _factorize_keys([lt], [rt], lkeys, rkeys)
-        return lc[0], rc[0]
-    refs = lrefs + rrefs
-    parts = (lparts, rparts)
-
-    def build():
-        lc, rc = _factorize_keys([lt], [rt], lkeys, rkeys)
-        out = (dc.freeze(lc[0]), dc.freeze(rc[0]))
-        return out, int(lc[0].nbytes + rc[0].nbytes)
-
-    return dc.HOST_DERIVED.get_or_build(("fact", parts), refs, build)
-
-
-def _pad_bucket_major_cached(
-    codes: np.ndarray, offsets: np.ndarray, fill=None, width: int | None = None
-) -> np.ndarray:
-    """Bucket-major pad through the derived cache when the input is
-    stable (index-sorted, frozen) — the [B, L] device upload then hits
-    the HBM cache too."""
-    from hyperspace_tpu.execution import device_cache as dc
-
-    if dc.is_stable(codes):
-        return dc.derived(
-            ("padbm", id(codes), offsets.tobytes(), repr(fill), width),
-            (codes,),
-            lambda: _pad_bucket_major(codes, offsets, fill=fill, width=width),
-        )
-    return _pad_bucket_major(codes, offsets, fill=fill, width=width)
-
-
-def _stack_cached(arrs: list, empty_shape: tuple) -> np.ndarray:
-    """np.stack through the derived cache when every channel is stable
-    (the [A, n] float64 stack is a 100MB-scale memcpy per query)."""
-    from hyperspace_tpu.execution import device_cache as dc
-
-    if not arrs:
-        return np.zeros(empty_shape)
-    if all(dc.is_stable(a) for a in arrs):
-        return dc.derived(
-            ("stack", tuple(id(a) for a in arrs)), tuple(arrs), lambda: np.stack(arrs)
-        )
-    return np.stack(arrs)
-
-
-def _key_null_mask(table: ColumnTable, keys: list[str]) -> np.ndarray | None:
-    """True where ANY key column is null (such rows never join — SQL:
-    NULL = NULL is not true). None when every key column is null-free."""
-    m = None
-    for k in keys:
-        valid = table.valid_mask(k)
-        if valid is not None:
-            m = ~valid if m is None else (m | ~valid)
-    return m
-
-
-def _apply_null_codes(lcodes, rcodes, lnulls, rnulls):
-    """Null-keyed rows get side-distinct negative codes (-2 left, -1
-    right): they sort first and can never equal across sides, so the merge
-    kernel drops them with zero extra work."""
-    for c, m in zip(lcodes, lnulls):
-        if m is not None:
-            c[m] = -2
-    for c, m in zip(rcodes, rnulls):
-        if m is not None:
-            c[m] = -1
-    return lcodes, rcodes
-
-
-def _factorize_keys(ltables, rtables, lkeys, rkeys):
-    """Map each partition's key tuples to a shared int32 rank-code space
-    whose order matches the lexicographic order of the raw key tuples.
-    int32 keeps the device merge-join kernels on native 32-bit lanes (TPU
-    emulates 64-bit); ranks always fit (bounded by total row count)."""
-    lnulls = [_key_null_mask(t, lkeys) for t in ltables]
-    rnulls = [_key_null_mask(t, rkeys) for t in rtables]
-    has_nulls = any(m is not None for m in lnulls + rnulls)
-    # Fast path: a single integer key whose value SPAN fits int32 needs no
-    # ranking — values shifted by the minimum are order-preserving codes.
-    # Codes are NON-NEGATIVE by construction, so a negative code always
-    # means a null-keyed row (the invariant _broadcast_probe and the
-    # null-code scheme below rely on). (Skipped with nulls: raw values
-    # could collide with the null codes.)
-    if len(lkeys) == 1 and not has_nulls:
-        lvals = [_logical_key(t, lkeys[0]) for t in ltables]
-        rvals = [_logical_key(t, rkeys[0]) for t in rtables]
-        if all(np.issubdtype(v.dtype, np.integer) for v in lvals + rvals):
-            lo = min((int(v.min()) for v in lvals + rvals if len(v)), default=0)
-            hi = max((int(v.max()) for v in lvals + rvals if len(v)), default=0)
-            # Span strictly below int32 max: the sentinel pad must still
-            # sort last after the shift.
-            if hi - lo < np.iinfo(np.int32).max - 1:
-                shift = np.int64(lo)
-                return (
-                    [(v.astype(np.int64) - shift).astype(np.int32) for v in lvals],
-                    [(v.astype(np.int64) - shift).astype(np.int32) for v in rvals],
-                )
-
-    per_col_codes_l: list[list[np.ndarray]] = [[] for _ in ltables]
-    per_col_codes_r: list[list[np.ndarray]] = [[] for _ in rtables]
-    cards: list[int] = []
-    for lname, rname in zip(lkeys, rkeys):
-        lvals = [_logical_key(t, lname) for t in ltables]
-        rvals = [_logical_key(t, rname) for t in rtables]
-        allv = np.concatenate(lvals + rvals) if (lvals or rvals) else np.array([])
-        uniq, inv = np.unique(allv, return_inverse=True)
-        cards.append(max(len(uniq), 1))
-        pos = 0
-        for i, v in enumerate(lvals):
-            per_col_codes_l[i].append(inv[pos : pos + len(v)])
-            pos += len(v)
-        for i, v in enumerate(rvals):
-            per_col_codes_r[i].append(inv[pos : pos + len(v)])
-            pos += len(v)
-
-    def combine(per_part):
-        out = []
-        for codes in per_part:
-            acc = np.zeros(len(codes[0]) if codes else 0, dtype=np.int64)
-            for c, k in zip(codes, cards):
-                acc = acc * np.int64(k) + c.astype(np.int64)
-            out.append(acc)
-        return out
-
-    import math
-
-    if math.prod(cards) >= np.iinfo(np.int64).max:
-        # The int64 mixed-radix combination itself would wrap — the codes
-        # in `combine` below would collide before any re-rank could help.
-        raise HyperspaceError(
-            f"join key cardinalities {cards} overflow the int64 code space"
-        )
-    lcomb, rcomb = combine(per_col_codes_l), combine(per_col_codes_r)
-    int32_max = np.iinfo(np.int32).max
-    # Mixed-radix codes that provably fit int32 cast directly — no
-    # re-rank pass needed (math.prod is exact, arbitrary precision).
-    if math.prod(cards) < int32_max:
-        return _apply_null_codes(
-            [c.astype(np.int32) for c in lcomb],
-            [c.astype(np.int32) for c in rcomb],
-            lnulls,
-            rnulls,
-        )
-    # Otherwise re-rank the combined codes down to int32 (order preserved
-    # by np.unique).
-    allc = np.concatenate(lcomb + rcomb) if (lcomb or rcomb) else np.zeros(0, np.int64)
-    uniq, inv = np.unique(allc, return_inverse=True)
-    if len(uniq) >= int32_max:
-        raise HyperspaceError(
-            f"join key space has {len(uniq)} distinct tuples — exceeds the "
-            "int32 code space"
-        )
-    inv = inv.astype(np.int32)
-    pos, out_l, out_r = 0, [], []
-    for c in lcomb:
-        out_l.append(inv[pos : pos + len(c)])
-        pos += len(c)
-    for c in rcomb:
-        out_r.append(inv[pos : pos + len(c)])
-        pos += len(c)
-    return _apply_null_codes(out_l, out_r, lnulls, rnulls)
-
-
-def _logical_key(table: ColumnTable, name: str) -> np.ndarray:
-    f = table.schema.field(name)
-    arr = table.columns[f.name]
-    if f.is_string:
-        return table.dictionaries[f.name][arr]
-    return arr
