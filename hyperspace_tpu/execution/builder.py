@@ -388,6 +388,9 @@ class DeviceIndexBuilder:
                             spill / hio.bucket_file_name(b),
                             arrow_sorted.schema,
                             compression=hio.INDEX_WRITE_COMPRESSION,
+                            # Stats skipped like write_bucket's: spill
+                            # footers are only read for sizes.
+                            write_statistics=False,
                             use_dictionary=[
                                 f.name for f in sub_schema.select(ordered).fields if f.is_string
                             ],

@@ -6,31 +6,16 @@ executor.py (round 5); the executor mixins import from here.
 from __future__ import annotations
 
 import dataclasses
-from pathlib import Path
 
 import numpy as np
 
 from hyperspace_tpu.exceptions import HyperspaceError
 from hyperspace_tpu.execution import io as hio
-from hyperspace_tpu.execution.builder import compute_row_hashes, hash_scalar_key
 from hyperspace_tpu.execution.table import ColumnTable
-from hyperspace_tpu.dataset import format_suffix, list_data_files
-from hyperspace_tpu.ops.filter import apply_filter, eval_predicate_mask
-from hyperspace_tpu.ops.hashing import bucket_ids
+from hyperspace_tpu.ops.filter import eval_predicate_mask
 from hyperspace_tpu.ops import join as join_ops
-from hyperspace_tpu.plan.expr import And, BinOp, Col, Expr, Lit, evaluate, split_conjuncts
-from hyperspace_tpu.plan.nodes import (
-    Aggregate,
-    Filter,
-    Join,
-    Limit,
-    LogicalPlan,
-    Project,
-    Scan,
-    Sort,
-    Union,
-    Window,
-)
+from hyperspace_tpu.plan.expr import BinOp, Col, Expr, Lit, split_conjuncts
+from hyperspace_tpu.plan.nodes import Aggregate, Join, LogicalPlan, Scan, Union
 
 
 

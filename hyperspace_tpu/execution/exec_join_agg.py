@@ -4,43 +4,18 @@ merge+accumulate venue that never materialize the joined pairs
 
 from __future__ import annotations
 
-import dataclasses
-from pathlib import Path
 
 import numpy as np
 
-from hyperspace_tpu.exceptions import HyperspaceError
-from hyperspace_tpu.execution import io as hio
-from hyperspace_tpu.execution.builder import compute_row_hashes, hash_scalar_key
 from hyperspace_tpu.execution.table import ColumnTable
-from hyperspace_tpu.dataset import format_suffix, list_data_files
-from hyperspace_tpu.ops.filter import apply_filter, eval_predicate_mask
-from hyperspace_tpu.ops.hashing import bucket_ids
-from hyperspace_tpu.ops import join as join_ops
-from hyperspace_tpu.plan.expr import And, BinOp, Col, Expr, Lit, evaluate, split_conjuncts
-from hyperspace_tpu.plan.nodes import (
-    Aggregate,
-    Filter,
-    Join,
-    Limit,
-    LogicalPlan,
-    Project,
-    Scan,
-    Sort,
-    Union,
-    Window,
-)
+from hyperspace_tpu.plan.nodes import Aggregate, Join, Project
 
 from hyperspace_tpu.execution.exec_common import (
     _RunExtremum,
-    _TableLeaf,
     _agg_channels_cached,
     _bucket_sorted_codes,
-    _composite_keys,
-    _copy_field,
     _factorize_keys_cached,
     _group_ids_cached,
-    _pad_bucket_major,
     _pad_bucket_major_cached,
     _stack_cached,
 )

