@@ -1,4 +1,4 @@
-"""TPC-DS slice benchmark: the 30 published queries of benchmarks/tpcds.py
+"""TPC-DS slice benchmark: the 76 published queries of benchmarks/tpcds.py (+ tpcds_ext.py)
 with and without indexes, results REQUIRED identical both ways, timed
 warm best-of-2 per side. Prints one JSON line with the geomean speedup —
 the artifact building toward BASELINE config 3 (SF1000 99-query

@@ -49,8 +49,12 @@ class AggregateMixin:
             return self._distinct_aggregate(plan, sorted(dcols))
         venue = self._agg_venue()
         pushed = self._try_partial_agg_pushdown(plan)
-        if pushed is not None:
+        if isinstance(pushed, ColumnTable):
             return pushed
+        if pushed is not None:
+            # Pushdown bailed AFTER materializing the left side: continue
+            # with the spliced plan so nothing below re-executes it.
+            plan = pushed
         # Fuse Aggregate(Join) on both venues: the device run-prefix
         # kernel avoids the match-pair readback; the host C++
         # merge+accumulate avoids materializing the pairs at all.
@@ -84,7 +88,7 @@ class AggregateMixin:
             groups=_group_ids_cached(table, plan.group_by),
         )
 
-    def _try_partial_agg_pushdown(self, plan: "Aggregate") -> ColumnTable | None:
+    def _try_partial_agg_pushdown(self, plan: "Aggregate") -> "ColumnTable | Aggregate | None":
         """Partial aggregation pushdown (Spark's PartialAggregate /
         aggregate-through-join analog): for Aggregate(Join(L, R)) where
         every aggregate reads only the L side — optionally inside a
@@ -173,8 +177,21 @@ class AggregateMixin:
         gid, k, rep = _group_ids_cached(lt, pkeys)
         if k > max(64, lt.num_rows // 8):
             # Less than ~8x shrink: the extra factorize + re-fold beats
-            # nothing the fused path doesn't already do better.
-            return None
+            # nothing the fused path doesn't already do better. When the
+            # left side is a deep subtree, it is already MATERIALIZED —
+            # hand back a plan with it spliced in so nothing below
+            # re-executes it. An index-aligned scan side stays a PLAN:
+            # splicing would knock it off the zero-exchange aligned path
+            # (and its DPP pruning), which beats the re-execution it
+            # avoids (the scan is cache-served anyway).
+            if self._aligned_side(child.left) is not None:
+                return None
+            return Aggregate(
+                Join(_TableLeaf(lt), child.right, child.left_on, child.right_on,
+                     child.how, condition=child.condition),
+                list(plan.group_by),
+                list(plan.aggs),
+            )
 
         from hyperspace_tpu.plan.nodes import Aggregate as _Agg
 

@@ -15,7 +15,7 @@ from hyperspace_tpu.execution.table import ColumnTable
 from hyperspace_tpu.ops.filter import eval_predicate_mask
 from hyperspace_tpu.ops import join as join_ops
 from hyperspace_tpu.plan.expr import BinOp, Col, Expr, Lit, split_conjuncts
-from hyperspace_tpu.plan.nodes import Aggregate, Join, LogicalPlan, Scan, Union
+from hyperspace_tpu.plan.nodes import Aggregate, Join, LogicalPlan, Scan
 
 
 

@@ -4,14 +4,11 @@ anti composition, and ON-residual matching (Executor mixin)."""
 
 from __future__ import annotations
 
-from pathlib import Path
-
 import numpy as np
 
 from hyperspace_tpu.execution.table import ColumnTable
 from hyperspace_tpu.ops.filter import eval_predicate_mask
 from hyperspace_tpu.ops import join as join_ops
-from hyperspace_tpu.plan.expr import evaluate
 from hyperspace_tpu.plan.nodes import Join
 
 from hyperspace_tpu.execution.exec_common import (

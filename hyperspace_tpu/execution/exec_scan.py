@@ -45,8 +45,12 @@ class ScanFilterMixin:
         cols = columns if columns is not None else scan.scan_schema.names
         if not files:  # everything pruned away
             return ColumnTable.empty(scan.scan_schema.select(cols))
-        if scan.bucket_spec is not None:
-            # Index files are immutable per version — cache their decode.
+        if scan.format == "parquet":
+            # ALL parquet scans ride the decoded-table cache, not just
+            # index files: the cache validates per-file mtimes, so a
+            # mutated source re-decodes while repeat queries over stable
+            # sources (dimension tables above all) skip the decode — the
+            # analog of Spark's in-memory relation cache.
             return self._cached_read(files, cols, scan.scan_schema)
         self.stats["files_read"] += len(files)
         return hio.read_table_files(files, scan.format, columns=cols, schema=scan.scan_schema)
