@@ -129,24 +129,21 @@ class ColumnTable:
                 if idx.null_count:
                     idx = pc.fill_null(idx, 0)
                 codes0 = np.asarray(idx).astype(np.int64, copy=False)
-                empty_code = None
-                if valid is not None:
+                if valid is not None and not (svals == "").any():
                     # Null slots take the deterministic "" value (added to
                     # the dictionary when absent), as the decode always has.
-                    hits = np.flatnonzero(svals == "")
-                    if len(hits):
-                        empty_code = int(hits[0])
-                    else:
-                        svals = np.append(svals, "")
-                        empty_code = len(svals) - 1
-                order = np.argsort(svals, kind="stable")
-                remap = np.empty(len(svals), np.int32)
-                remap[order] = np.arange(len(svals), dtype=np.int32)
-                codes = remap[codes0]
+                    svals = np.append(svals, "")
+                # np.unique over the SMALL dictionary: sorts AND dedups
+                # (arrow permits duplicate dictionary values — two codes
+                # meaning the same string must collapse to one, or code-
+                # domain equality silently drops rows).
+                sorted_dict, inv = np.unique(svals, return_inverse=True)
+                codes = inv.astype(np.int32, copy=False)[codes0]
                 if valid is not None:
-                    codes = np.where(valid, codes, remap[empty_code])
-                columns[f.name] = codes.astype(np.int32, copy=False)
-                dictionaries[f.name] = svals[order]
+                    empty_code = np.int32(np.searchsorted(sorted_dict, ""))
+                    codes = np.where(valid, codes, empty_code).astype(np.int32, copy=False)
+                columns[f.name] = codes
+                dictionaries[f.name] = sorted_dict
             elif f.is_vector:
                 combined = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
                 # .values, NOT .flatten(): flatten silently drops null list

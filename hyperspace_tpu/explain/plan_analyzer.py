@@ -156,11 +156,19 @@ def explain_executed(plan: LogicalPlan, session, mode=None) -> str:
 
     was_enabled = session.is_hyperspace_enabled()
     try:
+        from hyperspace_tpu.execution import io as _hio
+
+        # COLD evidence on both sides: files_read counts physical (miss)
+        # reads, so the shared decoded-table cache must not let either
+        # run ride the other's warm state — the "files read: X -> Y"
+        # line exists to show the INDEX's IO reduction.
         session.disable_hyperspace()
+        _hio.clear_table_cache()
         session.run(plan)
         phys_without = session.last_physical_plan
         stats_without = session.last_query_stats
         session.enable_hyperspace()
+        _hio.clear_table_cache()
         session.run(plan)
         phys_with = session.last_physical_plan
         stats_with = session.last_query_stats
