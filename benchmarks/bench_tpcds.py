@@ -119,7 +119,7 @@ def main(sf: float = 1.0):
             "cold_regime": "storage-cold (page cache dropped per rep)" if storage_cold
                            else "engine-caches-cleared only",
             "queries": results,
-        }))
+        }, indent=1))
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 

@@ -100,6 +100,79 @@ def kernel_only(n_rows: int) -> dict:
 
     jax.block_until_ready((k, b))
     out["filter_mask"] = timed(lambda: mask_fn(k, b), int(k.nbytes) + int(b.nbytes))
+
+    # The single-call timings above include one program DISPATCH, which
+    # on a tunneled deployment is pure link latency (~0.1s RTT) and
+    # swamps a microsecond kernel. Amortize it away: run the kernel K
+    # times CHAINED inside one jitted fori_loop (iteration-dependent
+    # constants keep XLA from hoisting the body), so kernel time is the
+    # slope between a 1-iteration and a K-iteration program.
+    from functools import partial
+
+    K = 64
+
+    @partial(jax.jit, static_argnames=("iters",))
+    def filter_loop(kc, bc, iters: int):
+        def body(i, acc):
+            m = ((kc % 3) == 0) & (bc > i.astype(jnp.float32) * 1e-7)
+            return acc + jnp.sum(m)
+
+        return jax.lax.fori_loop(0, iters, body, jnp.int32(0))
+
+    @partial(jax.jit, static_argnames=("iters",))
+    def seg_loop(vals_, gid_, iters: int):
+        def body(i, acc):
+            # Shift the segment ids per iteration (fused elementwise — no
+            # extra memory traffic): a scaled-values variant is LINEAR in
+            # the scale and XLA hoists the whole reduce out of the loop;
+            # a changing scatter pattern cannot fold.
+            r = _segment_reduce_many(vals_, (gid_ + i) % 131_072, 131_072, ("sum", "sum"))
+            return acc + r[0, 0]
+
+        return jax.lax.fori_loop(0, iters, body, jnp.float32(0))
+
+    @partial(jax.jit, static_argnames=("iters",))
+    def join_loop(lk_, rk_, iters: int):
+        def body(i, acc):
+            c, _cum, tot = join_counts(lk_ + i, rk_)
+            return acc + jnp.sum(tot)
+
+        return jax.lax.fori_loop(0, iters, body, jnp.int32(0))
+
+    def amortized(fn, nbytes, label):
+        jax.block_until_ready(fn(1))
+        jax.block_until_ready(fn(K))  # compile both variants
+        t1s, tks = [], []
+        for _ in range(3):
+            t0 = time.perf_counter(); jax.block_until_ready(fn(1)); t1s.append(time.perf_counter() - t0)
+            t0 = time.perf_counter(); jax.block_until_ready(fn(K)); tks.append(time.perf_counter() - t0)
+        per_iter = (min(tks) - min(t1s)) / (K - 1)
+        # Noise floor from run-to-run spread (NOT from t1 — t1 is the
+        # dispatch latency the loop exists to amortize away).
+        noise = (max(tks) - min(tks) + max(t1s) - min(t1s)) / (K - 1)
+        row = {
+            "t1_s": round(min(t1s), 5),
+            "tK_s": round(min(tks), 5),
+            "K": K,
+        }
+        if per_iter > max(2 * noise, 1e-7):
+            # The K-iteration program scaled above noise: the slope is a
+            # credible per-kernel time.
+            row["s_per_iter"] = round(per_iter, 7)
+            row["GBps"] = round(nbytes / 1e9 / per_iter, 2)
+        else:
+            # No scaling above noise: the kernel is below the measurable
+            # floor (or the runtime elided the loop) — report the raw
+            # walls rather than a fictional rate.
+            row["note"] = "no scaling above noise; kernel below measurable floor on this backend"
+        out[label] = row
+
+    amortized(lambda it: filter_loop(k, b, it), int(k.nbytes) + int(b.nbytes),
+              "filter_mask_amortized")
+    amortized(lambda it: seg_loop(vals, gid, it), n_pad * (2 * 4 + 4),
+              "segment_reduce_amortized")
+    amortized(lambda it: join_loop(lk, rk, it), 2 * B * L * 4,
+              "join_counts_amortized")
     out["hbm_roof_ref_GBps"] = 819  # v5e HBM roof for context
     return out
 
@@ -237,7 +310,7 @@ def main(n_rows: int = 4_000_000):
             "classes": table,
             "kernel_rates": kernel_rates,
             "kernel_only_device": ko,
-        }))
+        }, indent=1))
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
