@@ -26,7 +26,7 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def main(n: int = 1_000_000, append_n: int = 100_000):
+def main(n: int = 8_000_000, append_n: int = 800_000):
     sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
     from benchmarks.datagen import gen_lineitem
     from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col
@@ -69,13 +69,33 @@ def main(n: int = 1_000_000, append_n: int = 100_000):
                 total += len(session.run(q).columns["l_orderkey"])
             return total
 
-        rows_hybrid = run_queries()  # warmup
+        from hyperspace_tpu.execution import io as hio
+
+        def cold() -> bool:
+            """Storage-cold timed pass (the BENCH_TPCDS regime): engine
+            caches cleared + page cache dropped, so the scan IO the
+            hybrid index avoids is actually paid by the full scan.
+            Returns False when the page-cache drop is not permitted."""
+            hio.clear_table_cache()
+            try:
+                import os
+
+                os.sync()
+                with open("/proc/sys/vm/drop_caches", "w") as f:
+                    f.write("3")
+                return True
+            except OSError:
+                return False
+
+        rows_hybrid = run_queries()  # warmup (compile)
+        storage_cold = cold()
         t0 = time.perf_counter()
         rows_hybrid = run_queries()
         t_hybrid = time.perf_counter() - t0
 
         session.disable_hyperspace()
         rows_full = run_queries()  # warmup
+        cold()
         t0 = time.perf_counter()
         rows_full = run_queries()
         t_full = time.perf_counter() - t0
@@ -88,6 +108,8 @@ def main(n: int = 1_000_000, append_n: int = 100_000):
             "value": round(speedup, 3),
             "unit": "x",
             "vs_baseline": round(speedup, 3),
+            "cold_regime": "storage-cold (page cache dropped)" if storage_cold
+                           else "engine-caches-cleared only",
         }))
     finally:
         shutil.rmtree(tmp, ignore_errors=True)

@@ -1,6 +1,7 @@
 """TPC-DS slice benchmark: the 76 published queries of benchmarks/tpcds.py (+ tpcds_ext.py)
 with and without indexes, results REQUIRED identical both ways, timed
-warm best-of-2 per side. Prints one JSON line with the geomean speedup —
+in storage-cold and warm regimes per side. Prints one JSON document
+(pretty-printed) with the geomean speedups —
 the artifact building toward BASELINE config 3 (SF1000 99-query
 geomean)."""
 

@@ -1,7 +1,7 @@
 """Device-venue perf evidence: the same query classes on the host and
 device venues, with the device venue measured COLD (first query after a
 cache clear — pays staging) and WARM (repeat query — uploads served from
-the HBM-resident cache). Emits one JSON line with the warm-over-cold
+the HBM-resident cache). Emits one JSON document (pretty-printed) with the warm-over-cold
 device speedup plus the per-class venue table, and writes a
 jax.profiler trace of one warm device join for kernel inspection.
 

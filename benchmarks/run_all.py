@@ -1,4 +1,5 @@
-"""Run every benchmark; one JSON line per benchmark on stdout.
+"""Run every benchmark; one JSON document per benchmark on stdout
+(single-line for most; bench_tpcds/bench_venues pretty-print theirs).
 
 `python bench.py` at the repo root remains the driver's flagship entry
 (TPC-H point lookup); this harness covers the remaining BASELINE configs.
