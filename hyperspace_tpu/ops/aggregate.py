@@ -156,6 +156,30 @@ def group_ids(table: ColumnTable, group_by: list[str]):
     n = table.num_rows
     if not group_by:
         return np.zeros(n, np.int64), 1, np.zeros(1 if n else 0, np.int64)
+    if len(group_by) == 1 and n:
+        # Dictionary-coded string group column with no nulls: the codes
+        # already ARE compact ranks in value order (the dictionary is
+        # sorted) — one bincount decides whether any dictionary entry is
+        # unused, and the whole multi-pass rank machinery collapses to at
+        # most one small-table gather (at SF100 this was ~40% of the
+        # fused join-aggregate's wall on BOTH venues).
+        f = table.schema.field(group_by[0])
+        if f.is_string and table.valid_mask(group_by[0]) is None:
+            codes = np.asarray(table.columns[f.name])
+            k_dict = len(table.dictionaries[f.name])
+            if k_dict:
+                cnt = np.bincount(codes, minlength=k_dict)
+                used = cnt > 0
+                if used.all():
+                    gid = codes.astype(np.int64, copy=False)
+                    k = k_dict
+                else:
+                    lookup = np.cumsum(used, dtype=np.int64) - 1
+                    gid = lookup[codes]
+                    k = int(used.sum())
+                rep = np.empty(k, dtype=np.int64)
+                rep[gid] = np.arange(n, dtype=np.int64)
+                return gid, k, rep
     codes0, card0 = _column_codes(table, group_by[0])
     combined = codes0
     total = card0
