@@ -143,9 +143,25 @@ def _bucket_sorted_codes(codes: np.ndarray, side: SideData):
         if ok:
             return codes, None
     counts = np.diff(side.offsets)
-    bucket_of = np.repeat(np.arange(len(counts), dtype=np.int64), counts)
-    perm = np.lexsort((codes, bucket_of))  # stable; regroups identically
-    return codes[perm], perm
+
+    def build_sorted(freeze: bool):
+        bucket_of = np.repeat(np.arange(len(counts), dtype=np.int64), counts)
+        perm = np.lexsort((codes, bucket_of))  # stable; regroups identically
+        sc = codes[perm]
+        if freeze:  # cache-owned ⟺ frozen (the identity-cache invariant)
+            sc, perm = dc.freeze(sc), dc.freeze(perm)
+        return (sc, perm), sc.nbytes + perm.nbytes
+
+    if dc.is_stable(codes):
+        # Stable (identity-cached) codes: memoize the sort itself, not
+        # just the sortedness check — repeat queries over the same index
+        # version skip the O(n log n) pass entirely, and the frozen
+        # outputs keep the downstream pad/upload caches engaged.
+        return dc.HOST_DERIVED.get_or_build(
+            ("bsort", id(codes), side.offsets.tobytes()), (codes,),
+            lambda: build_sorted(True),
+        )
+    return build_sorted(False)[0]
 
 
 @dataclasses.dataclass
