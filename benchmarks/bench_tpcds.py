@@ -1,4 +1,4 @@
-"""TPC-DS slice benchmark: the 76 published queries of benchmarks/tpcds.py (+ tpcds_ext.py)
+"""TPC-DS slice benchmark: the 91 published queries of benchmarks/tpcds.py (+ tpcds_ext / tpcds_ext2)
 with and without indexes, results REQUIRED identical both ways, timed
 in storage-cold and warm regimes per side. Prints one JSON document
 (pretty-printed) with the geomean speedups —

@@ -1836,8 +1836,10 @@ def tpcds_queries(t: dict) -> dict:
         "q88": q88, "q89": q89, "q96": q96, "q98": q98,
     }
     from benchmarks.tpcds_ext import tpcds_extra_queries
+    from benchmarks.tpcds_ext2 import tpcds_extra_queries2
 
     out.update(tpcds_extra_queries(t))
+    out.update(tpcds_extra_queries2(t))
     return out
 
 

@@ -142,15 +142,18 @@ def _bucket_sorted_codes(codes: np.ndarray, side: SideData):
             ok = check()
         if ok:
             return codes, None
-    counts = np.diff(side.offsets)
-
-    def build_sorted(freeze: bool):
+    def build_sorted(cacheable: bool):
+        counts = np.diff(side.offsets)
         bucket_of = np.repeat(np.arange(len(counts), dtype=np.int64), counts)
         perm = np.lexsort((codes, bucket_of))  # stable; regroups identically
         sc = codes[perm]
-        if freeze:  # cache-owned ⟺ frozen (the identity-cache invariant)
+        nbytes = sc.nbytes + perm.nbytes
+        if cacheable and nbytes <= dc.HOST_DERIVED.budget // 4:
+            # Freeze ONLY what the cache will actually keep (same rule as
+            # the decoded-table cache): a frozen-but-uncached result would
+            # masquerade as stable and pile dead downstream entries.
             sc, perm = dc.freeze(sc), dc.freeze(perm)
-        return (sc, perm), sc.nbytes + perm.nbytes
+        return (sc, perm), nbytes
 
     if dc.is_stable(codes):
         # Stable (identity-cached) codes: memoize the sort itself, not
