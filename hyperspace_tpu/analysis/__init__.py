@@ -1,0 +1,25 @@
+"""Static analysis: pre-execution plan validation + trace-safety lint.
+
+Two layers (the analog of Catalyst's analyzer, which the Spark reference
+leans on to reject malformed plans before execution — Armbrust et al.,
+SIGMOD 2015; the reference inherits it wholesale):
+
+- `validator` — walks the logical plan IR before the executor touches a
+  device, checking schema/dtype resolution of every expression, join
+  bucket-spec compatibility, sort-key legality, and rewrite
+  (pushdown/prune) equivalence. Raises `PlanValidationError` with
+  structured `PlanDiagnostic`s naming the offending node.
+- `lint` — an AST lint over the package source flagging the bug classes
+  that actually bite a jax codebase: version-fragile jax imports outside
+  `compat.py`, host synchronization inside jitted code, Python control
+  flow on traced values, unhashable static args, unseeded randomness.
+  Run as `python -m hyperspace_tpu.analysis.lint <paths>`.
+"""
+
+from hyperspace_tpu.analysis.validator import (
+    check_plan,
+    validate_plan,
+    validate_rewrite,
+)
+
+__all__ = ["check_plan", "validate_plan", "validate_rewrite"]

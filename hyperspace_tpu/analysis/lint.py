@@ -1,0 +1,421 @@
+"""Trace-safety / compat linter: AST rules for the jax bug classes that
+actually bite this codebase.
+
+Run over paths (files or directories) with::
+
+    python -m hyperspace_tpu.analysis.lint hyperspace_tpu
+
+Exit status is non-zero iff any finding is reported — the CI gate. Rules:
+
+- **HSL001 fragile-jax-import** — importing jax symbols whose location
+  changes across jax versions (`from jax import shard_map`, anything
+  under `jax.experimental`) anywhere except the sanctioned
+  ``hyperspace_tpu/compat.py``. The seed shipped exactly this bug: a
+  bare ``from jax import shard_map`` produced 66 collection errors on
+  jax 0.4.37. The compat module resolves such symbols once, with
+  fallbacks; everything else imports from it.
+- **HSL002 host-sync-in-jit** — forcing a traced value to a host Python
+  value inside jitted/shard_mapped code: ``.item()``, ``.tolist()``,
+  ``float()/int()/bool()`` on non-literals, ``np.asarray``/``np.array``,
+  ``jax.device_get``. Under tracing these either fail
+  (ConcretizationTypeError) or silently insert a blocking transfer.
+- **HSL003 traced-control-flow** — Python ``if``/``while`` whose test
+  reads a traced argument's VALUE inside jitted code. Shape/dtype
+  attributes (``x.shape``, ``x.ndim``, ``x.dtype``, ``x.size``) are
+  static and exempt; branching on the value itself needs ``lax.cond`` /
+  ``jnp.where``.
+- **HSL004 unhashable-static** — ``static_argnums``/``static_argnames``
+  given a list/set/dict display. jit caches on static argument VALUES,
+  which therefore must be hashable; the tuple spelling is required.
+- **HSL005 unseeded-randomness** — module-level RNG calls
+  (``np.random.rand`` etc., stdlib ``random.*``) and
+  ``np.random.default_rng()`` with no seed. Unseeded randomness makes
+  device results irreproducible across runs and shards; pass an explicit
+  seed (``np.random.default_rng(0)``) or thread ``jax.random`` keys.
+
+Suppression: a finding on a line containing ``# noqa`` or
+``# noqa: HSLxxx`` (matching rule id) is dropped.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import pathlib
+import sys
+
+FRAGILE_IMPORT = "HSL001"
+HOST_SYNC = "HSL002"
+TRACED_FLOW = "HSL003"
+UNHASHABLE_STATIC = "HSL004"
+UNSEEDED_RNG = "HSL005"
+
+# The one module allowed to touch version-fragile jax import paths.
+SANCTIONED_COMPAT = "compat.py"
+
+_JIT_NAMES = {"jit", "shard_map", "pmap"}
+_HOST_SYNC_ATTRS = {"item", "tolist"}
+_HOST_SYNC_CASTS = {"float", "int", "bool"}
+_NP_SYNC_FNS = {"asarray", "array"}
+_STATIC_SHAPE_ATTRS = {"shape", "ndim", "dtype", "size"}
+_GLOBAL_RNG_FNS = {
+    "rand", "randn", "randint", "random", "random_sample", "choice",
+    "shuffle", "permutation", "normal", "uniform", "standard_normal", "seed",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of a Name/Attribute chain ('' otherwise)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _static_params(decl: ast.AST, ordered_params: list[str]) -> set[str]:
+    """Parameter names a jit declaration (decorator or wrapping call)
+    marks static via static_argnames (strings) / static_argnums
+    (positions into `ordered_params`)."""
+    out: set[str] = set()
+    for sub in ast.walk(decl):
+        if not isinstance(sub, ast.Call):
+            continue
+        for kw in sub.keywords:
+            if kw.arg not in ("static_argnums", "static_argnames"):
+                continue
+            values = (
+                kw.value.elts
+                if isinstance(kw.value, (ast.Tuple, ast.List, ast.Set))
+                else [kw.value]
+            )
+            for v in values:
+                if not isinstance(v, ast.Constant):
+                    continue
+                if kw.arg == "static_argnames" and isinstance(v.value, str):
+                    out.add(v.value)
+                elif kw.arg == "static_argnums" and isinstance(v.value, int):
+                    if 0 <= v.value < len(ordered_params):
+                        out.add(ordered_params[v.value])
+    return out
+
+
+def _mentions_jit(node: ast.AST) -> bool:
+    """True when the (decorator / callee) expression references a
+    jit-family transform anywhere: `jax.jit`, `functools.partial(jax.jit,
+    ...)`, bare `jit`, `shard_map`, ..."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in _JIT_NAMES:
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr in _JIT_NAMES:
+            return True
+    return False
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path: str, source: str, is_compat: bool):
+        self.path = path
+        self.lines = source.splitlines()
+        self.is_compat = is_compat
+        self.findings: list[Finding] = []
+        # Names wrapped by a jit-family call somewhere in the module
+        # (`return jax.jit(fn)` marks `fn` as traced code), and the call
+        # nodes that wrapped them (their static_arg* declarations apply).
+        self.jit_wrapped: set[str] = set()
+        self.static_decls: dict[str, list[ast.AST]] = {}
+        # Stack of (in_jit_context, param_names) per function scope.
+        self._fn_stack: list[tuple[bool, frozenset]] = []
+
+    # -- bookkeeping ---------------------------------------------------------
+
+    def collect_jit_wrapped(self, tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and node.args
+                and isinstance(node.args[0], ast.Name)
+                and _mentions_jit(node.func)
+            ):
+                self.jit_wrapped.add(node.args[0].id)
+                self.static_decls.setdefault(node.args[0].id, []).append(node)
+
+    def _report(self, node: ast.AST, rule: str, message: str) -> None:
+        line = getattr(node, "lineno", 0)
+        text = self.lines[line - 1] if 0 < line <= len(self.lines) else ""
+        if "# noqa" in text:
+            tail = text.split("# noqa", 1)[1]
+            if not tail.strip().startswith(":") or rule in tail:
+                return
+        self.findings.append(
+            Finding(self.path, line, getattr(node, "col_offset", 0), rule, message)
+        )
+
+    def _in_jit(self) -> bool:
+        return any(flag for flag, _ in self._fn_stack)
+
+    def _jit_params(self) -> set[str]:
+        out: set[str] = set()
+        for flag, params in self._fn_stack:
+            if flag:
+                out |= params
+        return out
+
+    # -- HSL001: fragile imports ---------------------------------------------
+
+    def visit_Import(self, node: ast.Import) -> None:
+        if not self.is_compat:
+            for alias in node.names:
+                if alias.name == "jax.experimental" or alias.name.startswith("jax.experimental."):
+                    self._report(
+                        node, FRAGILE_IMPORT,
+                        f"import of {alias.name!r} outside compat.py — jax moves "
+                        f"experimental symbols between versions; resolve it in "
+                        f"hyperspace_tpu/compat.py and import from there",
+                    )
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if not self.is_compat and node.module:
+            if node.module == "jax":
+                fragile = [a.name for a in node.names if a.name in ("shard_map", "enable_x64")]
+                for name in fragile:
+                    self._report(
+                        node, FRAGILE_IMPORT,
+                        f"'from jax import {name}' is version-fragile (moved "
+                        f"between jax releases; broke collection on jax "
+                        f"0.4.37) — import it from hyperspace_tpu.compat",
+                    )
+            elif node.module == "jax.experimental" or node.module.startswith("jax.experimental."):
+                self._report(
+                    node, FRAGILE_IMPORT,
+                    f"import from {node.module!r} outside compat.py — resolve "
+                    f"experimental symbols in hyperspace_tpu/compat.py",
+                )
+        self.generic_visit(node)
+
+    # -- function scopes -----------------------------------------------------
+
+    def _visit_fn(self, node) -> None:
+        in_jit = (
+            any(_mentions_jit(d) for d in node.decorator_list)
+            or node.name in self.jit_wrapped
+            or self._in_jit()  # nested defs inherit the traced context
+        )
+        ordered = [*node.args.posonlyargs, *node.args.args]
+        params = {
+            a.arg
+            for a in [
+                *ordered, *node.args.kwonlyargs,
+                *( [node.args.vararg] if node.args.vararg else [] ),
+                *( [node.args.kwarg] if node.args.kwarg else [] ),
+            ]
+        }
+        # Parameters declared static (static_argnums/static_argnames on
+        # the jit decorator or wrapping call) hold ordinary Python values
+        # — control flow on them is fine.
+        for decl in [*node.decorator_list, *self.static_decls.get(node.name, [])]:
+            params -= _static_params(decl, [a.arg for a in ordered])
+        self._fn_stack.append((in_jit, frozenset(params)))
+        self.generic_visit(node)
+        self._fn_stack.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    # -- HSL002 / HSL004 / HSL005: calls -------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        dotted = _dotted(node.func)
+
+        # HSL004: static_argnums/static_argnames must be hashable (tuple).
+        for kw in node.keywords:
+            if kw.arg in ("static_argnums", "static_argnames") and isinstance(
+                kw.value, (ast.List, ast.Set, ast.Dict)
+            ):
+                self._report(
+                    node, UNHASHABLE_STATIC,
+                    f"{kw.arg} given a {type(kw.value).__name__.lower()} "
+                    f"display; jit hashes static argument POSITIONS and "
+                    f"values — use a tuple",
+                )
+
+        # HSL005: module-level RNG state.
+        parts = dotted.split(".")
+        if len(parts) >= 2 and parts[-2] == "random" and parts[0] in ("np", "numpy", "jax"):
+            if parts[0] != "jax" and parts[-1] in _GLOBAL_RNG_FNS:
+                self._report(
+                    node, UNSEEDED_RNG,
+                    f"{dotted}() uses numpy's global RNG — results are not "
+                    f"reproducible across runs/shards; use "
+                    f"np.random.default_rng(seed)",
+                )
+            elif parts[-1] == "default_rng" and not node.args and not node.keywords:
+                self._report(
+                    node, UNSEEDED_RNG,
+                    "np.random.default_rng() without a seed is entropy-seeded "
+                    "— pass an explicit seed for reproducible builds",
+                )
+        elif len(parts) == 2 and parts[0] == "random" and parts[1] in (
+            _GLOBAL_RNG_FNS | {"gauss", "sample", "randrange"}
+        ):
+            self._report(
+                node, UNSEEDED_RNG,
+                f"stdlib {dotted}() draws from global, unseeded state — "
+                f"use a seeded np.random.default_rng",
+            )
+
+        # HSL002: host sync inside traced code.
+        if self._in_jit():
+            if isinstance(node.func, ast.Attribute) and node.func.attr in _HOST_SYNC_ATTRS:
+                self._report(
+                    node, HOST_SYNC,
+                    f".{node.func.attr}() forces a device->host transfer and "
+                    f"fails under tracing — return the array and read it "
+                    f"outside the jitted function",
+                )
+            elif (
+                isinstance(node.func, ast.Name)
+                and node.func.id in _HOST_SYNC_CASTS
+                and node.args
+                and not isinstance(node.args[0], ast.Constant)
+            ):
+                self._report(
+                    node, HOST_SYNC,
+                    f"{node.func.id}() on a traced value raises "
+                    f"ConcretizationTypeError inside jit — keep it an array "
+                    f"(jnp.float32(...) etc.) or hoist the cast to the host",
+                )
+            elif parts[-1] in _NP_SYNC_FNS and parts[0] in ("np", "numpy"):
+                self._report(
+                    node, HOST_SYNC,
+                    f"{dotted}() materializes a traced value on host inside "
+                    f"jit — use jnp equivalents",
+                )
+            elif dotted in ("jax.device_get",):
+                self._report(
+                    node, HOST_SYNC,
+                    "jax.device_get inside jitted code blocks on a transfer "
+                    "that tracing cannot represent",
+                )
+        self.generic_visit(node)
+
+    # -- HSL003: traced-value control flow ------------------------------------
+
+    def _check_branch(self, node, kind: str) -> None:
+        if self._in_jit():
+            tainted = self._traced_value_names(node.test)
+            if tainted:
+                self._report(
+                    node, TRACED_FLOW,
+                    f"Python {kind} on traced value(s) {sorted(tainted)} "
+                    f"inside jitted code — branch decisions must use "
+                    f"lax.cond/lax.while_loop/jnp.where (shape/dtype "
+                    f"attributes are static and fine)",
+                )
+        self.generic_visit(node)
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_branch(node, "if")
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_branch(node, "while")
+
+    def _traced_value_names(self, test: ast.AST) -> set[str]:
+        """Parameter names whose runtime VALUE the test reads. A name
+        consumed only through static attributes (x.shape, x.ndim, ...)
+        or len() does not count."""
+        params = self._jit_params()
+        if not params:
+            return set()
+        static_ids: set[int] = set()
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Attribute) and sub.attr in _STATIC_SHAPE_ATTRS:
+                for inner in ast.walk(sub.value):
+                    if isinstance(inner, ast.Name):
+                        static_ids.add(id(inner))
+            if (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Name)
+                and sub.func.id in ("len", "isinstance", "getattr", "hasattr")
+            ):
+                for inner in ast.walk(sub):
+                    if isinstance(inner, ast.Name):
+                        static_ids.add(id(inner))
+        return {
+            sub.id
+            for sub in ast.walk(test)
+            if isinstance(sub, ast.Name)
+            and sub.id in params
+            and id(sub) not in static_ids
+        }
+
+
+# -- driver ------------------------------------------------------------------
+
+def lint_source(source: str, path: str = "<string>") -> list[Finding]:
+    """Lint one source text; `path` only labels findings (a basename of
+    compat.py marks the sanctioned module)."""
+    tree = ast.parse(source, filename=path)
+    linter = _Linter(path, source, pathlib.PurePath(path).name == SANCTIONED_COMPAT)
+    linter.collect_jit_wrapped(tree)
+    linter.visit(tree)
+    return sorted(linter.findings, key=lambda f: (f.path, f.line, f.col))
+
+
+def lint_paths(paths: list[str]) -> list[Finding]:
+    findings: list[Finding] = []
+    for p in paths:
+        root = pathlib.Path(p)
+        files = sorted(root.rglob("*.py")) if root.is_dir() else [root]
+        for f in files:
+            try:
+                src = f.read_text()
+            except OSError as e:
+                findings.append(Finding(str(f), 0, 0, "HSL000", f"unreadable: {e}"))
+                continue
+            try:
+                findings.extend(lint_source(src, str(f)))
+            except SyntaxError as e:
+                findings.append(
+                    Finding(str(f), e.lineno or 0, e.offset or 0, "HSL000",
+                            f"syntax error: {e.msg}")
+                )
+    return findings
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m hyperspace_tpu.analysis.lint",
+        description="Trace-safety / jax-compat linter (rules HSL001-HSL005).",
+    )
+    ap.add_argument("paths", nargs="+", help="files or directories to lint")
+    ap.add_argument(
+        "-q", "--quiet", action="store_true", help="suppress the summary line"
+    )
+    args = ap.parse_args(argv)
+    findings = lint_paths(args.paths)
+    for f in findings:
+        print(f)
+    if not args.quiet:
+        print(f"{len(findings)} finding(s)", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

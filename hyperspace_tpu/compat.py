@@ -1,0 +1,87 @@
+"""Version-compatibility shims for jax's fragile import surface.
+
+jax renames and relocates public symbols across minor versions; the cost
+of importing them directly is not a graceful degradation but a module
+that fails to IMPORT — the seed shipped a bare ``from jax import
+shard_map`` that produced 66 collection errors and ~200 cascading test
+failures on jax 0.4.37. Every symbol jax has moved (or is likely to
+move) is resolved HERE and nowhere else:
+
+- ``shard_map``: ``jax.shard_map`` (new public API) falling back to
+  ``jax.experimental.shard_map.shard_map`` (0.4.x). Callers always use
+  the NEW kwarg spelling ``check_vma=``; the shim renames it to the
+  older ``check_rep=`` when the resolved function predates the rename.
+- Pallas: ``resolve_pallas()`` returns the ``pallas`` module from its
+  current home (``jax.experimental.pallas`` today).
+
+The trace-safety linter (``analysis/lint.py``, rule HSL001) makes this
+arrangement permanent: any ``from jax import shard_map`` or
+``jax.experimental`` use outside this module is a lint error, and the CI
+gate runs the linter over the package — so the seed's breakage class
+cannot be reintroduced by a future PR.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+
+
+def _resolve_shard_map():
+    import jax
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None and not callable(sm):
+        # Some versions expose jax.shard_map as a MODULE holding the fn.
+        sm = getattr(sm, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm  # noqa: HSL001
+    return sm
+
+
+_SHARD_MAP = _resolve_shard_map()
+try:
+    _SHARD_MAP_PARAMS = frozenset(inspect.signature(_SHARD_MAP).parameters)
+except (TypeError, ValueError):
+    # No introspectable signature: assume the modern kwarg surface.
+    _SHARD_MAP_PARAMS = frozenset()
+
+
+def shard_map(f=None, **kwargs):
+    """``jax.shard_map`` with the modern kwarg surface on every jax.
+
+    Accepts the new-style ``check_vma=`` kwarg and rewrites it to the
+    pre-rename ``check_rep=`` when the installed jax wants that. Usable
+    directly or through ``functools.partial(shard_map, mesh=..., ...)``
+    as a decorator (the call style ops/* use); calling with the keyword
+    arguments alone returns a decorator, matching jax's own behavior.
+    """
+    if (
+        "check_vma" in kwargs
+        and _SHARD_MAP_PARAMS
+        and "check_vma" not in _SHARD_MAP_PARAMS
+    ):
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    if f is None:
+        return functools.partial(shard_map, **kwargs)
+    return _SHARD_MAP(f, **kwargs)
+
+
+def enable_x64(new_val: bool = True):
+    """Scoped-x64 context manager: ``jax.enable_x64`` (new public API)
+    falling back to ``jax.experimental.enable_x64`` (0.4.x)."""
+    import jax
+
+    ctx = getattr(jax, "enable_x64", None)
+    if ctx is None:
+        from jax.experimental import enable_x64 as ctx  # noqa: HSL001
+    return ctx(new_val)
+
+
+def resolve_pallas():
+    """The Pallas module, wherever this jax puts it. Kernel factories
+    import it lazily through here (Pallas is optional at runtime — the
+    topk kernel falls back to lax.top_k when lowering fails)."""
+    from jax.experimental import pallas  # noqa: HSL001
+
+    return pallas

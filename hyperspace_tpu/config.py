@@ -52,6 +52,10 @@ JOIN_BROADCAST_MAX_ROWS = "hyperspace.join.broadcast.maxRows"
 # always re-bucketizes (bucket-aligned evidence for chained star joins);
 # "off" keeps the single-partition fallback.
 JOIN_REBUCKETIZE = "hyperspace.join.rebucketize"
+# Pre-execution plan validation (analysis/validator.py): reject malformed
+# plans with structured diagnostics before any device work. On by default;
+# the switch exists for benchmarking the (small) walk cost away.
+ANALYSIS_VALIDATE = "hyperspace.analysis.validate"
 
 # Directory-layout constants (reference index/IndexConstants.scala:38-39).
 HYPERSPACE_LOG_DIR = "_hyperspace_log"
@@ -87,6 +91,7 @@ class HyperspaceConf:
     filter_venue: str = DEFAULT_JOIN_VENUE
     join_broadcast_max_rows: int = DEFAULT_JOIN_BROADCAST_MAX_ROWS
     join_rebucketize: str = DEFAULT_JOIN_REBUCKETIZE
+    validate_plans: bool = True
     overrides: dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def __post_init__(self):
@@ -125,6 +130,10 @@ class HyperspaceConf:
             self.join_broadcast_max_rows = int(value)
         elif key == JOIN_REBUCKETIZE:
             self.join_rebucketize = str(value)
+        elif key == ANALYSIS_VALIDATE:
+            self.validate_plans = (
+                bool(value) if not isinstance(value, str) else value.lower() == "true"
+            )
 
     def get(self, key: str, default: Any = None) -> Any:
         if key in self.overrides:
@@ -159,4 +168,6 @@ class HyperspaceConf:
             return self.join_broadcast_max_rows
         if key == JOIN_REBUCKETIZE:
             return self.join_rebucketize
+        if key == ANALYSIS_VALIDATE:
+            return self.validate_plans
         return default

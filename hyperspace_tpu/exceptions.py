@@ -1,8 +1,17 @@
-"""Framework exception type.
+"""Framework exception types.
 
 Reference parity: com/microsoft/hyperspace/HyperspaceException.scala:17-19 —
-a single exception class carrying a message.
+a single exception class carrying a message. The static-analysis subsystem
+(analysis/) extends this with STRUCTURED diagnostics: plan validation
+failures carry one `PlanDiagnostic` per finding, each naming the offending
+plan node and its path from the plan root, so a malformed plan fails
+before execution with provenance instead of an opaque mid-execution XLA
+shape error.
 """
+
+from __future__ import annotations
+
+import dataclasses
 
 
 class HyperspaceError(Exception):
@@ -11,3 +20,46 @@ class HyperspaceError(Exception):
     def __init__(self, msg: str):
         super().__init__(msg)
         self.msg = msg
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanDiagnostic:
+    """One validator finding, anchored to a plan node.
+
+    `path` is the node's provenance from the plan root — child edges
+    joined with "/", e.g. "Join.left/Filter" — so a diagnostic names
+    WHERE in the plan tree the problem sits, not just what it is.
+    `severity` is "error" (the plan cannot execute correctly) or
+    "warning" (legal but almost certainly a mistake or a perf hazard,
+    e.g. two index scans bucketed on the join keys with mismatched
+    bucket counts, which silently falls off the zero-exchange path).
+    """
+
+    rule: str  # e.g. "unresolved-column", "join-bucket-mismatch"
+    node: str  # plan node type name, e.g. "Filter"
+    path: str  # provenance path from the plan root
+    message: str
+    severity: str = "error"  # "error" | "warning"
+
+    def __str__(self) -> str:
+        return f"[{self.rule}] {self.path or self.node}: {self.message}"
+
+
+class PlanValidationError(HyperspaceError):
+    """A plan failed pre-execution validation (analysis/validator.py).
+
+    Carries the full diagnostic list; the message renders every finding
+    with its rule id and node path.
+    """
+
+    def __init__(self, diagnostics):
+        self.diagnostics = list(diagnostics)
+        lines = "\n".join(f"  {d}" for d in self.diagnostics)
+        super().__init__(f"plan validation failed:\n{lines}")
+
+
+class PlanRewriteError(PlanValidationError):
+    """An optimizer rewrite (pushdown / column pruning) produced a plan
+    that is not equivalent to the original — wrong output schema, a
+    reference to a pruned-away column, or a filter pushed beneath the
+    null-extended side of an outer join."""

@@ -154,7 +154,27 @@ class Executor(
         from hyperspace_tpu.plan.prune import prune_columns
         from hyperspace_tpu.plan.pushdown import push_down_filters
 
-        return self._execute(prune_columns(push_down_filters(plan)))
+        from hyperspace_tpu.utils.jit_memory import maybe_relieve_jit_pressure
+
+        # Long-lived processes compiling many distinct programs can hit
+        # the kernel's vm.max_map_count and SIGSEGV inside LLVM on the
+        # next compile; drop jax caches before that point (sampled).
+        maybe_relieve_jit_pressure()
+        validate = self.conf is None or getattr(self.conf, "validate_plans", True)
+        if validate:
+            # Pre-execution analysis (analysis/validator.py): reject a
+            # malformed plan with node-provenance diagnostics up front
+            # instead of an opaque mid-execution KeyError / XLA error.
+            from hyperspace_tpu.analysis.validator import check_plan, validate_rewrite
+
+            check_plan(plan)
+        optimized = prune_columns(push_down_filters(plan))
+        if validate:
+            # Guard our own rewrites: pushdown/prune must preserve the
+            # output schema and never push a filter beneath the
+            # null-extended side of an outer join.
+            validate_rewrite(plan, optimized)
+        return self._execute(optimized)
 
     def _execute(self, plan: LogicalPlan) -> ColumnTable:
         from hyperspace_tpu.execution.physical import PhysicalNode

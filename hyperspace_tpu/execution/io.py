@@ -158,7 +158,11 @@ def _read_one_file(path: str, fmt: str, columns: list[str] | None, schema: Schem
     reference gates sources to the same four formats
     (index/serde/LogicalPlanSerDeUtils.scala:225-245)."""
     if fmt == "parquet":
-        return pq.read_table(path, columns=columns)
+        # partitioning=None: index files live under hive-looking `v__=N`
+        # version dirs; letting pyarrow infer a `v__` partition column
+        # would bake it into compacted files and then conflict with the
+        # inferred dictionary type on the next read.
+        return pq.read_table(path, columns=columns, partitioning=None)
     if fmt == "orc":
         from pyarrow import orc
 

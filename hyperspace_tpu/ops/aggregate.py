@@ -56,9 +56,15 @@ def _make_sharded_segment_reduce(mesh, axes: tuple, num_segments: int, fns: tupl
     (psum for sums, pmin/pmax for extrema) combines the [A, K] partials —
     the distributed HashAggregate the reference gets from Spark's partial
     + final aggregation (SURVEY.md §2.2), expressed as XLA collectives
-    over ICI."""
-    from jax import shard_map
+    over ICI.
+
+    Invariant: `fns` only contains channels with a commutative device
+    reduction over NUMERIC lanes — string inputs never reach here (the
+    plan validator rejects sum/mean over string expressions, rule
+    dtype-incompatible-aggregate)."""
     from jax.sharding import PartitionSpec as P
+
+    from hyperspace_tpu.compat import shard_map
 
     @functools.partial(
         shard_map,

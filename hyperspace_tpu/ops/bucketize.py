@@ -19,6 +19,14 @@ at actions/CreateActionBase.scala:110-112). Design per SURVEY.md §2.3:
 Rows are carried as a stack of int32/uint32/float32-compatible columns; the
 caller is responsible for representing every column as a jax-compatible
 array (ColumnTable guarantees this).
+
+Invariants (enforced statically where possible — analysis/validator.py
+checks bucket specs at plan level; analysis/lint.py keeps the jax import
+surface on compat.py):
+- num_buckets is a positive multiple of the mesh size (checked here);
+- bucket ids are a pure function of the key VALUES under the canonical
+  row hash, so per-device bucket ranges partition the key space;
+- invalid rows carry the 2^30 sentinel bucket and sink to shard tails.
 """
 
 from __future__ import annotations
@@ -30,7 +38,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+from hyperspace_tpu.compat import shard_map
 
 AXIS = "x"
 

@@ -25,6 +25,16 @@ proposition of the reference's JoinIndexRule
   gave it, counts/expands/compacts its buckets locally, and NO collective
   ever runs (the analog of the reference's cluster-parallel zero-exchange
   SMJ across Spark executors, JoinIndexRule.scala:124-153).
+
+Invariants assumed by these kernels (the plan validator,
+analysis/validator.py, rejects plans that cannot satisfy them — e.g.
+join sides bucketed with mismatched counts or hash dtype domains never
+reach the aligned path):
+- key codes are non-decreasing within each bucket on BOTH sides;
+- pads carry the key dtype's max value (sentinel_for), strictly above
+  every real code;
+- both sides' codes come from ONE shared order-preserving factorization,
+  so equal codes mean equal key values.
 """
 
 from __future__ import annotations
@@ -35,8 +45,9 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from hyperspace_tpu.compat import shard_map
 
 SENTINEL = np.iinfo(np.int64).max
 

@@ -22,6 +22,11 @@ a pure per-row function of the value, so chunks of any size decompose
 independently. The reference gets the analogous property for free from
 Spark's typed sort (index/DataFrameWriterExtensions.scala:49-66 sorts
 raw column values, not ranks).
+
+Invariant: lane decomposition is only defined for dtypes with a total
+order — the plan validator (analysis/validator.py, rule unsortable-key)
+rejects sort/window-order keys over vector columns before execution
+reaches the HyperspaceError below.
 """
 
 from __future__ import annotations
@@ -185,8 +190,10 @@ def _make_sharded_topn(mesh, axes, n: int):
     lax.sort per device under shard_map, zero collectives; the sharded
     outputs concatenate to the D*n global candidate list."""
     import jax
-    from jax import lax, shard_map
+    from jax import lax
     from jax.sharding import PartitionSpec as P
+
+    from hyperspace_tpu.compat import shard_map
 
     spec = P(axes)
 
@@ -205,8 +212,9 @@ def _make_sharded_topn(mesh, axes, n: int):
 def _make_sharded_le(mesh, axes):
     """Elementwise (hi, lo) <= (thr_hi, thr_lo) over the sharded rows."""
     import jax
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from hyperspace_tpu.compat import shard_map
 
     spec = P(axes)
 

@@ -38,7 +38,9 @@ _QBLOCK = 8  # queries per program (TPU sublane granularity)
 
 @functools.lru_cache(maxsize=32)
 def _make_tile_kernel(k: int, tile: int, interpret: bool):
-    from jax.experimental import pallas as pl
+    from hyperspace_tpu.compat import resolve_pallas
+
+    pl = resolve_pallas()
 
     out_lanes = _next_mult(k, 128)
 

@@ -18,13 +18,18 @@ from concurrent.futures import ThreadPoolExecutor
 
 _pool: ThreadPoolExecutor | None = None
 _pool_lock = threading.Lock()
+# The entered context object must stay referenced: on jax versions where
+# enable_x64 is a generator-based contextmanager, dropping it lets GC
+# close the generator and silently REVERT x64 on the worker thread.
+_x64_ctx = None
 
 
 def _enter_x64() -> None:
-    import jax
+    global _x64_ctx
+    from hyperspace_tpu.compat import enable_x64
 
-    ctx = jax.enable_x64(True)
-    ctx.__enter__()  # intentionally never exited: thread-local scope
+    _x64_ctx = enable_x64(True)
+    _x64_ctx.__enter__()  # intentionally never exited: thread-local scope
 
 
 def run_x64(fn, /, *args, **kwargs):
@@ -33,5 +38,22 @@ def run_x64(fn, /, *args, **kwargs):
     if _pool is None:
         with _pool_lock:
             if _pool is None:
-                _pool = ThreadPoolExecutor(max_workers=1, initializer=_enter_x64)
+                # XLA:CPU compiles on the calling thread, and LLVM's
+                # recursive passes can exhaust the default 8 MB pthread
+                # stack on very large fused programs (observed as a
+                # SIGSEGV inside backend_compile) — give the worker a
+                # deep stack before it is spawned.
+                prev = threading.stack_size()
+                try:
+                    threading.stack_size(256 << 20)
+                except (ValueError, RuntimeError):
+                    prev = None
+                try:
+                    _pool = ThreadPoolExecutor(max_workers=1, initializer=_enter_x64)
+                    # Spawn the worker NOW, while the stack size is set
+                    # (threads are created lazily on first submit).
+                    _pool.submit(lambda: None).result()
+                finally:
+                    if prev is not None:
+                        threading.stack_size(prev)
     return _pool.submit(fn, *args, **kwargs).result()

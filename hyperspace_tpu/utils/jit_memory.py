@@ -1,0 +1,55 @@
+"""Guard against kernel memory-map exhaustion from JIT accumulation.
+
+Every XLA:CPU executable pins LLVM-JIT'd code/rodata/data mappings for
+the life of jax's jit cache. A long-running process that compiles many
+programs (a query engine serving varied plans does exactly that)
+accumulates mappings until it hits the kernel's `vm.max_map_count`
+(default 65530), after which mmap fails inside LLVM and the next
+compilation SIGSEGVs — observed reproducibly on jaxlib 0.4.37 during
+full TPC-DS sweeps. Dropping jax's caches releases the executables; the
+occasional recompile is far cheaper than a dead process.
+
+The check reads /proc/self/maps, so it is sampled (every
+`_CHECK_EVERY` calls) and is a no-op on platforms without procfs.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+_CHECK_EVERY = 16
+_counter = itertools.count()
+_limit_cache: list = []  # [int] once resolved
+
+
+def _map_limit() -> int:
+    """70% of vm.max_map_count (0 where unknown: disables the guard)."""
+    if not _limit_cache:
+        try:
+            with open("/proc/sys/vm/max_map_count", "rb") as f:
+                _limit_cache.append(int(f.read()) * 7 // 10)
+        except (OSError, ValueError):
+            _limit_cache.append(0)
+    return _limit_cache[0]
+
+
+def _map_count() -> int:
+    try:
+        with open("/proc/self/maps", "rb") as f:
+            return sum(1 for _ in f)
+    except OSError:
+        return 0
+
+
+def maybe_relieve_jit_pressure() -> bool:
+    """Sampled check; clears jax's compilation caches when the process
+    nears the kernel mapping limit. Returns True when a clear ran."""
+    if next(_counter) % _CHECK_EVERY:
+        return False
+    limit = _map_limit()
+    if not limit or _map_count() <= limit:
+        return False
+    import jax
+
+    jax.clear_caches()
+    return True

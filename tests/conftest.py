@@ -8,6 +8,20 @@ assume real TPU hardware.
 
 import os
 
+# XLA:CPU compiles on the calling thread; LLVM's recursive passes can
+# overflow the default 8 MB main-thread stack on the largest fused
+# programs (observed as a SIGSEGV inside backend_compile deep into the
+# suite). The hard limit is unlimited here — raise the soft limit so the
+# main thread's stack can grow past 8 MB.
+try:
+    import resource
+
+    _soft, _hard = resource.getrlimit(resource.RLIMIT_STACK)
+    if _hard in (resource.RLIM_INFINITY, -1) or (_hard > _soft >= 0):
+        resource.setrlimit(resource.RLIMIT_STACK, (_hard, _hard))
+except (ImportError, ValueError, OSError):
+    pass
+
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
@@ -21,6 +35,30 @@ jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest
+
+
+def _map_count() -> int:
+    """Memory mappings of this process (Linux); 0 where unreadable."""
+    try:
+        with open("/proc/self/maps", "rb") as f:
+            return sum(1 for _ in f)
+    except OSError:
+        return 0
+
+
+@pytest.fixture(autouse=True)
+def _jit_map_guard():
+    """Keep the process under vm.max_map_count (default 65530).
+
+    Every XLA:CPU executable pins LLVM-JIT'd code/rodata/data mappings
+    for the life of the jit cache; a full-suite run compiles enough
+    programs (~18k live sections near the end) to exhaust the kernel's
+    mapping limit, after which mmap fails inside LLVM and the compiler
+    SIGSEGVs. Dropping jax's caches releases the executables; the
+    occasional recompile is far cheaper than a dead process."""
+    yield
+    if _map_count() > 40_000:
+        jax.clear_caches()
 
 
 @pytest.fixture

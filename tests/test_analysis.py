@@ -1,0 +1,478 @@
+"""Static-analysis subsystem tests: trace-safety lint rules + the
+pre-execution plan validator (analysis/)."""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from hyperspace_tpu.analysis.lint import lint_source, lint_paths, main as lint_main
+from hyperspace_tpu.analysis.validator import (
+    check_plan,
+    validate_plan,
+    validate_rewrite,
+)
+from hyperspace_tpu.exceptions import (
+    PlanDiagnostic,
+    PlanRewriteError,
+    PlanValidationError,
+)
+from hyperspace_tpu.plan.expr import col, lit
+from hyperspace_tpu.plan.nodes import (
+    Aggregate,
+    AggSpec,
+    Filter,
+    Join,
+    Project,
+    Scan,
+    Sort,
+    Union,
+    Window,
+    WindowSpec,
+)
+from hyperspace_tpu.schema import Field, Schema
+
+
+# -- lint rule fixtures ------------------------------------------------------
+
+def rules_of(src: str, path: str = "<fixture>.py") -> list[str]:
+    return [f.rule for f in lint_source(textwrap.dedent(src), path)]
+
+
+class TestLintFragileImports:
+    def test_from_jax_import_shard_map_flagged(self):
+        assert rules_of("from jax import shard_map\n") == ["HSL001"]
+
+    def test_from_jax_import_enable_x64_flagged(self):
+        assert rules_of("from jax import enable_x64\n") == ["HSL001"]
+
+    def test_jax_experimental_from_import_flagged(self):
+        assert rules_of("from jax.experimental import pallas\n") == ["HSL001"]
+
+    def test_jax_experimental_submodule_import_flagged(self):
+        assert rules_of("from jax.experimental.shard_map import shard_map\n") == ["HSL001"]
+        assert rules_of("import jax.experimental.pallas\n") == ["HSL001"]
+
+    def test_compat_module_is_sanctioned(self):
+        src = "from jax.experimental.shard_map import shard_map\n"
+        assert lint_source(src, "hyperspace_tpu/compat.py") == []
+
+    def test_stable_jax_imports_clean(self):
+        assert rules_of("from jax import lax\nimport jax.numpy as jnp\n") == []
+
+    def test_noqa_suppresses(self):
+        assert rules_of("from jax import shard_map  # noqa: HSL001\n") == []
+
+    def test_noqa_other_rule_does_not_suppress(self):
+        assert rules_of("from jax import shard_map  # noqa: HSL002\n") == ["HSL001"]
+
+
+class TestLintHostSync:
+    def test_item_in_jitted_function(self):
+        src = """
+        import jax
+        @jax.jit
+        def f(x):
+            return x.item()
+        """
+        assert rules_of(src) == ["HSL002"]
+
+    def test_float_cast_in_wrapped_function(self):
+        # jax.jit(fn) wrapping marks fn as traced even without a decorator.
+        src = """
+        import jax
+        def make():
+            def fn(x):
+                return float(x)
+            return jax.jit(fn)
+        """
+        assert rules_of(src) == ["HSL002"]
+
+    def test_np_asarray_under_shard_map(self):
+        src = """
+        import functools, numpy as np
+        from hyperspace_tpu.compat import shard_map
+        @functools.partial(shard_map, mesh=None, in_specs=(), out_specs=())
+        def f(x):
+            return np.asarray(x)
+        """
+        assert rules_of(src) == ["HSL002"]
+
+    def test_host_sync_outside_jit_is_fine(self):
+        src = """
+        def f(x):
+            return float(x.item())
+        """
+        assert rules_of(src) == []
+
+
+class TestLintTracedControlFlow:
+    def test_if_on_traced_param(self):
+        src = """
+        import jax
+        @jax.jit
+        def f(x):
+            if x > 0:
+                return x
+            return -x
+        """
+        assert rules_of(src) == ["HSL003"]
+
+    def test_while_on_traced_param(self):
+        src = """
+        import jax
+        @jax.jit
+        def f(x):
+            while x < 10:
+                x = x + 1
+            return x
+        """
+        assert rules_of(src) == ["HSL003"]
+
+    def test_shape_attribute_is_static(self):
+        src = """
+        import jax
+        @jax.jit
+        def f(x):
+            if x.shape[0] > 1:
+                return x
+            return -x
+        """
+        assert rules_of(src) == []
+
+    def test_static_argnames_param_is_exempt(self):
+        src = """
+        import functools, jax
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def f(x, n):
+            if n > 3:
+                return x
+            return -x
+        """
+        assert rules_of(src) == []
+
+
+class TestLintStaticArgsAndRandomness:
+    def test_list_static_argnums_flagged(self):
+        src = """
+        import jax
+        def f(x, n):
+            return x
+        g = jax.jit(f, static_argnums=[1])
+        """
+        assert rules_of(src) == ["HSL004"]
+
+    def test_tuple_static_argnames_clean(self):
+        src = """
+        import functools, jax
+        @functools.partial(jax.jit, static_argnames=("cap",))
+        def f(x, cap):
+            return x
+        """
+        assert rules_of(src) == []
+
+    def test_global_numpy_rng_flagged(self):
+        assert rules_of("import numpy as np\nv = np.random.rand(3)\n") == ["HSL005"]
+
+    def test_unseeded_default_rng_flagged(self):
+        assert rules_of("import numpy as np\nr = np.random.default_rng()\n") == ["HSL005"]
+
+    def test_seeded_default_rng_clean(self):
+        assert rules_of("import numpy as np\nr = np.random.default_rng(0)\n") == []
+
+    def test_stdlib_random_flagged(self):
+        assert rules_of("import random\nv = random.random()\n") == ["HSL005"]
+
+
+class TestLintCli:
+    def test_repo_package_is_clean(self):
+        # The permanent guarantee behind the compat satellite: the whole
+        # package passes its own linter (CI runs this as a gate).
+        import hyperspace_tpu
+
+        pkg_dir = hyperspace_tpu.__path__[0]
+        assert lint_paths([pkg_dir]) == []
+
+    def test_cli_exit_codes(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("from jax import shard_map\n")
+        good = tmp_path / "good.py"
+        good.write_text("from jax import lax\n")
+        assert lint_main([str(bad)]) == 1
+        assert lint_main([str(good)]) == 0
+
+    def test_module_invocation(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("import numpy as np\nv = np.random.rand(3)\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "hyperspace_tpu.analysis.lint", str(bad)],
+            capture_output=True, text=True,
+        )
+        assert proc.returncode == 1
+        assert "HSL005" in proc.stdout
+
+    def test_syntax_error_is_a_finding(self, tmp_path):
+        f = tmp_path / "broken.py"
+        f.write_text("def f(:\n")
+        findings = lint_paths([str(f)])
+        assert [x.rule for x in findings] == ["HSL000"]
+
+
+# -- plan validator ----------------------------------------------------------
+
+SCHEMA = Schema.of(
+    Field("k", "int32"),
+    Field("v", "float64"),
+    Field("s", "string"),
+    Field("d", "date"),
+    Field("emb", "vector", dim=4),
+)
+
+
+def scan(schema=SCHEMA, **kw) -> Scan:
+    return Scan("/data/t", "parquet", schema, **kw)
+
+
+def rules(plan) -> list[str]:
+    return [d.rule for d in validate_plan(plan)]
+
+
+class TestValidatorMalformedPlans:
+    """The >=5 malformed-plan classes from the issue, each rejected with
+    a diagnostic naming the offending node."""
+
+    def test_clean_plan_validates(self):
+        plan = Filter(scan(), (col("k") > 5) & (col("v") <= 2.5)).select("k", "v")
+        assert validate_plan(plan) == []
+        check_plan(plan)  # must not raise
+
+    def test_mismatched_join_bucket_specs(self):
+        left = scan(bucket_spec=(8, ["k"]))
+        right = Scan("/data/u", "parquet",
+                     Schema.of(Field("k", "int32"), Field("w", "float32")),
+                     bucket_spec=(16, ["k"]))
+        plan = Join(left, right, ["k"], ["k"])
+        diags = validate_plan(plan)
+        assert [d.rule for d in diags] == ["join-bucket-mismatch"]
+        assert diags[0].node == "Join"
+        assert "8" in diags[0].message and "16" in diags[0].message
+        # Warning severity: executable (falls back to a re-shuffle), but
+        # check_plan promotes it on request.
+        check_plan(plan)
+        with pytest.raises(PlanValidationError) as ei:
+            check_plan(plan, fail_on="warning")
+        assert "join-bucket-mismatch" in str(ei.value)
+
+    def test_mismatched_bucket_hash_domains(self):
+        # Equal counts, equal key names — but int32 vs int64 key dtypes
+        # hash differently, so the "aligned" pair can never align.
+        left = scan(bucket_spec=(8, ["k"]))
+        right = Scan("/data/u", "parquet",
+                     Schema.of(Field("k", "int64"), Field("w", "float32")),
+                     bucket_spec=(8, ["k"]))
+        diags = validate_plan(Join(left, right, ["k"], ["k"]))
+        assert [d.rule for d in diags] == ["join-bucket-mismatch"]
+        assert "dtype domain" in diags[0].message
+
+    def test_unresolved_column(self):
+        diags = validate_plan(Filter(scan(), col("missing") > 5))
+        assert [d.rule for d in diags] == ["unresolved-column"]
+        assert diags[0].node == "Filter"
+        assert "'missing'" in diags[0].message
+        with pytest.raises(PlanValidationError):
+            check_plan(Filter(scan(), col("missing") > 5))
+
+    def test_unresolved_join_key(self):
+        right = Scan("/data/u", "parquet", Schema.of(Field("k", "int32")))
+        diags = validate_plan(Join(scan(), right, ["k"], ["nope"]))
+        assert [d.rule for d in diags] == ["unresolved-column"]
+        assert diags[0].node == "Join"
+
+    def test_dtype_incompatible_predicate(self):
+        diags = validate_plan(Filter(scan(), col("s") > 5))
+        assert [d.rule for d in diags] == ["dtype-incompatible-predicate"]
+        assert "string" in diags[0].message
+
+    def test_non_boolean_predicate(self):
+        diags = validate_plan(Filter(scan(), col("k") + 1))
+        assert [d.rule for d in diags] == ["dtype-incompatible-predicate"]
+        assert "expected bool" in diags[0].message
+
+    def test_string_arithmetic(self):
+        diags = validate_plan(Project(scan(), [("x", col("s") * 2)]))
+        assert [d.rule for d in diags] == ["dtype-incompatible-predicate"]
+        assert "arithmetic" in diags[0].message
+
+    def test_bad_sort_key(self):
+        diags = validate_plan(Sort(scan(), [("emb", True)]))
+        assert [d.rule for d in diags] == ["unsortable-key"]
+        assert diags[0].node == "Sort"
+        with pytest.raises(PlanValidationError):
+            check_plan(Sort(scan(), [("emb", True)]))
+
+    def test_illegal_pushdown(self):
+        # A left outer join: filtering the RIGHT side before the join
+        # changes null-extension semantics. The rewrite guard catches a
+        # pushed conjunct the original never had below that side.
+        right = Scan("/data/u", "parquet",
+                     Schema.of(Field("k", "int32"), Field("w", "float32")))
+        pred = col("w") > 1.0
+        original = Filter(Join(scan(), right, ["k"], ["k"], how="left"), pred)
+        bad_rewrite = Join(scan(), Filter(right, pred), ["k"], ["k"], how="left")
+        with pytest.raises(PlanRewriteError) as ei:
+            validate_rewrite(original, bad_rewrite)
+        assert ei.value.diagnostics[0].rule == "illegal-pushdown"
+        assert "right" in ei.value.diagnostics[0].path
+
+    def test_illegal_prune(self):
+        # A rewrite that narrowed a scan below a filter still referencing
+        # the pruned column must be rejected.
+        import dataclasses
+
+        base = scan(Schema.of(Field("k", "int32"), Field("v", "float64")))
+        original = Filter(base, col("v") > 1.0).select("k", "v")
+        pruned = dataclasses.replace(base, scan_schema=base.scan_schema.select(["k"]))
+        bad_rewrite = Filter(pruned, col("v") > 1.0).select("k")
+        with pytest.raises(PlanRewriteError) as ei:
+            validate_rewrite(original, bad_rewrite)
+        assert any(d.rule == "unresolved-column" for d in ei.value.diagnostics)
+
+    def test_rewrite_schema_change(self):
+        original = scan().select("k", "v")
+        bad_rewrite = scan().select("k")
+        with pytest.raises(PlanRewriteError) as ei:
+            validate_rewrite(original, bad_rewrite)
+        assert ei.value.diagnostics[0].rule == "rewrite-schema-change"
+
+    def test_legal_rewrite_passes(self):
+        from hyperspace_tpu.plan.prune import prune_columns
+        from hyperspace_tpu.plan.pushdown import push_down_filters
+
+        right = Scan("/data/u", "parquet",
+                     Schema.of(Field("k", "int32"), Field("w", "float32")))
+        plan = Filter(
+            Join(scan(), right, ["k"], ["k"]), (col("v") > 0.5) & (col("w") > 1.0)
+        ).select("k", "v", "w")
+        validate_rewrite(plan, prune_columns(push_down_filters(plan)))
+
+
+class TestValidatorMoreRules:
+    def test_bad_bucket_spec_count(self):
+        diags = validate_plan(scan(bucket_spec=(0, ["k"])))
+        assert [d.rule for d in diags] == ["bad-bucket-spec"]
+
+    def test_bucket_column_missing(self):
+        diags = validate_plan(scan(bucket_spec=(8, ["zz"])))
+        assert [d.rule for d in diags] == ["unresolved-column"]
+        assert diags[0].node == "Scan"
+
+    def test_join_key_domain_mismatch(self):
+        right = Scan("/data/u", "parquet", Schema.of(Field("name", "string")))
+        diags = validate_plan(Join(scan(), right, ["k"], ["name"]))
+        assert [d.rule for d in diags] == ["join-key-type-mismatch"]
+
+    def test_outer_join_vector_null_extension_warns(self):
+        right = Scan(
+            "/data/u", "parquet",
+            Schema.of(Field("k", "int32"), Field("e2", "vector", dim=8)),
+        )
+        diags = validate_plan(Join(scan(), right, ["k"], ["k"], how="left"))
+        assert [(d.rule, d.severity) for d in diags] == [
+            ("null-extension-vector", "warning")
+        ]
+
+    def test_aggregate_sum_over_string(self):
+        plan = Aggregate(scan(), ["k"], [AggSpec.of("sum", "s", "bad")])
+        diags = validate_plan(plan)
+        assert [d.rule for d in diags] == ["dtype-incompatible-aggregate"]
+
+    def test_aggregate_unresolved_group_by(self):
+        plan = Aggregate(scan(), ["zz"], [AggSpec.of("count", None, "n")])
+        rules_found = rules(plan)
+        assert "unresolved-column" in rules_found
+
+    def test_window_order_by_vector(self):
+        plan = Window(scan(), ["k"], [("emb", True)],
+                      [WindowSpec.of("row_number", None, "rn")], "partition")
+        assert "unsortable-key" in rules(plan)
+
+    def test_in_list_domain_mismatch(self):
+        diags = validate_plan(Filter(scan(), col("k").isin(["a", "b"])))
+        assert [d.rule for d in diags] == ["dtype-incompatible-predicate"]
+        diags = validate_plan(Filter(scan(), col("s").isin([1, 2])))
+        assert [d.rule for d in diags] == ["dtype-incompatible-predicate"]
+
+    def test_like_over_non_string(self):
+        diags = validate_plan(Filter(scan(), col("k").like("a%")))
+        assert [d.rule for d in diags] == ["dtype-incompatible-predicate"]
+
+    def test_datepart_over_non_date(self):
+        from hyperspace_tpu.plan.expr import year
+
+        diags = validate_plan(Filter(scan(), year(col("k")) == 1998))
+        assert [d.rule for d in diags] == ["dtype-incompatible-predicate"]
+
+    def test_diagnostics_carry_provenance_path(self):
+        right = Scan("/data/u", "parquet",
+                     Schema.of(Field("k", "int32"), Field("w", "float32")))
+        plan = Join(scan(), Filter(right, col("nope") > 1), ["k"], ["k"])
+        diags = validate_plan(plan)
+        assert len(diags) == 1
+        assert diags[0].path == "Join/right:Filter"
+
+    def test_all_diagnostics_reported_at_once(self):
+        plan = Filter(
+            Sort(scan(), [("emb", True)]), col("missing").isin([1])
+        )
+        found = rules(plan)
+        assert set(found) == {"unresolved-column", "unsortable-key"}
+
+
+class TestExecutorIntegration:
+    """The executor refuses malformed plans before any device work."""
+
+    def test_execute_rejects_unresolved_column(self, tmp_path):
+        import numpy as np
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from hyperspace_tpu.execution.executor import Executor
+
+        root = tmp_path / "t"
+        root.mkdir()
+        pq.write_table(
+            pa.table({"k": pa.array(np.arange(4, dtype=np.int32))}),
+            root / "part-0.parquet",
+        )
+        plan = Filter(
+            Scan(str(root), "parquet", Schema.of(Field("k", "int32"))),
+            col("missing") > 1,
+        )
+        with pytest.raises(PlanValidationError) as ei:
+            Executor().execute(plan)
+        assert ei.value.diagnostics[0].rule == "unresolved-column"
+
+    def test_validation_can_be_disabled(self, tmp_path):
+        from hyperspace_tpu.config import ANALYSIS_VALIDATE, HyperspaceConf
+        from hyperspace_tpu.execution.executor import Executor
+
+        conf = HyperspaceConf()
+        conf.set(ANALYSIS_VALIDATE, "false")
+        assert conf.validate_plans is False
+        plan = Filter(scan(), col("missing") > 1)
+        # With validation off the malformed plan is NOT rejected up front
+        # (the empty scan root makes execution itself a no-op here).
+        try:
+            Executor(conf=conf).execute(plan)
+        except PlanValidationError:  # pragma: no cover - the regression
+            pytest.fail("validator ran despite hyperspace.analysis.validate=false")
+        except Exception:
+            pass  # any later failure mode is fine; only the bypass matters
+
+    def test_diagnostic_str_format(self):
+        d = PlanDiagnostic("unresolved-column", "Filter", "Join/left:Filter", "msg")
+        assert "[unresolved-column]" in str(d)
+        assert "Join/left:Filter" in str(d)

@@ -107,9 +107,11 @@ def pandas_tri(df, p):
         _, op, c, v = p
         s = df[c]
         known = s.notna().to_numpy()
-        # pandas 3 infers the new ``str`` dtype for string columns (no longer
-        # ``object``), so pick the fill by string-ness, not object-ness.
-        sv = s.fillna("" if pd.api.types.is_string_dtype(s) else 0).to_numpy()
+        # pandas 3 infers the new ``str`` dtype for string columns while
+        # pandas 2 keeps ``object`` (where is_string_dtype is False for
+        # None-bearing columns) — pick the fill by the LITERAL's type,
+        # which the fuzzer always matches to the column domain.
+        sv = s.fillna("" if isinstance(v, str) else 0).to_numpy()
         fn = {"eq": np.equal, "ne": np.not_equal, "lt": np.less,
               "le": np.less_equal, "gt": np.greater, "ge": np.greater_equal}[op]
         with np.errstate(all="ignore"):
