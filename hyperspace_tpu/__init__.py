@@ -61,4 +61,10 @@ def __getattr__(name):
         from hyperspace_tpu.vector.index import VectorIndexConfig
 
         return VectorIndexConfig
+    if name in ("stats", "faults"):
+        # Fault-tolerance observability (stats.snapshot()) and the
+        # deterministic fault-injection harness (docs/fault_tolerance.md).
+        import importlib
+
+        return importlib.import_module(f"hyperspace_tpu.{name}")
     raise AttributeError(name)

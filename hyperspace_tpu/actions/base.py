@@ -10,15 +10,33 @@ where `begin` CAS-writes log id `base_id + 1` in the transient state and
 concurrent writer, the action aborts with "Could not acquire proper state"
 (Action.scala:75-80) — single-writer optimistic concurrency.
 
-An action that dies between begin and end leaves the index in the transient
-state; `cancel` rolls it forward to the last stable state (see cancel.py).
+Failure semantics (docs/fault_tolerance.md):
+
+- An `op()` that raises an ordinary Exception is ROLLED BACK in-process:
+  a roll-back entry restoring the last stable state is CAS-written at
+  `base_id + 2`, the `latestStable` pointer is repointed, and the
+  action's partial data (`cleanup_failed_op`) is quarantined. The log
+  never stays transient because of a mere software failure.
+- A hard crash (process death, simulated by faults.CrashPoint — a
+  BaseException this handler deliberately does not catch) leaves the
+  transient entry behind; `Hyperspace.recover()` repairs it from the
+  next process, rolling forward/back exactly like `cancel` (cancel.py).
+- `end()` keeps the `latestStable` pointer present at all times: the
+  pointer file is atomically REPLACED (write_json's temp + os.replace),
+  never deleted first, so a concurrent reader can no longer catch the
+  window where the pointer is absent and fall into the backward scan.
 """
 
 from __future__ import annotations
 
+import dataclasses
+
+from hyperspace_tpu import stats as _stats
+from hyperspace_tpu import states
 from hyperspace_tpu.exceptions import HyperspaceError
 from hyperspace_tpu.metadata.log_entry import IndexLogEntry
 from hyperspace_tpu.metadata.log_manager import IndexLogManager
+from hyperspace_tpu.utils import retry
 
 
 class Action:
@@ -40,6 +58,11 @@ class Action:
     def build_log_entry(self) -> IndexLogEntry:
         """Construct the entry this action commits (lazily, once)."""
         raise NotImplementedError
+
+    def cleanup_failed_op(self) -> None:
+        """Quarantine/remove partial data a failed `op()` left behind.
+        Default: nothing (metadata-only actions have no data plane).
+        Must never raise."""
 
     # -- protocol ---------------------------------------------------------
     @property
@@ -70,11 +93,76 @@ class Action:
         entry = self.log_entry.with_state(self.final_state)
         final_id = self.base_id + 2
         self._save_entry(final_id, entry)
-        self.log_manager.delete_latest_stable_log()
+        # Atomic overwrite of the pointer (temp file + os.replace inside
+        # create_latest_stable_log): a delete-then-recreate here would
+        # reopen the race where a reader finds no pointer and pays the
+        # backward scan — or, crashing between the two calls, leaves no
+        # pointer at all.
         self.log_manager.create_latest_stable_log(final_id)
 
     def run(self) -> None:
-        self.validate()
-        self.begin()
-        self.op()
-        self.end()
+        """Execute the two-phase protocol, with rollback on op() failure.
+
+        CAS contention at begin() aborts by default (single-writer
+        optimistic concurrency, Action.scala:75-80); when
+        `hyperspace.retry.casAttempts` > 1 the whole protocol re-reads
+        the log and retries — useful for workloads where independent
+        writers race on DIFFERENT indexes through a shared log id space.
+        """
+        attempts = retry.cas_attempts()
+        for attempt in range(attempts):
+            self.validate()
+            try:
+                self.begin()
+            except HyperspaceError:
+                if attempt + 1 >= attempts:
+                    raise
+                # Concurrent writer won this id: re-read the world and
+                # re-validate from scratch.
+                self._base_id = None
+                self._log_entry = None
+                continue
+            break
+        try:
+            self.op()
+        except Exception:
+            # Software failure mid-op (NOT a crash: CrashPoint is a
+            # BaseException and skips this handler by design). Roll the
+            # log back to the last stable state and quarantine partial
+            # data, then surface the original error.
+            self._rollback_failed_op()
+            raise
+        try:
+            self.end()
+        except HyperspaceError:
+            # Lost the final CAS: a concurrent writer committed over us
+            # while op() ran. The winner's entry stands — only our
+            # partial data needs quarantining.
+            self.cleanup_failed_op()
+            raise
+
+    def _rollback_failed_op(self) -> None:
+        """Best-effort in-process recovery for a failed op(): CAS-write a
+        roll-back entry at `base_id + 2` restoring the last stable state
+        (DOESNOTEXIST when there is none, or for a dying vacuum — same
+        rules as cancel.py), repoint `latestStable`, quarantine partial
+        data. Every step tolerates failure: whatever this leaves undone,
+        `recover()` finishes from the next process."""
+        try:
+            stable = self.log_manager.get_latest_stable_log()
+            if self.transient_state == states.VACUUMING:
+                state = states.DOESNOTEXIST
+            else:
+                state = stable.state if stable is not None else states.DOESNOTEXIST
+            base = stable if stable is not None else self.log_entry
+            rollback = dataclasses.replace(base).with_state(state)
+            rollback_id = self.base_id + 2
+            if self.log_manager.write_log(rollback_id, rollback):
+                self.log_manager.create_latest_stable_log(rollback_id)
+                _stats.increment("action.rolled_back")
+        except Exception:
+            pass
+        try:
+            self.cleanup_failed_op()
+        except Exception:
+            pass

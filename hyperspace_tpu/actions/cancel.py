@@ -49,7 +49,7 @@ class CancelAction(Action):
         entry = self.log_entry.with_state(self.final_state)
         final_id = self.base_id + 1
         self._save_entry(final_id, entry)
-        self.log_manager.delete_latest_stable_log()
+        # Atomic pointer overwrite — same no-delete rule as Action.end().
         self.log_manager.create_latest_stable_log(final_id)
 
     def build_log_entry(self) -> IndexLogEntry:

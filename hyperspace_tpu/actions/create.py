@@ -70,12 +70,28 @@ class CreateActionBase(Action):
         self.index_path = Path(index_path)
         self.conf = conf
         self.writer = writer
+        self._version: int | None = None
 
     @property
     def _version_id(self) -> int:
-        """Next data version dir (CreateActionBase.scala:31-36)."""
-        latest = self.data_manager.get_latest_version_id()
-        return 0 if latest is None else latest + 1
+        """Next data version dir (CreateActionBase.scala:31-36). Memoized
+        on first access: once op() starts creating the directory, a
+        recomputation would see it and skip ahead — the log entry, the
+        build destination, and the failure cleanup must all name the SAME
+        version."""
+        if self._version is None:
+            latest = self.data_manager.get_latest_version_id()
+            self._version = 0 if latest is None else latest + 1
+        return self._version
+
+    def cleanup_failed_op(self) -> None:
+        """A failed build leaves a partial `v__=N`; quarantine it so it
+        can never be listed as index data (and never collides with the
+        next attempt's version numbering)."""
+        try:
+            self.data_manager.quarantine(self._version_id)
+        except Exception:
+            pass
 
     def _num_buckets(self) -> int:
         return int(self.conf.num_buckets)

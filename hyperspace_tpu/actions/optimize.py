@@ -42,6 +42,7 @@ class OptimizeAction(Action):
         super().__init__(log_manager)
         self.data_manager = data_manager
         self.compactor = compactor
+        self._version: int | None = None
         self.previous_entry = log_manager.get_latest_log()
         if self.previous_entry is None:
             raise HyperspaceError("no index to optimize")
@@ -60,8 +61,18 @@ class OptimizeAction(Action):
 
     @property
     def _version_id(self) -> int:
-        latest = self.data_manager.get_latest_version_id()
-        return 0 if latest is None else latest + 1
+        # Memoized for the same reason as CreateActionBase: entry, dest,
+        # and failure cleanup must agree on one version.
+        if self._version is None:
+            latest = self.data_manager.get_latest_version_id()
+            self._version = 0 if latest is None else latest + 1
+        return self._version
+
+    def cleanup_failed_op(self) -> None:
+        try:
+            self.data_manager.quarantine(self._version_id)
+        except Exception:
+            pass
 
     def build_log_entry(self) -> IndexLogEntry:
         entry = dataclasses.replace(self.previous_entry)

@@ -32,6 +32,16 @@ Exit status is non-zero iff any finding is reported — the CI gate. Rules:
   ``np.random.default_rng()`` with no seed. Unseeded randomness makes
   device results irreproducible across runs and shards; pass an explicit
   seed (``np.random.default_rng(0)``) or thread ``jax.random`` keys.
+- **HSL006 metadata-write-bypass** — bare ``.write_text()`` /
+  ``.write_bytes()`` / write-mode ``open()`` on metadata-plane paths
+  (``_hyperspace_log`` entries, the ``latestStable`` pointer, the index
+  manifest, ``v__=`` version dirs) anywhere except the sanctioned
+  ``utils/file_utils.py``. A bare write is a torn write waiting for a
+  crash: the metadata plane only stays crash-consistent because every
+  commit goes through ``file_utils.write_json``/``atomic_write`` (temp
+  file + fsync + atomic rename + dir fsync). The seed shipped exactly
+  this bug in ``write_manifest`` (``Path.write_text``); this rule keeps
+  it fixed.
 
 Suppression: a finding on a line containing ``# noqa`` or
 ``# noqa: HSLxxx`` (matching rule id) is dropped.
@@ -50,9 +60,29 @@ HOST_SYNC = "HSL002"
 TRACED_FLOW = "HSL003"
 UNHASHABLE_STATIC = "HSL004"
 UNSEEDED_RNG = "HSL005"
+METADATA_WRITE = "HSL006"
 
 # The one module allowed to touch version-fragile jax import paths.
 SANCTIONED_COMPAT = "compat.py"
+# The one module allowed to open metadata-plane paths for writing (it
+# implements the atomic temp+fsync+rename primitives everything uses).
+SANCTIONED_FILE_UTILS = "file_utils.py"
+
+# Expression text that marks a write target as metadata-plane: the log
+# dir and its pointer, version dirs, and the index manifest (both the
+# literal names and the config/module constants they're spelled with).
+_METADATA_PATH_MARKERS = (
+    "_hyperspace_log",
+    "lateststable",
+    "hyperspace_log_dir",
+    "latest_stable_log_name",
+    "_index_manifest",
+    "manifest_name",
+    "data_version_prefix",
+    "v__",
+    "log_dir",
+    "version_dir",
+)
 
 _JIT_NAMES = {"jit", "shard_map", "pmap"}
 _HOST_SYNC_ATTRS = {"item", "tolist"}
@@ -129,10 +159,12 @@ def _mentions_jit(node: ast.AST) -> bool:
 
 
 class _Linter(ast.NodeVisitor):
-    def __init__(self, path: str, source: str, is_compat: bool):
+    def __init__(self, path: str, source: str, is_compat: bool, is_file_utils: bool = False):
         self.path = path
+        self.source = source
         self.lines = source.splitlines()
         self.is_compat = is_compat
+        self.is_file_utils = is_file_utils
         self.findings: list[Finding] = []
         # Names wrapped by a jit-family call somewhere in the module
         # (`return jax.jit(fn)` marks `fn` as traced code), and the call
@@ -280,6 +312,9 @@ class _Linter(ast.NodeVisitor):
                 f"use a seeded np.random.default_rng",
             )
 
+        # HSL006: bare writes to metadata-plane paths.
+        self._check_metadata_write(node)
+
         # HSL002: host sync inside traced code.
         if self._in_jit():
             if isinstance(node.func, ast.Attribute) and node.func.attr in _HOST_SYNC_ATTRS:
@@ -314,6 +349,48 @@ class _Linter(ast.NodeVisitor):
                     "that tracing cannot represent",
                 )
         self.generic_visit(node)
+
+    # -- HSL006: bare metadata-plane writes ------------------------------------
+
+    def _check_metadata_write(self, node: ast.Call) -> None:
+        """Flag `<expr>.write_text/.write_bytes(...)` and write-mode
+        `open(...)` whose expression text names a metadata-plane path
+        (operation-log entries, latestStable, the index manifest,
+        version dirs) outside file_utils.py — such writes are torn on
+        crash; the atomic primitives exist precisely so they can't be."""
+        if self.is_file_utils:
+            return
+        is_write = (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("write_text", "write_bytes")
+        )
+        if not is_write and isinstance(node.func, ast.Name) and node.func.id == "open":
+            mode = None
+            if (
+                len(node.args) >= 2
+                and isinstance(node.args[1], ast.Constant)
+                and isinstance(node.args[1].value, str)
+            ):
+                mode = node.args[1].value
+            for kw in node.keywords:
+                if (
+                    kw.arg == "mode"
+                    and isinstance(kw.value, ast.Constant)
+                    and isinstance(kw.value.value, str)
+                ):
+                    mode = kw.value.value
+            is_write = mode is not None and any(c in mode for c in "wax+")
+        if not is_write:
+            return
+        seg = (ast.get_source_segment(self.source, node) or "").lower()
+        if any(m in seg for m in _METADATA_PATH_MARKERS):
+            self._report(
+                node, METADATA_WRITE,
+                "bare write to a metadata-plane path (operation log / "
+                "latestStable / manifest / version dir) — a crash mid-write "
+                "tears it; route through file_utils.write_json/atomic_write "
+                "(temp file + fsync + atomic rename + dir fsync)",
+            )
 
     # -- HSL003: traced-value control flow ------------------------------------
 
@@ -372,7 +449,10 @@ def lint_source(source: str, path: str = "<string>") -> list[Finding]:
     """Lint one source text; `path` only labels findings (a basename of
     compat.py marks the sanctioned module)."""
     tree = ast.parse(source, filename=path)
-    linter = _Linter(path, source, pathlib.PurePath(path).name == SANCTIONED_COMPAT)
+    name = pathlib.PurePath(path).name
+    linter = _Linter(
+        path, source, name == SANCTIONED_COMPAT, is_file_utils=name == SANCTIONED_FILE_UTILS
+    )
     linter.collect_jit_wrapped(tree)
     linter.visit(tree)
     return sorted(linter.findings, key=lambda f: (f.path, f.line, f.col))

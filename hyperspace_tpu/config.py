@@ -56,6 +56,21 @@ JOIN_REBUCKETIZE = "hyperspace.join.rebucketize"
 # plans with structured diagnostics before any device work. On by default;
 # the switch exists for benchmarking the (small) walk cost away.
 ANALYSIS_VALIDATE = "hyperspace.analysis.validate"
+# Fault-tolerance plane (docs/fault_tolerance.md). faults.enabled is the
+# injection-harness kill switch (False ⇒ fault_point is inert even with
+# rules registered — a production config can never inject). retry.* tune
+# the transient-IO retry layer (utils/retry.py; maxAttempts=1 disables).
+# fallback.enabled gates the query plane's corruption fallback: a query
+# whose index data turns out unreadable re-plans against the source
+# instead of failing. recover.onAccess makes index listing lazily repair
+# a crashed writer's transient log (after graceSeconds of staleness).
+FAULTS_ENABLED = "hyperspace.faults.enabled"
+RETRY_MAX_ATTEMPTS = "hyperspace.retry.maxAttempts"
+RETRY_BACKOFF_BASE = "hyperspace.retry.backoffBaseSeconds"
+RETRY_CAS_ATTEMPTS = "hyperspace.retry.casAttempts"
+FALLBACK_ENABLED = "hyperspace.fallback.enabled"
+RECOVER_ON_ACCESS = "hyperspace.recover.onAccess"
+RECOVER_GRACE_SECONDS = "hyperspace.recover.graceSeconds"
 
 # Directory-layout constants (reference index/IndexConstants.scala:38-39).
 HYPERSPACE_LOG_DIR = "_hyperspace_log"
@@ -70,6 +85,14 @@ DEFAULT_JOIN_VENUE = "auto"
 DEFAULT_JOIN_VENUE_MIN_MBPS = 200.0
 DEFAULT_JOIN_BROADCAST_MAX_ROWS = 4_000_000
 DEFAULT_JOIN_REBUCKETIZE = "auto"
+# Lazy recovery leaves a transient log alone until it is at least this
+# stale (entry timestamp), so listing indexes cannot cancel a LIVE
+# concurrent writer's in-flight action. Explicit recover() ignores it.
+DEFAULT_RECOVER_GRACE_SECONDS = 300.0
+
+
+def _as_bool(value: Any) -> bool:
+    return bool(value) if not isinstance(value, str) else value.lower() == "true"
 
 
 @dataclasses.dataclass
@@ -92,6 +115,9 @@ class HyperspaceConf:
     join_broadcast_max_rows: int = DEFAULT_JOIN_BROADCAST_MAX_ROWS
     join_rebucketize: str = DEFAULT_JOIN_REBUCKETIZE
     validate_plans: bool = True
+    fallback_enabled: bool = True
+    recover_on_access: bool = True
+    recover_grace_seconds: float = DEFAULT_RECOVER_GRACE_SECONDS
     overrides: dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def __post_init__(self):
@@ -131,9 +157,31 @@ class HyperspaceConf:
         elif key == JOIN_REBUCKETIZE:
             self.join_rebucketize = str(value)
         elif key == ANALYSIS_VALIDATE:
-            self.validate_plans = (
-                bool(value) if not isinstance(value, str) else value.lower() == "true"
-            )
+            self.validate_plans = _as_bool(value)
+        elif key == FALLBACK_ENABLED:
+            self.fallback_enabled = _as_bool(value)
+        elif key == RECOVER_ON_ACCESS:
+            self.recover_on_access = _as_bool(value)
+        elif key == RECOVER_GRACE_SECONDS:
+            self.recover_grace_seconds = float(value)
+        elif key == FAULTS_ENABLED:
+            # Process-global kill switch for the injection harness —
+            # matches the process-global filesystem state it guards.
+            from hyperspace_tpu import faults
+
+            faults.set_enabled(_as_bool(value))
+        elif key == RETRY_MAX_ATTEMPTS:
+            from hyperspace_tpu.utils import retry
+
+            retry.configure(max_attempts=int(value))
+        elif key == RETRY_BACKOFF_BASE:
+            from hyperspace_tpu.utils import retry
+
+            retry.configure(backoff_base=float(value))
+        elif key == RETRY_CAS_ATTEMPTS:
+            from hyperspace_tpu.utils import retry
+
+            retry.configure(cas_attempts=int(value))
 
     def get(self, key: str, default: Any = None) -> Any:
         if key in self.overrides:
@@ -170,4 +218,10 @@ class HyperspaceConf:
             return self.join_rebucketize
         if key == ANALYSIS_VALIDATE:
             return self.validate_plans
+        if key == FALLBACK_ENABLED:
+            return self.fallback_enabled
+        if key == RECOVER_ON_ACCESS:
+            return self.recover_on_access
+        if key == RECOVER_GRACE_SECONDS:
+            return self.recover_grace_seconds
         return default

@@ -12,6 +12,7 @@ shape error.
 from __future__ import annotations
 
 import dataclasses
+import errno as _errno
 
 
 class HyperspaceError(Exception):
@@ -20,6 +21,56 @@ class HyperspaceError(Exception):
     def __init__(self, msg: str):
         super().__init__(msg)
         self.msg = msg
+
+
+class IndexCorruptionError(HyperspaceError):
+    """Index data on disk is unreadable: a truncated/garbage bucket file,
+    a torn `_index_manifest.json`, or a missing file the log still
+    references. Carries enough provenance for the query plane to mark the
+    index unhealthy and re-plan against the source data instead of
+    failing the query (graceful degradation, docs/fault_tolerance.md)."""
+
+    def __init__(self, msg: str, index_root: str | None = None, path: str | None = None):
+        super().__init__(msg)
+        self.index_root = index_root
+        self.path = path
+
+
+class TransientIOError(OSError):
+    """Marker for IO failures worth retrying (lease contention, flaky
+    remote filesystems). Carries errno EIO so `is_retryable` classifies
+    it without special-casing the type."""
+
+    def __init__(self, msg: str):
+        super().__init__(_errno.EIO, msg)
+
+
+# errnos that signal a transient condition: the same call can succeed on
+# retry without anything else changing. ENOENT/EEXIST/EACCES are excluded
+# on purpose — they describe durable state, and retrying masks real bugs.
+TRANSIENT_ERRNOS = frozenset(
+    {
+        _errno.EIO,
+        _errno.EAGAIN,
+        _errno.EBUSY,
+        _errno.EINTR,
+        _errno.ETIMEDOUT,
+        _errno.ECONNRESET,
+        _errno.ECONNABORTED,
+        _errno.ESTALE,
+    }
+)
+
+
+def is_retryable(exc: BaseException) -> bool:
+    """Retryable-exception classification for utils/retry.py: transient
+    OS-level IO failures retry; everything else (corruption, missing
+    files, programming errors) surfaces immediately."""
+    if isinstance(exc, TimeoutError):
+        return True
+    if isinstance(exc, OSError):
+        return exc.errno in TRANSIENT_ERRNOS
+    return False
 
 
 @dataclasses.dataclass(frozen=True)

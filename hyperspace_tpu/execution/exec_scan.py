@@ -7,7 +7,10 @@ import dataclasses
 from pathlib import Path
 
 import numpy as np
+import pyarrow as pa
 
+from hyperspace_tpu import stats as _ft_stats
+from hyperspace_tpu.exceptions import IndexCorruptionError
 from hyperspace_tpu.execution import io as hio
 from hyperspace_tpu.execution.builder import hash_scalar_key
 from hyperspace_tpu.execution.table import ColumnTable
@@ -26,18 +29,41 @@ from hyperspace_tpu.execution.exec_common import (
 )
 
 
+def _corruption(e: BaseException, index_root: str, files: list[str]) -> IndexCorruptionError:
+    """Wrap an unreadable-index-file failure with provenance (which index,
+    which files) for the session's health map and fallback re-plan."""
+    _ft_stats.increment("index.corruption")
+    return IndexCorruptionError(
+        f"unreadable index data under {index_root}: {e}",
+        index_root=index_root,
+        path=files[0] if files else None,
+    )
+
+
 class ScanFilterMixin:
     def _scan_files(self, scan: Scan) -> list[str]:
         if scan.files is not None:
             return list(scan.files)
         return [fi.path for fi in list_data_files(scan.root, suffix=format_suffix(scan.format))]
 
-    def _cached_read(self, files: list[str], columns, schema) -> ColumnTable:
+    def _cached_read(self, files: list[str], columns, schema, index_root: str | None = None) -> ColumnTable:
         """Index-file read through the decoded-table cache; files_read
-        counts only physical (miss) reads."""
+        counts only physical (miss) reads. With `index_root` (the read
+        serves an INDEX scan), an unreadable file — missing, truncated,
+        or garbage parquet — surfaces as a typed IndexCorruptionError so
+        the session can quarantine the index and re-plan against the
+        source instead of failing the query."""
         before = hio.table_cache_stats()["miss_files"]
-        table = hio.read_parquet_cached(files, columns=columns, schema=schema)
-        self.stats["files_read"] += hio.table_cache_stats()["miss_files"] - before
+        try:
+            table = hio.read_parquet_cached(files, columns=columns, schema=schema)
+        except IndexCorruptionError:
+            raise
+        except (OSError, pa.ArrowException) as e:
+            if index_root is None:
+                raise
+            raise _corruption(e, index_root, files) from e
+        finally:
+            self.stats["files_read"] += hio.table_cache_stats()["miss_files"] - before
         return table
 
     def _scan(self, scan: Scan, columns: list[str] | None = None) -> ColumnTable:
@@ -51,7 +77,8 @@ class ScanFilterMixin:
             # mutated source re-decodes while repeat queries over stable
             # sources (dimension tables above all) skip the decode — the
             # analog of Spark's in-memory relation cache.
-            return self._cached_read(files, cols, scan.scan_schema)
+            root = scan.root if scan.bucket_spec is not None else None
+            return self._cached_read(files, cols, scan.scan_schema, index_root=root)
         self.stats["files_read"] += len(files)
         return hio.read_table_files(files, scan.format, columns=cols, schema=scan.scan_schema)
 
@@ -71,7 +98,9 @@ class ScanFilterMixin:
                     files_pruned=self.stats["files_pruned"] - fp0,
                     kernel=f"bucket-hash-prune + {mask_kernel}",
                 )
-                table = self._cached_read(pruned, child.scan_schema.names, child.scan_schema)
+                table = self._cached_read(
+                    pruned, child.scan_schema.names, child.scan_schema, index_root=child.root
+                )
                 return apply_filter(table, plan.predicate, mesh=self.mesh, venue=mask_venue)
             ranged = self._range_read(child, plan.predicate)
             if ranged is not None:
@@ -251,14 +280,20 @@ class ScanFilterMixin:
         if not kept:
             return ColumnTable.empty(schema), True
         before = hio.table_cache_stats()["miss_files"]
-        with ThreadPoolExecutor(max_workers=min(8, len(kept))) as pool:
-            tables = list(
-                pool.map(
-                    lambda fp: hio.read_parquet_cached([fp], columns=schema.names, schema=schema),
-                    kept,
+        try:
+            with ThreadPoolExecutor(max_workers=min(8, len(kept))) as pool:
+                tables = list(
+                    pool.map(
+                        lambda fp: hio.read_parquet_cached([fp], columns=schema.names, schema=schema),
+                        kept,
+                    )
                 )
-            )
-        self.stats["files_read"] += hio.table_cache_stats()["miss_files"] - before
+        except IndexCorruptionError:
+            raise
+        except (OSError, pa.ArrowException) as e:
+            raise _corruption(e, scan.root, kept) from e
+        finally:
+            self.stats["files_read"] += hio.table_cache_stats()["miss_files"] - before
         parts: list[ColumnTable] = []
         # Float keys can hold NaN VALUES (sorted last by the build); a
         # lower-bound-only slice would include them while the mask drops

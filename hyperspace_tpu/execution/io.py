@@ -17,9 +17,13 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.parquet as pq
 
-from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu import stats
+from hyperspace_tpu.exceptions import HyperspaceError, IndexCorruptionError
 from hyperspace_tpu.execution.table import ColumnTable
+from hyperspace_tpu.faults import fault_point
 from hyperspace_tpu.schema import Schema
+from hyperspace_tpu.utils import retry
+from hyperspace_tpu.utils.file_utils import write_json
 
 MANIFEST_NAME = "_index_manifest.json"
 
@@ -154,9 +158,16 @@ def _arrow_types_for(schema: Schema | None) -> dict | None:
 
 
 def _read_one_file(path: str, fmt: str, columns: list[str] | None, schema: Schema | None):
-    """One file of any supported source format → pyarrow Table. The
-    reference gates sources to the same four formats
+    """One file of any supported source format → pyarrow Table, with
+    transient-IO retry (pyarrow's IO errors subclass OSError; only
+    retryable errnos re-attempt — a missing or truncated file surfaces
+    immediately). The reference gates sources to the same four formats
     (index/serde/LogicalPlanSerDeUtils.scala:225-245)."""
+    return retry.retry_call(_read_one_file_once, path, fmt, columns, schema)
+
+
+def _read_one_file_once(path: str, fmt: str, columns: list[str] | None, schema: Schema | None):
+    fault_point("bucket.read", path)
     if fmt == "parquet":
         # partitioning=None: index files live under hive-looking `v__=N`
         # version dirs; letting pyarrow infer a `v__` partition column
@@ -213,15 +224,21 @@ def read_table_files(
     return ColumnTable.from_arrow(table, schema)
 
 
+def _read_footer(path: str) -> "pq.FileMetaData":
+    fault_point("footer.read", path)
+    return pq.ParquetFile(path).metadata
+
+
 def read_footers(files: list[str]) -> dict[str, "pq.FileMetaData"]:
     """One footer parse per file, reused by the size estimate, the chunk
-    planner, and the spill batcher (footers can be remote round-trips)."""
+    planner, and the spill batcher (footers can be remote round-trips —
+    hence the transient-IO retry)."""
     from concurrent.futures import ThreadPoolExecutor
 
     if len(files) == 1:
-        return {files[0]: pq.ParquetFile(files[0]).metadata}
+        return {files[0]: retry.retry_call(_read_footer, files[0])}
     with ThreadPoolExecutor(max_workers=min(8, len(files))) as ex:
-        mds = list(ex.map(lambda f: pq.ParquetFile(f).metadata, files))
+        mds = list(ex.map(lambda f: retry.retry_call(_read_footer, f), files))
     return dict(zip(files, mds))
 
 
@@ -361,6 +378,8 @@ def write_bucket(
     dest_dir: Path, bucket: int, table: ColumnTable, compression: str | None = None
 ) -> None:
     dest_dir.mkdir(parents=True, exist_ok=True)
+    dest = dest_dir / bucket_file_name(bucket)
+    fault_point("bucket.write", dest)
     # Dictionary-encode ONLY string columns: for numeric index data,
     # parquet dictionary encoding costs ~6x encode time AND grows the
     # files (high-cardinality keys, float payloads); for low-cardinality
@@ -368,7 +387,7 @@ def write_bucket(
     dict_cols = [f.name for f in table.schema.fields if f.is_string]
     pq.write_table(
         table.to_arrow(),
-        dest_dir / bucket_file_name(bucket),
+        dest,
         use_dictionary=dict_cols,
         compression=compression or INDEX_WRITE_COMPRESSION,
         # Pruning reads the MANIFEST's key/column stats (computed over the
@@ -377,6 +396,7 @@ def write_bucket(
         # buckets.
         write_statistics=False,
     )
+    fault_point("bucket.written", dest)
 
 
 def write_manifest(
@@ -401,14 +421,33 @@ def write_manifest(
         # Per-bucket {column: [min, max] | None} for the remaining scalar
         # columns — file pruning on included-column predicates.
         manifest["columnStats"] = column_stats
-    (dest_dir / MANIFEST_NAME).write_text(json.dumps(manifest, indent=2))
+    mp = dest_dir / MANIFEST_NAME
+    fault_point("manifest.write", mp)
+    # Atomic temp-file + os.replace (+ fsync) via write_json: a crash
+    # mid-write leaves either the previous manifest or none — never a
+    # torn `_index_manifest.json` that poisons every later read.
+    write_json(mp, manifest)
+    fault_point("manifest.written", mp)
 
 
 def read_manifest(version_dir: Path) -> dict | None:
+    """Version dir's manifest, or None when absent (pre-stats builds —
+    planning degrades to footer counts). Garbage raises a typed
+    IndexCorruptionError so callers can distinguish "no manifest" from
+    "index data is damaged" and degrade/fall back deliberately."""
     p = Path(version_dir) / MANIFEST_NAME
     if not p.exists():
         return None
-    return json.loads(p.read_text())
+    fault_point("manifest.read", p)
+    try:
+        return json.loads(p.read_text())
+    except (OSError, ValueError) as e:
+        stats.increment("index.corruption")
+        raise IndexCorruptionError(
+            f"corrupt index manifest {p}: {e}",
+            index_root=str(Path(version_dir).parent),
+            path=str(p),
+        ) from e
 
 
 _manifest_cache: "dict[str, tuple[int, dict | None]]" = {}

@@ -1,0 +1,257 @@
+"""Deterministic fault injection for the metadata and execution planes.
+
+The production claim this repo rides on — the operation log tolerates
+writers that die mid-`op()` (actions/base.py two-phase protocol) — is
+untestable without a way to make IO fail *on purpose, at a chosen call,
+deterministically*. This module provides that: named **fault points**
+threaded through `utils/file_utils.py`, `metadata/log_manager.py` and
+`execution/io.py` call :func:`fault_point` with a semantic name and the
+path being acted on; tests register :class:`FaultRule`\\ s that make a
+specific call raise a transient :class:`FaultError`, simulate a hard
+process death via :class:`CrashPoint`, or truncate/corrupt the bytes on
+disk — on a schedule (fail the first N calls, fail exactly call K).
+
+Design constraints:
+
+- **Zero overhead when disabled.** `fault_point` is a single module-global
+  check (`if not _armed: return`) on the hot IO paths; nothing else runs
+  unless a test armed the harness.
+- **Crash ≠ error.** :class:`CrashPoint` derives from ``BaseException``,
+  so the `except Exception` failure handling in `Action.run()` cannot
+  "survive" it — exactly like a real `kill -9`, the dying writer gets no
+  chance to clean up, and recovery must happen in a later process
+  (`Hyperspace.recover`). Transient :class:`FaultError` is an ``OSError``
+  with errno EIO, so `exceptions.is_retryable` classifies it and the
+  retry layer (utils/retry.py) handles it like any flaky disk.
+- **Deterministic.** Schedules count calls, never wall time or RNG.
+
+Kill switch: ``hyperspace.faults.enabled`` (config.py) — when set False,
+`fault_point` is inert even with rules registered, so a production
+config can never be one stray rule away from injected failures.
+
+Fault point names in use (see each call site):
+
+====================  =====================================================
+``file.write_json``   file_utils.write_json overwrite (temp + replace) path
+``file.atomic_write`` file_utils.atomic_write CAS-create path
+``log.write``         log_manager.write_log, before the entry CAS
+``log.written``       after a log entry commits (truncate ⇒ torn entry)
+``log.stable.write``  before the latestStable pointer rewrite
+``manifest.write``    io.write_manifest, before the atomic write
+``manifest.written``  after the manifest commits (truncate ⇒ torn manifest)
+``manifest.read``     io.read_manifest, before the JSON parse
+``bucket.write``      io.write_bucket, before the parquet encode
+``bucket.written``    after a bucket file lands (truncate ⇒ corrupt bucket)
+``bucket.read``       io._read_one_file, before any data-file decode
+``footer.read``       io.read_footers, before a footer parse
+====================  =====================================================
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import errno as _errno
+import os
+import threading
+from contextlib import contextmanager
+from typing import Iterator
+
+from hyperspace_tpu import stats
+
+KNOWN_POINTS = (
+    "file.write_json",
+    "file.atomic_write",
+    "log.write",
+    "log.written",
+    "log.stable.write",
+    "manifest.write",
+    "manifest.written",
+    "manifest.read",
+    "bucket.write",
+    "bucket.written",
+    "bucket.read",
+    "footer.read",
+)
+
+
+class FaultError(OSError):
+    """Injected transient IO failure. errno EIO ⇒ retryable
+    (exceptions.is_retryable), so the retry layer treats it exactly like
+    a real flaky disk."""
+
+    def __init__(self, msg: str):
+        super().__init__(_errno.EIO, msg)
+
+
+class CrashPoint(BaseException):
+    """Simulated hard process death at a fault point.
+
+    BaseException on purpose: recovery code that catches ``Exception``
+    must not be able to run in the "dying" process — the test harness
+    catches this at its outermost level and then plays the next process
+    (recover / re-open), which is the only honest way to test crash
+    consistency.
+    """
+
+    def __init__(self, point: str, path: str | None = None):
+        super().__init__(f"simulated crash at fault point {point!r}")
+        self.point = point
+        self.path = path
+
+
+@dataclasses.dataclass
+class FaultRule:
+    """One registered fault: where, what, and on which calls.
+
+    `at_call` fires on exactly the K-th arrival at the point (1-based);
+    `times` caps how many times the rule fires (fail-N-then-succeed);
+    both unset ⇒ fires on every arrival. Actions compose in order:
+    truncate/corrupt mutate the file first, then `error`/`crash` raise —
+    so a single rule can model "the disk wrote garbage AND the process
+    died"."""
+
+    point: str
+    error: BaseException | type | None = None
+    crash: bool = False
+    truncate: int | None = None  # keep only the first N bytes of `path`
+    corrupt: bytes | None = None  # overwrite the head of `path` with these bytes
+    at_call: int | None = None  # 1-based call index this rule fires at
+    times: int | None = None  # max number of firings (None = unlimited)
+    calls: int = 0
+    fired: int = 0
+
+
+_lock = threading.Lock()
+_rules: list[FaultRule] = []
+_observed: set[str] = set()
+_armed = False  # fast-path gate: False ⇒ fault_point returns immediately
+_enabled = True  # hyperspace.faults.enabled kill switch
+
+
+def set_enabled(enabled: bool) -> None:
+    """Config kill switch (`hyperspace.faults.enabled`). False disarms
+    the harness even with rules registered."""
+    global _enabled, _armed
+    with _lock:
+        _enabled = bool(enabled)
+        _armed = _enabled and bool(_rules)
+
+
+def inject(
+    point: str,
+    *,
+    error: BaseException | type | None = None,
+    crash: bool = False,
+    truncate: int | None = None,
+    corrupt: bytes | None = None,
+    at_call: int | None = None,
+    times: int | None = None,
+) -> FaultRule:
+    """Register a fault at `point`. With no explicit action, the rule
+    raises a transient :class:`FaultError` (the common retry-test case)."""
+    if point not in KNOWN_POINTS:
+        raise ValueError(f"unknown fault point {point!r} (see faults.KNOWN_POINTS)")
+    if error is None and not crash and truncate is None and corrupt is None:
+        error = FaultError
+    rule = FaultRule(
+        point=point, error=error, crash=crash, truncate=truncate,
+        corrupt=corrupt, at_call=at_call, times=times,
+    )
+    global _armed
+    with _lock:
+        _rules.append(rule)
+        _armed = _enabled
+    return rule
+
+
+def reset() -> None:
+    """Clear every rule and observation; disarm the fast path."""
+    global _armed
+    with _lock:
+        _rules.clear()
+        _observed.clear()
+        _armed = False
+
+
+@contextmanager
+def injected(point: str, **kwargs) -> Iterator[FaultRule]:
+    """`with faults.injected("log.write", crash=True): ...` — register one
+    rule for the block, always reset after."""
+    rule = inject(point, **kwargs)
+    try:
+        yield rule
+    finally:
+        reset()
+
+
+@contextmanager
+def recording() -> Iterator[set]:
+    """Arm the harness with no rules, purely to record which fault points
+    a block of code passes through — the discovery pass the crash sweep
+    uses to enumerate the points each action actually exercises. The
+    yielded set keeps its contents after the block exits."""
+    global _armed
+    out: set[str] = set()
+    with _lock:
+        _observed.clear()
+        _armed = _enabled
+    try:
+        yield out
+    finally:
+        with _lock:
+            out |= _observed
+        reset()
+
+
+def observed_points() -> set[str]:
+    """Fault points hit while the harness was armed (recording or rules)."""
+    with _lock:
+        return set(_observed)
+
+
+def fault_point(name: str, path: str | os.PathLike | None = None) -> None:
+    """Declare a named fault point. Call sites sprinkle this on the IO
+    paths; it is a no-op unless a test armed the harness."""
+    if not _armed:
+        return
+    _hit(name, path)
+
+
+def _hit(name: str, path: str | os.PathLike | None) -> None:
+    to_fire: list[FaultRule] = []
+    with _lock:
+        _observed.add(name)
+        for rule in _rules:
+            if rule.point != name:
+                continue
+            rule.calls += 1
+            if rule.at_call is not None and rule.calls != rule.at_call:
+                continue
+            if rule.times is not None and rule.fired >= rule.times:
+                continue
+            rule.fired += 1
+            to_fire.append(rule)
+    for rule in to_fire:
+        stats.increment("faults.injected")
+        if path is not None and (rule.truncate is not None or rule.corrupt is not None):
+            _mangle_file(path, rule)
+        if rule.crash:
+            raise CrashPoint(name, str(path) if path is not None else None)
+        if rule.error is not None:
+            if isinstance(rule.error, type):
+                raise rule.error(f"injected fault at {name!r}" + (f" ({path})" if path else ""))
+            raise rule.error
+
+
+def _mangle_file(path: str | os.PathLike, rule: FaultRule) -> None:
+    """Apply a truncate/corrupt schedule to the file at `path` (missing
+    file ⇒ no-op: the point fired before the bytes landed)."""
+    try:
+        if rule.truncate is not None:
+            with open(path, "r+b") as f:
+                f.truncate(rule.truncate)
+        if rule.corrupt is not None:
+            with open(path, "r+b") as f:
+                f.write(rule.corrupt)
+    except OSError:
+        pass

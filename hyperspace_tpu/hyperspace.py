@@ -67,6 +67,11 @@ class HyperspaceSession:
         # and the executed PhysicalNode tree.
         self.last_query_stats: dict = {}
         self.last_physical_plan = None
+        # Per-index health map (index root -> failure record). An index
+        # that served corrupt data is quarantined from the rewrite rules
+        # for the rest of the session; queries transparently fall back to
+        # the source (docs/fault_tolerance.md). recover()/refresh clears.
+        self.index_health: dict[str, dict] = {}
 
     # -- rule toggle (package.scala:46-70) --------------------------------
     def enable_hyperspace(self) -> "HyperspaceSession":
@@ -135,6 +140,14 @@ class HyperspaceSession:
         # sides (where the index rules cover them) and scans narrow to
         # what the query needs.
         indexes = self.manager.get_indexes()
+        if self.index_health:
+            # Indexes that served corrupt data are out of the candidate
+            # set until recovered — degradation is sticky per session,
+            # not re-discovered (and re-failed) on every query.
+            indexes = [
+                e for e in indexes
+                if str(Path(e.content.root)) not in self.index_health
+            ]
         return apply_rules(prune_columns(push_down_filters(plan)), indexes, conf=self.conf)
 
     def run(self, plan: LogicalPlan, profile_dir: str | Path | None = None):
@@ -142,19 +155,51 @@ class HyperspaceSession:
         returns a ColumnTable. With `profile_dir`, the execution runs
         under jax.profiler.trace and writes an xplane artifact there
         (SURVEY.md §5: the TPU profiling story) — open with TensorBoard
-        or xprof."""
+        or xprof.
+
+        Corruption fallback (`hyperspace.fallback.enabled`): when an
+        index scan hits unreadable index data mid-query, the failing
+        index is recorded in `index_health` and the query transparently
+        re-plans — first through the remaining healthy indexes, then
+        (if corruption persists) straight against the source data. The
+        query answers either way; `hyperspace_tpu.stats` counts it."""
+        from hyperspace_tpu import stats
+        from hyperspace_tpu.exceptions import IndexCorruptionError
         from hyperspace_tpu.execution.executor import Executor
 
-        executor = Executor(mesh=self.mesh, conf=self.conf)
-        optimized = self.optimized_plan(plan)
-        if profile_dir is not None:
-            import jax
+        use_indexes = True
+        while True:
+            executor = Executor(mesh=self.mesh, conf=self.conf)
+            optimized = self.optimized_plan(plan) if use_indexes else plan
+            try:
+                if profile_dir is not None:
+                    import jax
 
-            with jax.profiler.trace(str(profile_dir)):
-                result = executor.execute(optimized)
-        else:
-            result = executor.execute(optimized)
+                    with jax.profiler.trace(str(profile_dir)):
+                        result = executor.execute(optimized)
+                else:
+                    result = executor.execute(optimized)
+                break
+            except IndexCorruptionError as e:
+                if not (self._enabled and use_indexes and self.conf.fallback_enabled):
+                    raise
+                root = str(Path(e.index_root)) if e.index_root is not None else None
+                if root is None or root in self.index_health:
+                    # No provenance to quarantine by (or quarantining it
+                    # didn't help): indexes go off wholesale for this
+                    # query — the loop provably terminates.
+                    use_indexes = False
+                if root is not None:
+                    self.index_health[root] = {"reason": e.msg, "path": e.path}
+                stats.increment("fallback.queries")
+                import logging
+
+                logging.getLogger("hyperspace_tpu").warning(
+                    "index data unreadable (%s); re-planning query against source", e.msg
+                )
         self.last_query_stats = executor.stats
+        if self.index_health:
+            self.last_query_stats["degraded_indexes"] = sorted(self.index_health)
         self.last_physical_plan = executor.physical_plan
         return result
 
@@ -199,12 +244,38 @@ class Hyperspace:
         mode="incremental" indexes only appended source files into per-
         bucket delta files (pair with optimize_index to compact)."""
         self.session.manager.refresh(name, mode)
+        self._lift_quarantine(name)
 
     def optimize_index(self, name: str) -> None:
         self.session.manager.optimize(name)
+        self._lift_quarantine(name)
+
+    def _lift_quarantine(self, name: str) -> None:
+        """A successful rebuild supersedes whatever corruption got the
+        index quarantined in this session — let it serve queries again."""
+        root = str(self.session.manager.path_resolver.get_index_path(name))
+        self.session.index_health.pop(root, None)
 
     def cancel(self, name: str) -> None:
         self.session.manager.cancel(name)
+
+    def recover(self, name: str | None = None) -> dict:
+        """Crash recovery (docs/fault_tolerance.md): quarantine torn log
+        entries, roll a transient latest entry to the last stable state
+        (cancel semantics), refresh the latestStable pointer, and GC
+        version dirs no stable entry references. With no name, every
+        index under the system path is recovered. Also lifts the
+        session's corruption quarantine (`session.index_health`) so
+        repaired indexes serve queries again. Idempotent."""
+        mgr = self.session.manager
+        if name is not None:
+            report = mgr.recover(name)
+            root = str(mgr.path_resolver.get_index_path(name))
+            self.session.index_health.pop(root, None)
+            return report
+        reports = {d.name: mgr.recover(d.name) for d in mgr.path_resolver.list_index_paths()}
+        self.session.index_health.clear()
+        return reports
 
     def indexes(self):
         return self.session.manager.indexes()

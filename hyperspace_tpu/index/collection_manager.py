@@ -131,12 +131,109 @@ class IndexCollectionManager:
             raise HyperspaceError(f"index {name!r} does not exist")
         CancelAction(lm).run()
 
+    # -- crash recovery ---------------------------------------------------
+    def recover(self, name: str) -> dict:
+        """Repair one index after a crashed writer (docs/fault_tolerance.md).
+
+        Idempotent three-step state machine:
+
+        1. **Torn tail**: trailing log entries that no longer parse (a
+           writer died mid-write on a non-atomic filesystem, or injected
+           truncation) are quarantined until the tail is readable.
+        2. **Transient tail**: a latest entry in a transient state is
+           rolled forward/back to the last stable state with the exact
+           `cancel` semantics (cancel.py: VACUUMING → DOESNOTEXIST,
+           otherwise the last stable state), and the `latestStable`
+           pointer is refreshed — also repairing an `end()` that died
+           between the final CAS write and the pointer swap.
+        3. **Orphan GC**: `v__=N` dirs the latest stable entry does not
+           reference (partial builds, superseded failed refreshes) are
+           deleted. A DELETED entry still references its dirs (restore
+           needs them); DOESNOTEXIST references none, so a crashed
+           vacuum's remaining dirs are swept here.
+        """
+        from hyperspace_tpu import stats
+        from hyperspace_tpu.config import DATA_VERSION_PREFIX
+
+        lm, dm, _ = self._managers(name)
+        report = {"rolled": False, "quarantined_entries": 0, "orphans_removed": 0}
+        latest = None
+        while True:
+            latest_id = lm.get_latest_id()
+            if latest_id is None:
+                break
+            try:
+                latest = lm.get_log(latest_id)
+                break
+            except Exception:
+                if not lm.quarantine_log(latest_id):
+                    break
+                report["quarantined_entries"] += 1
+                stats.increment("recover.quarantined_entries")
+        if latest is None:
+            return report
+        if latest.state not in states.STABLE_STATES:
+            CancelAction(lm).run()
+            report["rolled"] = True
+            stats.increment("recover.rolled")
+        # Refresh the pointer unconditionally: cheap, and repairs a crash
+        # between end()'s final write and its pointer swap.
+        lm.create_latest_stable_log(lm.get_latest_id())
+        stable = lm.get_latest_stable_log()
+        referenced: set[str] = set()
+        if (
+            stable is not None
+            and stable.state != states.DOESNOTEXIST
+            and stable.content is not None
+        ):
+            referenced = set(stable.content.directories)
+        for vid in dm.get_version_ids():
+            if f"{DATA_VERSION_PREFIX}{vid}" not in referenced:
+                dm.delete(vid)
+                report["orphans_removed"] += 1
+                stats.increment("recover.orphans_removed")
+        return report
+
+    def _latest_for_listing(self, lm, dir_path: Path) -> IndexLogEntry | None:
+        """One index dir's latest entry, lazily repairing crash damage.
+
+        With `hyperspace.recover.onAccess` (default on), a torn latest
+        entry recovers immediately, and a TRANSIENT latest entry recovers
+        once it is older than `hyperspace.recover.graceSeconds` — the
+        grace keeps a listing from cancelling a live writer's in-flight
+        action, while a long-dead writer's index heals on first access
+        instead of staying unusable until a manual cancel. Safe against
+        the race anyway: recovery commits through the same CAS protocol,
+        so a live writer that loses simply aborts."""
+        import time
+
+        try:
+            entry = lm.get_latest_log()
+        except Exception:
+            entry = None
+            if not self.conf.recover_on_access:
+                raise
+        if not self.conf.recover_on_access:
+            return entry
+        stale = (
+            entry is not None
+            and entry.state not in states.STABLE_STATES
+            and time.time() - (entry.timestamp or 0) > self.conf.recover_grace_seconds
+        )
+        if entry is None or stale:
+            try:
+                self.recover(dir_path.name)
+                entry = lm.get_latest_log()
+            except Exception:
+                pass
+        return entry
+
     def get_indexes(self, states_filter=(states.ACTIVE,)) -> list[IndexLogEntry]:
         """Enumerate every index dir under the system path and read each
         latest log (IndexCollectionManager.scala:87-105)."""
         out = []
         for d in self.path_resolver.list_index_paths():
-            entry = self.log_manager_factory(d).get_latest_log()
+            entry = self._latest_for_listing(self.log_manager_factory(d), d)
             if entry is not None and entry.state in states_filter:
                 out.append(entry)
         return out
@@ -231,3 +328,7 @@ class CachingIndexCollectionManager(IndexCollectionManager):
     def cancel(self, name):
         self.clear_cache()
         super().cancel(name)
+
+    def recover(self, name):
+        self.clear_cache()
+        return super().recover(name)

@@ -41,3 +41,24 @@ class IndexDataManager:
 
     def delete(self, id: int) -> None:
         delete_recursively(self.get_path(id))
+
+    def quarantine(self, id: int) -> bool:
+        """Move a partial/orphaned version dir aside (failure path of
+        Action.run, recover()'s orphan GC). The dotted name no longer
+        matches the `v__=N` pattern, so the version id is immediately
+        reusable and index listings can never pick the partial data up;
+        the bytes stay for post-mortems. No-op (False) when absent."""
+        src = self.get_path(id)
+        if not src.exists():
+            return False
+        for attempt in range(10):
+            suffix = "" if attempt == 0 else f"-{attempt}"
+            dest = self.index_path / f".quarantine-{DATA_VERSION_PREFIX}{id}{suffix}"
+            if dest.exists():
+                continue
+            try:
+                os.rename(src, dest)
+                return True
+            except OSError:
+                return False
+        return False

@@ -21,6 +21,7 @@ import os
 from pathlib import Path
 
 from hyperspace_tpu.config import HYPERSPACE_LOG_DIR, LATEST_STABLE_LOG_NAME
+from hyperspace_tpu.faults import fault_point
 from hyperspace_tpu.metadata.log_entry import IndexLogEntry, entry_from_json
 from hyperspace_tpu.utils.file_utils import read_json, write_json
 from hyperspace_tpu.states import STABLE_STATES
@@ -58,12 +59,18 @@ class IndexLogManager:
             # Pointer absent, or caught mid delete/recreate by a concurrent
             # Action.end(): fall back to the backward scan.
             pass
-        # Backward scan fallback (IndexLogManager.scala:113-122).
+        # Backward scan fallback (IndexLogManager.scala:113-122). A torn
+        # or garbage entry (crashed writer on a filesystem without atomic
+        # create, injected truncation) is skipped, not fatal: the scan's
+        # contract is "the last stable state still resolves".
         latest = self.get_latest_id()
         if latest is None:
             return None
         for id in range(latest, -1, -1):
-            entry = self.get_log(id)
+            try:
+                entry = self.get_log(id)
+            except (ValueError, KeyError, TypeError, OSError):
+                continue
             if entry is not None and entry.state in STABLE_STATES:
                 return entry
         return None
@@ -72,7 +79,12 @@ class IndexLogManager:
     def write_log(self, id: int, entry: IndexLogEntry) -> bool:
         """CAS-create log entry `id`. False ⇒ a concurrent writer won."""
         entry.id = id
-        return write_json(self.log_dir / str(id), entry.to_json(), overwrite=False)
+        p = self.log_dir / str(id)
+        fault_point("log.write", p)
+        ok = write_json(p, entry.to_json(), overwrite=False)
+        if ok:
+            fault_point("log.written", p)
+        return ok
 
     def create_latest_stable_log(self, id: int) -> bool:
         """Copy entry `id` to the latestStable pointer
@@ -80,7 +92,9 @@ class IndexLogManager:
         entry = self.get_log(id)
         if entry is None or entry.state not in STABLE_STATES:
             return False
-        write_json(self.log_dir / LATEST_STABLE_LOG_NAME, entry.to_json(), overwrite=True)
+        p = self.log_dir / LATEST_STABLE_LOG_NAME
+        fault_point("log.stable.write", p)
+        write_json(p, entry.to_json(), overwrite=True)
         return True
 
     def delete_latest_stable_log(self) -> bool:
@@ -90,3 +104,20 @@ class IndexLogManager:
             return True
         except OSError:
             return False
+
+    def quarantine_log(self, id: int) -> bool:
+        """Move a torn/garbage log entry aside (recover()'s repair for a
+        truncated trailing entry). The renamed file no longer counts for
+        `get_latest_id` (non-digit name), so the id becomes writable
+        again; the bytes stay on disk for post-mortems."""
+        p = self.log_dir / str(id)
+        for attempt in range(10):
+            suffix = ".corrupt" if attempt == 0 else f".corrupt-{attempt}"
+            try:
+                os.rename(p, p.with_name(p.name + suffix))
+                return True
+            except FileExistsError:
+                continue
+            except OSError:
+                return False
+        return False

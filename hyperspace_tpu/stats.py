@@ -1,0 +1,50 @@
+"""Process-wide fault-tolerance counters.
+
+The observability half of the fault-tolerance layer
+(docs/fault_tolerance.md): retries, injected faults, detected
+corruption, recovery transitions, and query fallbacks all tick a named
+counter here, so degradation is measurable instead of silent. Counters
+are process-global (matching the filesystem state they describe) and
+thread-safe; `snapshot()` is the read API surfaced as
+`hyperspace_tpu.stats`.
+
+Counter names in use:
+
+- ``retry.attempts``       extra attempts made after a transient failure
+- ``retry.exhausted``      retry loops that gave up and re-raised
+- ``faults.injected``      faults the injection harness actually fired
+- ``index.corruption``     typed corruption detections (bucket/manifest)
+- ``fallback.queries``     queries re-planned against source data
+- ``action.rolled_back``   op() failures rolled back to the last stable state
+- ``recover.rolled``       recover() roll-forwards of a transient log
+- ``recover.quarantined_entries``  torn log entries quarantined by recover()
+- ``recover.orphans_removed``      unreferenced version dirs GC'd by recover()
+"""
+
+from __future__ import annotations
+
+import threading
+
+_lock = threading.Lock()
+_counters: dict[str, int] = {}
+
+
+def increment(name: str, n: int = 1) -> None:
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + n
+
+
+def get(name: str) -> int:
+    with _lock:
+        return _counters.get(name, 0)
+
+
+def snapshot() -> dict[str, int]:
+    """Point-in-time copy of every counter."""
+    with _lock:
+        return dict(_counters)
+
+
+def reset() -> None:
+    with _lock:
+        _counters.clear()

@@ -18,9 +18,29 @@ import tempfile
 from pathlib import Path
 from typing import Any
 
+from hyperspace_tpu.faults import fault_point
+from hyperspace_tpu.utils import retry
+
 
 def ensure_dir(path: str | os.PathLike) -> None:
     Path(path).mkdir(parents=True, exist_ok=True)
+
+
+def fsync_dir(path: str | os.PathLike) -> None:
+    """fsync a directory: POSIX makes a rename/link durable only once the
+    parent directory's entry is flushed separately — without this, the
+    `latestStable` pointer (and any os.replace commit) can vanish on
+    power loss even though the data file's bytes were fsynced."""
+    try:
+        fd = os.open(path, os.O_RDONLY | getattr(os, "O_DIRECTORY", 0))
+    except OSError:
+        return  # platform/filesystem without dir fds — best effort
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 def atomic_write(path: str | os.PathLike, data: bytes) -> bool:
@@ -34,6 +54,7 @@ def atomic_write(path: str | os.PathLike, data: bytes) -> bool:
     """
     path = Path(path)
     ensure_dir(path.parent)
+    fault_point("file.atomic_write", path)
     fd, tmp = tempfile.mkstemp(dir=path.parent, prefix=".tmp-", suffix=path.name)
     try:
         with os.fdopen(fd, "wb") as f:
@@ -42,6 +63,7 @@ def atomic_write(path: str | os.PathLike, data: bytes) -> bool:
             os.fsync(f.fileno())
         try:
             os.link(tmp, path)  # CAS: fails iff path exists
+            fsync_dir(path.parent)
             return True
         except FileExistsError:
             return False
@@ -179,6 +201,7 @@ def _locked_rename(tmp: str, path: Path) -> bool:
                 return False  # our lease was stolen — do not double-commit
             try:
                 os.rename(tmp, path)
+                fsync_dir(path.parent)
                 return True
             except OSError:
                 return False
@@ -196,12 +219,32 @@ def write_json(path: str | os.PathLike, obj: Any, *, overwrite: bool = True) -> 
     if overwrite:
         path = Path(path)
         ensure_dir(path.parent)
-        fd, tmp = tempfile.mkstemp(dir=path.parent, prefix=".tmp-")
+        retry.retry_call(_overwrite_json, path, data)
+        return True
+    return retry.retry_call(atomic_write, path, data)
+
+
+def _overwrite_json(path: Path, data: bytes) -> None:
+    """Torn-write-proof overwrite: fsync the payload BEFORE the rename
+    (an unfsynced os.replace can surface as an empty/partial file after
+    power loss — the exact torn `latestStable` the backward scan exists
+    to survive) and fsync the parent dir after, so the commit itself is
+    durable."""
+    fault_point("file.write_json", path)
+    fd, tmp = tempfile.mkstemp(dir=path.parent, prefix=".tmp-")
+    try:
         with os.fdopen(fd, "wb") as f:
             f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)
-        return True
-    return atomic_write(path, data)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    fsync_dir(path.parent)
 
 
 def read_json(path: str | os.PathLike) -> Any:

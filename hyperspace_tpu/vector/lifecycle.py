@@ -171,6 +171,7 @@ class VectorOptimizeAction(Action):
         self.data_manager = data_manager
         self.kmeans_iters = kmeans_iters
         self.seed = seed
+        self._version: int | None = None
         self.previous_entry = log_manager.get_latest_log()
         if self.previous_entry is None:
             raise HyperspaceError("no index to optimize")
@@ -187,8 +188,18 @@ class VectorOptimizeAction(Action):
 
     @property
     def _version_id(self) -> int:
-        latest = self.data_manager.get_latest_version_id()
-        return 0 if latest is None else latest + 1
+        # Memoized (see actions/create.py): entry, dest, and failure
+        # cleanup must agree on one version once op() starts writing.
+        if self._version is None:
+            latest = self.data_manager.get_latest_version_id()
+            self._version = 0 if latest is None else latest + 1
+        return self._version
+
+    def cleanup_failed_op(self) -> None:
+        try:
+            self.data_manager.quarantine(self._version_id)
+        except Exception:
+            pass
 
     def build_log_entry(self) -> IndexLogEntry:
         entry = dataclasses.replace(self.previous_entry)

@@ -107,15 +107,18 @@ class NoopAction(Action):
 
 def test_run_commits_exact_two_phase_sequence():
     """Empty log: run() must write id 0 transient, id 1 final, then swap
-    latestStable to 1 — the exact ActionTest.scala:139-166 sequence."""
+    latestStable to 1 — the ActionTest.scala:139-166 sequence, minus the
+    reference's delete-then-recreate of the pointer: the pointer is
+    atomically REPLACED (never deleted first), so a concurrent reader can
+    never catch a window with no pointer and fall into the backward scan."""
     lm = FakeLogManager()
     NoopAction(lm).run()
     assert lm.calls == [
         ("write_log", 0, states.CREATING),
         ("write_log", 1, states.ACTIVE),
-        ("delete_latest_stable",),
         ("create_latest_stable", 1),
     ]
+    assert ("delete_latest_stable",) not in lm.calls
 
 
 def test_run_on_existing_log_advances_base_id_by_two():
@@ -139,15 +142,98 @@ def test_losing_cas_aborts_with_no_final_write():
     assert lm.stable_id is None
 
 
-def test_op_failure_leaves_transient_state_no_stable_swap():
+def test_cas_contention_retry_rereads_log_and_commits():
+    """With hyperspace.retry.casAttempts > 1, a begin() that loses its
+    CAS re-reads the log (fresh base_id) and retries the whole protocol
+    instead of aborting — the committed ids sit ABOVE the winner's."""
+    from hyperspace_tpu.utils import retry
+
+    class ContendedLM(FakeLogManager):
+        """The concurrent winner's entry materializes exactly when our
+        CAS for id 0 fails — as a real race would leave the log."""
+
+        def write_log(self, id, entry):
+            if id == 0 and 0 not in self.logs:
+                self.calls.append(("write_log", id, entry.state))
+                self.logs[0] = _entry(states.ACTIVE)
+                self.stable_id = 0
+                return False
+            return super().write_log(id, entry)
+
+    lm = ContendedLM()
+    retry.configure(cas_attempts=2)
+    try:
+        NoopAction(lm).run()
+    finally:
+        retry.configure(cas_attempts=1)
+    assert [c for c in lm.calls if c[0] == "write_log"] == [
+        ("write_log", 0, states.CREATING),  # lost to the winner
+        ("write_log", 1, states.CREATING),  # re-read, retried above it
+        ("write_log", 2, states.ACTIVE),
+    ]
+    assert lm.stable_id == 2
+
+
+def test_op_failure_rolls_back_to_stable_and_cleans_up():
+    """A software failure in op() must not leave the log transient: run()
+    rolls the log back to the last stable state (DOESNOTEXIST when there
+    is none), repoints latestStable, and calls the cleanup hook — the
+    original exception still surfaces."""
+    cleaned = []
+
     class ExplodingAction(NoopAction):
         def op(self):
-            raise RuntimeError("mid-flight crash")
+            raise RuntimeError("mid-flight failure")
+
+        def cleanup_failed_op(self):
+            cleaned.append(True)
 
     lm = FakeLogManager()
+    with pytest.raises(RuntimeError, match="mid-flight failure"):
+        ExplodingAction(lm).run()
+    assert lm.calls == [
+        ("write_log", 0, states.CREATING),
+        ("write_log", 1, states.DOESNOTEXIST),
+        ("create_latest_stable", 1),
+    ]
+    assert lm.get_latest_log().state == states.DOESNOTEXIST
+    assert lm.get_latest_stable_log().state == states.DOESNOTEXIST
+    assert cleaned == [True]
+
+
+def test_op_failure_with_prior_stable_restores_it():
+    """With an ACTIVE entry in the log, a failed op() rolls back to
+    ACTIVE — readers keep resolving the pre-action index."""
+    class ExplodingAction(NoopAction):
+        transient_state = states.REFRESHING
+
+        def op(self):
+            raise RuntimeError("mid-flight failure")
+
+    lm = FakeLogManager(latest=_entry(states.ACTIVE))
     with pytest.raises(RuntimeError):
         ExplodingAction(lm).run()
-    # Transient entry committed, final never written, stable untouched.
+    assert [c for c in lm.calls if c[0] == "write_log"] == [
+        ("write_log", 1, states.REFRESHING),
+        ("write_log", 2, states.ACTIVE),
+    ]
+    assert lm.get_latest_log().state == states.ACTIVE
+    assert lm.stable_id == 2
+
+
+def test_simulated_crash_leaves_transient_state_for_recover():
+    """A hard crash (CrashPoint is a BaseException) must NOT trigger the
+    in-process rollback — the dying writer gets no cleanup, and the log
+    stays transient for recover() to repair from the next process."""
+    from hyperspace_tpu.faults import CrashPoint
+
+    class DyingAction(NoopAction):
+        def op(self):
+            raise CrashPoint("test.point")
+
+    lm = FakeLogManager()
+    with pytest.raises(CrashPoint):
+        DyingAction(lm).run()
     assert lm.calls == [("write_log", 0, states.CREATING)]
     assert lm.get_latest_log().state == states.CREATING
 

@@ -187,6 +187,45 @@ class TestLintStaticArgsAndRandomness:
         assert rules_of("import random\nv = random.random()\n") == ["HSL005"]
 
 
+class TestMetadataWriteBypass:
+    """HSL006: bare writes to metadata-plane paths (the operation log,
+    latestStable, the index manifest, version dirs) are torn writes
+    waiting for a crash — only file_utils.py may open them for writing."""
+
+    def test_manifest_write_text_flagged(self):
+        # The exact seed bug shape (execution/io.py write_manifest).
+        src = "(dest_dir / MANIFEST_NAME).write_text(json.dumps(m))\n"
+        assert rules_of(src) == ["HSL006"]
+
+    def test_log_dir_open_write_flagged(self):
+        src = "f = open(self.log_dir / str(id), 'w')\n"
+        assert rules_of(src) == ["HSL006"]
+
+    def test_latest_stable_write_bytes_flagged(self):
+        src = "(log_dir / LATEST_STABLE_LOG_NAME).write_bytes(data)\n"
+        assert rules_of(src) == ["HSL006"]
+
+    def test_version_dir_write_flagged(self):
+        src = "(root / 'v__=0' / name).write_text(payload)\n"
+        assert rules_of(src) == ["HSL006"]
+
+    def test_unrelated_write_text_clean(self):
+        assert rules_of("report_path.write_text(text)\n") == []
+
+    def test_read_mode_open_clean(self):
+        assert rules_of("open(self.log_dir / str(id)).read()\n") == []
+
+    def test_file_utils_is_sanctioned(self):
+        src = "open(log_dir / 'latestStable', 'w').write(data)\n"
+        from hyperspace_tpu.analysis.lint import lint_source
+
+        assert lint_source(src, "hyperspace_tpu/utils/file_utils.py") == []
+
+    def test_noqa_suppresses(self):
+        src = "(dest_dir / MANIFEST_NAME).write_text(m)  # noqa: HSL006\n"
+        assert rules_of(src) == []
+
+
 class TestLintCli:
     def test_repo_package_is_clean(self):
         # The permanent guarantee behind the compat satellite: the whole

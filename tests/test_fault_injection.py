@@ -1,0 +1,527 @@
+"""Crash-consistency and fault-tolerance tests (docs/fault_tolerance.md).
+
+The core property, swept mechanically: for EVERY fault point an action
+passes through (discovered per action with `faults.recording()`), a hard
+crash injected at that point must leave the index either fully present
+or cleanly absent — `get_latest_stable_log()` still resolves,
+`recover()` converges to a stable log with no orphan version dirs, and a
+subsequent query answers correctly (through the index when it survived,
+through the source otherwise). Plus: transparent retry of transient IO,
+typed corruption errors, query-plane fallback on a truncated bucket
+file, in-process rollback of failed op()s, and lazy recover-on-access.
+"""
+
+from pathlib import Path
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col, faults, states, stats
+from hyperspace_tpu.config import (
+    HYPERSPACE_LOG_DIR,
+    RECOVER_GRACE_SECONDS,
+    DATA_VERSION_PREFIX,
+)
+from hyperspace_tpu.exceptions import IndexCorruptionError, is_retryable
+from hyperspace_tpu.faults import CrashPoint, FaultError
+from hyperspace_tpu.metadata.data_manager import IndexDataManager
+from hyperspace_tpu.metadata.log_manager import IndexLogManager
+from hyperspace_tpu.utils import retry
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """Every test starts and ends with the harness disarmed and a fast
+    retry schedule (no real sleeping)."""
+    import time
+
+    faults.reset()
+    retry.configure(max_attempts=3, backoff_base=0.0, sleeper=lambda s: None)
+    yield
+    faults.reset()
+    retry.configure(max_attempts=3, backoff_base=0.005, sleeper=time.sleep)
+
+
+def _write_source(root: Path, n: int = 60) -> str:
+    rng = np.random.default_rng(7)
+    table = pa.table(
+        {
+            "id": pa.array(np.arange(n, dtype=np.int64)),
+            "key": pa.array((np.arange(n, dtype=np.int64) * 13) % 10),
+            "value": pa.array(rng.standard_normal(n)),
+        }
+    )
+    root.mkdir(parents=True, exist_ok=True)
+    pq.write_table(table.slice(0, n // 2), root / "part-0.parquet")
+    pq.write_table(table.slice(n // 2), root / "part-1.parquet")
+    return str(root)
+
+
+def _expected(source: str) -> pd.DataFrame:
+    import pyarrow.dataset as pads
+
+    df = pads.dataset(source, format="parquet").to_table().to_pandas()
+    return df[df["key"] == 7][["key", "value"]]
+
+
+def _query_matches(session, source: str) -> None:
+    """The canonical correctness probe: filter on the indexed column,
+    compare row-identically against pandas over the raw source."""
+    q = session.parquet(source).filter(col("key") == 7).select("key", "value")
+    got = session.to_pandas(q)
+    exp = _expected(source)
+    cols = ["key", "value"]
+    pd.testing.assert_frame_equal(
+        got[cols].sort_values(cols).reset_index(drop=True),
+        exp[cols].sort_values(cols).reset_index(drop=True),
+        check_dtype=False,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Fault-injection harness unit behavior
+# ---------------------------------------------------------------------------
+
+
+class TestFaultHarness:
+    def test_disabled_harness_is_inert(self):
+        faults.fault_point("log.write", "/nope")  # disarmed: must not raise
+
+    def test_unknown_point_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault point"):
+            faults.inject("not.a.point")
+
+    def test_default_rule_raises_transient_fault_error(self):
+        with faults.injected("log.write"):
+            with pytest.raises(FaultError) as ei:
+                faults.fault_point("log.write")
+            assert is_retryable(ei.value)
+
+    def test_fail_at_call_k(self):
+        with faults.injected("bucket.read", at_call=3):
+            faults.fault_point("bucket.read")
+            faults.fault_point("bucket.read")
+            with pytest.raises(FaultError):
+                faults.fault_point("bucket.read")
+            faults.fault_point("bucket.read")  # call 4: clean again
+
+    def test_fail_n_then_succeed(self):
+        with faults.injected("bucket.read", times=2):
+            for _ in range(2):
+                with pytest.raises(FaultError):
+                    faults.fault_point("bucket.read")
+            faults.fault_point("bucket.read")  # budget spent
+
+    def test_truncate_schedule_mangles_file(self, tmp_path):
+        p = tmp_path / "f"
+        p.write_bytes(b"x" * 100)
+        with faults.injected("bucket.written", truncate=10):
+            faults.fault_point("bucket.written", p)
+        assert p.stat().st_size == 10
+
+    def test_kill_switch_disarms_registered_rules(self):
+        faults.inject("log.write", crash=True)
+        faults.set_enabled(False)
+        try:
+            faults.fault_point("log.write")  # inert despite the rule
+        finally:
+            faults.set_enabled(True)
+            faults.reset()
+
+    def test_crash_point_is_base_exception(self):
+        assert not isinstance(CrashPoint("p"), Exception)
+
+    def test_recording_observes_points(self):
+        with faults.recording() as seen:
+            faults.fault_point("log.write")
+            faults.fault_point("manifest.read")
+        assert {"log.write", "manifest.read"} <= seen
+
+
+# ---------------------------------------------------------------------------
+# Retry / backoff
+# ---------------------------------------------------------------------------
+
+
+class TestRetry:
+    def test_transient_errors_retry_then_succeed(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise FaultError("transient")
+            return "ok"
+
+        assert retry.retry_call(flaky) == "ok"
+        assert len(calls) == 3
+
+    def test_non_retryable_surfaces_immediately(self):
+        calls = []
+
+        def missing():
+            calls.append(1)
+            raise FileNotFoundError("gone")
+
+        with pytest.raises(FileNotFoundError):
+            retry.retry_call(missing)
+        assert len(calls) == 1
+
+    def test_exhaustion_reraises_last_error(self):
+        def always():
+            raise FaultError("still down")
+
+        with pytest.raises(FaultError):
+            retry.retry_call(always, policy=retry.RetryPolicy(max_attempts=2))
+
+    def test_backoff_schedule_is_deterministic(self):
+        p = retry.RetryPolicy(backoff_base=0.01, backoff_multiplier=2.0, backoff_max=0.05)
+        assert [p.delay(a) for a in range(4)] == [0.01, 0.02, 0.04, 0.05]
+
+    def test_sleeper_receives_backoff(self):
+        slept = []
+        retry.configure(sleeper=slept.append, backoff_base=0.01)
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise FaultError("x")
+
+        retry.retry_call(flaky, policy=retry.RetryPolicy(backoff_base=0.01))
+        assert slept == [0.01, 0.02]
+
+    def test_create_index_survives_transient_log_write_faults(self, tmp_path):
+        """fail-2-then-succeed on the log entry CAS write: the retry
+        layer absorbs it and the create commits normally."""
+        source = _write_source(tmp_path / "src")
+        session = HyperspaceSession(system_path=str(tmp_path / "sys"), num_buckets=2)
+        hs = Hyperspace(session)
+        before = stats.get("retry.attempts")
+        faults.inject("file.atomic_write", times=2)
+        hs.create_index(session.parquet(source), IndexConfig("ridx", ["key"], ["value"]))
+        faults.reset()
+        lm = IndexLogManager(Path(tmp_path / "sys") / "ridx")
+        assert lm.get_latest_log().state == states.ACTIVE
+        assert stats.get("retry.attempts") - before >= 2
+
+
+# ---------------------------------------------------------------------------
+# Typed corruption + manifest atomicity
+# ---------------------------------------------------------------------------
+
+
+class TestCorruptionDetection:
+    def test_garbage_manifest_raises_typed_error(self, tmp_path):
+        from hyperspace_tpu.execution import io as hio
+
+        vdir = tmp_path / "idx" / "v__=0"
+        vdir.mkdir(parents=True)
+        (vdir / hio.MANIFEST_NAME).write_text('{"numBuckets": 2, "bucketRo')
+        with pytest.raises(IndexCorruptionError) as ei:
+            hio.read_manifest(vdir)
+        assert ei.value.index_root == str(tmp_path / "idx")
+
+    def test_absent_manifest_is_none_not_error(self, tmp_path):
+        from hyperspace_tpu.execution import io as hio
+
+        vdir = tmp_path / "empty"
+        vdir.mkdir()
+        assert hio.read_manifest(vdir) is None
+
+    def test_crash_during_manifest_write_never_tears_it(self, tmp_path):
+        """write_manifest goes through the atomic temp+replace path: a
+        crash mid-write leaves either no manifest or the previous one —
+        never a parse error."""
+        from hyperspace_tpu.execution import io as hio
+
+        vdir = tmp_path / "v__=0"
+        faults.inject("file.write_json", crash=True)
+        with pytest.raises(CrashPoint):
+            hio.write_manifest(vdir, 2, ["key"], [3, 4])
+        faults.reset()
+        assert hio.read_manifest(vdir) is None  # absent, not torn
+
+    def test_torn_log_entry_still_resolves_stable(self, tmp_path):
+        """A truncated trailing log entry must not break reads: the
+        backward scan skips it, and recover() quarantines it."""
+        source = _write_source(tmp_path / "src")
+        session = HyperspaceSession(system_path=str(tmp_path / "sys"), num_buckets=2)
+        hs = Hyperspace(session)
+        hs.create_index(session.parquet(source), IndexConfig("tidx", ["key"], ["value"]))
+        index_path = Path(tmp_path / "sys") / "tidx"
+        lm = IndexLogManager(index_path)
+        # Torn write of a would-be entry 2: half a JSON object.
+        (index_path / HYPERSPACE_LOG_DIR / "2").write_text('{"id": 2, "state": "REFR')
+        stable = lm.get_latest_stable_log()
+        assert stable is not None and stable.state == states.ACTIVE
+        report = hs.recover("tidx")
+        assert report["quarantined_entries"] == 1
+        assert lm.get_latest_id() == 1
+        assert lm.get_latest_log().state == states.ACTIVE
+
+
+# ---------------------------------------------------------------------------
+# Graceful degradation: corrupt bucket file → source-scan fallback
+# ---------------------------------------------------------------------------
+
+
+class TestCorruptionFallback:
+    def test_truncated_bucket_degrades_to_source_scan(self, tmp_path):
+        source = _write_source(tmp_path / "src")
+        session = HyperspaceSession(system_path=str(tmp_path / "sys"), num_buckets=2)
+        hs = Hyperspace(session)
+        df = session.parquet(source)
+        hs.create_index(df, IndexConfig("cidx", ["key"], ["value"]))
+        session.enable_hyperspace()
+        _query_matches(session, source)  # index path works when healthy
+
+        # Truncate EVERY bucket file (whichever bucket the predicate
+        # prunes to, the read fails) and drop the decoded-table cache so
+        # the corruption is actually read.
+        from hyperspace_tpu.execution import io as hio
+
+        vdir = Path(tmp_path / "sys") / "cidx" / f"{DATA_VERSION_PREFIX}0"
+        for f in sorted(vdir.glob("bucket-*.parquet")):
+            with open(f, "r+b") as fh:
+                fh.truncate(7)
+        hio.clear_table_cache()
+
+        before = stats.get("fallback.queries")
+        _query_matches(session, source)  # answers via source fallback
+        assert stats.get("fallback.queries") > before
+        assert session.index_health, "corrupt index not quarantined"
+        assert session.last_query_stats.get("degraded_indexes")
+        # Sticky: the next query plans straight past the broken index.
+        _query_matches(session, source)
+
+    def test_fallback_disabled_surfaces_typed_error(self, tmp_path):
+        source = _write_source(tmp_path / "src")
+        session = HyperspaceSession(system_path=str(tmp_path / "sys"), num_buckets=2)
+        hs = Hyperspace(session)
+        df = session.parquet(source)
+        hs.create_index(df, IndexConfig("cidx2", ["key"], ["value"]))
+        session.enable_hyperspace()
+        session.conf.set("hyperspace.fallback.enabled", False)
+        from hyperspace_tpu.execution import io as hio
+
+        vdir = Path(tmp_path / "sys") / "cidx2" / f"{DATA_VERSION_PREFIX}0"
+        for f in sorted(vdir.glob("bucket-*.parquet")):
+            with open(f, "r+b") as fh:
+                fh.truncate(7)
+        hio.clear_table_cache()
+        q = session.parquet(source).filter(col("key") == 7).select("key", "value")
+        with pytest.raises(IndexCorruptionError):
+            session.run(q)
+
+
+# ---------------------------------------------------------------------------
+# In-process rollback of a failed op()
+# ---------------------------------------------------------------------------
+
+
+class TestOpFailureRollback:
+    def test_failed_build_rolls_back_and_quarantines(self, tmp_path):
+        from hyperspace_tpu.actions.create import CreateAction
+        from hyperspace_tpu.config import HyperspaceConf
+
+        source = _write_source(tmp_path / "src")
+        conf = HyperspaceConf(system_path=str(tmp_path / "sys"), num_buckets=2)
+        index_path = Path(tmp_path / "sys") / "bidx"
+        lm, dm = IndexLogManager(index_path), IndexDataManager(index_path)
+
+        class PartialWriter:
+            def write(self, plan, columns, indexed_columns, num_buckets, dest_path):
+                Path(dest_path).mkdir(parents=True, exist_ok=True)
+                (Path(dest_path) / "bucket-00000.parquet").write_bytes(b"partial")
+                raise ValueError("builder blew up mid-carve")
+
+        from hyperspace_tpu.dataset import Dataset
+
+        plan = Dataset.parquet(source).scan()
+        cfg = IndexConfig("bidx", ["key"], ["value"])
+        with pytest.raises(ValueError, match="mid-carve"):
+            CreateAction(plan, cfg, lm, dm, index_path, conf, PartialWriter()).run()
+        # Log rolled back to a stable state; pointer resolves.
+        assert lm.get_latest_log().state == states.DOESNOTEXIST
+        assert lm.get_latest_stable_log().state == states.DOESNOTEXIST
+        # Partial version dir quarantined, version id reusable.
+        assert dm.get_version_ids() == []
+        assert list(index_path.glob(".quarantine-*")), "partial dir not quarantined"
+
+
+# ---------------------------------------------------------------------------
+# recover(): explicit and lazy
+# ---------------------------------------------------------------------------
+
+
+def _make_index(tmp_path, name="idx1"):
+    source = _write_source(tmp_path / "src")
+    session = HyperspaceSession(system_path=str(tmp_path / "sys"), num_buckets=2)
+    hs = Hyperspace(session)
+    hs.create_index(session.parquet(source), IndexConfig(name, ["key"], ["value"]))
+    return source, session, hs, Path(tmp_path / "sys") / name
+
+
+class TestRecover:
+    def test_recover_rolls_crashed_refresh_and_gcs_orphan(self, tmp_path):
+        source, session, hs, index_path = _make_index(tmp_path)
+        lm, dm = IndexLogManager(index_path), IndexDataManager(index_path)
+        # Fake a refresh that died after begin() + a partial v__=1.
+        dead = lm.get_latest_log().with_state(states.REFRESHING)
+        assert lm.write_log(2, dead)
+        orphan = index_path / f"{DATA_VERSION_PREFIX}1"
+        orphan.mkdir()
+        (orphan / "bucket-00000.parquet").write_bytes(b"junk")
+
+        report = hs.recover("idx1")
+        assert report["rolled"] and report["orphans_removed"] == 1
+        latest = lm.get_latest_log()
+        assert latest.state == states.ACTIVE
+        assert dm.get_version_ids() == [0]
+        assert lm.get_latest_stable_log().id == latest.id
+        # Idempotent.
+        again = hs.recover("idx1")
+        assert not again["rolled"] and again["orphans_removed"] == 0
+        # The index still serves queries.
+        session.enable_hyperspace()
+        _query_matches(session, source)
+
+    def test_lazy_recover_on_first_access(self, tmp_path):
+        source, session, hs, index_path = _make_index(tmp_path, "lazy1")
+        lm = IndexLogManager(index_path)
+        dead = lm.get_latest_log().with_state(states.REFRESHING)
+        assert lm.write_log(2, dead)
+        # Fresh session (fresh cache); grace 0 so staleness is immediate.
+        s2 = HyperspaceSession(system_path=str(Path(tmp_path) / "sys"), num_buckets=2)
+        s2.conf.set(RECOVER_GRACE_SECONDS, 0)
+        entries = s2.manager.get_indexes()
+        assert [e.state for e in entries] == [states.ACTIVE]
+        assert lm.get_latest_log().state == states.ACTIVE  # healed on disk
+
+    def test_lazy_recover_respects_grace_for_live_writers(self, tmp_path):
+        source, session, hs, index_path = _make_index(tmp_path, "lazy2")
+        lm = IndexLogManager(index_path)
+        dead = lm.get_latest_log().with_state(states.REFRESHING)  # fresh timestamp
+        assert lm.write_log(2, dead)
+        s2 = HyperspaceSession(system_path=str(Path(tmp_path) / "sys"), num_buckets=2)
+        # Default grace (300s): a just-written transient entry could be a
+        # LIVE writer — listing must not cancel it.
+        s2.manager.get_indexes()
+        assert lm.get_latest_log().state == states.REFRESHING
+
+
+# ---------------------------------------------------------------------------
+# THE SWEEP: a crash at every fault point of every action
+# ---------------------------------------------------------------------------
+
+ACTIONS = ("create", "refresh", "optimize", "vacuum")
+
+
+def _setup(tmp_path, action):
+    """Fresh source + session; for non-create actions, a healthy ACTIVE
+    index (and DELETED for vacuum) built with the harness disarmed."""
+    source = _write_source(tmp_path / "src")
+    session = HyperspaceSession(system_path=str(tmp_path / "sys"), num_buckets=2)
+    hs = Hyperspace(session)
+    if action != "create":
+        hs.create_index(session.parquet(source), IndexConfig("idx1", ["key"], ["value"]))
+    if action == "vacuum":
+        hs.delete_index("idx1")
+    return source, session, hs
+
+
+def _drive(hs, session, source, action):
+    if action == "create":
+        hs.create_index(session.parquet(source), IndexConfig("idx1", ["key"], ["value"]))
+    elif action == "refresh":
+        hs.refresh_index("idx1")
+    elif action == "optimize":
+        hs.optimize_index("idx1")
+    elif action == "vacuum":
+        hs.vacuum_index("idx1")
+
+
+def _assert_crash_consistent(tmp_path, source, action, point):
+    """Post-crash invariants + recovery convergence + query correctness."""
+    ctx = f"action={action} point={point}"
+    index_path = Path(tmp_path / "sys") / "idx1"
+    lm = IndexLogManager(index_path)
+    dm = IndexDataManager(index_path)
+    # 1. The last stable state still resolves (no exception), crash or not.
+    lm.get_latest_stable_log()
+    # 2. recover() converges: stable latest entry, refreshed pointer,
+    #    no orphan version dirs.
+    s2 = HyperspaceSession(system_path=str(Path(tmp_path) / "sys"), num_buckets=2)
+    hs2 = Hyperspace(s2)
+    hs2.recover("idx1")
+    latest = lm.get_latest_log()
+    if latest is not None:
+        assert latest.state in states.STABLE_STATES, ctx
+        stable = lm.get_latest_stable_log()
+        assert stable is not None and stable.id == latest.id, ctx
+        referenced = (
+            set(stable.content.directories)
+            if stable.state != states.DOESNOTEXIST and stable.content is not None
+            else set()
+        )
+        on_disk = {f"{DATA_VERSION_PREFIX}{v}" for v in dm.get_version_ids()}
+        assert on_disk <= referenced, f"{ctx}: orphan version dirs {on_disk - referenced}"
+        # Index-is-never-half: if the log says ACTIVE, the data it points
+        # to is complete enough to answer queries (checked below).
+    # 3. recover is idempotent.
+    again = hs2.recover("idx1")
+    assert not again["rolled"] and again["orphans_removed"] == 0, ctx
+    # 4. Queries answer correctly — via the index when it survived, via
+    #    the source (or fallback) otherwise.
+    s2.enable_hyperspace()
+    _query_matches(s2, source)
+
+
+@pytest.mark.parametrize("action", ACTIONS)
+def test_crash_sweep_every_fault_point(tmp_path_factory, action):
+    """For each fault point the action passes through, replay the action
+    from scratch with a hard crash at that point's first firing, then
+    require full crash consistency (see _assert_crash_consistent)."""
+    # Discovery pass: which points does this action exercise?
+    base = tmp_path_factory.mktemp(f"disc-{action}")
+    source, session, hs = _setup(base, action)
+    with faults.recording() as seen:
+        _drive(hs, session, source, action)
+    points = sorted(seen)
+    assert points, f"no fault points observed for {action}"
+
+    crashed_at = []
+    for point in points:
+        tmp = tmp_path_factory.mktemp(f"sweep-{action}")
+        source, session, hs = _setup(tmp, action)
+        faults.inject(point, crash=True, at_call=1)
+        try:
+            _drive(hs, session, source, action)
+        except CrashPoint:
+            crashed_at.append(point)
+        finally:
+            faults.reset()
+        _assert_crash_consistent(tmp, source, action, point)
+    # The sweep only proves something if crashes actually fired.
+    assert crashed_at, f"no crash fired for {action} across {points}"
+
+
+def test_crash_sweep_mid_schedule_calls(tmp_path_factory):
+    """Crashes at LATER calls of high-frequency points (the 2nd bucket
+    write, the 2nd log write) — the first-firing sweep above can miss
+    states only reachable mid-sequence."""
+    for point, k in (("log.write", 2), ("bucket.written", 2), ("file.write_json", 2)):
+        tmp = tmp_path_factory.mktemp("sweepk")
+        source, session, hs = _setup(tmp, "create")
+        faults.inject(point, crash=True, at_call=k)
+        try:
+            _drive(hs, session, source, "create")
+        except CrashPoint:
+            pass
+        finally:
+            faults.reset()
+        _assert_crash_consistent(tmp, source, "create", f"{point}@{k}")
