@@ -61,9 +61,11 @@ def __getattr__(name):
         from hyperspace_tpu.vector.index import VectorIndexConfig
 
         return VectorIndexConfig
-    if name in ("stats", "faults"):
-        # Fault-tolerance observability (stats.snapshot()) and the
-        # deterministic fault-injection harness (docs/fault_tolerance.md).
+    if name in ("stats", "faults", "obs"):
+        # Fault-tolerance counters (stats.snapshot()), the deterministic
+        # fault-injection harness (docs/fault_tolerance.md), and the
+        # observability plane — tracer/metrics/profiles
+        # (docs/observability.md).
         import importlib
 
         return importlib.import_module(f"hyperspace_tpu.{name}")

@@ -36,6 +36,7 @@ from hyperspace_tpu import states
 from hyperspace_tpu.exceptions import HyperspaceError
 from hyperspace_tpu.metadata.log_entry import IndexLogEntry
 from hyperspace_tpu.metadata.log_manager import IndexLogManager
+from hyperspace_tpu.obs import trace as obs_trace
 from hyperspace_tpu.utils import retry
 
 
@@ -109,37 +110,47 @@ class Action:
         the log and retries — useful for workloads where independent
         writers race on DIFFERENT indexes through a shared log id space.
         """
-        attempts = retry.cas_attempts()
-        for attempt in range(attempts):
-            self.validate()
+        # A root trace when called bare (create/refresh/... from user
+        # code), a child span when the session is already tracing. Spans
+        # close on BaseException too, so a simulated crash (CrashPoint)
+        # still records which phase died before propagating.
+        with obs_trace.trace(f"action.{type(self).__name__}"):
+            attempts = retry.cas_attempts()
+            for attempt in range(attempts):
+                with obs_trace.span("action.validate"):
+                    self.validate()
+                try:
+                    with obs_trace.span("action.begin", attempt=attempt + 1):
+                        self.begin()
+                except HyperspaceError:
+                    if attempt + 1 >= attempts:
+                        raise
+                    # Concurrent writer won this id: re-read the world and
+                    # re-validate from scratch.
+                    self._base_id = None
+                    self._log_entry = None
+                    continue
+                break
             try:
-                self.begin()
+                with obs_trace.span("action.op"):
+                    self.op()
+            except Exception:
+                # Software failure mid-op (NOT a crash: CrashPoint is a
+                # BaseException and skips this handler by design). Roll the
+                # log back to the last stable state and quarantine partial
+                # data, then surface the original error.
+                with obs_trace.span("action.rollback"):
+                    self._rollback_failed_op()
+                raise
+            try:
+                with obs_trace.span("action.end"):
+                    self.end()
             except HyperspaceError:
-                if attempt + 1 >= attempts:
-                    raise
-                # Concurrent writer won this id: re-read the world and
-                # re-validate from scratch.
-                self._base_id = None
-                self._log_entry = None
-                continue
-            break
-        try:
-            self.op()
-        except Exception:
-            # Software failure mid-op (NOT a crash: CrashPoint is a
-            # BaseException and skips this handler by design). Roll the
-            # log back to the last stable state and quarantine partial
-            # data, then surface the original error.
-            self._rollback_failed_op()
-            raise
-        try:
-            self.end()
-        except HyperspaceError:
-            # Lost the final CAS: a concurrent writer committed over us
-            # while op() ran. The winner's entry stands — only our
-            # partial data needs quarantining.
-            self.cleanup_failed_op()
-            raise
+                # Lost the final CAS: a concurrent writer committed over us
+                # while op() ran. The winner's entry stands — only our
+                # partial data needs quarantining.
+                self.cleanup_failed_op()
+                raise
 
     def _rollback_failed_op(self) -> None:
         """Best-effort in-process recovery for a failed op(): CAS-write a

@@ -32,6 +32,17 @@ Exit status is non-zero iff any finding is reported — the CI gate. Rules:
   ``np.random.default_rng()`` with no seed. Unseeded randomness makes
   device results irreproducible across runs and shards; pass an explicit
   seed (``np.random.default_rng(0)``) or thread ``jax.random`` keys.
+- **HSL007 wallclock-duration / undeclared-counter** — two observability
+  hazards (docs/observability.md): (a) ``time.time()`` appearing in a
+  subtraction — wall clock steps under NTP, so durations and TTL ages
+  must use ``time.monotonic()``/``time.perf_counter()`` (persisted
+  cross-process stamps are the legitimate exception; mark them
+  ``# noqa: HSL007`` with a comment saying why); (b) ``stats.increment``
+  with a constant counter name not declared in
+  ``stats.KNOWN_COUNTERS`` — a typo'd name would raise at runtime (the
+  declared-registry contract); the linter catches it before then. The
+  declared set is read by parsing ``hyperspace_tpu/stats.py``'s AST, so
+  the rule works in dependency-free CI.
 - **HSL006 metadata-write-bypass** — bare ``.write_text()`` /
   ``.write_bytes()`` / write-mode ``open()`` on metadata-plane paths
   (``_hyperspace_log`` entries, the ``latestStable`` pointer, the index
@@ -61,6 +72,7 @@ TRACED_FLOW = "HSL003"
 UNHASHABLE_STATIC = "HSL004"
 UNSEEDED_RNG = "HSL005"
 METADATA_WRITE = "HSL006"
+WALLCLOCK_OR_UNDECLARED = "HSL007"
 
 # The one module allowed to touch version-fragile jax import paths.
 SANCTIONED_COMPAT = "compat.py"
@@ -83,6 +95,39 @@ _METADATA_PATH_MARKERS = (
     "log_dir",
     "version_dir",
 )
+
+def _declared_counters() -> "frozenset[str] | None":
+    """Counter names declared in hyperspace_tpu/stats.py's
+    KNOWN_COUNTERS tuple, extracted by AST parse (no import — the lint
+    CI job runs without the package's dependencies installed). None when
+    the file can't be located/parsed, which disables the check."""
+    global _DECLARED_CACHE
+    if _DECLARED_CACHE is not ...:
+        return _DECLARED_CACHE
+    _DECLARED_CACHE = None
+    stats_path = pathlib.Path(__file__).resolve().parent.parent / "stats.py"
+    try:
+        tree = ast.parse(stats_path.read_text())
+    except (OSError, SyntaxError):
+        return None
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name) and tgt.id == "KNOWN_COUNTERS":
+                if isinstance(node.value, (ast.Tuple, ast.List)):
+                    names = [
+                        e.value
+                        for e in node.value.elts
+                        if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                    ]
+                    _DECLARED_CACHE = frozenset(names)
+                    return _DECLARED_CACHE
+    return None
+
+
+_DECLARED_CACHE: "frozenset[str] | None | object" = ...
+
 
 _JIT_NAMES = {"jit", "shard_map", "pmap"}
 _HOST_SYNC_ATTRS = {"item", "tolist"}
@@ -315,6 +360,25 @@ class _Linter(ast.NodeVisitor):
         # HSL006: bare writes to metadata-plane paths.
         self._check_metadata_write(node)
 
+        # HSL007(b): stats.increment with an undeclared constant name.
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "increment"
+            and "stats" in _dotted(node.func.value).lower()
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            declared = _declared_counters()
+            if declared is not None and node.args[0].value not in declared:
+                self._report(
+                    node, WALLCLOCK_OR_UNDECLARED,
+                    f"counter {node.args[0].value!r} is not declared in "
+                    f"stats.KNOWN_COUNTERS — undeclared names raise at "
+                    f"runtime (the declared-registry contract); fix the "
+                    f"typo or declare it",
+                )
+
         # HSL002: host sync inside traced code.
         if self._in_jit():
             if isinstance(node.func, ast.Attribute) and node.func.attr in _HOST_SYNC_ATTRS:
@@ -391,6 +455,28 @@ class _Linter(ast.NodeVisitor):
                 "tears it; route through file_utils.write_json/atomic_write "
                 "(temp file + fsync + atomic rename + dir fsync)",
             )
+
+    # -- HSL007(a): wall-clock duration measurement ----------------------------
+
+    def visit_BinOp(self, node: ast.BinOp) -> None:
+        """`time.time() - x` / `x - time.time()` measures a duration (or
+        a TTL age) with a steppable clock: an NTP adjustment makes it
+        negative or wildly large. Durations want time.monotonic() /
+        time.perf_counter(); persisted cross-process stamps are the one
+        legitimate wall-clock use — annotate those `# noqa: HSL007`."""
+        if isinstance(node.op, ast.Sub):
+            for side in (node.left, node.right):
+                if isinstance(side, ast.Call) and _dotted(side.func) == "time.time":
+                    self._report(
+                        node, WALLCLOCK_OR_UNDECLARED,
+                        "time.time() in a subtraction — wall clock steps "
+                        "under NTP; measure durations/TTL ages with "
+                        "time.monotonic() or time.perf_counter() (persisted "
+                        "cross-process stamps may stay wall-clock with a "
+                        "negative-age guard and `# noqa: HSL007`)",
+                    )
+                    break
+        self.generic_visit(node)
 
     # -- HSL003: traced-value control flow ------------------------------------
 
@@ -482,7 +568,7 @@ def lint_paths(paths: list[str]) -> list[Finding]:
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m hyperspace_tpu.analysis.lint",
-        description="Trace-safety / jax-compat linter (rules HSL001-HSL005).",
+        description="Trace-safety / jax-compat / observability linter (rules HSL001-HSL007).",
     )
     ap.add_argument("paths", nargs="+", help="files or directories to lint")
     ap.add_argument(

@@ -65,6 +65,14 @@ ANALYSIS_VALIDATE = "hyperspace.analysis.validate"
 # instead of failing. recover.onAccess makes index listing lazily repair
 # a crashed writer's transient log (after graceSeconds of staleness).
 FAULTS_ENABLED = "hyperspace.faults.enabled"
+# Observability plane (docs/observability.md). obs.enabled gates the
+# tracer: False makes span()/trace() return shared no-op singletons (no
+# allocation on the query hot path); per-query profiles remain available
+# either way (they ride the executed physical plan). obs.sink is a
+# JSON-lines path receiving one event per finished root trace — the
+# export feed (`python -m hyperspace_tpu.obs.export --sink <path>`).
+OBS_ENABLED = "hyperspace.obs.enabled"
+OBS_SINK = "hyperspace.obs.sink"
 RETRY_MAX_ATTEMPTS = "hyperspace.retry.maxAttempts"
 RETRY_BACKOFF_BASE = "hyperspace.retry.backoffBaseSeconds"
 RETRY_CAS_ATTEMPTS = "hyperspace.retry.casAttempts"
@@ -170,6 +178,15 @@ class HyperspaceConf:
             from hyperspace_tpu import faults
 
             faults.set_enabled(_as_bool(value))
+        elif key == OBS_ENABLED:
+            # Process-global like the metrics/sink it feeds (obs/trace.py).
+            from hyperspace_tpu.obs import trace as _obs_trace
+
+            _obs_trace.set_enabled(_as_bool(value))
+        elif key == OBS_SINK:
+            from hyperspace_tpu.obs import trace as _obs_trace
+
+            _obs_trace.configure(sink=str(value) if value else None)
         elif key == RETRY_MAX_ATTEMPTS:
             from hyperspace_tpu.utils import retry
 
@@ -224,4 +241,12 @@ class HyperspaceConf:
             return self.recover_on_access
         if key == RECOVER_GRACE_SECONDS:
             return self.recover_grace_seconds
+        if key == OBS_ENABLED:
+            from hyperspace_tpu.obs import trace as _obs_trace
+
+            return _obs_trace.enabled()
+        if key == OBS_SINK:
+            from hyperspace_tpu.obs import trace as _obs_trace
+
+            return _obs_trace.sink_path()
         return default

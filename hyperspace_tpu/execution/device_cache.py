@@ -26,19 +26,26 @@ import threading
 
 import numpy as np
 
+from hyperspace_tpu.obs import metrics as obs_metrics
+
 
 class RefCache:
     """Identity-keyed LRU memo with a byte budget. Entries hold strong
     references to their base arrays, so id()-based keys stay valid for
-    the lifetime of the entry."""
+    the lifetime of the entry. `name` keys the hit/miss/eviction
+    counters and byte gauge in the exportable metrics registry."""
 
-    def __init__(self, budget_bytes: int):
+    def __init__(self, budget_bytes: int, name: str = "ref_cache"):
         self.budget = int(budget_bytes)
         self._lock = threading.Lock()
         self._entries: dict[tuple, tuple[int, tuple, object]] = {}
         self._bytes = 0
         self.hits = 0
         self.misses = 0
+        self._met_hits = obs_metrics.counter(f"{name}.hits")
+        self._met_misses = obs_metrics.counter(f"{name}.misses")
+        self._met_evictions = obs_metrics.counter(f"{name}.evictions")
+        self._met_bytes = obs_metrics.gauge(f"{name}.bytes", "resident cached bytes")
 
     def get_or_build(self, key: tuple, base_refs: tuple, build):
         """`build() -> (value, nbytes)`; value cached under `key` while
@@ -48,9 +55,12 @@ class RefCache:
             if hit is not None:
                 self._entries[key] = self._entries.pop(key)  # LRU touch
                 self.hits += 1
+                self._met_hits.inc()
                 return hit[2]
             self.misses += 1
+        self._met_misses.inc()
         value, nbytes = build()
+        evicted = 0
         with self._lock:
             if nbytes <= self.budget // 4 and key not in self._entries:
                 self._entries[key] = (nbytes, base_refs, value)
@@ -59,6 +69,10 @@ class RefCache:
                     k = next(iter(self._entries))
                     nb, _, _ = self._entries.pop(k)
                     self._bytes -= nb
+                    evicted += 1
+            self._met_bytes.set(self._bytes)
+        if evicted:
+            self._met_evictions.inc(evicted)
         return value
 
     def clear(self) -> None:
@@ -67,6 +81,7 @@ class RefCache:
             self._bytes = 0
             self.hits = 0
             self.misses = 0
+            self._met_bytes.set(0)
 
     def stats(self) -> dict:
         with self._lock:
@@ -78,8 +93,12 @@ class RefCache:
             }
 
 
-DEVICE_CACHE = RefCache(int(os.environ.get("HYPERSPACE_DEVICE_CACHE_BYTES", 2 << 30)))
-HOST_DERIVED = RefCache(int(os.environ.get("HYPERSPACE_DERIVED_CACHE_BYTES", 1 << 30)))
+DEVICE_CACHE = RefCache(
+    int(os.environ.get("HYPERSPACE_DEVICE_CACHE_BYTES", 2 << 30)), name="device_cache"
+)
+HOST_DERIVED = RefCache(
+    int(os.environ.get("HYPERSPACE_DERIVED_CACHE_BYTES", 1 << 30)), name="host_derived"
+)
 
 
 def is_stable(arr: np.ndarray) -> bool:

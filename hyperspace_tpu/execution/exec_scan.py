@@ -53,7 +53,7 @@ class ScanFilterMixin:
         or garbage parquet — surfaces as a typed IndexCorruptionError so
         the session can quarantine the index and re-plan against the
         source instead of failing the query."""
-        before = hio.table_cache_stats()["miss_files"]
+        before = hio.table_cache_stats()
         try:
             table = hio.read_parquet_cached(files, columns=columns, schema=schema)
         except IndexCorruptionError:
@@ -63,7 +63,9 @@ class ScanFilterMixin:
                 raise
             raise _corruption(e, index_root, files) from e
         finally:
-            self.stats["files_read"] += hio.table_cache_stats()["miss_files"] - before
+            after = hio.table_cache_stats()
+            self.stats["files_read"] += after["miss_files"] - before["miss_files"]
+            self.stats["bytes_scanned"] += after["miss_bytes"] - before["miss_bytes"]
         return table
 
     def _scan(self, scan: Scan, columns: list[str] | None = None) -> ColumnTable:
@@ -80,6 +82,12 @@ class ScanFilterMixin:
             root = scan.root if scan.bucket_spec is not None else None
             return self._cached_read(files, cols, scan.scan_schema, index_root=root)
         self.stats["files_read"] += len(files)
+        import os as _os
+
+        try:
+            self.stats["bytes_scanned"] += sum(_os.path.getsize(f) for f in files)
+        except OSError:
+            pass
         return hio.read_table_files(files, scan.format, columns=cols, schema=scan.scan_schema)
 
     # -- filter (with index bucket pruning) ------------------------------
@@ -279,7 +287,7 @@ class ScanFilterMixin:
         field = schema.field(scan.bucket_spec[1][0])
         if not kept:
             return ColumnTable.empty(schema), True
-        before = hio.table_cache_stats()["miss_files"]
+        before = hio.table_cache_stats()
         try:
             with ThreadPoolExecutor(max_workers=min(8, len(kept))) as pool:
                 tables = list(
@@ -293,7 +301,9 @@ class ScanFilterMixin:
         except (OSError, pa.ArrowException) as e:
             raise _corruption(e, scan.root, kept) from e
         finally:
-            self.stats["files_read"] += hio.table_cache_stats()["miss_files"] - before
+            after = hio.table_cache_stats()
+            self.stats["files_read"] += after["miss_files"] - before["miss_files"]
+            self.stats["bytes_scanned"] += after["miss_bytes"] - before["miss_bytes"]
         parts: list[ColumnTable] = []
         # Float keys can hold NaN VALUES (sorted last by the build); a
         # lower-bound-only slice would include them while the mask drops

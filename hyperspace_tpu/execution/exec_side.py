@@ -400,7 +400,7 @@ class JoinSidesMixin:
                     groups = [g if b in keep else [] for b, g in enumerate(groups)]
                     self.stats["files_pruned"] += pruned
                     self._phys(dpp_files_pruned=pruned)
-        before = hio.table_cache_stats()["miss_files"]
+        before = hio.table_cache_stats()
         empty = ColumnTable.empty(schema)
         with ThreadPoolExecutor(max_workers=8) as pool:
             tables = list(
@@ -431,7 +431,9 @@ class JoinSidesMixin:
             if cut:
                 self.stats["rows_pruned"] += cut
                 self._phys(dpp_rows_pruned=cut)
-        self.stats["files_read"] += hio.table_cache_stats()["miss_files"] - before
+        after = hio.table_cache_stats()
+        self.stats["files_read"] += after["miss_files"] - before["miss_files"]
+        self.stats["bytes_scanned"] += after["miss_bytes"] - before["miss_bytes"]
         counts = np.array([t.num_rows for t in tables], dtype=np.int64)
         base = _concat_side_cached(tables)
         offsets = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)

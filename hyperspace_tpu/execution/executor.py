@@ -23,9 +23,12 @@ exec_agg) over the shared support layer (exec_common).
 from __future__ import annotations
 
 import dataclasses
+import time
 from pathlib import Path
 
 import numpy as np
+
+from hyperspace_tpu.obs import trace as obs_trace
 
 from hyperspace_tpu.exceptions import HyperspaceError
 from hyperspace_tpu.execution import io as hio
@@ -103,6 +106,7 @@ class Executor(
             "files_read": 0,
             "files_pruned": 0,
             "rows_pruned": 0,
+            "bytes_scanned": 0,
             "join_path": None,
             "join_kernel": None,
             "join_devices": 1,
@@ -186,18 +190,34 @@ class Executor(
         else:
             self.physical_plan = node
         files_before = self.stats["files_read"]
-        try:
-            result = self._dispatch(plan)
-        finally:
-            self._cur_phys = parent
-        # Physical file IO attributed to THIS operator = its frame's delta
-        # minus what child frames already claimed.
-        subtree = self.stats["files_read"] - files_before
-        node._subtree_files = subtree
-        own = subtree - sum(getattr(c, "_subtree_files", 0) for c in node.children)
-        if own > 0:
-            node.detail.setdefault("files", own)
-        node.rows_out = result.num_rows
+        bytes_before = self.stats["bytes_scanned"]
+        sp = obs_trace.span(f"execute.{type(plan).__name__}")
+        t0 = time.perf_counter()
+        with sp:
+            try:
+                result = self._dispatch(plan)
+            finally:
+                self._cur_phys = parent
+                # Wall time of this operator's frame (children included);
+                # recorded even on failure so partial profiles stay honest.
+                node.wall_s = time.perf_counter() - t0
+                sp.rename(f"execute.{node.op}")
+            # Physical file IO attributed to THIS operator = its frame's delta
+            # minus what child frames already claimed.
+            subtree = self.stats["files_read"] - files_before
+            node._subtree_files = subtree
+            own = subtree - sum(getattr(c, "_subtree_files", 0) for c in node.children)
+            if own > 0:
+                node.detail.setdefault("files", own)
+            sub_bytes = self.stats["bytes_scanned"] - bytes_before
+            node._subtree_bytes = sub_bytes
+            own_bytes = sub_bytes - sum(getattr(c, "_subtree_bytes", 0) for c in node.children)
+            if own_bytes > 0:
+                node.detail.setdefault("bytes", own_bytes)
+            node.rows_out = result.num_rows
+            sp.set(rows_out=result.num_rows)
+            if own > 0:
+                sp.set(files=own, bytes=own_bytes)
         return result
 
     def _dispatch(self, plan: LogicalPlan) -> ColumnTable:

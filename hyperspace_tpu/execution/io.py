@@ -21,6 +21,8 @@ from hyperspace_tpu import stats
 from hyperspace_tpu.exceptions import HyperspaceError, IndexCorruptionError
 from hyperspace_tpu.execution.table import ColumnTable
 from hyperspace_tpu.faults import fault_point
+from hyperspace_tpu.obs import metrics as obs_metrics
+from hyperspace_tpu.obs import trace as obs_trace
 from hyperspace_tpu.schema import Schema
 from hyperspace_tpu.utils import retry
 from hyperspace_tpu.utils.file_utils import write_json
@@ -39,7 +41,14 @@ _CACHE_BUDGET = 512 << 20
 _cache: "dict[tuple, tuple[tuple, int, ColumnTable]]" = {}
 _cache_bytes = 0
 _cache_lock = threading.Lock()
-_cache_stats = {"hits": 0, "misses": 0, "miss_files": 0}
+_cache_stats = {"hits": 0, "misses": 0, "miss_files": 0, "miss_bytes": 0}
+
+# Process-lifetime mirrors of the per-process cache dict above, in the
+# exportable registry (obs/export.py renders them).
+_MET_HITS = obs_metrics.counter("table_cache.hits", "decoded-table cache hits")
+_MET_MISSES = obs_metrics.counter("table_cache.misses", "decoded-table cache misses")
+_MET_BYTES = obs_metrics.counter("io.bytes_scanned", "bytes physically read (cache misses)")
+_MET_FILES = obs_metrics.counter("io.files_read", "files physically read (cache misses)")
 
 
 def set_table_cache_budget(nbytes: int) -> None:
@@ -96,18 +105,25 @@ def read_parquet_cached(files: list[str], columns: list[str] | None = None, sche
 
     key = (tuple(files), tuple(columns) if columns is not None else None)
     try:
-        mtimes = tuple(os.stat(f).st_mtime_ns for f in files)
+        stats_ = [os.stat(f) for f in files]
     except OSError:
         return read_parquet(files, columns=columns, schema=schema)
+    mtimes = tuple(s.st_mtime_ns for s in stats_)
     with _cache_lock:
         hit = _cache.get(key)
         if hit is not None and hit[0] == mtimes:
             # Re-insert for LRU recency (dict preserves insertion order).
             _cache[key] = _cache.pop(key)
             _cache_stats["hits"] += 1
+            _MET_HITS.inc()
             return hit[2]
         _cache_stats["misses"] += 1
         _cache_stats["miss_files"] += len(files)
+        disk_bytes = sum(s.st_size for s in stats_)
+        _cache_stats["miss_bytes"] += disk_bytes
+    _MET_MISSES.inc()
+    _MET_FILES.inc(len(files))
+    _MET_BYTES.inc(disk_bytes)
     table = read_parquet(files, columns=columns, schema=schema)
     nb = _table_nbytes(table)
     global _cache_bytes
@@ -211,14 +227,25 @@ def read_table_files(
     dataset schema; CSV/JSON decode is pinned to it."""
     if not files:
         raise HyperspaceError("no files to read")
-    if len(files) == 1:
-        tables = [_read_one_file(files[0], fmt, columns, schema)]
-    else:
-        from concurrent.futures import ThreadPoolExecutor
+    import os
 
-        with ThreadPoolExecutor(max_workers=min(8, len(files))) as ex:
-            tables = list(ex.map(lambda f: _read_one_file(f, fmt, columns, schema), files))
-    table = pa.concat_tables(tables, promote_options="default") if len(tables) > 1 else tables[0]
+    try:
+        nbytes = sum(os.path.getsize(f) for f in files)
+    except OSError:
+        nbytes = 0
+    with obs_trace.span("io.read", files=len(files), fmt=fmt, bytes=nbytes):
+        if len(files) == 1:
+            tables = [_read_one_file(files[0], fmt, columns, schema)]
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+
+            # wrap(): pool workers start with an empty contextvar
+            # context — re-plant the caller's active span so per-file
+            # retry/fault events attribute to this read.
+            read = obs_trace.wrap(lambda f: _read_one_file(f, fmt, columns, schema))
+            with ThreadPoolExecutor(max_workers=min(8, len(files))) as ex:
+                tables = list(ex.map(read, files))
+        table = pa.concat_tables(tables, promote_options="default") if len(tables) > 1 else tables[0]
     if schema is not None and columns is not None:
         schema = schema.select(columns)
     return ColumnTable.from_arrow(table, schema)
@@ -237,8 +264,10 @@ def read_footers(files: list[str]) -> dict[str, "pq.FileMetaData"]:
 
     if len(files) == 1:
         return {files[0]: retry.retry_call(_read_footer, files[0])}
-    with ThreadPoolExecutor(max_workers=min(8, len(files))) as ex:
-        mds = list(ex.map(lambda f: retry.retry_call(_read_footer, f), files))
+    with obs_trace.span("io.footers", files=len(files)):
+        read = obs_trace.wrap(lambda f: retry.retry_call(_read_footer, f))
+        with ThreadPoolExecutor(max_workers=min(8, len(files))) as ex:
+            mds = list(ex.map(read, files))
     return dict(zip(files, mds))
 
 
@@ -568,8 +597,9 @@ def carve_and_write(
             col_stats[p] = bucket_column_stats(sub, other_cols)
         write_bucket(dest, p, sub)
 
-    with ThreadPoolExecutor(max_workers=min(16, max(1, num_partitions))) as ex:
-        list(ex.map(write_one, range(num_partitions)))
+    with obs_trace.span("io.carve", partitions=num_partitions):
+        with ThreadPoolExecutor(max_workers=min(16, max(1, num_partitions))) as ex:
+            list(ex.map(obs_trace.wrap(write_one), range(num_partitions)))
     has_stats = any(s is not None for s in key_stats)
     write_manifest(
         dest, num_partitions, indexed_columns, rows,

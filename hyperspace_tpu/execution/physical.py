@@ -25,6 +25,10 @@ class PhysicalNode:
     detail: dict = dataclasses.field(default_factory=dict)
     children: list = dataclasses.field(default_factory=list)
     rows_out: int | None = None
+    # Measured wall time of this operator's frame (children included).
+    # Deliberately NOT part of label(): explain's plan diff matches
+    # labels across two runs, and wall times never match.
+    wall_s: float | None = None
 
     def label(self) -> str:
         parts = [self.op]
@@ -44,5 +48,6 @@ class PhysicalNode:
             "op": self.op,
             "detail": dict(self.detail),
             "rows": self.rows_out,
+            "wall_s": self.wall_s,
             "children": [c.to_json() for c in self.children],
         }

@@ -216,6 +216,30 @@ def explain_executed(plan: LogicalPlan, session, mode=None) -> str:
     return mode.finalize("\n".join(out))
 
 
+def explain_analyze(plan: LogicalPlan, session) -> str:
+    """EXPLAIN ANALYZE: run the query ONCE under the session's current
+    enablement and render the measured QueryProfile — the operator tree
+    that actually executed, annotated with per-operator wall time (and %
+    of total), rows in/out, bytes decoded, kernel/venue choices, cache
+    hit/miss deltas, and any corruption-fallback outcome. The analog of
+    Postgres's EXPLAIN ANALYZE over the reference's static explain
+    (PlanAnalyzer.scala only *estimates*; here the executor measures).
+
+    Unlike explain(physical=True) this does not force a rules-off
+    comparison run — it profiles the plan the session would really
+    execute, which is what a production latency investigation wants."""
+    from hyperspace_tpu.obs import profile as obs_profile
+
+    session.run(plan)
+    prof = session.last_profile()
+    out = [obs_profile.render(prof)]
+    rewritten = session.optimized_plan(plan)
+    used = _used_indexes(rewritten, session)
+    if used:
+        out.append("indexes used: " + ", ".join(used))
+    return "\n".join(out)
+
+
 def explain_string(
     plan: LogicalPlan, session, verbose: bool = False, mode=None
 ) -> str:

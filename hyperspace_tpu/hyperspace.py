@@ -67,6 +67,10 @@ class HyperspaceSession:
         # and the executed PhysicalNode tree.
         self.last_query_stats: dict = {}
         self.last_physical_plan = None
+        # QueryProfile of the most recent run() (docs/observability.md);
+        # always populated — the physical-plan side of the profile costs
+        # two perf_counter calls per operator even with tracing off.
+        self._last_profile = None
         # Per-index health map (index root -> failure record). An index
         # that served corrupt data is quarantined from the rewrite rules
         # for the rest of the session; queries transparently fall back to
@@ -163,45 +167,104 @@ class HyperspaceSession:
         re-plans — first through the remaining healthy indexes, then
         (if corruption persists) straight against the source data. The
         query answers either way; `hyperspace_tpu.stats` counts it."""
+        import time
+
         from hyperspace_tpu import stats
         from hyperspace_tpu.exceptions import IndexCorruptionError
+        from hyperspace_tpu.execution import device_cache
+        from hyperspace_tpu.execution import io as hio
         from hyperspace_tpu.execution.executor import Executor
+        from hyperspace_tpu.obs import profile as obs_profile
+        from hyperspace_tpu.obs import trace as obs_trace
 
+        cache_before = self._cache_counts(hio, device_cache)
+        replans = 0
         use_indexes = True
-        while True:
-            executor = Executor(mesh=self.mesh, conf=self.conf)
-            optimized = self.optimized_plan(plan) if use_indexes else plan
-            try:
-                if profile_dir is not None:
-                    import jax
+        t_start = time.perf_counter()
+        with obs_trace.trace("query") as root_span:
+            while True:
+                executor = Executor(mesh=self.mesh, conf=self.conf)
+                with obs_trace.span("plan.optimize", indexes_enabled=self._enabled):
+                    optimized = self.optimized_plan(plan) if use_indexes else plan
+                try:
+                    if profile_dir is not None:
+                        import jax
 
-                    with jax.profiler.trace(str(profile_dir)):
+                        with jax.profiler.trace(str(profile_dir)):
+                            result = executor.execute(optimized)
+                    else:
                         result = executor.execute(optimized)
-                else:
-                    result = executor.execute(optimized)
-                break
-            except IndexCorruptionError as e:
-                if not (self._enabled and use_indexes and self.conf.fallback_enabled):
-                    raise
-                root = str(Path(e.index_root)) if e.index_root is not None else None
-                if root is None or root in self.index_health:
-                    # No provenance to quarantine by (or quarantining it
-                    # didn't help): indexes go off wholesale for this
-                    # query — the loop provably terminates.
-                    use_indexes = False
-                if root is not None:
-                    self.index_health[root] = {"reason": e.msg, "path": e.path}
-                stats.increment("fallback.queries")
-                import logging
+                    break
+                except IndexCorruptionError as e:
+                    if not (self._enabled and use_indexes and self.conf.fallback_enabled):
+                        raise
+                    root = str(Path(e.index_root)) if e.index_root is not None else None
+                    if root is None or root in self.index_health:
+                        # No provenance to quarantine by (or quarantining it
+                        # didn't help): indexes go off wholesale for this
+                        # query — the loop provably terminates.
+                        use_indexes = False
+                    if root is not None:
+                        self.index_health[root] = {"reason": e.msg, "path": e.path}
+                    stats.increment("fallback.queries")
+                    replans += 1
+                    obs_trace.event("fallback.replan", index=root, reason=e.msg)
+                    import logging
 
-                logging.getLogger("hyperspace_tpu").warning(
-                    "index data unreadable (%s); re-planning query against source", e.msg
-                )
+                    logging.getLogger("hyperspace_tpu").warning(
+                        "index data unreadable (%s); re-planning query against source", e.msg
+                    )
+        total_s = time.perf_counter() - t_start
         self.last_query_stats = executor.stats
         if self.index_health:
             self.last_query_stats["degraded_indexes"] = sorted(self.index_health)
         self.last_physical_plan = executor.physical_plan
+        cache_after = self._cache_counts(hio, device_cache)
+        self._last_profile = obs_profile.build_profile(
+            total_s=total_s,
+            physical_plan=executor.physical_plan,
+            stats=self.last_query_stats,
+            venue=self._venue_info(),
+            cache={k: cache_after[k] - cache_before[k] for k in cache_after},
+            fallback={
+                "replans": replans,
+                "degraded_indexes": sorted(self.index_health),
+                "used_indexes": use_indexes,
+            },
+            trace_root=root_span if isinstance(root_span, obs_trace.Span) else None,
+        )
         return result
+
+    @staticmethod
+    def _cache_counts(hio, device_cache) -> dict:
+        t = hio.table_cache_stats()
+        d, h = device_cache.DEVICE_CACHE, device_cache.HOST_DERIVED
+        return {
+            "table_hits": t["hits"], "table_misses": t["misses"],
+            "device_hits": d.hits, "device_misses": d.misses,
+            "derived_hits": h.hits, "derived_misses": h.misses,
+        }
+
+    def _venue_info(self) -> dict:
+        """Where this session's queries physically run (profile evidence)."""
+        info: dict = {"mesh": self.mesh is not None}
+        try:
+            import jax
+
+            dev = jax.devices()[0]
+            info["platform"] = dev.platform
+            info["device_kind"] = getattr(dev, "device_kind", None)
+            info["device_count"] = jax.device_count()
+        except Exception:
+            info["platform"] = None
+        return info
+
+    def last_profile(self):
+        """The QueryProfile of the most recent run() in this session
+        (None before the first query). Render it with
+        `Hyperspace.explain(plan, mode="analyze")` or inspect
+        `.to_json()` (docs/observability.md)."""
+        return self._last_profile
 
     def to_pandas(self, plan: LogicalPlan):
         import pandas as pd
@@ -280,12 +343,30 @@ class Hyperspace:
     def indexes(self):
         return self.session.manager.indexes()
 
-    def explain(self, plan: LogicalPlan, verbose: bool = False, physical: bool = False) -> str:
+    def explain(
+        self,
+        plan: LogicalPlan,
+        verbose: bool = False,
+        physical: bool = False,
+        mode: str | None = None,
+    ) -> str:
         """Rules-off/on plan diff. physical=True EXECUTES both variants
         and diffs the physical plans that actually ran (files read,
-        kernels, bucket/device counts, rows per operator)."""
-        from hyperspace_tpu.explain.plan_analyzer import explain_executed, explain_string
+        kernels, bucket/device counts, rows per operator).
+        mode="analyze" EXECUTES the query once under the session's
+        current enablement and renders its QueryProfile — per-operator
+        measured wall time, rows in/out, bytes, venue, cache and
+        fallback outcomes (docs/observability.md)."""
+        from hyperspace_tpu.explain.plan_analyzer import (
+            explain_analyze,
+            explain_executed,
+            explain_string,
+        )
 
+        if mode == "analyze":
+            return explain_analyze(plan, self.session)
+        if mode not in (None, "diff"):
+            raise HyperspaceError(f"unknown explain mode {mode!r} (diff|analyze)")
         if physical:
             return explain_executed(plan, self.session)
         return explain_string(plan, self.session, verbose=verbose)

@@ -218,7 +218,10 @@ class IndexCollectionManager:
         stale = (
             entry is not None
             and entry.state not in states.STABLE_STATES
-            and time.time() - (entry.timestamp or 0) > self.conf.recover_grace_seconds
+            # Wall clock on purpose: entry.timestamp is a PERSISTED stamp
+            # from a possibly-different process/boot — monotonic() cannot
+            # compare across those.
+            and time.time() - (entry.timestamp or 0) > self.conf.recover_grace_seconds  # noqa: HSL007
         )
         if entry is None or stale:
             try:

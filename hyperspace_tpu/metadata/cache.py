@@ -34,14 +34,16 @@ class CreationTimeBasedCache(Cache[T]):
     def get(self) -> T | None:
         if self._entry is None:
             return None
-        if time.time() - self._set_at > self.expiry_seconds:
+        # monotonic, not wall clock: an NTP step backwards must not make
+        # a stale entry immortal (nor a forward step expire a fresh one).
+        if time.monotonic() - self._set_at > self.expiry_seconds:
             self.clear()
             return None
         return self._entry
 
     def set(self, entry: T) -> None:
         self._entry = entry
-        self._set_at = time.time()
+        self._set_at = time.monotonic()
 
     def clear(self) -> None:
         self._entry = None

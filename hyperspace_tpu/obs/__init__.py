@@ -1,0 +1,44 @@
+"""Query-plane observability: tracing spans, metrics, per-query profiles.
+
+The system's value proposition — "the optimizer transparently picked the
+index" — is invisible unless every query can explain what it did and
+what it cost. This package is that explanation, in three layers
+(docs/observability.md):
+
+- **trace** — a zero-dependency tracer with nestable spans
+  (``span("execute.join", rows=...)``) threaded through ``session.run``,
+  rule application, the executor's operator dispatch, parquet IO, the
+  device cache, the retry layer, and the action lifecycle. Contextvar
+  based, so worker threads inherit the active trace via
+  :func:`trace.wrap`; near-zero overhead when disabled
+  (``hyperspace.obs.enabled=false`` ⇒ ``span()`` returns a shared no-op
+  singleton, nothing is allocated).
+- **metrics** — a declared process-wide registry of counters, gauges,
+  and bounded histograms (p50/p95/p99 of operator wall time, bytes
+  scanned, bucket fan-out). ``hyperspace_tpu.stats`` is now a compat
+  shim over it; undeclared counter names raise instead of silently
+  creating new counters (lint rule HSL007 enforces call sites too).
+- **profile** — a per-query :class:`~hyperspace_tpu.obs.profile.QueryProfile`
+  assembled from the executed physical plan and the span tree: operator
+  tree with wall time, rows in/out, bytes, venue, cache and fallback
+  outcomes. ``session.last_profile()`` returns it;
+  ``explain(mode="analyze")`` renders it.
+
+Export: a JSON-lines event sink (``hyperspace.obs.sink``) receives one
+line per finished root trace, and ``python -m hyperspace_tpu.obs.export``
+renders Prometheus-style text exposition (of the live registry, or
+aggregated from a sink file).
+"""
+
+from hyperspace_tpu.obs import metrics, trace
+from hyperspace_tpu.obs.trace import annotate, current_span, event, set_enabled, span
+
+__all__ = [
+    "annotate",
+    "current_span",
+    "event",
+    "metrics",
+    "set_enabled",
+    "span",
+    "trace",
+]

@@ -1,0 +1,123 @@
+"""Metrics export: Prometheus-style text exposition.
+
+Two modes::
+
+    python -m hyperspace_tpu.obs.export            # live process registry
+    python -m hyperspace_tpu.obs.export --sink q.jsonl   # aggregate a sink file
+
+The first renders whatever this process's registry holds (useful from a
+long-lived server REPL or an embedding application that execs it). The
+second replays a JSON-lines trace sink (`hyperspace.obs.sink`) into a
+fresh registry — every `execute.*` span becomes an operator wall-time
+observation, every root a query observation — so offline trajectories
+(bench runs, soak tests) export the same way live processes do.
+
+Metric names are sanitized to the Prometheus grammar
+(`hyperspace_` prefix, dots → underscores); histograms render classic
+cumulative `_bucket{le="..."}` series plus `_sum`/`_count`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from hyperspace_tpu.obs import metrics as m
+
+
+def _prom_name(name: str) -> str:
+    return "hyperspace_" + "".join(
+        c if c.isalnum() or c == "_" else "_" for c in name
+    )
+
+
+def _fmt(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    return repr(float(v)) if isinstance(v, float) and not float(v).is_integer() else str(int(v))
+
+
+def render_prometheus(registry: "m.MetricsRegistry | None" = None) -> str:
+    """The registry as Prometheus text exposition format."""
+    reg = registry if registry is not None else m.REGISTRY
+    out: list[str] = []
+    for metric in reg.collect():
+        name = _prom_name(metric.name)
+        if metric.help:
+            out.append(f"# HELP {name} {metric.help}")
+        out.append(f"# TYPE {name} {metric.kind}")
+        if metric.kind in ("counter", "gauge"):
+            out.append(f"{name} {_fmt(metric.value)}")
+        else:  # histogram
+            for le, cum in metric.bucket_counts():
+                le_s = "+Inf" if le == float("inf") else repr(float(le))
+                out.append(f'{name}_bucket{{le="{le_s}"}} {cum}')
+            out.append(f"{name}_sum {float(metric.sum)!r}")
+            out.append(f"{name}_count {metric.count}")
+    return "\n".join(out) + "\n"
+
+
+def _walk_span(span: dict):
+    yield span
+    for c in span.get("children", ()):
+        yield from _walk_span(c)
+
+
+def registry_from_sink(path: str) -> "m.MetricsRegistry":
+    """Replay a JSON-lines trace sink into a fresh registry. Unparseable
+    lines are skipped (a crash mid-append can tear the final line)."""
+    reg = m.MetricsRegistry()
+    queries = reg.counter("query.count", "root traces in sink")
+    q_s = reg.histogram("query.seconds", "root trace wall time", buckets=m.SECONDS_BUCKETS)
+    op_s = reg.histogram("query.operator.seconds", "span wall time", buckets=m.SECONDS_BUCKETS)
+    io_b = reg.histogram("query.bytes_scanned", "bytes per io span", buckets=m.BYTES_BUCKETS)
+    errors = reg.counter("trace.errors", "spans closed with error=")
+    with open(path) as f:
+        for line in f:
+            try:
+                event = json.loads(line)
+            except ValueError:
+                continue
+            root = event.get("trace") or {}
+            queries.inc()
+            if root.get("wall_s") is not None:
+                q_s.observe(root["wall_s"])
+            for span in _walk_span(root):
+                if span.get("error"):
+                    errors.inc()
+                if span.get("wall_s") is None:
+                    continue
+                name = span.get("name", "")
+                if name.startswith("execute."):
+                    op_s.observe(span["wall_s"])
+                attrs = span.get("attrs") or {}
+                if name.startswith("io.") and "bytes" in attrs:
+                    io_b.observe(float(attrs["bytes"]))
+    return reg
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m hyperspace_tpu.obs.export",
+        description="Prometheus-style text exposition of hyperspace metrics.",
+    )
+    ap.add_argument(
+        "--sink", help="aggregate a JSON-lines trace sink file instead of the live registry"
+    )
+    args = ap.parse_args(argv)
+    if args.sink:
+        reg = registry_from_sink(args.sink)
+    else:
+        # Declare the core metric families so a fresh process exposes
+        # the full schema (zeros) instead of an empty page.
+        import hyperspace_tpu.obs.profile  # noqa: F401 — declares query.* metrics
+        import hyperspace_tpu.stats  # noqa: F401 — declares fault-plane counters
+
+        reg = None
+    sys.stdout.write(render_prometheus(reg))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,293 @@
+"""Zero-dependency tracer: nestable spans over a contextvar.
+
+A :class:`Span` measures one unit of work (``perf_counter`` wall time),
+carries free-form attributes and point-in-time events, and nests: the
+span active when another opens becomes its parent. The active span lives
+in a :data:`contextvars.ContextVar`, so nesting follows the call stack —
+including across ``await``-free thread hops when the submitted task is
+wrapped with :func:`wrap` (worker threads start with an empty context;
+the wrapper re-plants the caller's active span for the task's duration).
+
+Design constraints:
+
+- **Near-zero overhead when disabled.** ``span()``/``trace()`` check one
+  module global and return shared no-op singletons — no Span object, no
+  attrs dict, no contextvar write. ``hyperspace.obs.enabled`` routes
+  here (config.py).
+- **Spans always close.** ``__exit__`` runs on ``BaseException`` too, so
+  a simulated crash (faults.CrashPoint) or an injected FaultError still
+  records ``error=`` and the duration before propagating — the fault
+  plane is *more* visible under tracing, never less.
+- **Recording needs an active trace.** ``span()`` is a no-op unless some
+  enclosing :func:`trace` established a root (``session.run`` and
+  ``Action.run`` do). Instrumented library code can therefore call
+  ``span()`` unconditionally; outside a traced request nothing records.
+
+Finished root traces go to the JSON-lines sink when one is configured
+(``hyperspace.obs.sink``), and the last root is kept in-process for
+``session.last_profile()`` / tests (:func:`last_trace`).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import threading
+import time
+from typing import Any, Callable
+
+_current: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
+    "hyperspace_obs_span", default=None
+)
+
+_enabled = True  # hyperspace.obs.enabled; module-global fast path
+_sink_path: str | None = None  # hyperspace.obs.sink; None = no export
+_sink_lock = threading.Lock()
+_last_trace: "Span | None" = None  # most recently finished ROOT span
+
+
+class Span:
+    """One timed unit of work. Use as a context manager; attributes via
+    ``set(k=v)`` (chainable), point events via ``add_event``."""
+
+    __slots__ = ("name", "attrs", "children", "events", "start_s", "wall_s", "error", "_token")
+
+    def __init__(self, name: str, attrs: dict):
+        self.name = name
+        self.attrs = attrs
+        self.children: list[Span] = []
+        self.events: list[dict] = []
+        self.start_s: float | None = None
+        self.wall_s: float | None = None
+        self.error: str | None = None
+        self._token = None
+
+    def set(self, **attrs) -> "Span":
+        self.attrs.update(attrs)
+        return self
+
+    def rename(self, name: str) -> "Span":
+        self.name = name
+        return self
+
+    def add_event(self, name: str, **attrs) -> None:
+        self.events.append({"name": name, **attrs})
+
+    def __enter__(self) -> "Span":
+        parent = _current.get()
+        if parent is not None:
+            # list.append is atomic under the GIL — worker threads
+            # re-planted on this parent via wrap() attach children
+            # concurrently without a lock.
+            parent.children.append(self)
+        self._token = _current.set(self)
+        self.start_s = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        # BaseException included: a CrashPoint flying through still
+        # closes (and error-tags) every open span on its way out.
+        self.wall_s = time.perf_counter() - (self.start_s or 0.0)
+        if exc is not None and self.error is None:
+            self.error = f"{exc_type.__name__}: {exc}"
+        _current.reset(self._token)
+        return False
+
+    def self_s(self) -> float:
+        """Wall time NOT attributed to child spans."""
+        own = self.wall_s or 0.0
+        return max(0.0, own - sum(c.wall_s or 0.0 for c in self.children))
+
+    def walk(self):
+        yield self
+        for c in self.children:
+            yield from c.walk()
+
+    def to_json(self) -> dict:
+        out: dict[str, Any] = {"name": self.name, "wall_s": self.wall_s}
+        if self.attrs:
+            out["attrs"] = dict(self.attrs)
+        if self.error is not None:
+            out["error"] = self.error
+        if self.events:
+            out["events"] = list(self.events)
+        if self.children:
+            out["children"] = [c.to_json() for c in self.children]
+        return out
+
+
+class _NoopSpan:
+    """Shared do-nothing span: the disabled/untraced fast path. One
+    module-level instance; every method is a cheap no-op so call sites
+    never branch."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+    def set(self, **attrs) -> "_NoopSpan":
+        return self
+
+    def rename(self, name: str) -> "_NoopSpan":
+        return self
+
+    def add_event(self, name: str, **attrs) -> None:
+        pass
+
+
+NOOP = _NoopSpan()
+
+
+class _TraceHandle:
+    """Context manager establishing (or joining) a trace. Entering yields
+    the root span; exiting a true root records it as the last trace and
+    emits one JSON line to the sink."""
+
+    __slots__ = ("_span", "_is_root")
+
+    def __init__(self, span: Span):
+        self._span = span
+        self._is_root = False
+
+    def __enter__(self) -> Span:
+        self._is_root = _current.get() is None
+        return self._span.__enter__()
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self._span.__exit__(exc_type, exc, tb)
+        if self._is_root:
+            global _last_trace
+            _last_trace = self._span
+            _emit(self._span)
+        return False
+
+
+class _NoopTrace:
+    __slots__ = ()
+
+    def __enter__(self) -> _NoopSpan:
+        return NOOP
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+_NOOP_TRACE = _NoopTrace()
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def set_enabled(on: bool) -> None:
+    """`hyperspace.obs.enabled` (config.py routes here). Process-global,
+    like the metrics it feeds."""
+    global _enabled
+    _enabled = bool(on)
+
+
+def configure(sink: str | None = ...) -> None:
+    """Adjust module-global tracer config (`hyperspace.obs.*` keys).
+    `sink` is a JSON-lines path receiving one event per finished root
+    trace; None disables export."""
+    global _sink_path
+    if sink is not ...:
+        _sink_path = str(sink) if sink else None
+
+
+def sink_path() -> str | None:
+    return _sink_path
+
+
+def trace(name: str, **attrs):
+    """Open a ROOT span (or a plain child span when a trace is already
+    active — nested requests don't double-root). No-op when disabled."""
+    if not _enabled:
+        return _NOOP_TRACE
+    return _TraceHandle(Span(name, attrs))
+
+
+def span(name: str, **attrs):
+    """Open a child span under the active trace. Returns the shared
+    no-op singleton when disabled or untraced — nothing is allocated."""
+    if not _enabled or _current.get() is None:
+        return NOOP
+    return Span(name, attrs)
+
+
+def current_span() -> "Span | None":
+    return _current.get()
+
+
+def annotate(**attrs) -> None:
+    """Attach attributes to the active span, if any (used by code that
+    has evidence but did not open the span — e.g. a rule recording why
+    it failed)."""
+    cur = _current.get()
+    if cur is not None:
+        cur.attrs.update(attrs)
+
+
+def event(name: str, **attrs) -> None:
+    """Record a point-in-time event on the active span (retry attempts,
+    evictions). No-op when untraced."""
+    if not _enabled:
+        return
+    cur = _current.get()
+    if cur is not None:
+        cur.add_event(name, **attrs)
+
+
+def wrap(fn: Callable) -> Callable:
+    """Propagate the caller's active span into a worker-thread task.
+
+    ThreadPoolExecutor workers start with an empty context, so spans
+    opened inside them would silently detach; wrapping the submitted
+    callable re-plants the submitting thread's active span for the
+    task's duration (each task sets/resets its own thread's context —
+    safe under arbitrary pool fan-out)."""
+    if not _enabled:
+        return fn
+    parent = _current.get()
+    if parent is None:
+        return fn
+
+    def run(*args, **kwargs):
+        token = _current.set(parent)
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            _current.reset(token)
+
+    return run
+
+
+def last_trace() -> "Span | None":
+    """The most recently finished root span (None before the first)."""
+    return _last_trace
+
+
+def reset() -> None:
+    """Drop the last trace and sink config (test isolation)."""
+    global _last_trace, _sink_path
+    _last_trace = None
+    _sink_path = None
+
+
+def _emit(root: Span) -> None:
+    """Append one JSON line per finished root trace to the sink. Export
+    must never fail a query: errors are swallowed."""
+    if _sink_path is None:
+        return
+    # Wall-clock stamp (not a duration): sink lines are correlated with
+    # external logs, which speak wall time.
+    line = json.dumps({"ts": time.time(), "trace": root.to_json()}, default=str)
+    try:
+        with _sink_lock, open(_sink_path, "a") as f:
+            f.write(line + "\n")
+    except OSError:
+        pass

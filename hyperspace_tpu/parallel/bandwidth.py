@@ -110,9 +110,15 @@ def d2h_mb_per_s() -> float:
         data = {}
     try:
         # Missing/expired entry for THIS device must not discard other
-        # devices' cached entries on the rewrite below.
+        # devices' cached entries on the rewrite below. The persisted
+        # stamp must be wall clock (monotonic() restarts per boot, and
+        # the file outlives the process) — so guard the clock-step
+        # hazard instead: a NEGATIVE age means the clock stepped
+        # backwards past the stamp, and the entry is treated as expired
+        # rather than living arbitrarily long.
         ts, mbps = data[key]
-        if time.time() - ts < _PROBE_TTL_S:
+        age = time.time() - ts  # noqa: HSL007 — cross-process TTL, see above
+        if 0.0 <= age < _PROBE_TTL_S:
             return float(mbps)
     except Exception:
         pass

@@ -56,4 +56,9 @@ def run_x64(fn, /, *args, **kwargs):
                 finally:
                     if prev is not None:
                         threading.stack_size(prev)
-    return _pool.submit(fn, *args, **kwargs).result()
+    # The worker thread starts with an empty contextvar context —
+    # re-plant the caller's active trace span so f64 device work
+    # attributes to the operator that requested it.
+    from hyperspace_tpu.obs import trace as obs_trace
+
+    return _pool.submit(obs_trace.wrap(fn), *args, **kwargs).result()

@@ -36,16 +36,22 @@ class Rule:
 
 
 def apply_rules(plan: LogicalPlan, indexes: list[IndexLogEntry], rules=None, conf=None) -> LogicalPlan:
+    from hyperspace_tpu.obs import trace as obs_trace
+
     if rules is None:
         from hyperspace_tpu.rules.filter_index_rule import FilterIndexRule
         from hyperspace_tpu.rules.join_index_rule import JoinIndexRule
 
         rules = [JoinIndexRule(conf), FilterIndexRule(conf)]
     for rule in rules:
-        try:
-            plan = rule.apply(plan, indexes)
-        except Exception as e:  # noqa: BLE001 — rules must never break a query
-            logger.warning("rule %s failed, skipping: %s", rule.name, e)
+        with obs_trace.span(f"rule.{rule.name}", candidates=len(indexes)):
+            try:
+                plan = rule.apply(plan, indexes)
+            except Exception as e:  # noqa: BLE001 — rules must never break a query
+                # The span records the failure (a no-op rewrite is a
+                # per-query fact worth profiling), the query proceeds.
+                obs_trace.annotate(error=f"{type(e).__name__}: {e}")
+                logger.warning("rule %s failed, skipping: %s", rule.name, e)
     return plan
 
 

@@ -1,12 +1,19 @@
-"""Process-wide fault-tolerance counters.
+"""Process-wide fault-tolerance counters — compat shim over the metrics
+registry.
 
-The observability half of the fault-tolerance layer
-(docs/fault_tolerance.md): retries, injected faults, detected
-corruption, recovery transitions, and query fallbacks all tick a named
-counter here, so degradation is measurable instead of silent. Counters
-are process-global (matching the filesystem state they describe) and
-thread-safe; `snapshot()` is the read API surfaced as
-`hyperspace_tpu.stats`.
+Historically this module held its own ad-hoc ``dict[str, int]``; it is
+now a thin facade over the declared registry in
+`hyperspace_tpu/obs/metrics.py`, keeping the call-site API
+(``increment``/``get``/``snapshot``/``reset``) stable for the fault
+plane while everything lands in one exportable place
+(docs/observability.md).
+
+Counter names are **declared** in :data:`KNOWN_COUNTERS`; incrementing
+an undeclared name raises immediately instead of silently creating a new
+counter (the ``increment("retyr.attempts")`` typo class). Lint rule
+HSL007 flags undeclared constant names at call sites too, so the typo
+never survives to runtime. New counters are added by extending the
+tuple below (and its docstring row).
 
 Counter names in use:
 
@@ -23,28 +30,49 @@ Counter names in use:
 
 from __future__ import annotations
 
-import threading
+from hyperspace_tpu.obs import metrics as _metrics
 
-_lock = threading.Lock()
-_counters: dict[str, int] = {}
+# The declared counter set. analysis/lint.py parses this tuple (by AST,
+# not import — the lint CI job runs dependency-free) to validate
+# stats.increment call sites; keep it a plain literal of string
+# constants.
+KNOWN_COUNTERS = (
+    "retry.attempts",
+    "retry.exhausted",
+    "faults.injected",
+    "index.corruption",
+    "fallback.queries",
+    "action.rolled_back",
+    "recover.rolled",
+    "recover.quarantined_entries",
+    "recover.orphans_removed",
+)
+
+_counters = {name: _metrics.counter(name) for name in KNOWN_COUNTERS}
 
 
 def increment(name: str, n: int = 1) -> None:
-    with _lock:
-        _counters[name] = _counters.get(name, 0) + n
+    c = _counters.get(name)
+    if c is None:
+        raise KeyError(
+            f"undeclared counter {name!r} — declare it in stats.KNOWN_COUNTERS "
+            f"(silent typo counters are exactly what the declared registry removes)"
+        )
+    c.inc(n)
 
 
 def get(name: str) -> int:
-    with _lock:
-        return _counters.get(name, 0)
+    c = _counters.get(name)
+    if c is None:
+        raise KeyError(f"undeclared counter {name!r} (see stats.KNOWN_COUNTERS)")
+    return c.value
 
 
 def snapshot() -> dict[str, int]:
-    """Point-in-time copy of every counter."""
-    with _lock:
-        return dict(_counters)
+    """Point-in-time copy of every declared counter."""
+    return {name: c.value for name, c in _counters.items()}
 
 
 def reset() -> None:
-    with _lock:
-        _counters.clear()
+    for c in _counters.values():
+        c._reset()

@@ -129,11 +129,12 @@ def _try_reap(lock: Path, nonce: str) -> bool:
         # where mtime, with its server-clock caveat, is consulted), so a
         # live-but-not-yet-written lease is not reaped.
         try:
-            if time.time() - os.stat(lock).st_mtime <= _LOCK_STALE_S:
+            # Wall clock on purpose (cross-process lease vs file mtime).
+            if time.time() - os.stat(lock).st_mtime <= _LOCK_STALE_S:  # noqa: HSL007
                 return False
         except OSError:
             return True  # vanished — retry the acquire
-    elif time.time() - ep <= _LOCK_STALE_S:
+    elif time.time() - ep <= _LOCK_STALE_S:  # noqa: HSL007 — persisted epoch token
         return False
     reaped = lock.with_name(f"{lock.name}.reap-{nonce}")
     try:

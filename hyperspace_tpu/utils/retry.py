@@ -25,6 +25,7 @@ from typing import Any, Callable
 
 from hyperspace_tpu import stats
 from hyperspace_tpu.exceptions import is_retryable
+from hyperspace_tpu.obs import trace as obs_trace
 
 
 @dataclasses.dataclass(frozen=True)
@@ -108,9 +109,14 @@ def retry_call(fn: Callable[..., Any], *args, policy: RetryPolicy | None = None,
             if attempt >= p.max_attempts - 1 or not p.retryable(e):
                 if attempt > 0:
                     stats.increment("retry.exhausted")
+                    obs_trace.event("retry.exhausted", attempts=attempt + 1, error=str(e))
                 raise
             stats.increment("retry.attempts")
-            _sleeper(p.delay(attempt))
+            delay = p.delay(attempt)
+            # Point event on the active span (if any): which call site
+            # retried, why, and what the backoff cost.
+            obs_trace.event("retry", attempt=attempt + 1, delay_s=delay, error=str(e))
+            _sleeper(delay)
             attempt += 1
 
 

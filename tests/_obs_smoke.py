@@ -1,0 +1,45 @@
+"""EXPLAIN ANALYZE smoke on a TPC-DS query — the CI observability gate.
+
+Run as ``python tests/_obs_smoke.py``: generates the tiny-SF TPC-DS
+slice, builds the benchmark indexes, runs one query through
+``explain(mode="analyze")``, and asserts the profile rendered with
+measured operator evidence. Kept out of pytest collection (leading
+underscore) because the tier-1 suite already covers profile semantics;
+this is the cheap end-to-end "the whole pipeline renders" check."""
+
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    from benchmarks.tpcds import cached_tpcds, tpcds_indexes, tpcds_queries
+    from hyperspace_tpu import Hyperspace, HyperspaceSession
+
+    base = Path(tempfile.mkdtemp(prefix="hs_obs_smoke_"))
+    roots = cached_tpcds(sf=0.01, cache_root=base)
+    session = HyperspaceSession(system_path=str(base / "idx"), num_buckets=8)
+    session.conf.set("hyperspace.obs.sink", str(base / "events.jsonl"))
+    hs = Hyperspace(session)
+    scans = {name: session.parquet(root) for name, root in roots.items()}
+    tpcds_indexes(hs, scans)
+    session.enable_hyperspace()
+    queries = tpcds_queries(scans)
+    name, plan = sorted(queries.items())[0]
+    text = hs.explain(plan, mode="analyze")
+    print(f"-- EXPLAIN ANALYZE {name} --")
+    print(text)
+    assert "EXPLAIN ANALYZE" in text and "total:" in text and "cache:" in text, text
+    prof = session.last_profile()
+    assert prof is not None and prof.root is not None and prof.root.wall_s > 0
+    assert prof.operators(), "no operators profiled"
+    assert (base / "events.jsonl").exists(), "sink received no trace"
+    print(f"OK: {len(prof.operators())} operators profiled, "
+          f"total {prof.total_s * 1e3:.1f}ms")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

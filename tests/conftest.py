@@ -61,6 +61,22 @@ def _jit_map_guard():
         jax.clear_caches()
 
 
+@pytest.fixture(autouse=True)
+def _obs_reset():
+    """Observability isolation: counters/metrics and the tracer's
+    process-global state (last trace, sink path) are zeroed before each
+    test, so cross-test counter drift can't leak into assertions and a
+    test that configures a sink can't make a later test write to it."""
+    from hyperspace_tpu import stats
+    from hyperspace_tpu.obs import metrics, trace
+
+    stats.reset()
+    metrics.REGISTRY.reset()
+    trace.reset()
+    trace.set_enabled(True)
+    yield
+
+
 @pytest.fixture
 def tmp_system_path(tmp_path):
     """Per-test index system path isolation (analog of HyperspaceSuite's

@@ -13,8 +13,10 @@ from hyperspace_tpu.utils.name_utils import normalize_index_name
 def test_cache_ttl(monkeypatch):
     import time as time_mod
 
+    # The TTL clock is monotonic (clock-step hazard: an NTP step must
+    # not expire fresh entries or immortalize stale ones).
     t = [1000.0]
-    monkeypatch.setattr(time_mod, "time", lambda: t[0])
+    monkeypatch.setattr(time_mod, "monotonic", lambda: t[0])
     c = CreationTimeBasedCache(expiry_seconds=10)
     assert c.get() is None
     c.set([1, 2, 3])

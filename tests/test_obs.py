@@ -1,0 +1,337 @@
+"""Observability plane: tracer spans, metrics registry, per-query
+profiles, EXPLAIN ANALYZE, sink export, and the fault-plane interplay
+(spans must survive — and record — injected faults and crashes).
+See docs/observability.md."""
+
+import json
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col, faults, stats
+from hyperspace_tpu.obs import metrics, trace
+from hyperspace_tpu.obs.export import registry_from_sink, render_prometheus
+
+
+@pytest.fixture
+def tables(tmp_path):
+    rng = np.random.default_rng(11)
+    n = 5_000
+    fact = pd.DataFrame(
+        {
+            "k": rng.integers(0, 100, n).astype(np.int64),
+            "v": rng.normal(size=n).round(4),
+        }
+    )
+    dim = pd.DataFrame(
+        {
+            "k": np.arange(100, dtype=np.int64),
+            "g": (np.arange(100) % 7).astype(np.int64),
+        }
+    )
+    for name, df in (("fact", fact), ("dim", dim)):
+        (tmp_path / name).mkdir()
+        pq.write_table(
+            pa.Table.from_pandas(df, preserve_index=False), tmp_path / name / "p.parquet"
+        )
+    session = HyperspaceSession(system_path=str(tmp_path / "idx"), num_buckets=4)
+    hs = Hyperspace(session)
+    f = session.parquet(tmp_path / "fact")
+    d = session.parquet(tmp_path / "dim")
+    hs.create_index(f, IndexConfig("f_k", ["k"], ["v"]))
+    session.enable_hyperspace()
+    return session, hs, f, d, fact, dim
+
+
+# -- tracer basics ---------------------------------------------------------
+
+
+def test_span_nesting_and_attrs():
+    with trace.trace("root") as root:
+        with trace.span("a", x=1):
+            with trace.span("a.b") as inner:
+                inner.set(rows=7)
+            trace.event("tick", n=1)
+        with trace.span("c"):
+            pass
+    assert [c.name for c in root.children] == ["a", "c"]
+    a = root.children[0]
+    assert [c.name for c in a.children] == ["a.b"]
+    assert a.children[0].attrs == {"rows": 7}
+    assert a.events == [{"name": "tick", "n": 1}]
+    assert all(s.wall_s is not None and s.wall_s >= 0 for s in root.walk())
+    # self time never exceeds wall time, and the tree telescopes to root.
+    assert sum(s.self_s() for s in root.walk()) == pytest.approx(root.wall_s, rel=0.02)
+    assert trace.last_trace() is root
+
+
+def test_untraced_spans_are_noops():
+    # No enclosing trace ⇒ the shared no-op singleton, nothing recorded.
+    assert trace.span("orphan") is trace.NOOP
+    trace.event("orphan-event")  # must not raise
+    assert trace.last_trace() is None
+
+
+def test_disabled_mode_allocates_nothing():
+    trace.set_enabled(False)
+    assert trace.span("x") is trace.NOOP
+    with trace.trace("t") as root:
+        assert root is trace.NOOP
+        assert trace.span("y") is trace.NOOP
+    assert trace.last_trace() is None
+
+
+def test_worker_threads_inherit_active_span():
+    with trace.trace("root") as root:
+        with trace.span("parent"):
+
+            def task(i):
+                with trace.span(f"child-{i}"):
+                    return i
+
+            with ThreadPoolExecutor(max_workers=4) as ex:
+                assert sorted(ex.map(trace.wrap(task), range(4))) == [0, 1, 2, 3]
+    parent = root.children[0]
+    assert sorted(c.name for c in parent.children) == [f"child-{i}" for i in range(4)]
+
+
+# -- metrics registry ------------------------------------------------------
+
+
+def test_undeclared_counter_raises():
+    with pytest.raises(KeyError, match="retyr.attempts"):
+        stats.increment("retyr.attempts")  # noqa: HSL007 — the typo under test
+    stats.increment("retry.attempts")
+    assert stats.get("retry.attempts") == 1
+    assert stats.snapshot()["retry.attempts"] == 1
+    stats.reset()
+    assert stats.get("retry.attempts") == 0
+
+
+def test_histogram_percentiles_bounded():
+    h = metrics.Histogram("t", buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0))
+    for v in range(1, 101):
+        h.observe(float(v))
+    assert h.count == 100
+    assert h.sum == pytest.approx(5050.0)
+    p = h.percentiles()
+    # Bucket interpolation: coarse but order-correct and bounded.
+    assert 30 <= p["p50"] <= 70
+    assert p["p95"] >= p["p50"]
+    assert p["p99"] <= 100.0
+    h._reset()
+    assert h.count == 0 and h.quantile(0.5) is None
+
+
+def test_registry_kind_conflict_raises():
+    metrics.REGISTRY.counter("obs_test.metric")
+    with pytest.raises(ValueError, match="already declared"):
+        metrics.REGISTRY.gauge("obs_test.metric")
+
+
+# -- per-query profiles ----------------------------------------------------
+
+
+def test_filter_query_profile(tables):
+    from hyperspace_tpu.execution import io as hio
+
+    session, hs, f, d, fact, dim = tables
+    hio.clear_table_cache()  # cold read: files/bytes evidence must appear
+    q = f.filter(col("k") == 7).select("k", "v")
+    res = session.run(q)
+    prof = session.last_profile()
+    assert prof is not None and prof is session.last_profile()
+    ops = {o.op: o for o in prof.operators()}
+    assert "IndexPointLookup" in ops
+    lookup = ops["IndexPointLookup"]
+    assert lookup.rows_out == res.num_rows == int((fact.k == 7).sum())
+    assert lookup.detail["files"] == 1  # bucket-pruned point lookup
+    assert lookup.detail["bytes"] > 0
+    assert prof.stats["bytes_scanned"] > 0
+    # Wall-time attribution: the tree telescopes (self times sum to the
+    # root frame) and the root frame fits inside the end-to-end total.
+    assert prof.root.wall_s > 0
+    assert prof.operator_total_s() == pytest.approx(prof.root.wall_s, rel=0.05)
+    assert prof.root.wall_s <= prof.total_s
+    assert prof.venue["platform"] == "cpu"
+    assert prof.cache["table_misses"] >= 1
+    assert prof.fallback == {"replans": 0, "degraded_indexes": [], "used_indexes": True}
+    # Span tree mirrors the physical tree and carries the rule phase.
+    names = [s["name"] for s in _walk(prof.trace)]
+    assert "plan.optimize" in names
+    assert any(n.startswith("rule.") for n in names)
+    assert "execute.IndexPointLookup" in names
+
+
+def test_join_query_profile(tables):
+    session, hs, f, d, fact, dim = tables
+    res = session.run(f.join(d, ["k"]))
+    prof = session.last_profile()
+    joins = [o for o in prof.operators() if "Join" in o.op]
+    assert joins, [o.op for o in prof.operators()]
+    root = prof.root
+    assert root.rows_out == res.num_rows == len(fact.merge(dim, on="k"))
+    # rows_in = children's rows_out: both sides feed the join.
+    assert joins[0].rows_in == len(fact) + len(dim)
+    assert prof.operator_total_s() == pytest.approx(prof.root.wall_s, rel=0.05)
+    assert prof.stats["join_path"] is not None
+
+
+def test_profile_available_with_tracing_disabled(tables):
+    session, hs, f, d, fact, dim = tables
+    trace.set_enabled(False)
+    res = session.run(f.filter(col("k") == 3).select("k", "v"))
+    prof = session.last_profile()
+    assert prof.trace is None  # no spans allocated...
+    assert prof.root is not None and prof.root.wall_s > 0  # ...profile still real
+    assert prof.root.rows_out == res.num_rows
+
+
+def test_explain_analyze_renders(tables):
+    session, hs, f, d, fact, dim = tables
+    text = hs.explain(f.filter(col("k") == 7).select("k", "v"), mode="analyze")
+    assert "EXPLAIN ANALYZE" in text
+    assert "IndexPointLookup" in text
+    assert "total:" in text and "cache:" in text and "venue:" in text
+    assert "indexes used: f_k" in text
+    with pytest.raises(Exception, match="unknown explain mode"):
+        hs.explain(f, mode="bogus")
+
+
+# -- fault interplay -------------------------------------------------------
+
+
+def test_spans_close_with_error_on_fault(tables, tmp_path):
+    session, hs, f, d, fact, dim = tables
+    session.conf.set("hyperspace.retry.maxAttempts", 1)
+    try:
+        with faults.injected("bucket.read"):
+            with pytest.raises(OSError):
+                session.run(d.filter(col("g") == 1))  # raw scan: no fallback
+    finally:
+        session.conf.set("hyperspace.retry.maxAttempts", 3)
+    root = trace.last_trace()
+    assert root is not None and root.name == "query"
+    assert root.error and "injected" in root.error
+    # Every span closed (wall recorded) and the failing read is tagged.
+    spans = list(root.walk())
+    assert all(s.wall_s is not None for s in spans)
+    assert any(s.error for s in spans if s.name.startswith("execute."))
+
+
+def test_retry_events_recorded_on_span(tables):
+    session, hs, f, d, fact, dim = tables
+    from hyperspace_tpu.execution import io as hio
+
+    hio.clear_table_cache()
+    with faults.injected("bucket.read", times=1):
+        session.run(d.filter(col("g") == 1))  # retry absorbs the fault
+    root = trace.last_trace()
+    events = [e for s in root.walk() for e in s.events]
+    assert any(e["name"] == "retry" for e in events)
+    assert stats.get("retry.attempts") >= 1
+
+
+def test_spans_close_on_crash(tables, tmp_path):
+    session, hs, f, d, fact, dim = tables
+    with faults.injected("log.write", crash=True):
+        with pytest.raises(faults.CrashPoint):
+            hs.create_index(d, IndexConfig("d_g", ["g"], ["k"]))
+    root = trace.last_trace()
+    assert root is not None and root.name == "action.CreateAction"
+    assert root.error and "CrashPoint" in root.error
+    assert all(s.wall_s is not None for s in root.walk())
+    begin = [s for s in root.walk() if s.name == "action.begin"]
+    assert begin and begin[0].error
+
+
+# -- sink + export ---------------------------------------------------------
+
+
+def _walk(span_json):
+    yield span_json
+    for c in span_json.get("children", ()):
+        yield from _walk(c)
+
+
+def test_sink_and_export(tables, tmp_path):
+    session, hs, f, d, fact, dim = tables
+    sink = tmp_path / "events.jsonl"
+    session.conf.set("hyperspace.obs.sink", str(sink))
+    session.run(f.filter(col("k") == 7).select("k", "v"))
+    session.run(f.join(d, ["k"]))
+    lines = [json.loads(l) for l in sink.read_text().splitlines()]
+    assert len(lines) == 2
+    assert all(l["trace"]["name"] == "query" for l in lines)
+    reg = registry_from_sink(str(sink))
+    assert reg.get("query.count").value == 2
+    assert reg.get("query.operator.seconds").count > 0
+    text = render_prometheus(reg)
+    assert "hyperspace_query_count 2" in text
+    assert 'hyperspace_query_seconds_bucket{le="+Inf"}' in text
+    # Live-registry exposition carries the cache/metrics families too.
+    live = render_prometheus()
+    assert "hyperspace_table_cache_hits" in live
+    assert "hyperspace_query_operator_seconds_count" in live
+
+
+def test_metrics_fed_from_profiles(tables):
+    session, hs, f, d, fact, dim = tables
+    before = metrics.REGISTRY.get("query.count").value
+    session.run(f.filter(col("k") == 5).select("k", "v"))
+    assert metrics.REGISTRY.get("query.count").value == before + 1
+    assert metrics.REGISTRY.get("query.operator.seconds").count > 0
+
+
+# -- monotonic TTL (clock-step satellite) ----------------------------------
+
+
+def test_metadata_cache_uses_monotonic(monkeypatch):
+    from hyperspace_tpu.metadata.cache import CreationTimeBasedCache
+
+    c = CreationTimeBasedCache(expiry_seconds=3600.0)
+    c.set("entry")
+    # A wall-clock step (time.time jumping) must not expire the entry:
+    # the implementation may not consult time.time at all.
+    import time as _time
+
+    monkeypatch.setattr(_time, "time", lambda: _time.monotonic() + 10_000_000)
+    assert c.get() == "entry"
+    expired = CreationTimeBasedCache(expiry_seconds=0.0)
+    expired.set("entry")
+    _time.sleep(0.002)
+    assert expired.get() is None
+
+
+# -- lint HSL007 -----------------------------------------------------------
+
+
+def test_lint_hsl007():
+    from hyperspace_tpu.analysis.lint import lint_source
+
+    src = (
+        "import time\n"
+        "t0 = time.time()\n"
+        "d = time.time() - t0\n"
+        "from hyperspace_tpu import stats\n"
+        "stats.increment('retyr.attempts')\n"
+        "stats.increment('retry.attempts')\n"
+        "ok = time.perf_counter() - 0.0\n"
+    )
+    found = lint_source(src, "x.py")
+    assert [f.rule for f in found] == ["HSL007", "HSL007"]
+    assert found[0].line == 3 and found[1].line == 5
+    # noqa suppression works per line.
+    src2 = "import time\nd = time.time() - 0.0  # noqa: HSL007\n"
+    assert lint_source(src2, "y.py") == []
+    # The package itself is HSL007-clean (the linter gates CI on this).
+    from pathlib import Path
+
+    from hyperspace_tpu.analysis.lint import lint_paths
+
+    pkg = Path(__file__).resolve().parent.parent / "hyperspace_tpu"
+    assert [str(f) for f in lint_paths([str(pkg)])] == []
