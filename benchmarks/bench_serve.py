@@ -1,0 +1,177 @@
+"""Serving-plane benchmark: throughput + tail latency under concurrency.
+
+Measures the QueryServer (docs/serving.md) at 1/4/16 concurrent clients
+over a point-lookup workload of distinct plans, cold vs warm plan cache:
+
+- **cold**: fresh PlanCache — every distinct query pays `optimized_plan`
+  (rule matching, index-log reads, pushdown/prune) before execution;
+- **warm**: same submission pattern again — every plan is a versioned-key
+  hit and goes straight to the executor.
+
+XLA compilation and the decoded-table/device caches are warmed before
+measurement, so the cold-vs-warm delta isolates exactly the work the
+plan cache amortizes. Writes BENCH_SERVE.json; `--smoke` runs a quick
+4-client correctness pass (the CI `serving` job).
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def _gen_data(root: Path, rows: int, files: int) -> None:
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(7)
+    per = rows // files
+    root.mkdir(parents=True)
+    for f in range(files):
+        t = pa.table(
+            {
+                "id": pa.array(np.arange(f * per, (f + 1) * per, dtype=np.int64)),
+                "key": pa.array(rng.integers(0, 1024, per, dtype=np.int64)),
+                "value": pa.array(rng.standard_normal(per)),
+                "amount": pa.array(rng.integers(0, 10_000, per, dtype=np.int64)),
+            }
+        )
+        pq.write_table(t, root / f"part-{f}.parquet")
+
+
+def _stats(lat_s: list[float], wall_s: float) -> dict:
+    import numpy as np
+
+    arr = np.sort(np.asarray(lat_s))
+    return {
+        "queries": len(arr),
+        "throughput_qps": round(len(arr) / wall_s, 2),
+        "p50_ms": round(float(np.percentile(arr, 50)) * 1e3, 3),
+        "p95_ms": round(float(np.percentile(arr, 95)) * 1e3, 3),
+        "p99_ms": round(float(np.percentile(arr, 99)) * 1e3, 3),
+        "mean_ms": round(float(arr.mean()) * 1e3, 3),
+    }
+
+
+def _run_phase(server, queries, n_clients: int, reps: int) -> dict:
+    """Each client submits its share of `queries` x reps; per-query
+    latency is submit→result as a client sees it."""
+    lat: list[float] = []
+    lock = threading.Lock()
+    errors: list[BaseException] = []
+
+    def client(cid: int):
+        mine = [q for i, q in enumerate(queries) if i % n_clients == cid]
+        out: list[float] = []
+        try:
+            for _ in range(reps):
+                for q in mine:
+                    t0 = time.perf_counter()
+                    server.submit(q).result(timeout=600)
+                    out.append(time.perf_counter() - t0)
+        except BaseException as e:
+            errors.append(e)
+        with lock:
+            lat.extend(out)
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    if errors:
+        raise errors[0]
+    return _stats(lat, wall)
+
+
+def main(smoke: bool = False) -> int:
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    import numpy as np
+
+    from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col
+    from hyperspace_tpu.serve import PlanCache
+
+    rows = 40_000 if smoke else 400_000
+    n_keys = 16 if smoke else 96
+    reps = 1 if smoke else 3
+    client_counts = [4] if smoke else [1, 4, 16]
+
+    tmp = Path(tempfile.mkdtemp(prefix="hs_benchserve_"))
+    try:
+        data = tmp / "events"
+        _gen_data(data, rows, 8)
+        session = HyperspaceSession(system_path=str(tmp / "indexes"), num_buckets=16)
+        hs = Hyperspace(session)
+        df = session.parquet(data)
+        hs.create_index(df, IndexConfig("events_key", ["key"], ["value", "amount"]))
+        session.enable_hyperspace()
+
+        queries = [
+            df.filter(col("key") == int(k)).select("key", "value", "amount")
+            for k in range(n_keys)
+        ]
+        # Warm XLA + table/device caches so cold-vs-warm isolates the
+        # planning cost (all point lookups share one jitted program).
+        serial = [session.run(q) for q in queries[: min(4, n_keys)]]
+
+        if smoke:
+            with session.serve(workers=4, max_queue_depth=256) as server:
+                for i, q in enumerate(queries[: len(serial)]):
+                    out = server.submit(q).result(timeout=600).decode()
+                    ref = serial[i].decode()
+                    assert set(out) == set(ref)
+                    for c in out:
+                        assert np.array_equal(
+                            np.asarray(out[c]), np.asarray(ref[c])
+                        ), f"smoke mismatch in {c}"
+                st = _run_phase(server, queries, n_clients=4, reps=2)
+            log(f"smoke OK: 4 clients, {st['queries']} queries, "
+                f"p95 {st['p95_ms']}ms, {st['throughput_qps']} qps")
+            return 0
+
+        results: dict = {
+            "rows": rows,
+            "distinct_queries": n_keys,
+            "workers": 4,
+            "reps_per_phase": reps,
+            "clients": {},
+        }
+        for nc in client_counts:
+            cache = PlanCache(max_entries=256)
+            with session.serve(workers=4, max_queue_depth=1024, plan_cache=cache) as server:
+                cold = _run_phase(server, queries, n_clients=nc, reps=1)
+                cold["plan_cache"] = dict(cache.stats())
+                warm = _run_phase(server, queries, n_clients=nc, reps=reps)
+                warm["plan_cache"] = dict(cache.stats())
+            results["clients"][str(nc)] = {"cold": cold, "warm": warm}
+            log(
+                f"{nc:>2} client(s): cold p95 {cold['p95_ms']:8.3f}ms "
+                f"{cold['throughput_qps']:8.2f} qps | warm p95 "
+                f"{warm['p95_ms']:8.3f}ms {warm['throughput_qps']:8.2f} qps"
+            )
+
+        out = Path(__file__).resolve().parent.parent / "BENCH_SERVE.json"
+        out.write_text(json.dumps(results, indent=2) + "\n")
+        log(f"wrote {out}")
+        for nc, r in results["clients"].items():
+            if r["warm"]["p95_ms"] >= r["cold"]["p95_ms"]:
+                log(f"WARNING: warm p95 not below cold at {nc} clients")
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main(smoke="--smoke" in sys.argv))

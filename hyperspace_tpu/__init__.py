@@ -61,11 +61,12 @@ def __getattr__(name):
         from hyperspace_tpu.vector.index import VectorIndexConfig
 
         return VectorIndexConfig
-    if name in ("stats", "faults", "obs"):
+    if name in ("stats", "faults", "obs", "serve"):
         # Fault-tolerance counters (stats.snapshot()), the deterministic
-        # fault-injection harness (docs/fault_tolerance.md), and the
+        # fault-injection harness (docs/fault_tolerance.md), the
         # observability plane — tracer/metrics/profiles
-        # (docs/observability.md).
+        # (docs/observability.md) — and the concurrent query-serving
+        # plane (docs/serving.md).
         import importlib
 
         return importlib.import_module(f"hyperspace_tpu.{name}")

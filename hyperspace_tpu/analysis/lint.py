@@ -43,6 +43,16 @@ Exit status is non-zero iff any finding is reported — the CI gate. Rules:
   declared-registry contract); the linter catches it before then. The
   declared set is read by parsing ``hyperspace_tpu/stats.py``'s AST, so
   the rule works in dependency-free CI.
+- **HSL008 unlocked-global-mutation** — a module-level mutable container
+  (dict/list/set/deque display or constructor) mutated from inside a
+  function or method without a lock held (no enclosing ``with`` whose
+  context expression names a lock). This is the bug class the serving
+  plane's concurrency hardening removed (docs/serving.md): module
+  globals that were safe under one caller become torn-eviction /
+  lost-update races under N worker threads. Mutations at module level
+  (import time, single-threaded) are exempt; so are the declared
+  allowlist entries (:data:`HSL008_ALLOWED` — e.g. the obs no-op
+  singleton plumbing, where a benign last-writer-wins is the design).
 - **HSL006 metadata-write-bypass** — bare ``.write_text()`` /
   ``.write_bytes()`` / write-mode ``open()`` on metadata-plane paths
   (``_hyperspace_log`` entries, the ``latestStable`` pointer, the index
@@ -73,6 +83,7 @@ UNHASHABLE_STATIC = "HSL004"
 UNSEEDED_RNG = "HSL005"
 METADATA_WRITE = "HSL006"
 WALLCLOCK_OR_UNDECLARED = "HSL007"
+UNLOCKED_GLOBAL = "HSL008"
 
 # The one module allowed to touch version-fragile jax import paths.
 SANCTIONED_COMPAT = "compat.py"
@@ -95,6 +106,26 @@ _METADATA_PATH_MARKERS = (
     "log_dir",
     "version_dir",
 )
+
+# HSL008 allowlist: (module basename, container name) pairs whose
+# unlocked mutation is deliberate. The obs singletons' module state is
+# written only through set_enabled/configure/reset — config-plane calls
+# where last-writer-wins is the intended semantic, not a data race on
+# the query path.
+HSL008_ALLOWED = {
+    ("trace.py", "NOOP"),
+    ("trace.py", "_NOOP_TRACE"),
+}
+
+# Container constructors whose module-level result HSL008 tracks, and
+# the method names that mutate such a container in place.
+_HSL008_CTORS = {"dict", "list", "set", "deque", "defaultdict", "OrderedDict", "Counter"}
+_HSL008_MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "update", "setdefault", "pop", "popleft", "popitem", "clear",
+    "remove", "discard",
+}
+
 
 def _declared_counters() -> "frozenset[str] | None":
     """Counter names declared in hyperspace_tpu/stats.py's
@@ -218,6 +249,38 @@ class _Linter(ast.NodeVisitor):
         self.static_decls: dict[str, list[ast.AST]] = {}
         # Stack of (in_jit_context, param_names) per function scope.
         self._fn_stack: list[tuple[bool, frozenset]] = []
+        # HSL008 state: module-level mutable container names, and how
+        # many lock-holding `with` blocks enclose the current node.
+        self.module_containers: set[str] = set()
+        self._lock_depth = 0
+
+    def collect_module_containers(self, tree: ast.Module) -> None:
+        """Names assigned a mutable container display/constructor at
+        module level (HSL008 candidates). Only simple top-level
+        assignments count — a container built inside a function is local
+        state, and attribute targets belong to lock-owning objects."""
+        for node in tree.body:
+            targets: list[ast.expr] = []
+            value = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            if value is None:
+                continue
+            is_container = isinstance(
+                value, (ast.Dict, ast.List, ast.Set, ast.DictComp, ast.ListComp, ast.SetComp)
+            ) or (
+                isinstance(value, ast.Call)
+                and _dotted(value.func).split(".")[-1] in _HSL008_CTORS
+            )
+            if not is_container:
+                continue
+            for tgt in targets:
+                if isinstance(tgt, ast.Name):
+                    basename = pathlib.PurePath(self.path).name
+                    if (basename, tgt.id) not in HSL008_ALLOWED:
+                        self.module_containers.add(tgt.id)
 
     # -- bookkeeping ---------------------------------------------------------
 
@@ -379,6 +442,16 @@ class _Linter(ast.NodeVisitor):
                     f"typo or declare it",
                 )
 
+        # HSL008: in-place mutation of a module-level container from a
+        # function without a lock held.
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _HSL008_MUTATORS
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in self.module_containers
+        ):
+            self._check_global_mutation(node, node.func.value.id, f".{node.func.attr}()")
+
         # HSL002: host sync inside traced code.
         if self._in_jit():
             if isinstance(node.func, ast.Attribute) and node.func.attr in _HOST_SYNC_ATTRS:
@@ -455,6 +528,61 @@ class _Linter(ast.NodeVisitor):
                 "tears it; route through file_utils.write_json/atomic_write "
                 "(temp file + fsync + atomic rename + dir fsync)",
             )
+
+    # -- HSL008: unlocked module-global container mutation ---------------------
+
+    def _check_global_mutation(self, node: ast.AST, name: str, how: str) -> None:
+        if not self._fn_stack:
+            return  # module level runs once at import, single-threaded
+        if self._lock_depth > 0:
+            return
+        self._report(
+            node, UNLOCKED_GLOBAL,
+            f"module-level container {name!r} mutated ({how}) outside a "
+            f"lock — safe single-threaded, a lost-update/torn-eviction "
+            f"race under the concurrent serving plane (docs/serving.md); "
+            f"guard it with a module lock (`with _lock:`) or move it into "
+            f"a lock-guarded class",
+        )
+
+    def _subscript_base(self, tgt: ast.expr) -> str | None:
+        """The bare module-container name a Subscript target indexes, if
+        any (`NAME[k] = v` / `del NAME[k]` / `NAME[k] += v`)."""
+        if isinstance(tgt, ast.Subscript) and isinstance(tgt.value, ast.Name):
+            if tgt.value.id in self.module_containers:
+                return tgt.value.id
+        return None
+
+    def visit_With(self, node: ast.With) -> None:
+        held = any(
+            "lock" in (ast.get_source_segment(self.source, item.context_expr) or "").lower()
+            for item in node.items
+        )
+        if held:
+            self._lock_depth += 1
+        self.generic_visit(node)
+        if held:
+            self._lock_depth -= 1
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for tgt in node.targets:
+            base = self._subscript_base(tgt)
+            if base is not None:
+                self._check_global_mutation(node, base, "[...] = ")
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        base = self._subscript_base(node.target)
+        if base is not None:
+            self._check_global_mutation(node, base, "[...] op= ")
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for tgt in node.targets:
+            base = self._subscript_base(tgt)
+            if base is not None:
+                self._check_global_mutation(node, base, "del [...]")
+        self.generic_visit(node)
 
     # -- HSL007(a): wall-clock duration measurement ----------------------------
 
@@ -540,6 +668,7 @@ def lint_source(source: str, path: str = "<string>") -> list[Finding]:
         path, source, name == SANCTIONED_COMPAT, is_file_utils=name == SANCTIONED_FILE_UTILS
     )
     linter.collect_jit_wrapped(tree)
+    linter.collect_module_containers(tree)
     linter.visit(tree)
     return sorted(linter.findings, key=lambda f: (f.path, f.line, f.col))
 

@@ -73,6 +73,22 @@ FAULTS_ENABLED = "hyperspace.faults.enabled"
 # export feed (`python -m hyperspace_tpu.obs.export --sink <path>`).
 OBS_ENABLED = "hyperspace.obs.enabled"
 OBS_SINK = "hyperspace.obs.sink"
+# Concurrent query-serving plane (docs/serving.md). The subsystem is OFF
+# by default: nothing changes for direct `session.run()` callers; a
+# QueryServer is constructed explicitly (or via `session.serve()`) and
+# reads these knobs as its defaults. workers bounds the executor pool;
+# maxQueueDepth is the admission-control limit (submits beyond it raise
+# AdmissionRejected); queryTimeoutSeconds (0 = none) expires queries
+# still queued (and bounds result() waits). The plan cache memoizes
+# optimized plans per (plan signature, data fingerprint, index log
+# versions); the result cache is opt-in and byte-bounded.
+SERVE_WORKERS = "hyperspace.serve.workers"
+SERVE_MAX_QUEUE_DEPTH = "hyperspace.serve.maxQueueDepth"
+SERVE_QUERY_TIMEOUT_SECONDS = "hyperspace.serve.queryTimeoutSeconds"
+SERVE_PLAN_CACHE_ENABLED = "hyperspace.serve.planCache.enabled"
+SERVE_PLAN_CACHE_MAX_ENTRIES = "hyperspace.serve.planCache.maxEntries"
+SERVE_RESULT_CACHE_ENABLED = "hyperspace.serve.resultCache.enabled"
+SERVE_RESULT_CACHE_MAX_BYTES = "hyperspace.serve.resultCache.maxBytes"
 RETRY_MAX_ATTEMPTS = "hyperspace.retry.maxAttempts"
 RETRY_BACKOFF_BASE = "hyperspace.retry.backoffBaseSeconds"
 RETRY_CAS_ATTEMPTS = "hyperspace.retry.casAttempts"
@@ -97,6 +113,10 @@ DEFAULT_JOIN_REBUCKETIZE = "auto"
 # stale (entry timestamp), so listing indexes cannot cancel a LIVE
 # concurrent writer's in-flight action. Explicit recover() ignores it.
 DEFAULT_RECOVER_GRACE_SECONDS = 300.0
+DEFAULT_SERVE_WORKERS = 4
+DEFAULT_SERVE_MAX_QUEUE_DEPTH = 32
+DEFAULT_SERVE_PLAN_CACHE_MAX_ENTRIES = 128
+DEFAULT_SERVE_RESULT_CACHE_MAX_BYTES = 256 << 20
 
 
 def _as_bool(value: Any) -> bool:
@@ -126,6 +146,13 @@ class HyperspaceConf:
     fallback_enabled: bool = True
     recover_on_access: bool = True
     recover_grace_seconds: float = DEFAULT_RECOVER_GRACE_SECONDS
+    serve_workers: int = DEFAULT_SERVE_WORKERS
+    serve_max_queue_depth: int = DEFAULT_SERVE_MAX_QUEUE_DEPTH
+    serve_query_timeout_seconds: float = 0.0  # 0 = no per-query timeout
+    serve_plan_cache_enabled: bool = True
+    serve_plan_cache_max_entries: int = DEFAULT_SERVE_PLAN_CACHE_MAX_ENTRIES
+    serve_result_cache_enabled: bool = False  # opt-in: results pin host memory
+    serve_result_cache_max_bytes: int = DEFAULT_SERVE_RESULT_CACHE_MAX_BYTES
     overrides: dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def __post_init__(self):
@@ -172,6 +199,20 @@ class HyperspaceConf:
             self.recover_on_access = _as_bool(value)
         elif key == RECOVER_GRACE_SECONDS:
             self.recover_grace_seconds = float(value)
+        elif key == SERVE_WORKERS:
+            self.serve_workers = int(value)
+        elif key == SERVE_MAX_QUEUE_DEPTH:
+            self.serve_max_queue_depth = int(value)
+        elif key == SERVE_QUERY_TIMEOUT_SECONDS:
+            self.serve_query_timeout_seconds = float(value)
+        elif key == SERVE_PLAN_CACHE_ENABLED:
+            self.serve_plan_cache_enabled = _as_bool(value)
+        elif key == SERVE_PLAN_CACHE_MAX_ENTRIES:
+            self.serve_plan_cache_max_entries = int(value)
+        elif key == SERVE_RESULT_CACHE_ENABLED:
+            self.serve_result_cache_enabled = _as_bool(value)
+        elif key == SERVE_RESULT_CACHE_MAX_BYTES:
+            self.serve_result_cache_max_bytes = int(value)
         elif key == FAULTS_ENABLED:
             # Process-global kill switch for the injection harness —
             # matches the process-global filesystem state it guards.
@@ -241,6 +282,20 @@ class HyperspaceConf:
             return self.recover_on_access
         if key == RECOVER_GRACE_SECONDS:
             return self.recover_grace_seconds
+        if key == SERVE_WORKERS:
+            return self.serve_workers
+        if key == SERVE_MAX_QUEUE_DEPTH:
+            return self.serve_max_queue_depth
+        if key == SERVE_QUERY_TIMEOUT_SECONDS:
+            return self.serve_query_timeout_seconds
+        if key == SERVE_PLAN_CACHE_ENABLED:
+            return self.serve_plan_cache_enabled
+        if key == SERVE_PLAN_CACHE_MAX_ENTRIES:
+            return self.serve_plan_cache_max_entries
+        if key == SERVE_RESULT_CACHE_ENABLED:
+            return self.serve_result_cache_enabled
+        if key == SERVE_RESULT_CACHE_MAX_BYTES:
+            return self.serve_result_cache_max_bytes
         if key == OBS_ENABLED:
             from hyperspace_tpu.obs import trace as _obs_trace
 

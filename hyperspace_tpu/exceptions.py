@@ -36,6 +36,32 @@ class IndexCorruptionError(HyperspaceError):
         self.path = path
 
 
+class AdmissionRejected(HyperspaceError):
+    """The serving layer refused to enqueue a query (docs/serving.md):
+    the admission queue is at its configured max depth, or the server is
+    draining/shut down. Deliberately raised at submit time — load
+    shedding happens at the door, not after a query has consumed queue
+    slots and worker time. Carries the observed depth for backpressure
+    decisions (retry-after, client-side throttling)."""
+
+    def __init__(self, msg: str, depth: int | None = None, max_depth: int | None = None):
+        super().__init__(msg)
+        self.depth = depth
+        self.max_depth = max_depth
+
+
+class QueryTimeout(HyperspaceError):
+    """A served query exceeded its per-query timeout (docs/serving.md):
+    either it expired while still waiting in the admission queue (the
+    worker discards it unexecuted), or the caller's `result()` wait ran
+    out while the query was still executing. `elapsed_s` is how long the
+    query had been in the system when the timeout fired."""
+
+    def __init__(self, msg: str, elapsed_s: float | None = None):
+        super().__init__(msg)
+        self.elapsed_s = elapsed_s
+
+
 class TransientIOError(OSError):
     """Marker for IO failures worth retrying (lease contention, flaky
     remote filesystems). Carries errno EIO so `is_retryable` classifies

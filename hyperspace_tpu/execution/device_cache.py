@@ -39,6 +39,10 @@ class RefCache:
         self.budget = int(budget_bytes)
         self._lock = threading.Lock()
         self._entries: dict[tuple, tuple[int, tuple, object]] = {}
+        # Single-flight: key -> Event set when that key's in-progress
+        # build finishes (docs/serving.md — N concurrent clients missing
+        # on the same cold key must not stage the same upload N times).
+        self._building: dict[tuple, threading.Event] = {}
         self._bytes = 0
         self.hits = 0
         self.misses = 0
@@ -49,17 +53,34 @@ class RefCache:
 
     def get_or_build(self, key: tuple, base_refs: tuple, build):
         """`build() -> (value, nbytes)`; value cached under `key` while
-        `base_refs` are pinned."""
-        with self._lock:
-            hit = self._entries.get(key)
-            if hit is not None:
-                self._entries[key] = self._entries.pop(key)  # LRU touch
-                self.hits += 1
-                self._met_hits.inc()
-                return hit[2]
-            self.misses += 1
+        `base_refs` are pinned. Concurrent misses on the same key are
+        single-flighted: one caller builds, the rest wait on its event
+        and then hit (a waiter re-builds only if the value turned out
+        too large to cache — same cost as before the dedup)."""
+        while True:
+            with self._lock:
+                hit = self._entries.get(key)
+                if hit is not None:
+                    self._entries[key] = self._entries.pop(key)  # LRU touch
+                    self.hits += 1
+                    self._met_hits.inc()
+                    return hit[2]
+                ev = self._building.get(key)
+                if ev is None:
+                    self._building[key] = threading.Event()
+                    self.misses += 1
+                    break  # this caller builds
+            ev.wait()
+            # Re-check: usually a hit now. If the builder failed or the
+            # value was uncacheable, the building slot is free again and
+            # this caller becomes the builder on the next lap.
         self._met_misses.inc()
-        value, nbytes = build()
+        try:
+            value, nbytes = build()
+        except BaseException:
+            with self._lock:
+                self._building.pop(key).set()
+            raise
         evicted = 0
         with self._lock:
             if nbytes <= self.budget // 4 and key not in self._entries:
@@ -71,6 +92,7 @@ class RefCache:
                     self._bytes -= nb
                     evicted += 1
             self._met_bytes.set(self._bytes)
+            self._building.pop(key).set()
         if evicted:
             self._met_evictions.inc(evicted)
         return value

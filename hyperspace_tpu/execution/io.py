@@ -79,7 +79,8 @@ def _evict_locked() -> None:
     global _cache_bytes
     while _cache_bytes > _CACHE_BUDGET and _cache:
         k = next(iter(_cache))
-        _, nb, _ = _cache.pop(k)
+        # Caller holds _cache_lock (the _locked suffix is the contract).
+        _, nb, _ = _cache.pop(k)  # noqa: HSL008
         _cache_bytes -= nb
 
 

@@ -11,6 +11,8 @@ that applies the rewrite rules when enabled.
 
 from __future__ import annotations
 
+import dataclasses
+import threading
 from pathlib import Path
 
 from hyperspace_tpu.config import HyperspaceConf
@@ -49,8 +51,32 @@ def _enable_persistent_compile_cache() -> None:
         pass
 
 
+@dataclasses.dataclass
+class QueryOutcome:
+    """Per-query handle state: everything one `run` produced, owned by
+    the caller instead of smeared across session globals. Two concurrent
+    queries each get their own outcome; the session keeps a lock-guarded
+    *view* of the most recent one (`last_query_stats` / `last_profile()`)
+    for the single-caller API. The serving plane (docs/serving.md)
+    attaches an outcome to each QueryHandle."""
+
+    result: object  # ColumnTable
+    stats: dict
+    physical_plan: object
+    profile: object
+    replans: int = 0
+    used_indexes: bool = True
+
+
 class HyperspaceSession:
-    """The engine session: configuration + mesh + executor + rule toggle."""
+    """The engine session: configuration + mesh + executor + rule toggle.
+
+    Thread-safety: `run()` may be called from N threads (the serving
+    plane does exactly that). Each query's mutable state lives in a
+    per-query :class:`QueryOutcome`; the shared session view
+    (`last_query_stats`, `last_physical_plan`, `last_profile()`, the
+    corruption-quarantine `index_health` map, lazy manager init) is
+    guarded by one reentrant lock."""
 
     def __init__(self, system_path: str | None = None, num_buckets: int | None = None, mesh=None):
         kwargs = {}
@@ -63,6 +89,8 @@ class HyperspaceSession:
         self.mesh = mesh
         self._enabled = False
         self._manager: CachingIndexCollectionManager | None = None
+        # Guards the session view below + lazy manager construction.
+        self._state_lock = threading.RLock()
         # Executed-plan evidence of the most recent run(): Executor.stats
         # and the executed PhysicalNode tree.
         self.last_query_stats: dict = {}
@@ -75,6 +103,8 @@ class HyperspaceSession:
         # that served corrupt data is quarantined from the rewrite rules
         # for the rest of the session; queries transparently fall back to
         # the source (docs/fault_tolerance.md). recover()/refresh clears.
+        # Mutations go through _state_lock; per-query snapshots keep one
+        # query's replan decisions consistent.
         self.index_health: dict[str, dict] = {}
 
     # -- rule toggle (package.scala:46-70) --------------------------------
@@ -93,20 +123,22 @@ class HyperspaceSession:
     @property
     def manager(self) -> CachingIndexCollectionManager:
         if self._manager is None:
-            def writer_factory():
-                from hyperspace_tpu.execution.builder import DeviceIndexBuilder
+            with self._state_lock:
+                if self._manager is None:
+                    def writer_factory():
+                        from hyperspace_tpu.execution.builder import DeviceIndexBuilder
 
-                w = DeviceIndexBuilder(
-                    mesh=self.mesh,
-                    memory_budget_bytes=self.conf.build_memory_budget_bytes,
-                    chunk_bytes=self.conf.build_chunk_bytes or None,
-                    venue=self.conf.build_venue,
-                    venue_min_mbps=self.conf.join_venue_min_mbps,
-                )
-                self._last_writer = w
-                return w
+                        w = DeviceIndexBuilder(
+                            mesh=self.mesh,
+                            memory_budget_bytes=self.conf.build_memory_budget_bytes,
+                            chunk_bytes=self.conf.build_chunk_bytes or None,
+                            venue=self.conf.build_venue,
+                            venue_min_mbps=self.conf.join_venue_min_mbps,
+                        )
+                        self._last_writer = w
+                        return w
 
-            self._manager = CachingIndexCollectionManager(self.conf, writer_factory)
+                    self._manager = CachingIndexCollectionManager(self.conf, writer_factory)
         return self._manager
 
     @property
@@ -144,13 +176,15 @@ class HyperspaceSession:
         # sides (where the index rules cover them) and scans narrow to
         # what the query needs.
         indexes = self.manager.get_indexes()
-        if self.index_health:
+        with self._state_lock:
+            unhealthy = set(self.index_health)
+        if unhealthy:
             # Indexes that served corrupt data are out of the candidate
             # set until recovered — degradation is sticky per session,
             # not re-discovered (and re-failed) on every query.
             indexes = [
                 e for e in indexes
-                if str(Path(e.content.root)) not in self.index_health
+                if str(Path(e.content.root)) not in unhealthy
             ]
         return apply_rules(prune_columns(push_down_filters(plan)), indexes, conf=self.conf)
 
@@ -167,6 +201,23 @@ class HyperspaceSession:
         re-plans — first through the remaining healthy indexes, then
         (if corruption persists) straight against the source data. The
         query answers either way; `hyperspace_tpu.stats` counts it."""
+        outcome = self.run_query(plan, profile_dir=profile_dir)
+        self._publish(outcome)
+        return outcome.result
+
+    def run_query(
+        self,
+        plan: LogicalPlan,
+        profile_dir: str | Path | None = None,
+        plan_cache=None,
+    ) -> QueryOutcome:
+        """Execute a plan into a per-query :class:`QueryOutcome` without
+        touching the session view — the concurrency-safe entry point the
+        serving plane uses (docs/serving.md). `plan_cache` (a
+        serve.PlanCache) memoizes `optimized_plan` per versioned plan
+        key; its key includes the quarantine set, so a mid-query
+        corruption replan re-optimizes under the new key instead of
+        hitting the poisoned entry."""
         import time
 
         from hyperspace_tpu import stats
@@ -185,7 +236,12 @@ class HyperspaceSession:
             while True:
                 executor = Executor(mesh=self.mesh, conf=self.conf)
                 with obs_trace.span("plan.optimize", indexes_enabled=self._enabled):
-                    optimized = self.optimized_plan(plan) if use_indexes else plan
+                    if not use_indexes:
+                        optimized = plan
+                    elif plan_cache is not None and self._enabled:
+                        optimized = plan_cache.get_or_optimize(self, plan)
+                    else:
+                        optimized = self.optimized_plan(plan)
                 try:
                     if profile_dir is not None:
                         import jax
@@ -199,13 +255,14 @@ class HyperspaceSession:
                     if not (self._enabled and use_indexes and self.conf.fallback_enabled):
                         raise
                     root = str(Path(e.index_root)) if e.index_root is not None else None
-                    if root is None or root in self.index_health:
-                        # No provenance to quarantine by (or quarantining it
-                        # didn't help): indexes go off wholesale for this
-                        # query — the loop provably terminates.
-                        use_indexes = False
-                    if root is not None:
-                        self.index_health[root] = {"reason": e.msg, "path": e.path}
+                    with self._state_lock:
+                        if root is None or root in self.index_health:
+                            # No provenance to quarantine by (or quarantining
+                            # it didn't help): indexes go off wholesale for
+                            # this query — the loop provably terminates.
+                            use_indexes = False
+                        if root is not None:
+                            self.index_health[root] = {"reason": e.msg, "path": e.path}
                     stats.increment("fallback.queries")
                     replans += 1
                     obs_trace.event("fallback.replan", index=root, reason=e.msg)
@@ -215,25 +272,43 @@ class HyperspaceSession:
                         "index data unreadable (%s); re-planning query against source", e.msg
                     )
         total_s = time.perf_counter() - t_start
-        self.last_query_stats = executor.stats
-        if self.index_health:
-            self.last_query_stats["degraded_indexes"] = sorted(self.index_health)
-        self.last_physical_plan = executor.physical_plan
+        with self._state_lock:
+            degraded = sorted(self.index_health)
+        query_stats = executor.stats
+        if degraded:
+            query_stats["degraded_indexes"] = degraded
         cache_after = self._cache_counts(hio, device_cache)
-        self._last_profile = obs_profile.build_profile(
+        profile = obs_profile.build_profile(
             total_s=total_s,
             physical_plan=executor.physical_plan,
-            stats=self.last_query_stats,
+            stats=query_stats,
             venue=self._venue_info(),
             cache={k: cache_after[k] - cache_before[k] for k in cache_after},
             fallback={
                 "replans": replans,
-                "degraded_indexes": sorted(self.index_health),
+                "degraded_indexes": degraded,
                 "used_indexes": use_indexes,
             },
             trace_root=root_span if isinstance(root_span, obs_trace.Span) else None,
         )
-        return result
+        return QueryOutcome(
+            result=result,
+            stats=query_stats,
+            physical_plan=executor.physical_plan,
+            profile=profile,
+            replans=replans,
+            used_indexes=use_indexes,
+        )
+
+    def _publish(self, outcome: QueryOutcome) -> None:
+        """Install a finished query's outcome as the session view
+        (`last_query_stats` / `last_physical_plan` / `last_profile()`)
+        in one locked step, so a reader never sees the stats of one
+        query next to the profile of another."""
+        with self._state_lock:
+            self.last_query_stats = outcome.stats
+            self.last_physical_plan = outcome.physical_plan
+            self._last_profile = outcome.profile
 
     @staticmethod
     def _cache_counts(hio, device_cache) -> dict:
@@ -263,8 +338,21 @@ class HyperspaceSession:
         """The QueryProfile of the most recent run() in this session
         (None before the first query). Render it with
         `Hyperspace.explain(plan, mode="analyze")` or inspect
-        `.to_json()` (docs/observability.md)."""
-        return self._last_profile
+        `.to_json()` (docs/observability.md). Under concurrent serving,
+        per-query profiles ride the QueryHandle instead
+        (docs/serving.md) — this view is only "the most recent"."""
+        with self._state_lock:
+            return self._last_profile
+
+    def serve(self, **kwargs):
+        """Construct a concurrent QueryServer over this session
+        (docs/serving.md): bounded worker pool, admission control, and
+        the versioned plan/result caches. Keyword arguments override the
+        `hyperspace.serve.*` config defaults. The serving subsystem is
+        otherwise off — plain `run()` callers never pay for it."""
+        from hyperspace_tpu.serve import QueryServer
+
+        return QueryServer(self, **kwargs)
 
     def to_pandas(self, plan: LogicalPlan):
         import pandas as pd
@@ -317,7 +405,8 @@ class Hyperspace:
         """A successful rebuild supersedes whatever corruption got the
         index quarantined in this session — let it serve queries again."""
         root = str(self.session.manager.path_resolver.get_index_path(name))
-        self.session.index_health.pop(root, None)
+        with self.session._state_lock:
+            self.session.index_health.pop(root, None)
 
     def cancel(self, name: str) -> None:
         self.session.manager.cancel(name)
@@ -334,10 +423,12 @@ class Hyperspace:
         if name is not None:
             report = mgr.recover(name)
             root = str(mgr.path_resolver.get_index_path(name))
-            self.session.index_health.pop(root, None)
+            with self.session._state_lock:
+                self.session.index_health.pop(root, None)
             return report
         reports = {d.name: mgr.recover(d.name) for d in mgr.path_resolver.list_index_paths()}
-        self.session.index_health.clear()
+        with self.session._state_lock:
+            self.session.index_health.clear()
         return reports
 
     def indexes(self):

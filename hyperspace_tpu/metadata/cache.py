@@ -4,12 +4,24 @@ Reference parity: index/Cache.scala:23-41 (get/set/clear SPI) and
 index/CachingIndexCollectionManager.scala:117-160
 (CreationTimeBasedIndexCache: entries expire `expiry_seconds` after they
 were set; every mutating API clears the cache).
+
+Thread-safe: the serving plane (docs/serving.md) reads this cache from N
+worker threads while mutating APIs clear it. One lock covers the whole
+get/set/clear surface — in particular the stamp check and the expiry
+eviction in ``get`` are a single critical section, so a concurrent
+``set`` can never interleave between "entry is stale" and "drop it" and
+have its fresh entry evicted (the torn read the single-threaded version
+tolerated). Hits and misses land in the declared counter registry
+(``stats.KNOWN_COUNTERS``: ``metadata.cache.hits`` / ``.misses``).
 """
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Generic, TypeVar
+
+from hyperspace_tpu import stats
 
 T = TypeVar("T")
 
@@ -28,23 +40,31 @@ class Cache(Generic[T]):
 class CreationTimeBasedCache(Cache[T]):
     def __init__(self, expiry_seconds: float):
         self.expiry_seconds = expiry_seconds
+        self._lock = threading.Lock()
         self._entry: T | None = None
         self._set_at: float = 0.0
 
     def get(self) -> T | None:
-        if self._entry is None:
-            return None
-        # monotonic, not wall clock: an NTP step backwards must not make
-        # a stale entry immortal (nor a forward step expire a fresh one).
-        if time.monotonic() - self._set_at > self.expiry_seconds:
-            self.clear()
-            return None
-        return self._entry
+        with self._lock:
+            if self._entry is None:
+                stats.increment("metadata.cache.misses")
+                return None
+            # monotonic, not wall clock: an NTP step backwards must not make
+            # a stale entry immortal (nor a forward step expire a fresh one).
+            if time.monotonic() - self._set_at > self.expiry_seconds:
+                self._entry = None
+                self._set_at = 0.0
+                stats.increment("metadata.cache.misses")
+                return None
+            stats.increment("metadata.cache.hits")
+            return self._entry
 
     def set(self, entry: T) -> None:
-        self._entry = entry
-        self._set_at = time.monotonic()
+        with self._lock:
+            self._entry = entry
+            self._set_at = time.monotonic()
 
     def clear(self) -> None:
-        self._entry = None
-        self._set_at = 0.0
+        with self._lock:
+            self._entry = None
+            self._set_at = 0.0

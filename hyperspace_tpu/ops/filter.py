@@ -689,8 +689,13 @@ def _eval3(e: Expr, cols: dict, lit_iter):
 # (structure, column layout, literal dtypes, padded length) → jitted fn.
 # Literals enter as traced scalars and shapes are padded to powers of two,
 # so repeated point lookups with different keys / different bucket sizes
-# hit the XLA compile cache instead of re-tracing per query.
+# hit the XLA compile cache instead of re-tracing per query. Lock-guarded
+# for concurrent serve workers (a racing double-trace is harmless but the
+# insert must not tear the dict).
+import threading
+
 _MASK_FN_CACHE: dict = {}
+_MASK_FN_LOCK = threading.Lock()
 
 
 def _pow2(n: int) -> int:
@@ -848,7 +853,8 @@ def eval_predicate_mask(
     lit_args = [np.asarray(v) for v in lits]
 
     key = (struct, tuple(layout), tuple(a.dtype.str for a in lit_args), n_pad)
-    fn = _MASK_FN_CACHE.get(key)
+    with _MASK_FN_LOCK:
+        fn = _MASK_FN_CACHE.get(key)
     if fn is None:
         lowered_names = [nm for nm, _ in layout]
 
@@ -858,7 +864,8 @@ def eval_predicate_mask(
             return jnp.broadcast_to(t, (n_pad,))
 
         fn = jax.jit(raw)
-        _MASK_FN_CACHE[key] = fn
+        with _MASK_FN_LOCK:
+            _MASK_FN_CACHE[key] = fn
 
     mask = fn(tuple(arrays), tuple(jnp.asarray(v) for v in lit_args))
     return np.asarray(jax.device_get(mask)).astype(bool)[:n]

@@ -25,8 +25,12 @@ _TILE = 2048
 _MAX_PALLAS_K = 64
 
 # (k, tile) combos whose Pallas lowering failed — only those fall back
-# permanently; other shapes keep the fast path.
+# permanently; other shapes keep the fast path. Lock-guarded: concurrent
+# serve-plane queries record failures from N worker threads.
+import threading
+
 _pallas_bad: set = set()
+_pallas_bad_lock = threading.Lock()
 
 
 def _next_mult(n: int, m: int) -> int:
@@ -126,6 +130,7 @@ def topk(scores, k: int, impl: str = "auto") -> tuple[np.ndarray, np.ndarray]:
         except Exception:  # noqa: BLE001 — fall back to the XLA path
             if impl == "pallas":
                 raise
-            _pallas_bad.add((k, tile))
+            with _pallas_bad_lock:
+                _pallas_bad.add((k, tile))
     v, i = jax.lax.top_k(scores, k)
     return np.asarray(v), np.asarray(i)

@@ -1,0 +1,27 @@
+"""Concurrent query-serving plane (docs/serving.md).
+
+`QueryServer` puts a bounded worker pool + admission-control queue in
+front of one `HyperspaceSession`; `PlanCache`/`ResultCache` memoize
+optimized plans and whole results under versioned keys that index
+mutations and source appends invalidate structurally. Off by default —
+construct it explicitly (`session.serve()`); plain `session.run()` is
+unchanged.
+"""
+
+from hyperspace_tpu.serve.plan_cache import (
+    PlanCache,
+    collection_log_versions,
+    versioned_plan_key,
+)
+from hyperspace_tpu.serve.result_cache import ResultCache, table_nbytes
+from hyperspace_tpu.serve.scheduler import QueryHandle, QueryServer
+
+__all__ = [
+    "QueryServer",
+    "QueryHandle",
+    "PlanCache",
+    "ResultCache",
+    "collection_log_versions",
+    "versioned_plan_key",
+    "table_nbytes",
+]

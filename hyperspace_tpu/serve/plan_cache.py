@@ -1,0 +1,110 @@
+"""Optimized-plan cache: repeat queries skip `optimized_plan()` entirely.
+
+Plan optimization is pure host work — rule matching, index-log reads,
+pushdown/prune rewrites — but under serving traffic it runs once per
+query, and for a point-lookup workload it can dominate the (cached,
+device-resident) execution. This cache memoizes the *output* of
+`HyperspaceSession.optimized_plan` under a **versioned key**, so
+invalidation is structural rather than event-driven:
+
+    (plan signature,            # canonical-JSON MD5 of the logical plan
+     data fingerprint,          # (size, mtime, path) fold of source files
+     index log versions,        # (index dir, latest log id) per index
+     quarantine set,            # session.index_health snapshot
+     hyperspace enabled?)
+
+Every mutating index API — create/refresh/optimize/delete/restore/vacuum
+— commits by writing a NEW log entry, so the latest log id bumps and old
+keys simply never hit again; appended/rewritten source files change the
+data fingerprint the same way. There is no invalidation hook to forget
+and no stale-entry window: a key either describes the current world or
+is unreachable. The LRU bound only caps memory.
+
+Thread-safe; hits/misses/evictions land in the exportable metrics
+registry (`serve.plan_cache.*`, docs/observability.md).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from hyperspace_tpu.obs import metrics as obs_metrics
+from hyperspace_tpu.signature import FileBasedSignatureProvider, plan_signature
+
+
+def collection_log_versions(session) -> tuple:
+    """(index dir name, latest log id) per index under the system path —
+    the cheap metadata-plane stamp every versioned serve key embeds. Any
+    committed index mutation writes a new log entry and bumps it."""
+    mgr = session.manager
+    out = []
+    for d in mgr.path_resolver.list_index_paths():
+        out.append((d.name, mgr.log_manager_factory(d).get_latest_id()))
+    return tuple(out)
+
+
+def versioned_plan_key(session, plan) -> tuple:
+    """The full serve-cache key for `plan` under `session`'s current
+    world state (module docstring). Stat-ing the source files costs one
+    os.stat per file — orders of magnitude cheaper than re-optimizing,
+    and it is exactly what makes a post-append/post-refresh hit
+    impossible."""
+    fp = FileBasedSignatureProvider().signature(plan)
+    with session._state_lock:
+        quarantined = tuple(sorted(session.index_health))
+    return (
+        plan_signature(plan),
+        fp.value if fp is not None else None,
+        collection_log_versions(session),
+        quarantined,
+        session.is_hyperspace_enabled(),
+    )
+
+
+class PlanCache:
+    """Bounded LRU of optimized logical plans keyed by versioned plan
+    key. Cached plans are shared across threads — plan nodes are
+    immutable after construction (the optimizer builds new trees, the
+    executor only reads them)."""
+
+    def __init__(self, max_entries: int = 128):
+        self.max_entries = int(max_entries)
+        self._lock = threading.Lock()
+        self._entries: dict[tuple, object] = {}
+        self._hits = obs_metrics.counter("serve.plan_cache.hits", "optimized-plan cache hits")
+        self._misses = obs_metrics.counter("serve.plan_cache.misses", "optimized-plan cache misses")
+        self._evictions = obs_metrics.counter("serve.plan_cache.evictions", "LRU evictions")
+
+    def get_or_optimize(self, session, plan):
+        """The optimized plan for `plan`, from cache when the versioned
+        key matches, else freshly via `session.optimized_plan` (outside
+        the lock — optimization reads the index log and stats files)."""
+        key = versioned_plan_key(session, plan)
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is not None:
+                self._entries[key] = self._entries.pop(key)  # LRU touch
+                self._hits.inc()
+                return hit
+        self._misses.inc()
+        optimized = session.optimized_plan(plan)
+        with self._lock:
+            if key not in self._entries:
+                self._entries[key] = optimized
+                while len(self._entries) > self.max_entries:
+                    self._entries.pop(next(iter(self._entries)))
+                    self._evictions.inc()
+        return optimized
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "hits": self._hits.value,
+                "misses": self._misses.value,
+                "evictions": self._evictions.value,
+            }

@@ -1,0 +1,109 @@
+"""Opt-in result cache: identical repeat queries skip execution outright.
+
+Values are whole :class:`~hyperspace_tpu.execution.table.ColumnTable`
+results under the same versioned keys as the plan cache
+(serve/plan_cache.py): plan signature + source-data fingerprint + index
+log versions + quarantine set + enablement. The version stamping is what
+makes the "never serve pre-refresh rows" guarantee structural: a refresh
+(or any index mutation, or a source append) bumps the stamp, so every
+entry cached before it becomes unreachable — there is no epoch counter
+to bump and no window where a stale row can hit. tests/test_serve.py
+drives a refresh mid-flight to prove it.
+
+Opt-in (`hyperspace.serve.resultCache.enabled`, default false) because
+caching results pins host memory per distinct query and only pays off
+for workloads with literal repeats. Byte accounting is explicit: entries
+are LRU-evicted past `maxBytes`, and a single result larger than a
+quarter of the budget is never admitted (it would flush the whole cache
+for one query's benefit).
+
+Cached tables are returned by reference to every hit — treat results as
+read-only (the decode path already does).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from hyperspace_tpu.obs import metrics as obs_metrics
+from hyperspace_tpu.serve.plan_cache import versioned_plan_key
+
+
+def table_nbytes(table) -> int:
+    """Resident byte estimate of a ColumnTable: physical column arrays,
+    validity masks, and dictionary payloads (object arrays report only
+    pointer bytes via .nbytes, so string payload is summed explicitly)."""
+    n = 0
+    for arr in table.columns.values():
+        n += int(arr.nbytes)
+    for arr in table.validity.values():
+        n += int(arr.nbytes)
+    for d in table.dictionaries.values():
+        n += int(d.nbytes) + sum(len(str(s)) for s in d.tolist())
+    return n
+
+
+class ResultCache:
+    """Bounded LRU of query results keyed by versioned plan key."""
+
+    def __init__(self, max_bytes: int = 256 << 20):
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        self._entries: dict[tuple, tuple[int, object]] = {}
+        self._bytes = 0
+        self._hits = obs_metrics.counter("serve.result_cache.hits", "result cache hits")
+        self._misses = obs_metrics.counter("serve.result_cache.misses", "result cache misses")
+        self._evictions = obs_metrics.counter("serve.result_cache.evictions", "LRU evictions")
+        self._gauge_bytes = obs_metrics.gauge("serve.result_cache.bytes", "resident result bytes")
+
+    def key(self, session, plan) -> tuple:
+        return versioned_plan_key(session, plan)
+
+    def get(self, key: tuple):
+        """The cached result for `key`, or None (counted as hit/miss)."""
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is not None:
+                self._entries[key] = self._entries.pop(key)  # LRU touch
+                self._hits.inc()
+                return hit[1]
+            self._misses.inc()
+            return None
+
+    def put(self, key: tuple, table) -> bool:
+        """Admit `table` under `key`; False when it is too large to cache
+        (more than a quarter of the byte budget) or already present."""
+        nb = table_nbytes(table)
+        if nb > self.max_bytes // 4:
+            return False
+        evicted = 0
+        with self._lock:
+            if key in self._entries:
+                return False
+            self._entries[key] = (nb, table)
+            self._bytes += nb
+            while self._bytes > self.max_bytes and len(self._entries) > 1:
+                k = next(iter(self._entries))  # oldest = least recently used
+                old_nb, _ = self._entries.pop(k)
+                self._bytes -= old_nb
+                evicted += 1
+            self._gauge_bytes.set(self._bytes)
+        if evicted:
+            self._evictions.inc(evicted)
+        return True
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+            self._gauge_bytes.set(0)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes": self._bytes,
+                "hits": self._hits.value,
+                "misses": self._misses.value,
+                "evictions": self._evictions.value,
+            }

@@ -59,6 +59,19 @@ def diff_source_files(entry, plan, current=None):
     return appended, deleted
 
 
+def plan_signature(plan: LogicalPlan) -> str:
+    """Structural fingerprint of a logical plan: an MD5 over its canonical
+    JSON serialization (sorted keys, so dict ordering cannot perturb it).
+    Two plans with the same signature ask the same question of the same
+    sources — the serving plane's plan/result caches key on this plus the
+    data fingerprint and the index-collection log versions
+    (serve/plan_cache.py), so a repeat query skips re-optimization."""
+    import json
+
+    payload = json.dumps(plan.to_json(), sort_keys=True, default=str)
+    return hashlib.md5(payload.encode()).hexdigest()
+
+
 class SignatureProvider:
     name: str = "base"
 
@@ -83,16 +96,22 @@ class FileBasedSignatureProvider(SignatureProvider):
         return Fingerprint(kind=self.name, value=fingerprint_files(files))
 
 
+import threading
+
 _REGISTRY: dict[str, type[SignatureProvider]] = {
     FileBasedSignatureProvider.name: FileBasedSignatureProvider,
 }
+_REGISTRY_LOCK = threading.Lock()
 
 
 def register_signature_provider(cls: type[SignatureProvider]) -> None:
-    _REGISTRY[cls.name] = cls
+    with _REGISTRY_LOCK:
+        _REGISTRY[cls.name] = cls
 
 
 def create_signature_provider(name: str = "fileBased") -> SignatureProvider:
-    if name not in _REGISTRY:
+    with _REGISTRY_LOCK:
+        provider = _REGISTRY.get(name)
+    if provider is None:
         raise HyperspaceError(f"unknown signature provider {name!r}")
-    return _REGISTRY[name]()
+    return provider()

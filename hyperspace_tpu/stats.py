@@ -26,6 +26,8 @@ Counter names in use:
 - ``recover.rolled``       recover() roll-forwards of a transient log
 - ``recover.quarantined_entries``  torn log entries quarantined by recover()
 - ``recover.orphans_removed``      unreferenced version dirs GC'd by recover()
+- ``metadata.cache.hits``    TTL index-entry cache hits (metadata/cache.py)
+- ``metadata.cache.misses``  TTL index-entry cache misses (empty or expired)
 """
 
 from __future__ import annotations
@@ -46,6 +48,8 @@ KNOWN_COUNTERS = (
     "recover.rolled",
     "recover.quarantined_entries",
     "recover.orphans_removed",
+    "metadata.cache.hits",
+    "metadata.cache.misses",
 )
 
 _counters = {name: _metrics.counter(name) for name in KNOWN_COUNTERS}

@@ -16,21 +16,24 @@ The check reads /proc/self/maps, so it is sampled (every
 from __future__ import annotations
 
 import itertools
+import threading
 
 _CHECK_EVERY = 16
 _counter = itertools.count()
 _limit_cache: list = []  # [int] once resolved
+_limit_lock = threading.Lock()
 
 
 def _map_limit() -> int:
     """70% of vm.max_map_count (0 where unknown: disables the guard)."""
-    if not _limit_cache:
-        try:
-            with open("/proc/sys/vm/max_map_count", "rb") as f:
-                _limit_cache.append(int(f.read()) * 7 // 10)
-        except (OSError, ValueError):
-            _limit_cache.append(0)
-    return _limit_cache[0]
+    with _limit_lock:
+        if not _limit_cache:
+            try:
+                with open("/proc/sys/vm/max_map_count", "rb") as f:
+                    _limit_cache.append(int(f.read()) * 7 // 10)
+            except (OSError, ValueError):
+                _limit_cache.append(0)
+        return _limit_cache[0]
 
 
 def _map_count() -> int:

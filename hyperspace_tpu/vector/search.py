@@ -262,7 +262,12 @@ def _read_partition_multi(dirs: list[Path], p: int, schema: Schema, rows_map: di
 
 # Per-process partition read cache: (path, mtime_ns) → ColumnTable. The
 # probed working set is re-read on every query batch otherwise; bounded by
-# total cached bytes with FIFO eviction.
+# total cached bytes with FIFO eviction. One lock covers both caches —
+# the byte-budget eviction is a read-modify-write that concurrent serve
+# workers must not interleave.
+import threading
+
+_VEC_CACHE_LOCK = threading.Lock()
 _PARTITION_CACHE: dict = {}
 _PARTITION_CACHE_BYTES = 2 * 1024**3
 
@@ -284,18 +289,20 @@ def _partition_device_emb(version_dir: Path, p: int, schema: Schema, emb_name: s
 
     path = str(version_dir / hio.bucket_file_name(p))
     key = (path, os.stat(path).st_mtime_ns, emb_name)
-    hit = _DEVICE_EMB_CACHE.get(key)
+    with _VEC_CACHE_LOCK:
+        hit = _DEVICE_EMB_CACHE.get(key)
     if hit is not None:
         return hit
     # Read ONLY the embedding column — payload columns are read lazily by
     # _read_partition when a winning row actually lands in this partition.
     t = hio.read_parquet([path], columns=[emb_name], schema=schema)
     arr = jnp.asarray(t.columns[emb_name], dtype=jnp.float32)
-    _DEVICE_EMB_CACHE[key] = arr
-    total = sum(a.nbytes for a in _DEVICE_EMB_CACHE.values())
-    while total > _DEVICE_EMB_CACHE_BYTES and len(_DEVICE_EMB_CACHE) > 1:
-        oldest = next(iter(_DEVICE_EMB_CACHE))
-        total -= _DEVICE_EMB_CACHE.pop(oldest).nbytes
+    with _VEC_CACHE_LOCK:
+        _DEVICE_EMB_CACHE[key] = arr
+        total = sum(a.nbytes for a in _DEVICE_EMB_CACHE.values())
+        while total > _DEVICE_EMB_CACHE_BYTES and len(_DEVICE_EMB_CACHE) > 1:
+            oldest = next(iter(_DEVICE_EMB_CACHE))
+            total -= _DEVICE_EMB_CACHE.pop(oldest).nbytes
     return arr
 
 
@@ -304,15 +311,17 @@ def _read_partition(version_dir: Path, p: int, schema: Schema) -> ColumnTable:
 
     path = str(version_dir / hio.bucket_file_name(p))
     key = (path, os.stat(path).st_mtime_ns)
-    hit = _PARTITION_CACHE.get(key)
+    with _VEC_CACHE_LOCK:
+        hit = _PARTITION_CACHE.get(key)
     if hit is not None:
         return hit
     t = hio.read_parquet([path], columns=schema.names, schema=schema)
-    _PARTITION_CACHE[key] = t
-    # FIFO-evict oldest entries past the byte budget (dict preserves
-    # insertion order).
-    total = sum(_table_bytes(tab) for tab in _PARTITION_CACHE.values())
-    while total > _PARTITION_CACHE_BYTES and len(_PARTITION_CACHE) > 1:
-        oldest = next(iter(_PARTITION_CACHE))
-        total -= _table_bytes(_PARTITION_CACHE.pop(oldest))
+    with _VEC_CACHE_LOCK:
+        _PARTITION_CACHE[key] = t
+        # FIFO-evict oldest entries past the byte budget (dict preserves
+        # insertion order).
+        total = sum(_table_bytes(tab) for tab in _PARTITION_CACHE.values())
+        while total > _PARTITION_CACHE_BYTES and len(_PARTITION_CACHE) > 1:
+            oldest = next(iter(_PARTITION_CACHE))
+            total -= _table_bytes(_PARTITION_CACHE.pop(oldest))
     return t

@@ -226,6 +226,90 @@ class TestMetadataWriteBypass:
         assert rules_of(src) == []
 
 
+class TestLintUnlockedGlobalMutation:
+    def test_unlocked_function_mutation_flagged(self):
+        src = """
+        _cache = {}
+        def put(k, v):
+            _cache[k] = v
+        """
+        assert rules_of(src) == ["HSL008"]
+
+    def test_method_call_mutators_flagged(self):
+        src = """
+        _seen: set = set()
+        def record(x):
+            _seen.add(x)
+        """
+        assert rules_of(src) == ["HSL008"]
+
+    def test_pop_and_del_flagged(self):
+        src = """
+        _cache = dict()
+        def evict(k, j):
+            _cache.pop(k)
+            del _cache[j]
+        """
+        assert rules_of(src) == ["HSL008", "HSL008"]
+
+    def test_mutation_under_lock_clean(self):
+        src = """
+        import threading
+        _cache = {}
+        _lock = threading.Lock()
+        def put(k, v):
+            with _lock:
+                _cache[k] = v
+        """
+        assert rules_of(src) == []
+
+    def test_module_level_mutation_clean(self):
+        # Import-time initialization is single-threaded by construction.
+        src = """
+        _registry = {}
+        _registry["default"] = object()
+        """
+        assert rules_of(src) == []
+
+    def test_local_container_clean(self):
+        src = """
+        def collect(items):
+            out = []
+            for i in items:
+                out.append(i)
+            return out
+        """
+        assert rules_of(src) == []
+
+    def test_read_only_use_clean(self):
+        src = """
+        _cache = {}
+        def get(k):
+            return _cache.get(k)
+        """
+        assert rules_of(src) == []
+
+    def test_allowlisted_obs_singletons_clean(self):
+        # The allowlist is keyed on (basename, name): trace.py's
+        # singleton plumbing mutates by design.
+        src = """
+        NOOP = {}
+        def poke():
+            NOOP["x"] = 1
+        """
+        from hyperspace_tpu.analysis.lint import lint_source
+
+        assert lint_source(textwrap.dedent(src), "hyperspace_tpu/obs/trace.py") == []
+
+    def test_noqa_suppresses(self):
+        src = """
+        _cache = {}
+        def put(k, v):
+            _cache[k] = v  # noqa: HSL008
+        """
+        assert rules_of(src) == []
+
+
 class TestLintCli:
     def test_repo_package_is_clean(self):
         # The permanent guarantee behind the compat satellite: the whole
