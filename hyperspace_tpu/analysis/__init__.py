@@ -1,19 +1,30 @@
-"""Static analysis: pre-execution plan validation + trace-safety lint.
+"""Static analysis: plan validation, per-file lint, whole-program engine.
 
-Two layers (the analog of Catalyst's analyzer, which the Spark reference
-leans on to reject malformed plans before execution — Armbrust et al.,
-SIGMOD 2015; the reference inherits it wholesale):
+Three layers (the analog of Catalyst's analyzer, which the Spark
+reference leans on to reject malformed plans before execution —
+Armbrust et al., SIGMOD 2015; the reference inherits it wholesale):
 
 - `validator` — walks the logical plan IR before the executor touches a
   device, checking schema/dtype resolution of every expression, join
   bucket-spec compatibility, sort-key legality, and rewrite
   (pushdown/prune) equivalence. Raises `PlanValidationError` with
   structured `PlanDiagnostic`s naming the offending node.
-- `lint` — an AST lint over the package source flagging the bug classes
+- `lint` — the per-file AST rules (HSL001-HSL008) for the bug classes
   that actually bite a jax codebase: version-fragile jax imports outside
   `compat.py`, host synchronization inside jitted code, Python control
-  flow on traced values, unhashable static args, unseeded randomness.
-  Run as `python -m hyperspace_tpu.analysis.lint <paths>`.
+  flow on traced values, unhashable static args, unseeded randomness,
+  metadata-write bypass, wall-clock durations / undeclared counters,
+  unlocked global mutation. Run as
+  `python -m hyperspace_tpu.analysis.lint <paths>`.
+- the **whole-program engine** — `program` (module/symbol index +
+  single-pass function summaries), `callgraph` (cross-module call
+  resolution), `locks` (the static lock-acquisition graph), and the
+  rules only it can express: HSL009 lock-order inversion with two-chain
+  witnesses, HSL010 config-key drift against `config.KNOWN_KEYS`,
+  HSL011 resource/exception safety, HSL012 fault-point coverage against
+  `faults.KNOWN_POINTS`. The unified driver — lint + whole-program
+  rules + validator corpus + findings baseline — is
+  `python -m hyperspace_tpu.analysis.check` (docs/static_analysis.md).
 """
 
 from hyperspace_tpu.analysis.validator import (
@@ -22,4 +33,28 @@ from hyperspace_tpu.analysis.validator import (
     validate_rewrite,
 )
 
-__all__ = ["check_plan", "validate_plan", "validate_rewrite"]
+__all__ = [
+    "check_plan",
+    "validate_plan",
+    "validate_rewrite",
+    "CallGraph",
+    "LockGraph",
+    "Program",
+]
+
+
+def __getattr__(name):
+    # Lazy: the engine is only needed by the check driver and tests.
+    if name == "Program":
+        from hyperspace_tpu.analysis.program import Program
+
+        return Program
+    if name == "CallGraph":
+        from hyperspace_tpu.analysis.callgraph import CallGraph
+
+        return CallGraph
+    if name == "LockGraph":
+        from hyperspace_tpu.analysis.locks import LockGraph
+
+        return LockGraph
+    raise AttributeError(name)

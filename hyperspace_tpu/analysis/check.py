@@ -1,0 +1,531 @@
+"""Unified static-analysis driver: lint + whole-program rules + baseline.
+
+    python -m hyperspace_tpu.analysis.check [paths...] [--format json]
+
+One command runs everything the analysis subsystem knows how to check,
+parsing every file exactly ONCE and feeding the same AST to the
+per-file linter (HSL001-HSL008, analysis/lint.py) and the whole-program
+engine (analysis/program.py → callgraph.py → locks.py):
+
+- **HSL009 lock-order inversion** — the static lock-acquisition graph
+  (lock held → locks reachable through the call graph inside the
+  ``with`` body) must be cycle-free; findings carry a two-chain witness.
+- **HSL010 config-key drift** — every ``hyperspace.*`` key that is
+  get/set (or declared as a module constant) must be declared in
+  ``config.KNOWN_KEYS`` (typo suggestions via edit distance); declared
+  keys never read anywhere are dead and reported; the generated key
+  table in docs/configuration.md must match the registry
+  (``--write-config-docs`` regenerates it).
+- **HSL011 resource/exception safety** — locks/spans/files acquired
+  outside ``with``/``try-finally`` on a path that can raise.
+- **HSL012 fault-point coverage** — ``faults.KNOWN_POINTS`` and the
+  ``fault_point()``/``inject()`` call sites must agree in both
+  directions.
+- **Validator corpus** — a small set of known-good / known-bad logical
+  plans is pushed through the plan validator (analysis/validator.py) as
+  a self-test; skipped (with a note) when numpy isn't installed, so the
+  dependency-free CI lint job still runs everything else.
+
+Default paths: the package itself plus ``benchmarks/``, ``bench.py``
+and ``tests/conftest.py`` (the satellite surfaces that feed CI), with a
+narrow, justified allowlist for findings that are correct-but-benign in
+single-threaded benchmark code (:data:`TEST_ALLOWLIST`).
+
+**Baseline.** CI fails only on findings not present in the committed
+``ANALYSIS_BASELINE.json`` (``--write-baseline`` refreshes it), so a
+newly added rule with pre-existing findings can land without blocking
+every unrelated PR, while any NEW finding fails immediately.
+
+Exit codes: 0 = clean (no new findings), 1 = new findings,
+2 = the analyzer itself crashed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import pathlib
+import sys
+
+from hyperspace_tpu.analysis import lint as lint_mod
+from hyperspace_tpu.analysis.callgraph import CallGraph
+from hyperspace_tpu.analysis.lint import (
+    EXIT_CLEAN,
+    EXIT_FINDINGS,
+    EXIT_INTERNAL_ERROR,
+    Finding,
+    RULES,
+)
+from hyperspace_tpu.analysis.locks import LockGraph, resource_findings
+from hyperspace_tpu.analysis.program import Program, _index_module, _module_name
+
+CONFIG_DRIFT = "HSL010"
+FAULT_COVERAGE = "HSL012"
+
+BASELINE_NAME = "ANALYSIS_BASELINE.json"
+DOCS_BEGIN = "<!-- KNOWN_KEYS:begin (generated from config.KNOWN_KEYS — edit config.py, then run python -m hyperspace_tpu.analysis.check --write-config-docs) -->"
+DOCS_END = "<!-- KNOWN_KEYS:end -->"
+
+# (path suffix, rule) -> justification. The narrow test-only allowlist:
+# entries must name code that is single-threaded by construction or
+# otherwise exempt BY DESIGN — anything else gets fixed, not listed.
+TEST_ALLOWLIST: dict[tuple[str, str], str] = {
+    # TPC-DS datagen memoizes generated sales tables in a module dict.
+    # Benchmarks are one process, one thread, by construction (the
+    # harness forks fresh processes per scale) — the HSL008 race cannot
+    # occur, and locking the datagen would suggest it is serve-safe when
+    # it is not meant to be.
+    ("benchmarks/tpcds.py", "HSL008"): "single-threaded benchmark datagen memo",
+}
+
+
+def _repo_root() -> pathlib.Path:
+    return pathlib.Path(__file__).resolve().parent.parent.parent
+
+
+def default_paths(root: pathlib.Path) -> list[pathlib.Path]:
+    out = []
+    for rel in ("hyperspace_tpu", "benchmarks", "bench.py", "tests/conftest.py"):
+        p = root / rel
+        if p.exists():
+            out.append(p)
+    return out
+
+
+# -- shared-parse loading -----------------------------------------------------
+
+def load_sources(paths: list[pathlib.Path]) -> tuple[list, list[Finding]]:
+    """Parse every .py under `paths` once. Returns ([(name, path, source,
+    tree)], findings-for-unparseable-files)."""
+    sources, findings = [], []
+    for p in paths:
+        files = sorted(p.rglob("*.py")) if p.is_dir() else [p]
+        for f in files:
+            try:
+                src = f.read_text()
+            except OSError as e:
+                findings.append(Finding(str(f), 0, 0, "HSL000", f"unreadable: {e}"))
+                continue
+            try:
+                tree = ast.parse(src, filename=str(f))
+            except SyntaxError as e:
+                findings.append(Finding(str(f), e.lineno or 0, e.offset or 0,
+                                        "HSL000", f"syntax error: {e.msg}"))
+                continue
+            sources.append((_module_name(f), str(f), src, tree))
+    return sources, findings
+
+
+def build_program(sources: list) -> Program:
+    modules = {name: _index_module(name, path, src, tree)
+               for name, path, src, tree in sources}
+    return Program(modules)
+
+
+# -- HSL010: config-key drift -------------------------------------------------
+
+def config_key_findings(program: Program, usage_dirs: list[pathlib.Path]) -> list[Finding]:
+    from hyperspace_tpu import config as config_mod
+
+    declared = set(config_mod.KNOWN_KEYS)
+    findings: list[Finding] = []
+    config_module_names = {m.name for m in program.modules.values()
+                           if m.path.endswith("hyperspace_tpu/config.py")}
+    used: set[str] = set()
+    # get/set call sites
+    for fn in sorted(program.functions.values(), key=lambda f: (f.module, f.line)):
+        mod = program.modules[fn.module]
+        for acc in fn.config_accesses:
+            used.add(acc.key)
+            if acc.key in declared:
+                continue
+            if _suppressed(mod, acc.line, CONFIG_DRIFT):
+                continue
+            import difflib
+
+            close = difflib.get_close_matches(acc.key, declared, n=1, cutoff=0.6)
+            hint = f" — did you mean {close[0]!r}?" if close else ""
+            findings.append(Finding(
+                mod.path, acc.line, 0, CONFIG_DRIFT,
+                f"config {'set' if acc.write else 'get'} of undeclared key "
+                f"{acc.key!r}{hint} (declare it in config.KNOWN_KEYS; the "
+                f"runtime rejects it too)",
+            ))
+    # hyperspace.* constants declared outside config.py
+    for mod in program.modules.values():
+        if mod.name in config_module_names:
+            continue
+        for name, val in sorted(mod.const_strings.items()):
+            if val.startswith("hyperspace.") and val not in declared:
+                findings.append(Finding(
+                    mod.path, 0, 0, CONFIG_DRIFT,
+                    f"module constant {name} declares key {val!r} outside "
+                    f"config.KNOWN_KEYS — every hyperspace.* key lives in the "
+                    f"one registry (move the declaration to config.py)",
+                ))
+    # dead keys: declared in KNOWN_KEYS but consumed by NOTHING — not
+    # wired into the conf get/set dispatch, never get/set by key, never
+    # referenced by constant name in another module, and never spelled
+    # literally in the usage scan (tests). The registry-only key is the
+    # drift this catches: documented, settable, and ignored. Only
+    # meaningful when config.py itself is in the scanned set (a corpus
+    # file scanned alone must not report the whole registry dead).
+    if not config_module_names:
+        return findings
+    const_of_key = {}
+    wired: set[str] = set()
+    for mname in config_module_names:
+        mod = program.modules[mname]
+        for cname, val in mod.const_strings.items():
+            const_of_key[val] = cname
+        wired |= {const for const in _dispatch_references(mod.tree)}
+    other_sources = [m.source for m in program.modules.values()
+                     if m.name not in config_module_names]
+    for d in usage_dirs:
+        for f in sorted(d.rglob("*.py")) if d.is_dir() else [d]:
+            try:
+                other_sources.append(f.read_text())
+            except OSError:
+                continue
+    config_paths = [program.modules[m].path for m in config_module_names]
+    for key in sorted(declared - used):
+        cname = const_of_key.get(key)
+        if cname is not None and cname in wired:
+            continue
+        if any(
+            (cname is not None and cname in src) or key in src
+            for src in other_sources
+        ):
+            continue
+        findings.append(Finding(
+            config_paths[0] if config_paths else "hyperspace_tpu/config.py", 0, 0,
+            CONFIG_DRIFT,
+            f"declared key {key!r} is dead: not wired into the conf get/set "
+            f"dispatch and never referenced anywhere — wire it up or delete "
+            f"it from KNOWN_KEYS",
+        ))
+    return findings
+
+
+def _dispatch_references(config_tree: ast.Module) -> set[str]:
+    """Constant names config.py references OUTSIDE their own definition
+    and the KNOWN_KEYS literal — i.e. names the get/set dispatch (or any
+    other real code) actually consumes."""
+    skip_ids: set[int] = set()
+    for node in ast.walk(config_tree):
+        if isinstance(node, ast.Assign):
+            is_const_def = any(
+                isinstance(t, ast.Name) and t.id.isupper() for t in node.targets
+            )
+            is_registry = any(
+                isinstance(t, ast.Name) and t.id == "KNOWN_KEYS" for t in node.targets
+            )
+            if is_registry:
+                for sub in ast.walk(node.value):
+                    skip_ids.add(id(sub))
+            elif is_const_def and isinstance(node.value, ast.Constant):
+                for t in node.targets:
+                    skip_ids.add(id(t))
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            if isinstance(node.target, ast.Name) and node.target.id == "KNOWN_KEYS":
+                for sub in ast.walk(node.value):
+                    skip_ids.add(id(sub))
+    return {
+        node.id
+        for node in ast.walk(config_tree)
+        if isinstance(node, ast.Name)
+        and isinstance(node.ctx, ast.Load)
+        and node.id.isupper()
+        and id(node) not in skip_ids
+    }
+
+
+def docs_findings(root: pathlib.Path) -> list[Finding]:
+    """The generated key table in docs/configuration.md must match
+    config.KNOWN_KEYS exactly."""
+    from hyperspace_tpu import config as config_mod
+
+    doc = root / "docs" / "configuration.md"
+    if not doc.exists():
+        return []
+    text = doc.read_text()
+    if DOCS_BEGIN not in text or DOCS_END not in text:
+        return [Finding(str(doc), 0, 0, CONFIG_DRIFT,
+                        "docs/configuration.md has no KNOWN_KEYS generated-table "
+                        "markers — run python -m hyperspace_tpu.analysis.check "
+                        "--write-config-docs")]
+    current = text.split(DOCS_BEGIN, 1)[1].split(DOCS_END, 1)[0].strip()
+    if current != config_mod.docs_table().strip():
+        return [Finding(str(doc), 0, 0, CONFIG_DRIFT,
+                        "docs/configuration.md key table is stale relative to "
+                        "config.KNOWN_KEYS — run python -m "
+                        "hyperspace_tpu.analysis.check --write-config-docs")]
+    return []
+
+
+def write_config_docs(root: pathlib.Path) -> bool:
+    from hyperspace_tpu import config as config_mod
+
+    doc = root / "docs" / "configuration.md"
+    text = doc.read_text()
+    if DOCS_BEGIN not in text or DOCS_END not in text:
+        return False
+    head, rest = text.split(DOCS_BEGIN, 1)
+    _, tail = rest.split(DOCS_END, 1)
+    doc.write_text(f"{head}{DOCS_BEGIN}\n{config_mod.docs_table()}\n{DOCS_END}{tail}")
+    return True
+
+
+# -- HSL012: fault-point coverage ---------------------------------------------
+
+def fault_point_findings(program: Program) -> list[Finding]:
+    from hyperspace_tpu import faults as faults_mod
+
+    declared = set(faults_mod.KNOWN_POINTS)
+    findings: list[Finding] = []
+    threaded: set[str] = set()
+    faults_path = None
+    for fn in sorted(program.functions.values(), key=lambda f: (f.module, f.line)):
+        mod = program.modules[fn.module]
+        if mod.path.endswith("hyperspace_tpu/faults.py"):
+            faults_path = mod.path
+            continue  # the harness's own docstrings/validation, not call sites
+        for name, line, kind in fn.fault_refs:
+            if kind == "point" and fn.module.startswith("hyperspace_tpu."):
+                threaded.add(name)
+            if name not in declared and not _suppressed(mod, line, FAULT_COVERAGE):
+                findings.append(Finding(
+                    mod.path, line, 0, FAULT_COVERAGE,
+                    f"fault point {name!r} is not declared in "
+                    f"faults.KNOWN_POINTS — an undeclared name can never fire "
+                    f"a registered rule (fix the typo or declare it)",
+                ))
+    for mod in program.modules.values():
+        if mod.path.endswith("hyperspace_tpu/faults.py"):
+            faults_path = mod.path
+    if not any(m.startswith("hyperspace_tpu.") for m in program.modules):
+        # Coverage direction needs the package in the scanned set; a
+        # corpus file scanned alone must not report every point missing.
+        return findings
+    for point in sorted(declared - threaded):
+        findings.append(Finding(
+            faults_path or "hyperspace_tpu/faults.py", 0, 0, FAULT_COVERAGE,
+            f"declared fault point {point!r} is never threaded through a "
+            f"fault_point() call site — the crash sweep cannot exercise it; "
+            f"thread it or remove it from KNOWN_POINTS",
+        ))
+    return findings
+
+
+def _suppressed(mod, line: int, rule: str) -> bool:
+    lines = mod.lines
+    text = lines[line - 1] if 0 < line <= len(lines) else ""
+    if "# noqa" not in text:
+        return False
+    tail = text.split("# noqa", 1)[1]
+    return not tail.strip().startswith(":") or rule in tail
+
+
+# -- validator corpus ---------------------------------------------------------
+
+def validator_corpus() -> dict:
+    """Self-test the plan validator over a tiny known-good/known-bad
+    corpus. Returns a JSON-able status dict; `failures` non-empty means
+    the validator regressed."""
+    try:
+        from hyperspace_tpu.analysis.validator import validate_plan
+        from hyperspace_tpu.plan.expr import col
+        from hyperspace_tpu.plan.nodes import Filter, Join, Scan, Sort
+        from hyperspace_tpu.schema import Field, Schema
+    except ImportError as e:
+        return {"status": "skipped", "reason": f"dependencies unavailable: {e}"}
+    schema = Schema.of(Field("k", "int32"), Field("v", "float64"),
+                       Field("emb", "vector", dim=4))
+    right = Scan("/corpus/u", "parquet", Schema.of(Field("k", "int32")))
+    base = Scan("/corpus/t", "parquet", schema)
+    corpus = [
+        ("clean-filter", Filter(base, col("k") > 1), []),
+        ("unresolved-column", Filter(base, col("zz") > 1), ["unresolved-column"]),
+        ("dtype-predicate", Filter(base, col("emb") > 1), ["dtype-incompatible-predicate"]),
+        ("unsortable-key", Sort(base, [("emb", True)]), ["unsortable-key"]),
+        ("bucket-mismatch",
+         Join(Scan("/corpus/t", "parquet", schema, bucket_spec=(8, ["k"])),
+              Scan("/corpus/u", "parquet", Schema.of(Field("k", "int32")),
+                   bucket_spec=(16, ["k"])),
+              ["k"], ["k"]),
+         ["join-bucket-mismatch"]),
+        ("clean-join", Join(base, right, ["k"], ["k"]), []),
+    ]
+    failures = []
+    for name, plan, expect in corpus:
+        got = [d.rule for d in validate_plan(plan)]
+        if got != expect:
+            failures.append({"case": name, "expected": expect, "got": got})
+    return {"status": "ok" if not failures else "failed",
+            "cases": len(corpus), "failures": failures}
+
+
+# -- baseline -----------------------------------------------------------------
+
+def _finding_key(f: Finding, root: pathlib.Path) -> list:
+    path = f.path
+    try:
+        path = str(pathlib.Path(f.path).resolve().relative_to(root))
+    except ValueError:
+        pass
+    return [f.rule, path, f.message]
+
+
+def load_baseline(path: pathlib.Path) -> set[tuple]:
+    try:
+        data = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return set()
+    return {tuple(entry) for entry in data.get("findings", [])}
+
+
+# -- driver -------------------------------------------------------------------
+
+def run_check(
+    paths: list[pathlib.Path],
+    root: pathlib.Path,
+    usage_dirs: list[pathlib.Path],
+    allowlist: dict | None = None,
+) -> dict:
+    """Everything except baseline comparison and rendering: returns the
+    full report dict (findings as Finding objects under '_findings')."""
+    allowlist = TEST_ALLOWLIST if allowlist is None else allowlist
+    sources, findings = load_sources(paths)
+    for name, path, src, tree in sources:
+        findings.extend(lint_mod.lint_source(src, path, tree=tree))
+    program = build_program(sources)
+    callgraph = CallGraph(program)
+    lockgraph = LockGraph(program, callgraph)
+    findings.extend(lockgraph.inversions())
+    findings.extend(resource_findings(program))
+    findings.extend(config_key_findings(program, usage_dirs))
+    findings.extend(docs_findings(root))
+    findings.extend(fault_point_findings(program))
+    allowed = []
+    kept = []
+    for f in findings:
+        just = next(
+            (why for (suffix, rule), why in allowlist.items()
+             if f.rule == rule and f.path.endswith(suffix)),
+            None,
+        )
+        (allowed if just is not None else kept).append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.rule))
+    corpus = validator_corpus()
+    if corpus.get("failures"):
+        for fail in corpus["failures"]:
+            kept.append(Finding(
+                "hyperspace_tpu/analysis/validator.py", 0, 0, "HSL000",
+                f"validator corpus case {fail['case']!r} regressed: expected "
+                f"{fail['expected']}, got {fail['got']}",
+            ))
+    return {
+        "_findings": kept,
+        "summary": {
+            "files": len(sources),
+            "findings": len(kept),
+            "allowlisted": len(allowed),
+            "functions": len(program.functions),
+            "call_edges": len(callgraph.edges),
+            "locks": len(program.locks),
+            "lock_edges": len(lockgraph.order_edges()),
+        },
+        "validator_corpus": corpus,
+        "lock_graph": lockgraph.to_json(),
+        "allowlisted": [
+            {"rule": f.rule, "path": f.path, "line": f.line} for f in allowed
+        ],
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m hyperspace_tpu.analysis.check",
+        description="Unified static analysis: per-file lint (HSL001-HSL008), "
+                    "whole-program rules (HSL009-HSL012), validator corpus, "
+                    "findings baseline.",
+    )
+    ap.add_argument("paths", nargs="*", help="files/directories (default: the "
+                    "package + benchmarks + bench.py + tests/conftest.py)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--output", help="also write the report to this file")
+    ap.add_argument("--baseline", help=f"baseline file (default: {BASELINE_NAME} "
+                    "at the repo root when present)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write the current findings as the new baseline")
+    ap.add_argument("--write-config-docs", action="store_true",
+                    help="regenerate the docs/configuration.md key table from "
+                         "config.KNOWN_KEYS and exit")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="fail on ALL findings, ignoring any baseline")
+    args = ap.parse_args(argv)
+    try:
+        root = _repo_root()
+        if args.write_config_docs:
+            ok = write_config_docs(root)
+            print("docs/configuration.md key table "
+                  + ("regenerated" if ok else "markers missing — not rewritten"))
+            return EXIT_CLEAN if ok else EXIT_INTERNAL_ERROR
+        paths = [pathlib.Path(p) for p in args.paths] or default_paths(root)
+        usage_dirs = [root / "tests"] if (root / "tests").exists() else []
+        report = run_check(paths, root, usage_dirs)
+        findings: list[Finding] = report.pop("_findings")
+        baseline_path = pathlib.Path(args.baseline) if args.baseline else root / BASELINE_NAME
+        if args.write_baseline:
+            baseline_path.write_text(json.dumps(
+                {"findings": sorted(_finding_key(f, root) for f in findings)},
+                indent=2, sort_keys=True,
+            ) + "\n")
+            print(f"baseline written: {baseline_path} ({len(findings)} finding(s))")
+            return EXIT_CLEAN
+        baseline = set() if args.no_baseline else (
+            load_baseline(baseline_path) if baseline_path.exists() else set()
+        )
+        new = [f for f in findings if tuple(_finding_key(f, root)) not in baseline]
+        stale = len(baseline) - (len(findings) - len(new))
+        report["findings"] = [
+            {"rule": f.rule, "slug": RULES[f.rule].slug if f.rule in RULES else f.rule,
+             "path": f.path, "line": f.line, "message": f.message,
+             "new": tuple(_finding_key(f, root)) not in baseline}
+            for f in findings
+        ]
+        report["baseline"] = {
+            "path": str(baseline_path) if baseline_path.exists() else None,
+            "known": len(baseline), "stale": max(0, stale), "new": len(new),
+        }
+        report["summary"]["new_findings"] = len(new)
+        rendered = json.dumps(report, indent=2, sort_keys=True)
+        if args.output:
+            pathlib.Path(args.output).write_text(rendered + "\n")
+        if args.format == "json":
+            print(rendered)
+        else:
+            for f in findings:
+                marker = "" if tuple(_finding_key(f, root)) in baseline else " [new]"
+                print(f"{f}{marker}")
+            s = report["summary"]
+            print(
+                f"{s['files']} files, {s['functions']} functions, "
+                f"{s['locks']} locks ({s['lock_edges']} order edges, cycle-free="
+                f"{not any(f.rule == 'HSL009' for f in findings)}); "
+                f"{s['findings']} finding(s), {len(new)} new, "
+                f"{s['allowlisted']} allowlisted; validator corpus: "
+                f"{report['validator_corpus']['status']}",
+                file=sys.stderr,
+            )
+        return EXIT_FINDINGS if new else EXIT_CLEAN
+    except SystemExit:
+        raise
+    except Exception as e:
+        print(f"internal error: {type(e).__name__}: {e}", file=sys.stderr)
+        return EXIT_INTERNAL_ERROR
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -66,6 +66,16 @@ Exit status is non-zero iff any finding is reported — the CI gate. Rules:
 
 Suppression: a finding on a line containing ``# noqa`` or
 ``# noqa: HSLxxx`` (matching rule id) is dropped.
+
+This module is the *per-file* half of the analysis engine. The
+whole-program rules — HSL009 lock-order inversion, HSL010 config-key
+drift, HSL011 resource/exception safety, HSL012 fault-point coverage —
+need the cross-module index (analysis/program.py, callgraph.py,
+locks.py) and run from the unified driver ``python -m
+hyperspace_tpu.analysis.check``, which parses each file ONCE and feeds
+the same tree to this linter and to the program index. All rules,
+per-file and whole-program, are declared in :data:`RULES` — the one
+registry the JSON report, the docs table, and the baseline key on.
 """
 
 from __future__ import annotations
@@ -84,6 +94,59 @@ UNSEEDED_RNG = "HSL005"
 METADATA_WRITE = "HSL006"
 WALLCLOCK_OR_UNDECLARED = "HSL007"
 UNLOCKED_GLOBAL = "HSL008"
+
+# Exit codes (`main` and analysis/check.py): CI must be able to tell "the
+# tree has findings" from "the analyzer crashed".
+EXIT_CLEAN = 0
+EXIT_FINDINGS = 1
+EXIT_INTERNAL_ERROR = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class RuleInfo:
+    """One registered rule: id, short slug, one-line summary, and where
+    it runs ('file' = per-file AST walk here, 'program' = whole-program
+    engine in check.py)."""
+
+    rule: str
+    slug: str
+    summary: str
+    scope: str = "file"
+
+
+RULES: dict[str, RuleInfo] = {
+    r.rule: r
+    for r in (
+        RuleInfo("HSL000", "unparseable", "file cannot be read or parsed"),
+        RuleInfo("HSL001", "fragile-jax-import",
+                 "version-fragile jax import outside the sanctioned compat.py"),
+        RuleInfo("HSL002", "host-sync-in-jit",
+                 "device->host sync (.item()/float()/np.asarray/...) inside traced code"),
+        RuleInfo("HSL003", "traced-control-flow",
+                 "Python if/while on a traced value inside jitted code"),
+        RuleInfo("HSL004", "unhashable-static",
+                 "static_argnums/static_argnames given an unhashable display"),
+        RuleInfo("HSL005", "unseeded-randomness",
+                 "global/unseeded RNG use — irreproducible across runs and shards"),
+        RuleInfo("HSL006", "metadata-write-bypass",
+                 "bare write to a metadata-plane path outside file_utils.py"),
+        RuleInfo("HSL007", "wallclock-or-undeclared-counter",
+                 "time.time() in a duration subtraction; undeclared stats counter name"),
+        RuleInfo("HSL008", "unlocked-global-mutation",
+                 "module-level container mutated in a function without a lock held"),
+        RuleInfo("HSL009", "lock-order-inversion",
+                 "cycle in the whole-program lock-acquisition graph", scope="program"),
+        RuleInfo("HSL010", "config-key-drift",
+                 "hyperspace.* config key not declared in config.KNOWN_KEYS (or declared and dead)",
+                 scope="program"),
+        RuleInfo("HSL011", "resource-safety",
+                 "lock/span/file acquired outside with/try-finally on a raising path",
+                 scope="program"),
+        RuleInfo("HSL012", "fault-point-coverage",
+                 "faults.KNOWN_POINTS and fault_point()/inject() call sites out of sync",
+                 scope="program"),
+    )
+}
 
 # The one module allowed to touch version-fragile jax import paths.
 SANCTIONED_COMPAT = "compat.py"
@@ -659,10 +722,13 @@ class _Linter(ast.NodeVisitor):
 
 # -- driver ------------------------------------------------------------------
 
-def lint_source(source: str, path: str = "<string>") -> list[Finding]:
+def lint_source(source: str, path: str = "<string>", tree: ast.Module | None = None) -> list[Finding]:
     """Lint one source text; `path` only labels findings (a basename of
-    compat.py marks the sanctioned module)."""
-    tree = ast.parse(source, filename=path)
+    compat.py marks the sanctioned module). Pass `tree` to reuse an
+    existing parse — the unified check driver parses each file exactly
+    once and feeds the same AST to this linter and the program index."""
+    if tree is None:
+        tree = ast.parse(source, filename=path)
     name = pathlib.PurePath(path).name
     linter = _Linter(
         path, source, name == SANCTIONED_COMPAT, is_file_utils=name == SANCTIONED_FILE_UTILS
@@ -704,12 +770,19 @@ def main(argv: list[str] | None = None) -> int:
         "-q", "--quiet", action="store_true", help="suppress the summary line"
     )
     args = ap.parse_args(argv)
-    findings = lint_paths(args.paths)
-    for f in findings:
-        print(f)
-    if not args.quiet:
-        print(f"{len(findings)} finding(s)", file=sys.stderr)
-    return 1 if findings else 0
+    # Unambiguous exit codes: 0 = clean, 1 = findings, 2 = the linter
+    # itself crashed (an unreadable/unparseable TARGET is a finding —
+    # HSL000 — not a crash).
+    try:
+        findings = lint_paths(args.paths)
+        for f in findings:
+            print(f)
+        if not args.quiet:
+            print(f"{len(findings)} finding(s)", file=sys.stderr)
+    except Exception as e:  # pragma: no cover - exercised via unit test stub
+        print(f"internal error: {type(e).__name__}: {e}", file=sys.stderr)
+        return EXIT_INTERNAL_ERROR
+    return EXIT_FINDINGS if findings else EXIT_CLEAN
 
 
 if __name__ == "__main__":

@@ -1,0 +1,567 @@
+"""Whole-program index: modules, symbols, and per-function summaries.
+
+The per-file linter (analysis/lint.py) sees one AST at a time; the rules
+that actually guard the concurrent serving plane need to see the whole
+package at once — a lock acquired in ``serve/scheduler.py`` while a call
+chain reaches into ``hyperspace.py`` holding the session RLock is
+invisible to any single-file walk. This module builds the shared
+substrate every cross-module rule runs on:
+
+- :class:`ModuleInfo` — one parsed module: dotted name, AST, imports
+  (alias → dotted target), module-level string constants, module-level
+  lock definitions, and variable → class type bindings.
+- :class:`FunctionInfo` — one function/method summary extracted in a
+  SINGLE visitor pass: calls made (with the stack of locks held at each
+  call site), locks acquired via ``with`` (with the locks already held),
+  config get/set keys, fault-point references, and the raw AST node for
+  rules that need a closer look (resource safety, HSL011).
+- :class:`Program` — the package-wide index: symbol tables, lock
+  definitions (module-level and ``self.X = threading.Lock()`` class
+  attributes), attribute/variable type bindings, and the name-resolution
+  machinery the call graph builds on (analysis/callgraph.py).
+
+Everything here is stdlib-``ast`` only and never imports the analyzed
+code — the CI check job runs without the package's dependencies
+installed, exactly like the per-file linter always has.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import pathlib
+
+_LOCK_CTORS = {
+    "Lock": "Lock",
+    "RLock": "RLock",
+    "Condition": "Condition",
+}
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of a Name/Attribute chain ('' otherwise)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _lock_kind(value: ast.expr) -> str | None:
+    """'Lock' / 'RLock' / 'Condition' when `value` is a threading lock
+    constructor call (``threading.Lock()`` or a bare imported ``Lock()``),
+    else None."""
+    if not isinstance(value, ast.Call):
+        return None
+    name = _dotted(value.func).split(".")[-1]
+    return _LOCK_CTORS.get(name)
+
+
+@dataclasses.dataclass(frozen=True)
+class LockRef:
+    """An unresolved lock reference as spelled at a ``with``/call site.
+
+    kind: 'name' (bare module-level name), 'self' (``self.<attr>``), or
+    'attr' (``<expr>.<attr>`` where the base is not self). Resolution to
+    a program-wide lock id happens in :meth:`Program.resolve_lock`.
+    """
+
+    kind: str
+    name: str  # the bare name or the attribute name
+    line: int
+
+
+@dataclasses.dataclass(frozen=True)
+class CallSite:
+    """One call expression: the raw dotted callee text plus the stack of
+    lock references held (lexically, via enclosing ``with``) at the call."""
+
+    raw: str
+    line: int
+    held: tuple[LockRef, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class Acquire:
+    """One ``with <lock>`` entry and the locks already held around it."""
+
+    ref: LockRef
+    line: int
+    held: tuple[LockRef, ...]
+
+
+@dataclasses.dataclass
+class ConfigAccess:
+    """One conf ``get``/``set`` whose key resolves (constant or named
+    constant) to a ``hyperspace.*`` string. `key` may still be None
+    right after the per-module pass when the site spells the key through
+    an imported constant (``conf.set(JOIN_VENUE, ...)``); Program._index
+    resolves those against the merged constant table of every indexed
+    module."""
+
+    key: str | None
+    line: int
+    write: bool
+    pending_name: str | None = None
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    qname: str
+    module: str
+    cls: str | None
+    name: str
+    line: int
+    node: ast.AST
+    calls: list[CallSite] = dataclasses.field(default_factory=list)
+    acquires: list[Acquire] = dataclasses.field(default_factory=list)
+    config_accesses: list[ConfigAccess] = dataclasses.field(default_factory=list)
+    fault_refs: list[tuple[str, int, str]] = dataclasses.field(default_factory=list)
+    returns_type: str | None = None  # raw annotation text, when a simple name
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    qname: str
+    module: str
+    name: str
+    line: int
+    bases: list[str]
+    methods: dict[str, FunctionInfo] = dataclasses.field(default_factory=dict)
+    attr_locks: dict[str, str] = dataclasses.field(default_factory=dict)  # attr -> kind
+    attr_types: dict[str, str] = dataclasses.field(default_factory=dict)  # attr -> raw ctor ref
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    name: str
+    path: str
+    tree: ast.Module
+    source: str
+    imports: dict[str, str] = dataclasses.field(default_factory=dict)  # alias -> dotted target
+    functions: dict[str, FunctionInfo] = dataclasses.field(default_factory=dict)
+    classes: dict[str, ClassInfo] = dataclasses.field(default_factory=dict)
+    module_locks: dict[str, str] = dataclasses.field(default_factory=dict)  # name -> kind
+    var_types: dict[str, str] = dataclasses.field(default_factory=dict)  # name -> raw ctor ref
+    const_strings: dict[str, str] = dataclasses.field(default_factory=dict)
+
+    @property
+    def lines(self) -> list[str]:
+        return self.source.splitlines()
+
+
+class _FunctionPass(ast.NodeVisitor):
+    """The single per-function visitor pass: collects calls, lock
+    acquisitions (with the held stack), config accesses, and fault-point
+    references in one walk."""
+
+    def __init__(self, info: FunctionInfo, module: ModuleInfo):
+        self.info = info
+        self.module = module
+        self._held: list[LockRef] = []
+
+    def _lock_ref(self, ctx: ast.expr, line: int) -> LockRef | None:
+        """A LockRef when the with-item context expression *could* be a
+        lock: a bare name or a terminal attribute access. Whether it IS
+        one is decided at resolution time against the program-wide lock
+        definitions — so ``with open(...)`` or ``with span(...)`` never
+        produce a ref (calls are not lock expressions)."""
+        if isinstance(ctx, ast.Name):
+            return LockRef("name", ctx.id, line)
+        if isinstance(ctx, ast.Attribute):
+            base = ctx.value
+            if isinstance(base, ast.Name) and base.id == "self":
+                return LockRef("self", ctx.attr, line)
+            return LockRef("attr", ctx.attr, line)
+        return None
+
+    def visit_With(self, node: ast.With) -> None:
+        refs: list[LockRef] = []
+        for item in node.items:
+            ref = self._lock_ref(item.context_expr, node.lineno)
+            if ref is not None:
+                self.info.acquires.append(Acquire(ref, node.lineno, tuple(self._held)))
+                refs.append(ref)
+                self._held.append(ref)
+            # Context expressions that are calls (span(...), open(...))
+            # still contain visitable sub-calls.
+            if isinstance(item.context_expr, ast.Call):
+                self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in refs:
+            self._held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def _visit_nested_fn(self, node) -> None:
+        # Nested defs/lambdas run later, not at the enclosing call site —
+        # but the serving plane's closures (QueryServer._body) DO run
+        # with no lock held, so walk them with an empty held stack.
+        saved, self._held = self._held, []
+        for stmt in getattr(node, "body", []) if not isinstance(node, ast.Lambda) else [node.body]:
+            self.visit(stmt)
+        self._held = saved
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_nested_fn(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._visit_nested_fn(node)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._visit_nested_fn(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        raw = _dotted(node.func)
+        if raw:
+            self.info.calls.append(CallSite(raw, node.lineno, tuple(self._held)))
+        self._check_config_access(node, raw)
+        self._check_fault_ref(node, raw)
+        self.generic_visit(node)
+
+    # -- config get/set ----------------------------------------------------
+    def _check_config_access(self, node: ast.Call, raw: str) -> None:
+        attr = raw.split(".")[-1]
+        if attr not in ("get", "set") or not node.args:
+            return
+        expr = node.args[0]
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            if expr.value.startswith("hyperspace."):
+                self.info.config_accesses.append(
+                    ConfigAccess(expr.value, node.lineno, write=(attr == "set"))
+                )
+            return
+        name = None
+        if isinstance(expr, ast.Name):
+            name = expr.id
+        elif isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+            name = expr.attr
+        if name is None:
+            return
+        val = self.module.const_strings.get(name)
+        if val is not None:
+            if val.startswith("hyperspace."):
+                self.info.config_accesses.append(
+                    ConfigAccess(val, node.lineno, write=(attr == "set"))
+                )
+            return
+        # Imported constant: leave the name pending; Program._index
+        # resolves it against every indexed module's constants.
+        self.info.config_accesses.append(
+            ConfigAccess(None, node.lineno, write=(attr == "set"), pending_name=name)
+        )
+
+    # -- fault points ------------------------------------------------------
+    def _check_fault_ref(self, node: ast.Call, raw: str) -> None:
+        tail = raw.split(".")[-1]
+        if tail == "fault_point":
+            kind = "point"
+        elif tail in ("inject", "injected") and (
+            raw.split(".")[0] in ("faults",) or tail == raw
+        ):
+            kind = "inject"
+        else:
+            return
+        arg: ast.expr | None = node.args[0] if node.args else None
+        for kw in node.keywords:
+            if kw.arg == "point":
+                arg = kw.value
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            self.info.fault_refs.append((arg.value, node.lineno, kind))
+
+
+def _index_module(name: str, path: str, source: str, tree: ast.Module) -> ModuleInfo:
+    mod = ModuleInfo(name=name, path=path, tree=tree, source=source)
+    for node in tree.body:
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                mod.imports[alias.asname or alias.name.split(".")[0]] = alias.name
+        elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+            for alias in node.names:
+                mod.imports[alias.asname or alias.name] = f"{node.module}.{alias.name}"
+        elif isinstance(node, ast.ImportFrom) and node.module and node.level > 0:
+            # Relative import: resolve against this module's package.
+            pkg_parts = name.split(".")[: -node.level]
+            base = ".".join(pkg_parts + [node.module])
+            for alias in node.names:
+                mod.imports[alias.asname or alias.name] = f"{base}.{alias.name}"
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if isinstance(tgt, ast.Name):
+                kind = _lock_kind(node.value)
+                if kind is not None:
+                    mod.module_locks[tgt.id] = kind
+                elif isinstance(node.value, ast.Constant) and isinstance(node.value.value, str):
+                    mod.const_strings[tgt.id] = node.value.value
+                elif isinstance(node.value, ast.Call):
+                    mod.var_types[tgt.id] = _dotted(node.value.func)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            mod.functions[node.name] = _index_function(mod, None, node)
+        elif isinstance(node, ast.ClassDef):
+            mod.classes[node.name] = _index_class(mod, node)
+    return mod
+
+
+def _index_function(mod: ModuleInfo, cls: str | None, node) -> FunctionInfo:
+    qname = f"{mod.name}.{cls}.{node.name}" if cls else f"{mod.name}.{node.name}"
+    info = FunctionInfo(
+        qname=qname, module=mod.name, cls=cls, name=node.name,
+        line=node.lineno, node=node,
+    )
+    ret = getattr(node, "returns", None)
+    if isinstance(ret, ast.Name):
+        info.returns_type = ret.id
+    elif isinstance(ret, ast.Constant) and isinstance(ret.value, str):
+        info.returns_type = ret.value.strip("'\"")
+    _FunctionPass(info, mod).generic_visit(node)
+    return info
+
+
+def _index_class(mod: ModuleInfo, node: ast.ClassDef) -> ClassInfo:
+    cls = ClassInfo(
+        qname=f"{mod.name}.{node.name}", module=mod.name, name=node.name,
+        line=node.lineno, bases=[_dotted(b) for b in node.bases if _dotted(b)],
+    )
+    for item in node.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            cls.methods[item.name] = _index_function(mod, node.name, item)
+            # Attribute locks / attribute types: `self.X = threading.Lock()`
+            # and `self.X = SomeClass(...)` anywhere in the class's methods
+            # (constructors usually, but lazy init counts too).
+            for sub in ast.walk(item):
+                if not (isinstance(sub, ast.Assign) and len(sub.targets) == 1):
+                    continue
+                tgt = sub.targets[0]
+                if not (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                ):
+                    continue
+                kind = _lock_kind(sub.value)
+                if kind is not None:
+                    cls.attr_locks[tgt.attr] = kind
+                elif isinstance(sub.value, ast.Call):
+                    cls.attr_types.setdefault(tgt.attr, _dotted(sub.value.func))
+    return cls
+
+
+@dataclasses.dataclass(frozen=True)
+class LockDef:
+    """One lock *class* in the program: a module-level lock object or a
+    (class, attribute) pair. Static analysis treats every instance of a
+    class as holding the same lock id — the standard lockset
+    abstraction, and exactly right for the singleton caches/sessions
+    this codebase locks."""
+
+    lock_id: str
+    kind: str  # Lock | RLock | Condition
+    module: str
+    attr: str  # bare name for module locks, attribute name for class locks
+    cls: str | None
+
+
+class Program:
+    """The whole-program index: every module parsed once, plus the
+    symbol tables name resolution needs."""
+
+    def __init__(self, modules: dict[str, ModuleInfo]):
+        self.modules = modules
+        self.functions: dict[str, FunctionInfo] = {}
+        self.classes: dict[str, ClassInfo] = {}
+        self.locks: dict[str, LockDef] = {}
+        self._locks_by_attr: dict[str, list[LockDef]] = {}
+        self._classes_by_method: dict[str, list[str]] = {}
+        self._classes_by_name: dict[str, list[str]] = {}
+        self._index()
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def load(cls, paths: list[str | pathlib.Path], package_roots: dict[str, str] | None = None) -> "Program":
+        """Parse every ``*.py`` under `paths` (files or directories).
+
+        Module names are derived from the path relative to the nearest
+        named package root (default: a directory holding an
+        ``__init__.py`` chain), so ``hyperspace_tpu/serve/scheduler.py``
+        indexes as ``hyperspace_tpu.serve.scheduler`` and stray files
+        (``bench.py``) index under their stem.
+        """
+        modules: dict[str, ModuleInfo] = {}
+        for p in paths:
+            root = pathlib.Path(p)
+            files = sorted(root.rglob("*.py")) if root.is_dir() else [root]
+            for f in files:
+                try:
+                    source = f.read_text()
+                    tree = ast.parse(source, filename=str(f))
+                except (OSError, SyntaxError):
+                    continue  # the linter reports these; the index skips
+                name = _module_name(f)
+                modules[name] = _index_module(name, str(f), source, tree)
+        return cls(modules)
+
+    def _index(self) -> None:
+        # hyperspace.* key constants importable across modules: the
+        # merged constant table resolves `conf.set(JOIN_VENUE, ...)`
+        # sites whose constant lives in config.py.
+        key_constants: dict[str, str] = {}
+        for mod in self.modules.values():
+            for cname, val in mod.const_strings.items():
+                if val.startswith("hyperspace."):
+                    key_constants.setdefault(cname, val)
+        for mod in self.modules.values():
+            for fn in mod.functions.values():
+                self.functions[fn.qname] = fn
+            for name, lk in mod.module_locks.items():
+                d = LockDef(f"{mod.name}.{name}", lk, mod.name, name, None)
+                self.locks[d.lock_id] = d
+            for cls in mod.classes.values():
+                self.classes[cls.qname] = cls
+                self._classes_by_name.setdefault(cls.name, []).append(cls.qname)
+                for m, fn in cls.methods.items():
+                    self.functions[fn.qname] = fn
+                    self._classes_by_method.setdefault(m, []).append(cls.qname)
+                for attr, lk in cls.attr_locks.items():
+                    d = LockDef(f"{cls.qname}.{attr}", lk, mod.name, attr, cls.name)
+                    self.locks[d.lock_id] = d
+        for d in self.locks.values():
+            self._locks_by_attr.setdefault(d.attr, []).append(d)
+        for fn in self.functions.values():
+            for acc in fn.config_accesses:
+                if acc.key is None and acc.pending_name is not None:
+                    acc.key = key_constants.get(acc.pending_name)
+            # A pending name that resolves to nothing was not a config
+            # key after all (dict.get(x), conf.get(other_var), ...).
+            fn.config_accesses = [a for a in fn.config_accesses if a.key is not None]
+
+    # -- lock resolution ---------------------------------------------------
+    def resolve_lock(self, ref: LockRef, module: str, cls: str | None) -> LockDef | None:
+        """The LockDef a with-site reference names, or None.
+
+        - ``with _lock:`` → the module-level lock of the same module
+          (or the one it was imported from).
+        - ``with self._lock:`` → the enclosing class's attribute lock
+          (walking base classes by name when the class itself doesn't
+          define it).
+        - ``with obj._state_lock:`` → resolved by attribute name when
+          exactly ONE class in the program defines a lock attribute with
+          that name; ambiguous attribute names stay unresolved
+          (conservative: no false edges from `_lock`-vs-`_lock`).
+        """
+        mod = self.modules.get(module)
+        if ref.kind == "name":
+            if mod is not None and ref.name in mod.module_locks:
+                return self.locks.get(f"{module}.{ref.name}")
+            if mod is not None and ref.name in mod.imports:
+                return self.locks.get(mod.imports[ref.name])
+            return None
+        if ref.kind == "self" and cls is not None:
+            for cq in self._mro(f"{module}.{cls}"):
+                c = self.classes.get(cq)
+                if c is not None and ref.name in c.attr_locks:
+                    return self.locks.get(f"{cq}.{ref.name}")
+        candidates = [d for d in self._locks_by_attr.get(ref.name, []) if d.cls is not None]
+        if len(candidates) == 1:
+            return candidates[0]
+        return None
+
+    def _mro(self, cls_qname: str) -> list[str]:
+        """The class plus program-local bases (by simple name), depth-first."""
+        out, stack, seen = [], [cls_qname], set()
+        while stack:
+            q = stack.pop(0)
+            if q in seen:
+                continue
+            seen.add(q)
+            out.append(q)
+            c = self.classes.get(q)
+            if c is None:
+                continue
+            for b in c.bases:
+                base_name = b.split(".")[-1]
+                mod = self.modules.get(c.module)
+                if mod is not None and b in mod.imports:
+                    stack.append(mod.imports[b])
+                elif mod is not None and base_name in mod.classes:
+                    stack.append(f"{c.module}.{base_name}")
+                elif len(self._classes_by_name.get(base_name, [])) == 1:
+                    stack.append(self._classes_by_name[base_name][0])
+        return out
+
+    # -- type/symbol resolution (used by the call graph) -------------------
+    def resolve_symbol(self, module: str, name: str) -> str | None:
+        """A dotted program qname for a bare name used in `module`:
+        a local function/class, or an imported one."""
+        mod = self.modules.get(module)
+        if mod is None:
+            return None
+        if name in mod.functions:
+            return mod.functions[name].qname
+        if name in mod.classes:
+            return mod.classes[name].qname
+        if name in mod.imports:
+            target = mod.imports[name]
+            if target in self.functions or target in self.classes or target in self.modules:
+                return target
+            # `from hyperspace_tpu.obs import trace as obs_trace` maps the
+            # alias to hyperspace_tpu.obs.trace: also try the module map by
+            # suffix (modules index under their file-derived dotted name).
+            for mname in self.modules:
+                if mname == target or mname.endswith("." + target.split(".")[-1]) and target.endswith(mname.split(".")[-1]):
+                    if target == mname or target.endswith(mname) or mname.endswith(target):
+                        return mname
+        return None
+
+    def class_of_ctor(self, module: str, ctor_raw: str) -> str | None:
+        """The class qname `ctor_raw` (a dotted ctor/factory expression)
+        constructs: a direct class reference, or a function whose return
+        annotation names a program class."""
+        parts = ctor_raw.split(".")
+        target = self.resolve_symbol(module, parts[0])
+        if target is None:
+            return None
+        for p in parts[1:]:
+            if target in self.modules:
+                mod = self.modules[target]
+                if p in mod.classes:
+                    target = mod.classes[p].qname
+                elif p in mod.functions:
+                    target = mod.functions[p].qname
+                elif p in mod.var_types:
+                    inner = self.class_of_ctor(target, mod.var_types[p])
+                    target = inner if inner else None
+                else:
+                    return None
+            else:
+                return None
+            if target is None:
+                return None
+        if target in self.classes:
+            return target
+        fn = self.functions.get(target)
+        if fn is not None and fn.returns_type:
+            mod = self.modules.get(fn.module)
+            if mod is not None and fn.returns_type in mod.classes:
+                return mod.classes[fn.returns_type].qname
+        return None
+
+    def classes_defining(self, method: str) -> list[str]:
+        return self._classes_by_method.get(method, [])
+
+
+def _module_name(path: pathlib.Path) -> str:
+    """Dotted module name from the filesystem: walk up while
+    ``__init__.py`` exists, so any package nesting maps correctly."""
+    path = path.resolve()
+    parts = [path.stem] if path.name != "__init__.py" else []
+    d = path.parent
+    while (d / "__init__.py").exists():
+        parts.insert(0, d.name)
+        d = d.parent
+    if not parts:
+        parts = [path.parent.name]
+    return ".".join(parts)

@@ -10,8 +10,11 @@ can set individual knobs.
 from __future__ import annotations
 
 import dataclasses
+import difflib
 import os
 from typing import Any
+
+from hyperspace_tpu.exceptions import UnknownConfigKeyError
 
 # String keys (kept spiritually compatible with spark.hyperspace.* keys,
 # reference index/IndexConstants.scala:21-49).
@@ -95,6 +98,11 @@ RETRY_CAS_ATTEMPTS = "hyperspace.retry.casAttempts"
 FALLBACK_ENABLED = "hyperspace.fallback.enabled"
 RECOVER_ON_ACCESS = "hyperspace.recover.onAccess"
 RECOVER_GRACE_SECONDS = "hyperspace.recover.graceSeconds"
+# Explain rendering (explain/display_mode.py re-exports these; declared
+# here so every hyperspace.* key lives in ONE registry — HSL010).
+EXPLAIN_DISPLAY_MODE = "hyperspace.explain.displayMode"
+EXPLAIN_HIGHLIGHT_BEGIN = "hyperspace.explain.displayMode.highlight.beginTag"
+EXPLAIN_HIGHLIGHT_END = "hyperspace.explain.displayMode.highlight.endTag"
 
 # Directory-layout constants (reference index/IndexConstants.scala:38-39).
 HYPERSPACE_LOG_DIR = "_hyperspace_log"
@@ -117,6 +125,199 @@ DEFAULT_SERVE_WORKERS = 4
 DEFAULT_SERVE_MAX_QUEUE_DEPTH = 32
 DEFAULT_SERVE_PLAN_CACHE_MAX_ENTRIES = 128
 DEFAULT_SERVE_RESULT_CACHE_MAX_BYTES = 256 << 20
+
+
+@dataclasses.dataclass(frozen=True)
+class ConfKey:
+    """One declared config key: its rendered default and its one-line
+    doc. docs/configuration.md's key table is GENERATED from this
+    registry (analysis/check.py verifies it; --write-config-docs
+    rewrites it), so the docs cannot drift from the code."""
+
+    default: str
+    doc: str
+
+
+# The declared-key registry — the config analog of stats.KNOWN_COUNTERS
+# and faults.KNOWN_POINTS. `HyperspaceConf.get/set` REJECT any
+# hyperspace.* key not declared here (UnknownConfigKeyError, with a
+# did-you-mean suggestion), and static rule HSL010 checks every call
+# site against it before runtime. Keep this a plain dict literal keyed
+# by the constants above: the analysis engine reads it by AST parse, no
+# imports (the CI check job runs dependency-free).
+KNOWN_KEYS: dict[str, ConfKey] = {
+    INDEX_SYSTEM_PATH: ConfKey(
+        "`<cwd>/spark-warehouse/indexes`",
+        "Root directory holding every index (log + data versions)."),
+    INDEX_NUM_BUCKETS: ConfKey(
+        "8",
+        "Bucket count for new covering indexes (= build/query parallelism; the "
+        "analog of `spark.hyperspace.index.num.buckets`)."),
+    INDEX_CACHE_EXPIRY_SECONDS: ConfKey(
+        "300",
+        "TTL of the read-path metadata cache; every mutating API clears it."),
+    INDEX_HYBRID_SCAN_ENABLED: ConfKey(
+        "false",
+        "Serve stale indexes by unioning the index scan with a pinned scan of "
+        "appended files."),
+    INDEX_HYBRID_SCAN_MAX_APPENDED_RATIO: ConfKey(
+        "0.3",
+        "Hybrid scan applies only while appended bytes stay below this fraction "
+        "of the indexed source."),
+    INDEX_BUILD_MEMORY_BUDGET: ConfKey(
+        "4 GiB",
+        "Sources whose uncompressed footer estimate exceeds this stream through "
+        "the out-of-core build."),
+    INDEX_BUILD_CHUNK_BYTES: ConfKey(
+        "0 (derived)",
+        "Row-group chunk size of the streaming build; 0 derives it from the "
+        "budget."),
+    JOIN_VENUE: ConfKey(
+        "`auto`",
+        "Where the materialized join's merge runs: `auto` probes device→host "
+        "bandwidth once and picks `host` (threaded C++ kernel) below the floor, "
+        "else `device`; `host`/`device` force it (unknown values raise)."),
+    JOIN_VENUE_MIN_MBPS: ConfKey(
+        "200",
+        "The link-speed floor shared by every `auto` venue choice (join, build, "
+        "aggregation, sort): below it, host paths win."),
+    BUILD_VENUE: ConfKey(
+        "`auto`",
+        "Where the build's bucketize+sort permutation is computed: threaded C++ "
+        "counting/key sort on host vs the device all_to_all exchange (a real "
+        "multi-device mesh keeps device in `auto`)."),
+    AGG_VENUE: ConfKey(
+        "`auto`",
+        "Where the grouped segment-reduce runs: numpy bincount/reduceat on host "
+        "vs the device (mesh-sharded with psum/pmin/pmax collectives) segment "
+        "reduce."),
+    SORT_VENUE: ConfKey(
+        "`auto`",
+        "Where ORDER BY runs: numpy lexsort on host vs one device lax.sort over "
+        "32-bit lanes."),
+    FILTER_VENUE: ConfKey(
+        "`auto`",
+        "Where predicate masks evaluate: exact numpy on host vs the fused XLA "
+        "computation (mesh-sharded rows on device)."),
+    JOIN_BROADCAST_MAX_ROWS: ConfKey(
+        "4,000,000",
+        "A non-aligned join whose smaller side is under this row count (and ≥4x "
+        "smaller than the other) takes the broadcast hash path — dense code "
+        "table from the small side, vectorized gather probe, large side never "
+        "sorted. 0 disables."),
+    JOIN_REBUCKETIZE: ConfKey(
+        "`auto`",
+        "Query-time re-bucketing exchange when exactly one join side is an index "
+        "bucketed on its join keys: the other side re-groups into the index's "
+        "bucket layout (native counting sort on host / one device sort on the "
+        "device venue). `auto` engages it when the broadcast probe does not "
+        "apply; `force` always; `off` keeps the single-partition fallback."),
+    EXPLAIN_DISPLAY_MODE: ConfKey(
+        "`plaintext`",
+        "Explain rendering: `plaintext`, `console` (ANSI), or `html`."),
+    EXPLAIN_HIGHLIGHT_BEGIN: ConfKey(
+        "`<b>`",
+        "Custom highlight tag opening replaced subtrees in html explain output "
+        "(notebook use)."),
+    EXPLAIN_HIGHLIGHT_END: ConfKey(
+        "`</b>`",
+        "Custom highlight tag closing replaced subtrees in html explain output "
+        "(notebook use)."),
+    ANALYSIS_VALIDATE: ConfKey(
+        "true",
+        "Pre-execution plan validation (analysis/validator.py): reject malformed "
+        "plans with structured diagnostics before any device work."),
+    FAULTS_ENABLED: ConfKey(
+        "true",
+        "Kill switch for the fault-injection harness (`faults.py`): false makes "
+        "every `fault_point` inert even with rules registered. See "
+        "[fault_tolerance.md](fault_tolerance.md)."),
+    RETRY_MAX_ATTEMPTS: ConfKey(
+        "3",
+        "Attempts per transient-IO call site (log/pointer/manifest writes, "
+        "parquet data/footer reads); 1 disables retry."),
+    RETRY_BACKOFF_BASE: ConfKey(
+        "0.005",
+        "First-retry delay; doubles per attempt (capped, deterministic — jitter "
+        "is an explicit hook)."),
+    RETRY_CAS_ATTEMPTS: ConfKey(
+        "1",
+        "Whole-protocol retries when `Action.begin()` loses its CAS to a "
+        "concurrent writer; 1 = abort (the reference's single-writer behavior)."),
+    FALLBACK_ENABLED: ConfKey(
+        "true",
+        "Query-plane corruption fallback: an index scan over unreadable data "
+        "quarantines the index (`session.index_health`) and re-plans the query "
+        "against healthy indexes / the source instead of failing."),
+    OBS_ENABLED: ConfKey(
+        "true",
+        "Tracer gate (process-global, [observability.md](observability.md)): "
+        "false makes `span()`/`trace()` shared no-ops (nothing allocated on the "
+        "query hot path); per-query profiles (`session.last_profile()`, "
+        "`explain(mode=\"analyze\")`) remain available either way."),
+    OBS_SINK: ConfKey(
+        "unset",
+        "JSON-lines path receiving one event per finished root trace (query or "
+        "action) — the export feed for `python -m hyperspace_tpu.obs.export "
+        "--sink <path>`."),
+    RECOVER_ON_ACCESS: ConfKey(
+        "true",
+        "Index listing lazily repairs a crashed writer's log (torn entries "
+        "immediately, transient tails after the grace)."),
+    RECOVER_GRACE_SECONDS: ConfKey(
+        "300",
+        "Minimum staleness of a transient entry before lazy recovery touches it "
+        "— keeps a listing from cancelling a LIVE writer's in-flight action. "
+        "Explicit `recover()` ignores it."),
+    SERVE_WORKERS: ConfKey(
+        "4",
+        "Worker threads of the concurrent query server ([serving.md](serving.md)); "
+        "the subsystem is off unless a `QueryServer` is constructed "
+        "(`session.serve()`)."),
+    SERVE_MAX_QUEUE_DEPTH: ConfKey(
+        "32",
+        "Admission-control limit: submits beyond it raise `AdmissionRejected`."),
+    SERVE_QUERY_TIMEOUT_SECONDS: ConfKey(
+        "0 (off)",
+        "Per-query deadline — expires queries still waiting in the queue and "
+        "bounds `QueryHandle.result()` waits (`QueryTimeout`)."),
+    SERVE_PLAN_CACHE_ENABLED: ConfKey(
+        "true",
+        "Serving-plane plan cache: memoize `optimized_plan()` under versioned "
+        "keys that index mutations / source appends invalidate structurally."),
+    SERVE_PLAN_CACHE_MAX_ENTRIES: ConfKey(
+        "128",
+        "Plan-cache LRU bound."),
+    SERVE_RESULT_CACHE_ENABLED: ConfKey(
+        "false",
+        "Opt-in whole-result cache under the same versioned keys (never serves "
+        "pre-refresh rows)."),
+    SERVE_RESULT_CACHE_MAX_BYTES: ConfKey(
+        "256 MiB",
+        "Result-cache byte budget; LRU eviction past it, no single entry above "
+        "a quarter of it."),
+}
+
+
+def check_known_key(key: str) -> None:
+    """Reject an undeclared ``hyperspace.*`` key with a did-you-mean
+    suggestion (the runtime counterpart of static rule HSL010). Keys
+    outside the hyperspace namespace pass through — the overrides map
+    doubles as a scratch space for tests and embedding apps."""
+    if not key.startswith("hyperspace.") or key in KNOWN_KEYS:
+        return
+    close = difflib.get_close_matches(key, KNOWN_KEYS, n=1, cutoff=0.6)
+    raise UnknownConfigKeyError(key, close[0] if close else None)
+
+
+def docs_table() -> str:
+    """The markdown key table docs/configuration.md embeds between its
+    `<!-- KNOWN_KEYS:begin -->` / `end` markers. Generated so a key can
+    never exist in code without a documented default and meaning."""
+    lines = ["| Key | Default | Meaning |", "|---|---|---|"]
+    for key, spec in KNOWN_KEYS.items():
+        lines.append(f"| `{key}` | {spec.default} | {spec.doc} |")
+    return "\n".join(lines)
 
 
 def _as_bool(value: Any) -> bool:
@@ -160,6 +361,7 @@ class HyperspaceConf:
             self.system_path = os.path.join(os.getcwd(), "spark-warehouse", "indexes")
 
     def set(self, key: str, value: Any) -> None:
+        check_known_key(key)
         self.overrides[key] = value
         if key == INDEX_SYSTEM_PATH:
             self.system_path = str(value)
@@ -242,6 +444,7 @@ class HyperspaceConf:
             retry.configure(cas_attempts=int(value))
 
     def get(self, key: str, default: Any = None) -> Any:
+        check_known_key(key)
         if key in self.overrides:
             return self.overrides[key]
         if key == INDEX_SYSTEM_PATH:

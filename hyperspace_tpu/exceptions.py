@@ -50,6 +50,24 @@ class AdmissionRejected(HyperspaceError):
         self.max_depth = max_depth
 
 
+class UnknownConfigKeyError(HyperspaceError):
+    """A `hyperspace.*` config key was get/set that is not declared in
+    `config.KNOWN_KEYS` — almost always a typo (`hyperspace.srve.workers`),
+    which under the old accept-anything behavior silently configured
+    nothing. Carries a did-you-mean `suggestion` when a declared key is
+    close (edit distance); the static rule HSL010 catches the same drift
+    before runtime. Declare new keys in `config.KNOWN_KEYS`."""
+
+    def __init__(self, key: str, suggestion: str | None = None):
+        msg = f"unknown config key {key!r}"
+        if suggestion:
+            msg += f" — did you mean {suggestion!r}?"
+        msg += " (declared keys live in hyperspace_tpu.config.KNOWN_KEYS)"
+        super().__init__(msg)
+        self.key = key
+        self.suggestion = suggestion
+
+
 class QueryTimeout(HyperspaceError):
     """A served query exceeded its per-query timeout (docs/serving.md):
     either it expired while still waiting in the admission queue (the

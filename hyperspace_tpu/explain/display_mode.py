@@ -14,9 +14,13 @@ Selected via conf key `hyperspace.explain.displayMode`.
 
 from __future__ import annotations
 
-EXPLAIN_DISPLAY_MODE = "hyperspace.explain.displayMode"
-EXPLAIN_HIGHLIGHT_BEGIN = "hyperspace.explain.displayMode.highlight.beginTag"
-EXPLAIN_HIGHLIGHT_END = "hyperspace.explain.displayMode.highlight.endTag"
+# Declared in config.KNOWN_KEYS (the one hyperspace.* registry — HSL010);
+# re-exported here for the existing import sites.
+from hyperspace_tpu.config import (  # noqa: F401
+    EXPLAIN_DISPLAY_MODE,
+    EXPLAIN_HIGHLIGHT_BEGIN,
+    EXPLAIN_HIGHLIGHT_END,
+)
 
 
 class DisplayMode:

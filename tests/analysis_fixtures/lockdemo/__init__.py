@@ -1,0 +1,10 @@
+"""Fixture mini-package for the whole-program analysis engine tests.
+
+NOT imported at runtime — the engine only parses it. Contains, on
+purpose: a module-level lock + registry, a class-attribute lock pair
+resolved through a typed attribute (``self.cache = Cache()``), a
+cross-module call chain, and a DELIBERATE lock-order inversion between
+``alpha._registry_lock`` and ``beta._audit_lock`` (the HSL009 seeded
+regression: the engine must report the cycle with a two-chain witness).
+Golden call-graph and lock-graph outputs live in ../goldens/.
+"""

@@ -1,0 +1,52 @@
+"""Fixture module A: module lock + registry, session/cache class locks."""
+
+import threading
+
+from lockdemo import beta
+
+_registry_lock = threading.Lock()
+_registry = {}
+
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+
+    def put_entry(self, key, value):
+        with self._lock:
+            self._entries[key] = value
+
+
+class Session:
+    def __init__(self):
+        self._state_lock = threading.RLock()
+        self.cache = Cache()
+
+    def publish(self, key, value):
+        # state lock held across a call into the typed-attribute cache:
+        # the engine must produce the edge Session._state_lock -> Cache._lock.
+        with self._state_lock:
+            self.cache.put_entry(key, value)
+
+    def refresh(self):
+        # RLock re-entry on the same thread: NOT an HSL009 self-cycle.
+        with self._state_lock:
+            return self.snapshot()
+
+    def snapshot(self):
+        with self._state_lock:
+            return dict(_registry)
+
+
+def register(name, value):
+    # One half of the seeded inversion: registry lock, then (via the
+    # call chain) beta's audit lock.
+    with _registry_lock:
+        _registry[name] = value
+        beta.audit(name)
+
+
+def lookup(name):
+    with _registry_lock:
+        return _registry.get(name)

@@ -1,0 +1,22 @@
+"""Fixture module B: the other half of the seeded lock-order inversion."""
+
+import threading
+
+from lockdemo import alpha
+
+_audit_lock = threading.Lock()
+_audit = []
+
+
+def audit(name):
+    with _audit_lock:
+        _audit.append(name)
+
+
+def rollback(name):
+    # The DELIBERATE inversion: audit lock held while calling back into
+    # alpha.register, which takes the registry lock — the reverse of
+    # register's registry->audit order. HSL009 must report this cycle
+    # with both chains as witness.
+    with _audit_lock:
+        alpha.register(name, None)

@@ -1,0 +1,11 @@
+"""HSL001 fragile-jax-import corpus (flagged and clean forms)."""
+
+from jax import shard_map  # expect: HSL001
+from jax import enable_x64  # expect: HSL001
+from jax.experimental import pallas  # expect: HSL001
+from jax.experimental.shard_map import shard_map as sm  # expect: HSL001
+import jax.experimental.pallas  # expect: HSL001
+
+from jax import lax
+import jax.numpy as jnp
+from hyperspace_tpu.compat import shard_map as compat_shard_map

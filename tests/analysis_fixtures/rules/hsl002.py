@@ -1,0 +1,25 @@
+"""HSL002 host-sync-in-jit corpus."""
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def item_sync(x):
+    return x.item()  # expect: HSL002
+
+
+def wrapped(x):
+    return float(x)  # expect: HSL002
+
+
+g = jax.jit(wrapped)
+
+
+@jax.jit
+def asarray_sync(x):
+    return np.asarray(x)  # expect: HSL002
+
+
+def host_side_is_fine(x):
+    return float(x.item())

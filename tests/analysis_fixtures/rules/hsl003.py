@@ -1,0 +1,33 @@
+"""HSL003 traced-control-flow corpus."""
+
+import functools
+
+import jax
+
+
+@jax.jit
+def value_branch(x):
+    if x > 0:  # expect: HSL003
+        return x
+    return -x
+
+
+@jax.jit
+def value_loop(x):
+    while x < 10:  # expect: HSL003
+        x = x + 1
+    return x
+
+
+@jax.jit
+def shape_branch_is_static(x):
+    if x.shape[0] > 1:
+        return x
+    return -x
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def static_param_is_fine(x, n):
+    if n > 3:
+        return x
+    return -x

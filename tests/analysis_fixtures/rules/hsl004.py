@@ -1,0 +1,17 @@
+"""HSL004 unhashable-static corpus."""
+
+import functools
+
+import jax
+
+
+def f(x, n):
+    return x
+
+
+g = jax.jit(f, static_argnums=[1])  # expect: HSL004
+
+
+@functools.partial(jax.jit, static_argnames=("cap",))
+def tuple_spelling_is_fine(x, cap):
+    return x

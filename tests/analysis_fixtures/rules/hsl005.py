@@ -1,0 +1,11 @@
+"""HSL005 unseeded-randomness corpus."""
+
+import random
+
+import numpy as np
+
+v = np.random.rand(3)  # expect: HSL005
+r = np.random.default_rng()  # expect: HSL005
+s = random.random()  # expect: HSL005
+
+seeded = np.random.default_rng(0)

@@ -1,0 +1,23 @@
+"""HSL006 metadata-write-bypass corpus."""
+
+import json
+
+
+def write_manifest_bad(dest_dir, manifest, MANIFEST_NAME):
+    (dest_dir / MANIFEST_NAME).write_text(json.dumps(manifest))  # expect: HSL006
+
+
+def write_pointer_bad(log_dir, LATEST_STABLE_LOG_NAME, data):
+    (log_dir / LATEST_STABLE_LOG_NAME).write_bytes(data)  # expect: HSL006
+
+
+def write_version_dir_bad(root, payload):
+    (root / "v__=0" / "part").write_text(payload)  # expect: HSL006
+
+
+def unrelated_write_is_fine(report_path, text):
+    report_path.write_text(text)
+
+
+def read_mode_is_fine(log_dir, entry_id):
+    return open(log_dir / str(entry_id)).read()

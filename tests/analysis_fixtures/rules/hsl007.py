@@ -1,0 +1,21 @@
+"""HSL007 wallclock-duration / undeclared-counter corpus."""
+
+import time
+
+from hyperspace_tpu import stats
+
+
+def age_bad(stamp):
+    return time.time() - stamp  # expect: HSL007
+
+
+def count_bad():
+    stats.increment("retyr.attempts")  # expect: HSL007
+
+
+def count_ok():
+    stats.increment("retry.attempts")
+
+
+def age_ok(start):
+    return time.monotonic() - start

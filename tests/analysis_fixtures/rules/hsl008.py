@@ -1,0 +1,39 @@
+"""HSL008 unlocked-global-mutation corpus."""
+
+import threading
+
+_cache = {}
+_seen = set()
+_lock = threading.Lock()
+
+
+def put_bad(key, value):
+    _cache[key] = value  # expect: HSL008
+
+
+def record_bad(x):
+    _seen.add(x)  # expect: HSL008
+
+
+def evict_bad(key, other):
+    _cache.pop(key)  # expect: HSL008
+    del _cache[other]  # expect: HSL008
+
+
+def put_under_lock_is_fine(key, value):
+    with _lock:
+        _cache[key] = value
+
+
+_cache["import-time-init"] = object()
+
+
+def read_only_is_fine(key):
+    return _cache.get(key)
+
+
+def local_container_is_fine(items):
+    out = []
+    for i in items:
+        out.append(i)
+    return out

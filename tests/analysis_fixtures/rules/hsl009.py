@@ -1,0 +1,29 @@
+"""HSL009 lock-order-inversion corpus: a direct two-lock inversion.
+
+(The cross-module, call-graph-mediated form lives in the lockdemo
+fixture package; this file is the minimal lexical form.)
+"""
+
+import threading
+
+_lock_a = threading.Lock()
+_lock_b = threading.Lock()
+_lock_c = threading.Lock()
+
+
+def a_then_b():
+    with _lock_a:  # expect: HSL009
+        with _lock_b:
+            pass
+
+
+def b_then_a():
+    with _lock_b:
+        with _lock_a:
+            pass
+
+
+def consistent_order_is_fine():
+    with _lock_a:
+        with _lock_c:
+            pass

@@ -1,0 +1,18 @@
+"""HSL010 config-key-drift corpus: get/set of undeclared keys."""
+
+
+def typo_set(conf):
+    conf.set("hyperspace.srve.workers", 2)  # expect: HSL010
+
+
+def typo_get(conf):
+    return conf.get("hyperspace.obs.enabld")  # expect: HSL010
+
+
+def declared_keys_are_fine(conf):
+    conf.set("hyperspace.serve.workers", 2)
+    return conf.get("hyperspace.obs.enabled")
+
+
+def non_hyperspace_namespace_is_fine(conf):
+    return conf.get("myapp.custom.knob")

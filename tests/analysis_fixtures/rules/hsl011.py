@@ -1,0 +1,43 @@
+"""HSL011 resource/exception-safety corpus."""
+
+import threading
+
+_lock = threading.Lock()
+
+
+def acquire_bad():
+    _lock.acquire()  # expect: HSL011
+    do_work()
+    _lock.release()
+
+
+def acquire_with_finally_is_fine():
+    _lock.acquire()
+    try:
+        do_work()
+    finally:
+        _lock.release()
+
+
+def open_bad(path):
+    f = open(path)  # expect: HSL011
+    return f.read()
+
+
+def open_with_is_fine(path):
+    with open(path) as f:
+        return f.read()
+
+
+def span_bad(obs_trace):
+    obs_trace.span("query.step")  # expect: HSL011
+    do_work()
+
+
+def span_entered_is_fine(obs_trace):
+    with obs_trace.span("query.step"):
+        do_work()
+
+
+def do_work():
+    pass

@@ -1,0 +1,11 @@
+"""HSL012 fault-point-coverage corpus: call sites naming undeclared points."""
+
+from hyperspace_tpu.faults import fault_point
+
+
+def write_log_entry_bad(path):
+    fault_point("log.wriet", path)  # expect: HSL012
+
+
+def write_log_entry_ok(path):
+    fault_point("log.write", path)

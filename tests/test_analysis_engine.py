@@ -1,0 +1,333 @@
+"""Whole-program analysis engine tests (analysis/program.py,
+callgraph.py, locks.py, check.py): fixture-package goldens, the seeded
+lock-inversion regression, the per-rule corpus, and the repo-wide
+guarantees the CI check gate rides on (cycle-free lock graph, zero
+config/fault drift)."""
+
+from __future__ import annotations
+
+import ast
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from hyperspace_tpu.analysis.callgraph import CallGraph
+from hyperspace_tpu.analysis.check import (
+    TEST_ALLOWLIST,
+    config_key_findings,
+    default_paths,
+    fault_point_findings,
+    main as check_main,
+    run_check,
+    validator_corpus,
+)
+from hyperspace_tpu.analysis.lint import (
+    EXIT_CLEAN,
+    EXIT_FINDINGS,
+    EXIT_INTERNAL_ERROR,
+    RULES,
+    lint_source,
+)
+from hyperspace_tpu.analysis.locks import LockGraph, resource_findings
+from hyperspace_tpu.analysis.program import Program, _index_module, _module_name
+
+TESTS_DIR = pathlib.Path(__file__).resolve().parent
+FIXTURES = TESTS_DIR / "analysis_fixtures"
+REPO_ROOT = TESTS_DIR.parent
+
+
+# -- shared fixtures ----------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def lockdemo():
+    program = Program.load([FIXTURES / "lockdemo"])
+    callgraph = CallGraph(program)
+    return program, callgraph, LockGraph(program, callgraph)
+
+
+@pytest.fixture(scope="module")
+def repo_program():
+    program = Program.load(default_paths(REPO_ROOT))
+    callgraph = CallGraph(program)
+    return program, callgraph
+
+
+# -- fixture-package goldens --------------------------------------------------
+
+class TestLockdemoGoldens:
+    def test_call_graph_matches_golden(self, lockdemo):
+        _, callgraph, _ = lockdemo
+        golden = json.loads((FIXTURES / "goldens" / "lockdemo_callgraph.json").read_text())
+        assert json.loads(json.dumps(callgraph.to_json())) == golden
+
+    def test_lock_graph_matches_golden(self, lockdemo):
+        _, _, lockgraph = lockdemo
+        golden = json.loads((FIXTURES / "goldens" / "lockdemo_lockgraph.json").read_text())
+        assert json.loads(json.dumps(lockgraph.to_json())) == golden
+
+    def test_lock_identities_and_kinds(self, lockdemo):
+        program, _, _ = lockdemo
+        assert program.locks["lockdemo.alpha._registry_lock"].kind == "Lock"
+        assert program.locks["lockdemo.alpha.Session._state_lock"].kind == "RLock"
+        assert program.locks["lockdemo.alpha.Cache._lock"].cls == "Cache"
+
+    def test_typed_attribute_call_resolution(self, lockdemo):
+        # self.cache = Cache() makes self.cache.put_entry resolve without
+        # any unique-name fallback.
+        _, callgraph, _ = lockdemo
+        assert "lockdemo.alpha.Cache.put_entry" in callgraph.callees(
+            "lockdemo.alpha.Session.publish"
+        )
+
+    def test_cross_module_call_resolution(self, lockdemo):
+        _, callgraph, _ = lockdemo
+        assert "lockdemo.beta.audit" in callgraph.callees("lockdemo.alpha.register")
+        assert "lockdemo.alpha.register" in callgraph.callees("lockdemo.beta.rollback")
+
+    def test_reachability(self, lockdemo):
+        _, callgraph, _ = lockdemo
+        reach = callgraph.reachable("lockdemo.beta.rollback")
+        assert "lockdemo.beta.audit" in reach  # rollback -> register -> audit
+
+
+class TestSeededInversion:
+    """The acceptance regression: HSL009 catches the deliberately
+    inverted lock pair in the fixture package, with a two-chain witness
+    naming both conflicting call chains."""
+
+    def test_inversion_reported(self, lockdemo):
+        _, _, lockgraph = lockdemo
+        rules = [f.rule for f in lockgraph.inversions()]
+        assert "HSL009" in rules
+
+    def test_two_chain_witness(self, lockdemo):
+        _, _, lockgraph = lockdemo
+        pair = [
+            f for f in lockgraph.inversions()
+            if "_registry_lock" in f.message and "_audit_lock" in f.message
+            and "inversion" in f.message
+        ]
+        assert len(pair) == 1
+        msg = pair[0].message
+        assert "chain 1" in msg and "chain 2" in msg
+        # chain 1: register (holds registry) -> audit; chain 2:
+        # rollback (holds audit) -> register.
+        assert "lockdemo.alpha.register -> lockdemo.beta.audit" in msg
+        assert "lockdemo.beta.rollback -> lockdemo.alpha.register" in msg
+
+    def test_transitive_self_deadlock_reported(self, lockdemo):
+        # rollback holds the (non-reentrant) audit lock and the chain
+        # register -> audit re-acquires it: a real self-deadlock.
+        _, _, lockgraph = lockdemo
+        assert any(
+            "re-acquired while already held" in f.message
+            for f in lockgraph.inversions()
+        )
+
+    def test_rlock_reentry_not_flagged(self, lockdemo):
+        # Session.refresh -> snapshot re-enters the session RLock: legal.
+        _, _, lockgraph = lockdemo
+        assert not any(
+            "_state_lock" in f.message for f in lockgraph.inversions()
+        )
+
+    def test_edge_direction_recorded_both_ways(self, lockdemo):
+        _, _, lockgraph = lockdemo
+        best = lockgraph.order_edges()
+        assert ("lockdemo.alpha._registry_lock", "lockdemo.beta._audit_lock") in best
+        assert ("lockdemo.beta._audit_lock", "lockdemo.alpha._registry_lock") in best
+
+
+# -- per-rule corpus ----------------------------------------------------------
+
+CORPUS = sorted((FIXTURES / "rules").glob("hsl*.py"))
+
+
+def _expected(path: pathlib.Path) -> set[tuple[int, str]]:
+    out = set()
+    for i, line in enumerate(path.read_text().splitlines(), 1):
+        if "# expect:" in line:
+            out.add((i, line.split("# expect:", 1)[1].strip()))
+    return out
+
+
+def _corpus_findings(path: pathlib.Path) -> set[tuple[int, str]]:
+    """Run the full rule set (per-file lint + whole-program rules) over
+    one corpus file, exactly as check.py composes them."""
+    src = path.read_text()
+    tree = ast.parse(src)
+    findings = list(lint_source(src, str(path), tree=tree))
+    name = _module_name(path)
+    program = Program({name: _index_module(name, str(path), src, tree)})
+    callgraph = CallGraph(program)
+    findings += LockGraph(program, callgraph).inversions()
+    findings += resource_findings(program)
+    findings += config_key_findings(program, [])
+    findings += fault_point_findings(program)
+    return {(f.line, f.rule) for f in findings}
+
+
+@pytest.mark.parametrize("path", CORPUS, ids=[p.stem for p in CORPUS])
+def test_rule_corpus(path):
+    """Each corpus file must produce exactly its `# expect:` annotations:
+    flagged lines flag, clean lines stay clean, nothing extra fires."""
+    assert _corpus_findings(path) == _expected(path)
+
+
+def test_corpus_covers_every_rule():
+    covered = {p.stem.upper() for p in CORPUS}
+    declared = {r for r in RULES if r not in ("HSL000",)}
+    assert covered == declared
+
+
+# -- repo-wide guarantees (what the CI gate asserts) --------------------------
+
+class TestRepoWideGuarantees:
+    def test_lock_graph_is_cycle_free(self, repo_program):
+        """The acceptance proof: the full lock-acquisition graph —
+        session RLock, metadata cache, device cache, serve scheduler
+        condvar, plan/result caches, module memo locks — has no cycle."""
+        program, callgraph = repo_program
+        lockgraph = LockGraph(program, callgraph)
+        assert lockgraph.inversions() == []
+        # and it actually covers the locks the serving PR added:
+        for lock_id in (
+            "hyperspace_tpu.hyperspace.HyperspaceSession._state_lock",
+            "hyperspace_tpu.metadata.cache.CreationTimeBasedCache._lock",
+            "hyperspace_tpu.execution.device_cache.RefCache._lock",
+            "hyperspace_tpu.serve.scheduler.QueryServer._cv",
+            "hyperspace_tpu.serve.plan_cache.PlanCache._lock",
+            "hyperspace_tpu.serve.result_cache.ResultCache._lock",
+            "hyperspace_tpu.ops.filter._MASK_FN_LOCK",
+            "hyperspace_tpu.utils.jit_memory._limit_lock",
+        ):
+            assert lock_id in program.locks, lock_id
+
+    def test_lock_holders_reach_only_leaf_metric_locks(self, repo_program):
+        # The shape of the healthy graph: every order edge terminates in
+        # a metrics-registry leaf lock (which never calls out).
+        program, callgraph = repo_program
+        lockgraph = LockGraph(program, callgraph)
+        inner = {b for (_, b) in lockgraph.order_edges()}
+        outer = {a for (a, _) in lockgraph.order_edges()}
+        assert not any(lock.startswith("hyperspace_tpu.obs.metrics") for lock in outer)
+        assert inner  # the graph is not trivially empty
+
+    def test_zero_config_key_drift(self, repo_program):
+        program, _ = repo_program
+        assert config_key_findings(program, [TESTS_DIR]) == []
+
+    def test_zero_fault_point_drift(self, repo_program):
+        program, _ = repo_program
+        assert fault_point_findings(program) == []
+
+    def test_zero_resource_findings(self, repo_program):
+        program, _ = repo_program
+        assert resource_findings(program) == []
+
+    def test_validator_corpus_passes(self):
+        report = validator_corpus()
+        assert report["status"] == "ok", report
+
+    def test_run_check_clean(self, repo_program):
+        report = run_check(default_paths(REPO_ROOT), REPO_ROOT, [TESTS_DIR])
+        assert report["_findings"] == []
+        assert report["summary"]["allowlisted"] == len(report["allowlisted"])
+        assert report["summary"]["locks"] >= 20
+
+    def test_seeded_typo_counter_is_caught(self, repo_program):
+        # Sanity that the repo-wide zero isn't vacuous: a typo'd key in a
+        # scratch module next to the real program is flagged with a
+        # did-you-mean naming the declared key.
+        src = 'def f(conf):\n    return conf.get("hyperspace.serve.workerz")\n'
+        name, path = "scratch_mod", "scratch_mod.py"
+        program = Program({name: _index_module(name, path, src, ast.parse(src))})
+        findings = config_key_findings(program, [])
+        assert [f.rule for f in findings] == ["HSL010"]
+        assert "hyperspace.serve.workers" in findings[0].message
+
+    def test_seeded_unthreaded_fault_point_is_caught(self, repo_program, monkeypatch):
+        from hyperspace_tpu import faults as faults_mod
+
+        program, _ = repo_program
+        monkeypatch.setattr(
+            faults_mod, "KNOWN_POINTS", (*faults_mod.KNOWN_POINTS, "ghost.point")
+        )
+        findings = fault_point_findings(program)
+        assert [f.rule for f in findings] == ["HSL012"]
+        assert "ghost.point" in findings[0].message
+        assert "never threaded" in findings[0].message
+
+    def test_allowlist_is_narrow_and_justified(self):
+        for (suffix, rule), why in TEST_ALLOWLIST.items():
+            assert not suffix.startswith("hyperspace_tpu/"), (
+                "the allowlist is for test/benchmark surfaces only — "
+                "package findings get fixed"
+            )
+            assert why
+
+
+# -- check CLI ----------------------------------------------------------------
+
+class TestCheckCli:
+    def test_exit_clean_on_repo(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "hyperspace_tpu.analysis.check"],
+            capture_output=True, text=True, cwd=REPO_ROOT,
+        )
+        assert proc.returncode == EXIT_CLEAN, proc.stdout + proc.stderr
+        assert "cycle-free=True" in proc.stderr
+
+    def test_exit_findings_without_baseline(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("from jax import shard_map\n")
+        assert check_main([str(bad), "--no-baseline"]) == EXIT_FINDINGS
+
+    def test_exit_internal_error(self, monkeypatch):
+        import hyperspace_tpu.analysis.check as check_mod
+
+        monkeypatch.setattr(
+            check_mod, "run_check",
+            lambda *a, **k: (_ for _ in ()).throw(RuntimeError("boom")),
+        )
+        assert check_mod.main(["--no-baseline"]) == EXIT_INTERNAL_ERROR
+
+    def test_baseline_masks_old_findings_only(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("from jax import shard_map\n")
+        baseline = tmp_path / "baseline.json"
+        # 1. write the baseline: current findings become "known"
+        assert check_main([str(bad), "--baseline", str(baseline),
+                           "--write-baseline"]) == EXIT_CLEAN
+        assert json.loads(baseline.read_text())["findings"]
+        # 2. same findings, baseline present -> clean
+        assert check_main([str(bad), "--baseline", str(baseline)]) == EXIT_CLEAN
+        # 3. a NEW finding fails even with the baseline
+        bad.write_text("from jax import shard_map\nimport numpy as np\nv = np.random.rand(3)\n")
+        assert check_main([str(bad), "--baseline", str(baseline)]) == EXIT_FINDINGS
+
+    def test_json_report_shape(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("from jax import shard_map\n")
+        out = tmp_path / "report.json"
+        rc = check_main([str(bad), "--no-baseline", "--format", "json",
+                         "--output", str(out)])
+        assert rc == EXIT_FINDINGS
+        report = json.loads(out.read_text())
+        assert report["summary"]["new_findings"] == 1
+        (finding,) = report["findings"]
+        assert finding["rule"] == "HSL001"
+        assert finding["slug"] == "fragile-jax-import"
+        assert finding["new"] is True
+        assert report["validator_corpus"]["status"] in ("ok", "skipped")
+        assert "lock_graph" in report
+
+    def test_docs_table_in_sync(self):
+        # docs/configuration.md's key table is generated from
+        # config.KNOWN_KEYS; this is the no-drift assertion.
+        from hyperspace_tpu.analysis.check import docs_findings
+
+        assert docs_findings(REPO_ROOT) == []

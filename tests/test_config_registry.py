@@ -1,0 +1,73 @@
+"""Runtime counterpart of HSL010: the declared config-key registry
+(config.KNOWN_KEYS) rejects undeclared hyperspace.* keys with a
+did-you-mean suggestion, and the generated docs table stays in sync."""
+
+from __future__ import annotations
+
+import pytest
+
+from hyperspace_tpu import config
+from hyperspace_tpu.exceptions import UnknownConfigKeyError
+
+
+@pytest.fixture()
+def conf():
+    return config.HyperspaceConf()
+
+
+class TestKnownKeysRegistry:
+    def test_every_constant_key_is_declared(self):
+        # Every hyperspace.* string constant in config.py is in the
+        # registry (the module can't grow a key outside it).
+        consts = [
+            v for v in vars(config).values()
+            if isinstance(v, str) and v.startswith("hyperspace.")
+        ]
+        assert consts
+        for key in consts:
+            assert key in config.KNOWN_KEYS, key
+
+    def test_registry_entries_are_documented(self):
+        for key, spec in config.KNOWN_KEYS.items():
+            assert spec.doc.strip(), key
+            assert spec.default.strip(), key
+
+    def test_docs_table_lists_every_key(self):
+        table = config.docs_table()
+        for key in config.KNOWN_KEYS:
+            assert f"`{key}`" in table
+
+    def test_set_unknown_key_raises_with_suggestion(self, conf):
+        with pytest.raises(UnknownConfigKeyError) as ei:
+            conf.set("hyperspace.srve.workers", 2)
+        assert ei.value.suggestion == "hyperspace.serve.workers"
+        assert "did you mean" in str(ei.value)
+
+    def test_get_unknown_key_raises(self, conf):
+        with pytest.raises(UnknownConfigKeyError):
+            conf.get("hyperspace.obs.enabld")
+
+    def test_unknown_key_without_close_match_has_no_suggestion(self, conf):
+        with pytest.raises(UnknownConfigKeyError) as ei:
+            conf.set("hyperspace.zzzz.qqqq.wwww", 1)
+        assert ei.value.suggestion is None
+
+    def test_declared_keys_still_work(self, conf):
+        conf.set(config.SERVE_WORKERS, 2)
+        assert conf.get(config.SERVE_WORKERS) == 2
+        conf.set("hyperspace.index.num.buckets", 16)
+        assert conf.num_buckets == 16
+
+    def test_non_hyperspace_namespace_passes_through(self, conf):
+        # The overrides map stays usable as an app scratch space.
+        conf.set("myapp.custom.knob", "x")
+        assert conf.get("myapp.custom.knob") == "x"
+
+    def test_explain_keys_live_in_config(self, conf):
+        # Moved out of display_mode.py so the registry is the single
+        # declaration point; the re-export keeps old imports working.
+        from hyperspace_tpu.explain.display_mode import EXPLAIN_DISPLAY_MODE
+
+        assert EXPLAIN_DISPLAY_MODE == config.EXPLAIN_DISPLAY_MODE
+        conf.set(EXPLAIN_DISPLAY_MODE, "console")
+        assert conf.get(EXPLAIN_DISPLAY_MODE) == "console"
