@@ -18,13 +18,17 @@ Armbrust et al., SIGMOD 2015; the reference inherits it wholesale):
   `python -m hyperspace_tpu.analysis.lint <paths>`.
 - the **whole-program engine** — `program` (module/symbol index +
   single-pass function summaries), `callgraph` (cross-module call
-  resolution), `locks` (the static lock-acquisition graph), and the
+  resolution), `locks` (the static lock-acquisition graph), `effects`
+  (per-function shared-state effect summaries with locksets), and the
   rules only it can express: HSL009 lock-order inversion with two-chain
   witnesses, HSL010 config-key drift against `config.KNOWN_KEYS`,
   HSL011 resource/exception safety, HSL012 fault-point coverage against
-  `faults.KNOWN_POINTS`. The unified driver — lint + whole-program
-  rules + validator corpus + findings baseline — is
-  `python -m hyperspace_tpu.analysis.check` (docs/static_analysis.md).
+  `faults.KNOWN_POINTS`, HSL013 lockset data races with two-path
+  witnesses, HSL014 torn check-then-act atomicity violations, HSL015
+  jit-cache hygiene (recompile-storm / executable-leak call sites). The
+  unified driver — lint + whole-program rules + validator corpus +
+  findings baseline — is `python -m hyperspace_tpu.analysis.check`
+  (docs/static_analysis.md).
 """
 
 from hyperspace_tpu.analysis.validator import (
@@ -38,6 +42,7 @@ __all__ = [
     "validate_plan",
     "validate_rewrite",
     "CallGraph",
+    "Effects",
     "LockGraph",
     "Program",
 ]
@@ -57,4 +62,8 @@ def __getattr__(name):
         from hyperspace_tpu.analysis.locks import LockGraph
 
         return LockGraph
+    if name == "Effects":
+        from hyperspace_tpu.analysis.effects import Effects
+
+        return Effects
     raise AttributeError(name)

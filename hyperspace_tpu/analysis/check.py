@@ -21,6 +21,13 @@ engine (analysis/program.py → callgraph.py → locks.py):
 - **HSL012 fault-point coverage** — ``faults.KNOWN_POINTS`` and the
   ``fault_point()``/``inject()`` call sites must agree in both
   directions.
+- **HSL013 lockset data race** — shared state accessed under
+  inconsistent locksets with a write in play, over the effect
+  summaries (analysis/effects.py → races.py); two-path witness.
+- **HSL014 atomicity violation** — torn check-then-act across released
+  and re-acquired locks (memo-fill and re-check idioms exempt).
+- **HSL015 jit-cache hygiene** — jit call sites manufacturing a fresh
+  cache key per call (recompile storm / executable leak).
 - **Validator corpus** — a small set of known-good / known-bad logical
   plans is pushed through the plan validator (analysis/validator.py) as
   a self-test; skipped (with a note) when numpy isn't installed, so the
@@ -36,8 +43,12 @@ single-threaded benchmark code (:data:`TEST_ALLOWLIST`).
 newly added rule with pre-existing findings can land without blocking
 every unrelated PR, while any NEW finding fails immediately.
 
-Exit codes: 0 = clean (no new findings), 1 = new findings,
-2 = the analyzer itself crashed.
+``--format sarif`` renders the same findings as SARIF 2.1.0 (the CI
+code-scanning artifact); ``--changed`` restricts *reporting* to files
+changed vs origin/main while the engine still indexes the whole program
+(the fast local pre-push mode). Exit codes are format-independent:
+0 = clean (no new findings), 1 = new findings, 2 = the analyzer itself
+crashed.
 """
 
 from __future__ import annotations
@@ -50,6 +61,7 @@ import sys
 
 from hyperspace_tpu.analysis import lint as lint_mod
 from hyperspace_tpu.analysis.callgraph import CallGraph
+from hyperspace_tpu.analysis.effects import Effects
 from hyperspace_tpu.analysis.lint import (
     EXIT_CLEAN,
     EXIT_FINDINGS,
@@ -59,6 +71,11 @@ from hyperspace_tpu.analysis.lint import (
 )
 from hyperspace_tpu.analysis.locks import LockGraph, resource_findings
 from hyperspace_tpu.analysis.program import Program, _index_module, _module_name
+from hyperspace_tpu.analysis.races import (
+    atomicity_findings,
+    jit_hygiene_findings,
+    lockset_race_findings,
+)
 
 CONFIG_DRIFT = "HSL010"
 FAULT_COVERAGE = "HSL012"
@@ -366,6 +383,83 @@ def validator_corpus() -> dict:
             "cases": len(corpus), "failures": failures}
 
 
+# -- SARIF --------------------------------------------------------------------
+
+def to_sarif(findings: list[Finding], baseline: set[tuple], root: pathlib.Path) -> dict:
+    """SARIF 2.1.0 form of the findings — the code-scanning artifact CI
+    uploads next to the JSON report. Baseline-known findings carry
+    ``baselineState: unchanged`` so scanners triage only what's new."""
+    rules = [
+        {
+            "id": r.rule,
+            "name": r.slug,
+            "shortDescription": {"text": r.summary},
+            "properties": {"scope": r.scope},
+        }
+        for r in sorted(RULES.values(), key=lambda r: r.rule)
+    ]
+    results = []
+    for f in findings:
+        path = _finding_key(f, root)[1]
+        results.append({
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": path},
+                    "region": {"startLine": max(1, f.line)},
+                },
+            }],
+            "baselineState": (
+                "unchanged" if tuple(_finding_key(f, root)) in baseline else "new"
+            ),
+        })
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "hyperspace-analysis",
+                    "informationUri": "docs/static_analysis.md",
+                    "rules": rules,
+                },
+            },
+            "results": results,
+        }],
+    }
+
+
+# -- --changed: restrict findings to files changed vs origin/main -------------
+
+def changed_files(root: pathlib.Path) -> tuple[str, set[str]] | None:
+    """(base ref, changed .py paths relative to root) from git, trying
+    ``origin/main`` then ``main`` then ``HEAD``; None when git (or the
+    repo) is unavailable — the caller falls back to a full run."""
+    import subprocess
+
+    for base in ("origin/main", "main", "HEAD"):
+        try:
+            proc = subprocess.run(
+                ["git", "diff", "--name-only", base, "--", "*.py"],
+                cwd=root, capture_output=True, text=True, timeout=30,
+            )
+        except (OSError, subprocess.SubprocessError):
+            return None
+        if proc.returncode == 0:
+            files = {line.strip() for line in proc.stdout.splitlines() if line.strip()}
+            return base, files
+    return None
+
+
+def restrict_findings(findings: list[Finding], changed: set[str], root: pathlib.Path) -> list[Finding]:
+    """Findings whose (root-relative) path is in the changed set. The
+    engine still indexed the WHOLE program — resolution and cross-module
+    rules saw everything; only the reporting surface narrows."""
+    return [f for f in findings if _finding_key(f, root)[1] in changed]
+
+
 # -- baseline -----------------------------------------------------------------
 
 def _finding_key(f: Finding, root: pathlib.Path) -> list:
@@ -402,11 +496,15 @@ def run_check(
     program = build_program(sources)
     callgraph = CallGraph(program)
     lockgraph = LockGraph(program, callgraph)
+    effects = Effects(program, callgraph)
     findings.extend(lockgraph.inversions())
     findings.extend(resource_findings(program))
     findings.extend(config_key_findings(program, usage_dirs))
     findings.extend(docs_findings(root))
     findings.extend(fault_point_findings(program))
+    findings.extend(lockset_race_findings(program, effects))
+    findings.extend(atomicity_findings(program, effects))
+    findings.extend(jit_hygiene_findings(program))
     allowed = []
     kept = []
     for f in findings:
@@ -425,6 +523,7 @@ def run_check(
                 f"validator corpus case {fail['case']!r} regressed: expected "
                 f"{fail['expected']}, got {fail['got']}",
             ))
+    total_calls = len(callgraph.edges) + len(callgraph.unresolved)
     return {
         "_findings": kept,
         "summary": {
@@ -433,8 +532,17 @@ def run_check(
             "allowlisted": len(allowed),
             "functions": len(program.functions),
             "call_edges": len(callgraph.edges),
+            # Resolution-quality accounting: the engine's blind spots.
+            # A rising unresolved ratio silently weakens every
+            # whole-program rule, so tests pin a regression bound on it.
+            "calls_unresolved": len(callgraph.unresolved),
+            "calls_unresolved_ratio": round(
+                len(callgraph.unresolved) / total_calls, 4
+            ) if total_calls else 0.0,
             "locks": len(program.locks),
             "lock_edges": len(lockgraph.order_edges()),
+            "shared_states": len(effects.by_state),
+            "entry_guaranteed_fns": len(effects.entry_locks),
         },
         "validator_corpus": corpus,
         "lock_graph": lockgraph.to_json(),
@@ -453,8 +561,12 @@ def main(argv: list[str] | None = None) -> int:
     )
     ap.add_argument("paths", nargs="*", help="files/directories (default: the "
                     "package + benchmarks + bench.py + tests/conftest.py)")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--format", choices=("text", "json", "sarif"), default="text")
     ap.add_argument("--output", help="also write the report to this file")
+    ap.add_argument("--changed", action="store_true",
+                    help="report findings only for files changed vs origin/main "
+                         "(the engine still indexes the whole program) — the "
+                         "fast local pre-push mode")
     ap.add_argument("--baseline", help=f"baseline file (default: {BASELINE_NAME} "
                     "at the repo root when present)")
     ap.add_argument("--write-baseline", action="store_true",
@@ -476,6 +588,15 @@ def main(argv: list[str] | None = None) -> int:
         usage_dirs = [root / "tests"] if (root / "tests").exists() else []
         report = run_check(paths, root, usage_dirs)
         findings: list[Finding] = report.pop("_findings")
+        if args.changed:
+            got = changed_files(root)
+            if got is None:
+                print("--changed: git unavailable — running on everything",
+                      file=sys.stderr)
+            else:
+                base, files = got
+                findings = restrict_findings(findings, files, root)
+                report["changed"] = {"base": base, "files": sorted(files)}
         baseline_path = pathlib.Path(args.baseline) if args.baseline else root / BASELINE_NAME
         if args.write_baseline:
             baseline_path.write_text(json.dumps(
@@ -500,10 +621,13 @@ def main(argv: list[str] | None = None) -> int:
             "known": len(baseline), "stale": max(0, stale), "new": len(new),
         }
         report["summary"]["new_findings"] = len(new)
-        rendered = json.dumps(report, indent=2, sort_keys=True)
+        if args.format == "sarif":
+            rendered = json.dumps(to_sarif(findings, baseline, root), indent=2)
+        else:
+            rendered = json.dumps(report, indent=2, sort_keys=True)
         if args.output:
             pathlib.Path(args.output).write_text(rendered + "\n")
-        if args.format == "json":
+        if args.format in ("json", "sarif"):
             print(rendered)
         else:
             for f in findings:

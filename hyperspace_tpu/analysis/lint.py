@@ -69,11 +69,13 @@ Suppression: a finding on a line containing ``# noqa`` or
 
 This module is the *per-file* half of the analysis engine. The
 whole-program rules — HSL009 lock-order inversion, HSL010 config-key
-drift, HSL011 resource/exception safety, HSL012 fault-point coverage —
-need the cross-module index (analysis/program.py, callgraph.py,
-locks.py) and run from the unified driver ``python -m
-hyperspace_tpu.analysis.check``, which parses each file ONCE and feeds
-the same tree to this linter and to the program index. All rules,
+drift, HSL011 resource/exception safety, HSL012 fault-point coverage,
+HSL013 lockset data races, HSL014 torn check-then-act, HSL015
+jit-cache hygiene — need the cross-module index (analysis/program.py,
+callgraph.py, locks.py, effects.py, races.py) and run from the unified
+driver ``python -m hyperspace_tpu.analysis.check``, which parses each
+file ONCE and feeds the same tree to this linter and to the program
+index. All rules,
 per-file and whole-program, are declared in :data:`RULES` — the one
 registry the JSON report, the docs table, and the baseline key on.
 """
@@ -144,6 +146,15 @@ RULES: dict[str, RuleInfo] = {
                  scope="program"),
         RuleInfo("HSL012", "fault-point-coverage",
                  "faults.KNOWN_POINTS and fault_point()/inject() call sites out of sync",
+                 scope="program"),
+        RuleInfo("HSL013", "lockset-race",
+                 "shared state accessed under inconsistent locksets with a write in play",
+                 scope="program"),
+        RuleInfo("HSL014", "atomicity-violation",
+                 "torn check-then-act: read under a lock, released, stale write-back re-acquiring it",
+                 scope="program"),
+        RuleInfo("HSL015", "jit-cache-hygiene",
+                 "jit call site manufacturing a fresh cache key per call (recompile storm / executable leak)",
                  scope="program"),
     )
 }

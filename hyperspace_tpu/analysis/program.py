@@ -93,6 +93,30 @@ class Acquire:
     held: tuple[LockRef, ...]
 
 
+@dataclasses.dataclass(frozen=True)
+class AttrAccess:
+    """One shared-state access: a ``self.<attr>`` load/store or a
+    module-global load/store, with the stack of lock references lexically
+    held at the access site (the raw material of the effect summaries in
+    analysis/effects.py and the HSL013 lockset race rule).
+
+    kind: 'self' (instance attribute through ``self``) or 'global'
+    (module-level name in this module's shared-global candidate set).
+    write covers rebinds, augmented assigns, subscript stores, ``del``,
+    and in-place mutator calls (``.append``/``.update``/...); ``keyed``
+    marks subscript/keyed-mutator forms (``S[k] = v``, ``S.pop(k)``) —
+    the memo-fill shape the atomicity rule treats differently from a
+    whole-value rebind."""
+
+    kind: str
+    attr: str
+    line: int
+    write: bool
+    held: tuple[LockRef, ...]
+    keyed: bool = False
+    in_init: bool = False
+
+
 @dataclasses.dataclass
 class ConfigAccess:
     """One conf ``get``/``set`` whose key resolves (constant or named
@@ -120,6 +144,7 @@ class FunctionInfo:
     acquires: list[Acquire] = dataclasses.field(default_factory=list)
     config_accesses: list[ConfigAccess] = dataclasses.field(default_factory=list)
     fault_refs: list[tuple[str, int, str]] = dataclasses.field(default_factory=list)
+    attr_accesses: list[AttrAccess] = dataclasses.field(default_factory=list)
     returns_type: str | None = None  # raw annotation text, when a simple name
 
 
@@ -133,6 +158,7 @@ class ClassInfo:
     methods: dict[str, FunctionInfo] = dataclasses.field(default_factory=dict)
     attr_locks: dict[str, str] = dataclasses.field(default_factory=dict)  # attr -> kind
     attr_types: dict[str, str] = dataclasses.field(default_factory=dict)  # attr -> raw ctor ref
+    attr_names: set[str] = dataclasses.field(default_factory=set)  # every self.X assigned
 
 
 @dataclasses.dataclass
@@ -147,6 +173,10 @@ class ModuleInfo:
     module_locks: dict[str, str] = dataclasses.field(default_factory=dict)  # name -> kind
     var_types: dict[str, str] = dataclasses.field(default_factory=dict)  # name -> raw ctor ref
     const_strings: dict[str, str] = dataclasses.field(default_factory=dict)
+    # Module-level names whose loads/stores count as shared-state
+    # accesses: mutable containers assigned at module level plus any
+    # name some function rebinds through `global` (analysis/effects.py).
+    shared_globals: set[str] = dataclasses.field(default_factory=set)
 
     @property
     def lines(self) -> list[str]:
@@ -158,10 +188,22 @@ class _FunctionPass(ast.NodeVisitor):
     acquisitions (with the held stack), config accesses, and fault-point
     references in one walk."""
 
+    _INIT_NAMES = ("__init__", "__new__", "__post_init__")
+
     def __init__(self, info: FunctionInfo, module: ModuleInfo):
         self.info = info
         self.module = module
         self._held: list[LockRef] = []
+        self._in_init = info.cls is not None and info.name in self._INIT_NAMES
+        self._global_decls: set[str] = set()
+        # Attribute/Name nodes already accounted for by an enclosing
+        # write form (mutator call, subscript store) — their Load visit
+        # must not double-record a read.
+        self._claimed: set[int] = set()
+        # Lambdas that run under the current lock stack despite being
+        # nested functions: predicates passed to Condition.wait_for are
+        # evaluated while the condition's lock is held.
+        self._inherit_held: set[int] = set()
 
     def _lock_ref(self, ctx: ast.expr, line: int) -> LockRef | None:
         """A LockRef when the with-item context expression *could* be a
@@ -201,7 +243,11 @@ class _FunctionPass(ast.NodeVisitor):
         # Nested defs/lambdas run later, not at the enclosing call site —
         # but the serving plane's closures (QueryServer._body) DO run
         # with no lock held, so walk them with an empty held stack.
-        saved, self._held = self._held, []
+        # Exception: wait_for predicates (marked in _inherit_held) are
+        # evaluated by Condition.wait_for WITH the lock held.
+        saved = self._held
+        if id(node) not in self._inherit_held:
+            self._held = []
         for stmt in getattr(node, "body", []) if not isinstance(node, ast.Lambda) else [node.body]:
             self.visit(stmt)
         self._held = saved
@@ -221,7 +267,96 @@ class _FunctionPass(ast.NodeVisitor):
             self.info.calls.append(CallSite(raw, node.lineno, tuple(self._held)))
         self._check_config_access(node, raw)
         self._check_fault_ref(node, raw)
+        # In-place mutator call on shared state: self.X.append(...) /
+        # GLOBAL.update(...) is a WRITE to X / GLOBAL.
+        if isinstance(node.func, ast.Attribute) and node.func.attr in _MUTATOR_METHODS:
+            keyed = node.func.attr in _KEYED_MUTATORS and bool(node.args)
+            self._record_target(node.func.value, node.lineno, write=True, keyed=keyed)
+        # wait_for predicates run under the condition's lock — mark the
+        # lambda so _visit_nested_fn keeps the held stack for it.
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "wait_for":
+            for arg in node.args:
+                if isinstance(arg, (ast.Lambda, ast.Name)):
+                    self._inherit_held.add(id(arg))
         self.generic_visit(node)
+
+    # -- shared-state accesses ---------------------------------------------
+    def visit_Global(self, node: ast.Global) -> None:
+        self._global_decls.update(node.names)
+
+    def _record_target(self, base: ast.expr, line: int, write: bool, keyed: bool) -> None:
+        """Record a write through an access base: ``self.X`` or a shared
+        module-global name (claiming the base node so its Load visit
+        doesn't double-record a read)."""
+        if (
+            isinstance(base, ast.Attribute)
+            and isinstance(base.value, ast.Name)
+            and base.value.id == "self"
+        ):
+            self._claimed.add(id(base))
+            self.info.attr_accesses.append(AttrAccess(
+                "self", base.attr, line, write, tuple(self._held),
+                keyed=keyed, in_init=self._in_init,
+            ))
+        elif isinstance(base, ast.Name) and base.id in self.module.shared_globals:
+            self._claimed.add(id(base))
+            self.info.attr_accesses.append(AttrAccess(
+                "global", base.id, line, write, tuple(self._held),
+                keyed=keyed, in_init=self._in_init,
+            ))
+
+    def _record_store(self, tgt: ast.expr, line: int) -> None:
+        if isinstance(tgt, ast.Attribute):
+            self._record_target(tgt, line, write=True, keyed=False)
+        elif isinstance(tgt, ast.Subscript):
+            self._record_target(tgt.value, line, write=True, keyed=True)
+        elif isinstance(tgt, ast.Name):
+            if tgt.id in self._global_decls and tgt.id in self.module.shared_globals:
+                self.info.attr_accesses.append(AttrAccess(
+                    "global", tgt.id, line, write=True, held=tuple(self._held),
+                    in_init=self._in_init,
+                ))
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._record_store(el, line)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for tgt in node.targets:
+            self._record_store(tgt, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._record_store(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for tgt in node.targets:
+            self._record_store(tgt, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if (
+            isinstance(node.ctx, ast.Load)
+            and id(node) not in self._claimed
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            self.info.attr_accesses.append(AttrAccess(
+                "self", node.attr, node.lineno, write=False,
+                held=tuple(self._held), in_init=self._in_init,
+            ))
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if (
+            isinstance(node.ctx, ast.Load)
+            and id(node) not in self._claimed
+            and node.id in self.module.shared_globals
+        ):
+            self.info.attr_accesses.append(AttrAccess(
+                "global", node.id, node.lineno, write=False,
+                held=tuple(self._held), in_init=self._in_init,
+            ))
 
     # -- config get/set ----------------------------------------------------
     def _check_config_access(self, node: ast.Call, raw: str) -> None:
@@ -274,8 +409,70 @@ class _FunctionPass(ast.NodeVisitor):
             self.info.fault_refs.append((arg.value, node.lineno, kind))
 
 
+# Container constructors whose module-level instances count as shared
+# state, and the in-place method names that mutate shared state (the
+# keyed subset is the memo-fill shape: S[k]=v / S.pop(k) / S.setdefault).
+_CONTAINER_CTORS = {"dict", "list", "set", "deque", "defaultdict", "OrderedDict", "Counter"}
+_MUTATOR_METHODS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "update", "setdefault", "pop", "popleft", "popitem", "clear",
+    "remove", "discard",
+}
+_KEYED_MUTATORS = {"pop", "setdefault"}
+
+
+def _shared_global_names(tree: ast.Module) -> set[str]:
+    """Module-level names whose cross-thread accesses matter: mutable
+    containers assigned at the top level, plus every name declared
+    ``global`` inside some function (rebound module state)."""
+    out: set[str] = set()
+    for node in tree.body:
+        targets: list[ast.expr] = []
+        value = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        if value is None:
+            continue
+        is_container = isinstance(
+            value, (ast.Dict, ast.List, ast.Set, ast.DictComp, ast.ListComp, ast.SetComp)
+        ) or (
+            isinstance(value, ast.Call)
+            and _dotted(value.func).split(".")[-1] in _CONTAINER_CTORS
+        )
+        if not is_container:
+            continue
+        for tgt in targets:
+            if isinstance(tgt, ast.Name):
+                out.add(tgt.id)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Global):
+            out.update(node.names)
+    return out
+
+
+def _local_bound_names(fn_node: ast.AST) -> set[str]:
+    """Names bound locally anywhere in a function (params, assignment /
+    loop / with / except targets, comprehension vars) — a module-global
+    load is only a shared read when the name is NOT shadowed locally."""
+    bound: set[str] = set()
+    global_names: set[str] = set()
+    for sub in ast.walk(fn_node):
+        if isinstance(sub, ast.Name) and isinstance(sub.ctx, (ast.Store, ast.Del)):
+            bound.add(sub.id)
+        elif isinstance(sub, ast.arg):
+            bound.add(sub.arg)
+        elif isinstance(sub, ast.ExceptHandler) and sub.name:
+            bound.add(sub.name)
+        elif isinstance(sub, ast.Global):
+            global_names.update(sub.names)
+    return bound - global_names
+
+
 def _index_module(name: str, path: str, source: str, tree: ast.Module) -> ModuleInfo:
     mod = ModuleInfo(name=name, path=path, tree=tree, source=source)
+    mod.shared_globals = _shared_global_names(tree)
     for node in tree.body:
         if isinstance(node, ast.Import):
             for alias in node.names:
@@ -318,6 +515,14 @@ def _index_function(mod: ModuleInfo, cls: str | None, node) -> FunctionInfo:
     elif isinstance(ret, ast.Constant) and isinstance(ret.value, str):
         info.returns_type = ret.value.strip("'\"")
     _FunctionPass(info, mod).generic_visit(node)
+    # A module-global load shadowed by a local binding of the same name
+    # is not a shared access after all.
+    shadowed = _local_bound_names(node)
+    if shadowed:
+        info.attr_accesses = [
+            a for a in info.attr_accesses
+            if not (a.kind == "global" and not a.write and a.attr in shadowed)
+        ]
     return info
 
 
@@ -342,6 +547,7 @@ def _index_class(mod: ModuleInfo, node: ast.ClassDef) -> ClassInfo:
                     and tgt.value.id == "self"
                 ):
                     continue
+                cls.attr_names.add(tgt.attr)
                 kind = _lock_kind(sub.value)
                 if kind is not None:
                     cls.attr_locks[tgt.attr] = kind

@@ -212,7 +212,11 @@ def observed_points() -> set[str]:
 def fault_point(name: str, path: str | os.PathLike | None = None) -> None:
     """Declare a named fault point. Call sites sprinkle this on the IO
     paths; it is a no-op unless a test armed the harness."""
-    if not _armed:
+    # Benign racy read BY DESIGN: _armed is a monotonic bool gate flipped
+    # under _lock; a stale False skips at most one injection during the
+    # arming instant, and the disarmed fast path must stay lock-free
+    # (every metadata/IO call site runs through here).
+    if not _armed:  # noqa: HSL013
         return
     _hit(name, path)
 

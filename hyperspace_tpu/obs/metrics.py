@@ -85,14 +85,16 @@ class Gauge:
 
     @property
     def value(self) -> float:
-        return self._value
+        with self._lock:
+            return self._value
 
     def _reset(self) -> None:
         with self._lock:
             self._value = 0.0
 
     def snapshot(self):
-        return self._value
+        with self._lock:
+            return self._value
 
 
 class Histogram:
@@ -132,11 +134,13 @@ class Histogram:
 
     @property
     def count(self) -> int:
-        return self._count
+        with self._lock:
+            return self._count
 
     @property
     def sum(self) -> float:
-        return self._sum
+        with self._lock:
+            return self._sum
 
     def quantile(self, q: float) -> float | None:
         """Estimated q-quantile (0..1); None when empty."""

@@ -35,6 +35,10 @@ def _enter_x64() -> None:
 def run_x64(fn, /, *args, **kwargs):
     """Run `fn` on the persistent x64 worker thread and return its result."""
     global _pool
+    # Double-checked init (HSL013-allowlisted): the unguarded read is
+    # the lock-free hot path; a stale None only sends the loser into the
+    # locked block, where the re-check under _pool_lock decides. Once
+    # published, _pool is never reassigned.
     if _pool is None:
         with _pool_lock:
             if _pool is None:

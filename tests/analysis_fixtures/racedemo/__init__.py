@@ -1,0 +1,19 @@
+"""Fixture mini-package for the effects/race analysis tests.
+
+NOT imported at runtime — the engine only parses it. Contains, on
+purpose, exactly three planted findings (the HSL013–HSL015 seeded
+regressions):
+
+- ``store.Store.reset_unsafe`` writes ``_version`` without the lock
+  every other access holds — the HSL013 lockset race, reported with a
+  two-path witness naming the guarded and unguarded access.
+- ``store.Store.bump_torn`` reads ``_version`` under the lock, releases
+  it, and writes the stale value back under a re-acquired lock — the
+  HSL014 torn check-then-act.
+- ``kernels.scale_columns`` jits a fresh lambda per loop iteration —
+  the HSL015 recompile-storm / executable-leak pattern.
+
+Everything else in the package is the clean counterpart of each pattern
+(consistent locksets, atomic check-then-act, memoized jit factories).
+The golden effect-summary JSON lives in ../goldens/.
+"""

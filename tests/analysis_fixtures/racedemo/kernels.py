@@ -1,0 +1,44 @@
+"""Fixture module: one per-call jit site (HSL015) next to the two
+sanctioned bounded patterns (lru_cache factory, explicit memo)."""
+
+import functools
+import threading
+
+import jax
+
+
+def scale_columns(columns, factor):
+    out = []
+    for arr in columns:
+        # DELIBERATE HSL015: a fresh lambda per iteration means a fresh
+        # jit cache key per iteration — compile + executable leak each
+        # time around the loop.
+        fn = jax.jit(lambda x: x * factor)
+        out.append(fn(arr))
+    return out
+
+
+@functools.lru_cache(maxsize=8)
+def make_scaler(factor):
+    def scale(x):
+        return x * factor
+
+    return jax.jit(scale)  # clean: the factory is memoized
+
+
+_FN_CACHE: dict = {}
+_FN_LOCK = threading.Lock()
+
+
+def offset_kernel(offset):
+    with _FN_LOCK:
+        fn = _FN_CACHE.get(offset)
+    if fn is None:
+        fn = jax.jit(functools.partial(_shift, offset))  # clean: memo below
+        with _FN_LOCK:
+            _FN_CACHE[offset] = fn
+    return fn
+
+
+def _shift(offset, x):
+    return x + offset

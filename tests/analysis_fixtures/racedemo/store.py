@@ -1,0 +1,42 @@
+"""Fixture module: a lock-disciplined store with one deliberate
+unguarded write (HSL013) and one torn check-then-act (HSL014)."""
+
+import threading
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+        self._version = 0
+
+    def put(self, key, value):
+        with self._lock:
+            self._entries[key] = value
+            self._version += 1
+
+    def get(self, key):
+        with self._lock:
+            return self._entries.get(key)
+
+    def size(self):
+        with self._lock:
+            return len(self._entries)
+
+    def reset_unsafe(self):
+        # DELIBERATE HSL013: every other _version access holds _lock;
+        # this write races the guarded increment in put().
+        self._version = 0
+
+    def bump_torn(self):
+        # DELIBERATE HSL014: the value read under the lock is written
+        # back under a RE-ACQUIRED lock — a concurrent put() between the
+        # two critical sections is lost.
+        with self._lock:
+            v = self._version
+        with self._lock:
+            self._version = v + 1
+
+    def bump_atomic(self):
+        with self._lock:
+            self._version = self._version + 1

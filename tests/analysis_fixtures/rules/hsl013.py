@@ -1,0 +1,73 @@
+"""HSL013 lockset-race corpus: shared state under inconsistent locksets.
+
+(The cross-class form with a two-path witness lives in the racedemo
+fixture package; this file is the minimal per-state forms.)
+"""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._label = "idle"
+
+    def bump(self):
+        with self._lock:
+            self._count += 1
+
+    def read(self):
+        with self._lock:
+            return self._count
+
+    def clear_unsafe(self):
+        self._count = 0  # expect: HSL013
+
+    def relabel(self):
+        with self._lock:
+            self._label = "busy"
+
+    def read_label_consistent(self):
+        with self._lock:
+            return self._label
+
+
+class EventLike:
+    """No lock anywhere — no locking discipline exists to violate, so
+    the guarded-by inference stays silent (cross-thread safety here is
+    somebody else's argument, e.g. an Event or a happens-before)."""
+
+    def __init__(self):
+        self.flag = False
+
+    def set_flag(self):
+        self.flag = True
+
+    def get_flag(self):
+        return self.flag
+
+
+_g_lock = threading.Lock()
+_g_version = 0
+
+
+def g_bump(delta):
+    global _g_version
+    with _g_lock:
+        _g_version += delta
+
+
+def g_read():
+    with _g_lock:
+        return _g_version
+
+
+def g_reset_unsafe():
+    global _g_version
+    _g_version = 0  # expect: HSL013
+
+
+def g_reset_sanctioned():
+    global _g_version
+    _g_version = -1  # noqa: HSL013 — test-only reset before threads start

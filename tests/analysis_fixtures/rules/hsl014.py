@@ -1,0 +1,60 @@
+"""HSL014 atomicity corpus: torn check-then-act across lock regions."""
+
+import threading
+
+
+class Budget:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._remaining = 10
+        self._cache = {}
+
+    def spend_torn(self, cost):
+        with self._lock:
+            left = self._remaining
+        if left >= cost:
+            with self._lock:
+                self._remaining = left - cost  # expect: HSL014
+        return left
+
+    def spend_atomic(self, cost):
+        with self._lock:
+            left = self._remaining
+            if left >= cost:
+                self._remaining = left - cost
+            return left
+
+    def memo_fill_is_fine(self, key):
+        # Keyed read then keyed insert: duplicate idempotent work at
+        # worst — the sanctioned cache idiom, not a torn update.
+        with self._lock:
+            value = self._cache.get(key)
+        if value is None:
+            value = _expensive(key)
+            with self._lock:
+                self._cache[key] = value
+        return value
+
+    def recheck_is_fine(self, cost):
+        # Double-checked: the second region revalidates before writing.
+        with self._lock:
+            left = self._remaining
+        if left >= cost:
+            with self._lock:
+                if self._remaining >= cost:
+                    self._remaining = self._remaining - cost
+
+    def torn_through_helper(self, cost):
+        with self._lock:
+            left = self._remaining
+        if left >= cost:
+            self._apply(left - cost)  # expect: HSL014
+        return left
+
+    def _apply(self, value):
+        with self._lock:
+            self._remaining = value
+
+
+def _expensive(key):
+    return key

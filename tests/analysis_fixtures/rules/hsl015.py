@@ -1,0 +1,66 @@
+"""HSL015 jit-cache-hygiene corpus: call sites that manufacture a fresh
+jit cache key per call (recompile storm / executable leak)."""
+
+import functools
+import threading
+
+import jax
+
+
+def per_call_lambda(columns, factor):
+    out = []
+    for arr in columns:
+        fn = jax.jit(lambda x: x * factor)  # expect: HSL015
+        out.append(fn(arr))
+    return out
+
+
+def per_call_partial(arr, factor):
+    fn = jax.jit(functools.partial(_scale, factor))  # expect: HSL015
+    return fn(arr)
+
+
+def per_call_closure(arr, factor):
+    def scale(x):
+        return x * factor
+
+    return jax.jit(scale)(arr)  # expect: HSL015
+
+
+def _scale(factor, x):
+    return factor * x
+
+
+@functools.lru_cache(maxsize=32)
+def cached_factory(factor):
+    def scale(x):
+        return x * factor
+
+    return jax.jit(scale)  # clean: the factory is memoized
+
+
+_FN_CACHE: dict = {}
+_FN_LOCK = threading.Lock()
+
+
+def memo_filled(offset):
+    with _FN_LOCK:
+        fn = _FN_CACHE.get(offset)
+    if fn is None:
+        fn = jax.jit(functools.partial(_scale, offset))  # clean: memo below
+        with _FN_LOCK:
+            _FN_CACHE[offset] = fn
+    return fn
+
+
+@jax.jit
+def _kernel(x, mode):
+    return x
+
+
+def fstring_static(x, name):
+    return _kernel(x, f"mode-{name}")  # expect: HSL015
+
+
+def stable_static(x):
+    return _kernel(x, "mode-fixed")

@@ -109,8 +109,9 @@ class TestLintBehaviors:
     def test_rules_registry_covers_all_ids(self):
         from hyperspace_tpu.analysis.lint import RULES
 
-        assert sorted(RULES) == [f"HSL{i:03d}" for i in range(13)]
+        assert sorted(RULES) == [f"HSL{i:03d}" for i in range(16)]
         assert RULES["HSL009"].scope == "program"
+        assert RULES["HSL013"].scope == "program"
         assert RULES["HSL001"].scope == "file"
 
 
