@@ -171,9 +171,13 @@ class Action:
             if self.log_manager.write_log(rollback_id, rollback):
                 self.log_manager.create_latest_stable_log(rollback_id)
                 _stats.increment("action.rolled_back")
-        except Exception:
-            pass
+        except Exception as rb_err:
+            # Must-not-raise path, but never a SILENT one: a failed
+            # rollback means recover() owns the repair — say so.
+            _stats.increment("action.rollback_failed")
+            obs_trace.event("action.rollback_failed", error=str(rb_err))
         try:
             self.cleanup_failed_op()
-        except Exception:
-            pass
+        except Exception as cl_err:
+            _stats.increment("action.cleanup_failed")
+            obs_trace.event("action.cleanup_failed", error=str(cl_err))

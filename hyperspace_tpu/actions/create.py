@@ -19,6 +19,7 @@ from __future__ import annotations
 from pathlib import Path
 from typing import Protocol
 
+from hyperspace_tpu import stats as _stats
 from hyperspace_tpu.actions import states
 from hyperspace_tpu.actions.base import Action
 from hyperspace_tpu.config import HyperspaceConf
@@ -91,7 +92,9 @@ class CreateActionBase(Action):
         try:
             self.data_manager.quarantine(self._version_id)
         except Exception:
-            pass
+            # Must-not-raise path, but never silent: recover()'s orphan
+            # GC owns whatever this leaves behind.
+            _stats.increment("action.cleanup_failed")
 
     def _num_buckets(self) -> int:
         return int(self.conf.num_buckets)

@@ -17,6 +17,7 @@ import dataclasses
 from pathlib import Path
 from typing import Protocol
 
+from hyperspace_tpu import stats as _stats
 from hyperspace_tpu.actions import states
 from hyperspace_tpu.actions.base import Action
 from hyperspace_tpu.exceptions import HyperspaceError
@@ -72,7 +73,9 @@ class OptimizeAction(Action):
         try:
             self.data_manager.quarantine(self._version_id)
         except Exception:
-            pass
+            # Must-not-raise path, but never silent: recover()'s orphan
+            # GC owns whatever this leaves behind.
+            _stats.increment("action.cleanup_failed")
 
     def build_log_entry(self) -> IndexLogEntry:
         entry = dataclasses.replace(self.previous_entry)

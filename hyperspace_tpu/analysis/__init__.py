@@ -19,13 +19,18 @@ Armbrust et al., SIGMOD 2015; the reference inherits it wholesale):
 - the **whole-program engine** — `program` (module/symbol index +
   single-pass function summaries), `callgraph` (cross-module call
   resolution), `locks` (the static lock-acquisition graph), `effects`
-  (per-function shared-state effect summaries with locksets), and the
-  rules only it can express: HSL009 lock-order inversion with two-chain
-  witnesses, HSL010 config-key drift against `config.KNOWN_KEYS`,
-  HSL011 resource/exception safety, HSL012 fault-point coverage against
-  `faults.KNOWN_POINTS`, HSL013 lockset data races with two-path
-  witnesses, HSL014 torn check-then-act atomicity violations, HSL015
-  jit-cache hygiene (recompile-storm / executable-leak call sites). The
+  (per-function shared-state effect summaries with locksets), `raises`
+  (per-function exception escape sets over the same call graph), and
+  the rules only it can express: HSL009 lock-order inversion with
+  two-chain witnesses, HSL010 config-key drift against
+  `config.KNOWN_KEYS`, HSL011 resource/exception safety, HSL012
+  fault-point coverage against `faults.KNOWN_POINTS`, HSL013 lockset
+  data races with two-path witnesses, HSL014 torn check-then-act
+  atomicity violations, HSL015 jit-cache hygiene (recompile-storm /
+  executable-leak call sites), HSL016 error-contract drift against
+  `exceptions.ERROR_CONTRACTS` (generated docs/errors.md), HSL017
+  swallowed crash/fault handlers, HSL018 the static unwind-safety
+  proof over `faults.KNOWN_POINTS`. The
   unified driver — lint + whole-program rules + validator corpus +
   findings baseline — is `python -m hyperspace_tpu.analysis.check`
   (docs/static_analysis.md).
@@ -45,6 +50,7 @@ __all__ = [
     "Effects",
     "LockGraph",
     "Program",
+    "Raises",
 ]
 
 
@@ -66,4 +72,8 @@ def __getattr__(name):
         from hyperspace_tpu.analysis.effects import Effects
 
         return Effects
+    if name == "Raises":
+        from hyperspace_tpu.analysis.raises import Raises
+
+        return Raises
     raise AttributeError(name)

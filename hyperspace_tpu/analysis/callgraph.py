@@ -3,13 +3,20 @@
 Resolution is deliberately *under*-approximate: an edge exists only when
 the callee can be named with confidence. The strategies, in order:
 
-1. ``self.m()`` → the enclosing class (walking program-local bases).
+1. ``self.m()`` → the enclosing class (walking program-local bases),
+   and ``super().m()`` → the nearest base defining ``m``.
 2. ``self.attr.m()`` / ``obj.m()`` where the attribute/variable has a
-   known type binding (``self.attr = SomeClass(...)``, a module-level
-   ``X = SomeClass(...)``, or a factory whose return annotation names a
-   program class) → that class's method.
+   known type binding (``self.attr = SomeClass(...)``, ``self.attr =
+   param`` with an annotated parameter, a module-level ``X =
+   SomeClass(...)``, a local ``x = SomeClass(...)`` / ``x = self.a.b``
+   first binding, a factory or property whose return annotation names a
+   program class) → that class's method. ``Ctor(...).m(...)`` — the
+   immediate-invoke shape (``CreateAction(...).run()``) — types the
+   receiver through the constructor the same way.
 3. A bare or dotted name that resolves through the module's imports to a
-   program function, class (→ ``__init__``), or module attribute.
+   program function, class (→ ``__init__``), or module attribute —
+   following one package re-export hop (``from pkg import X`` where
+   ``pkg/__init__.py`` itself imports ``X``).
 4. **Unique-method fallback**: ``anything.m()`` where exactly one class
    in the whole program defines ``m`` → that method. This is what
    connects ``session.run_query(...)`` in the scheduler to
@@ -60,7 +67,21 @@ class CallGraph:
     def resolve_call(self, fn: FunctionInfo, raw: str) -> str | None:
         """The program-function qname `raw` refers to inside `fn`."""
         prog = self.program
+        # Ctor(...).m(...): type the receiver through the constructor.
+        if "()." in raw:
+            ctor_raw, _, rest = raw.partition("().")
+            cls_q = prog.class_of_ctor(fn.module, ctor_raw)
+            if cls_q is not None and rest:
+                return self._method_chain(cls_q, rest.split("."))
+            return None
         parts = raw.split(".")
+        # super().m() — resolved through the enclosing class's bases.
+        if parts[0] == "super" and len(parts) == 2 and fn.cls is not None:
+            for q in prog._mro(f"{fn.module}.{fn.cls}")[1:]:
+                c = prog.classes.get(q)
+                if c is not None and parts[1] in c.methods:
+                    return c.methods[parts[1]].qname
+            return None
         # self.m() / self.attr.m()
         if parts[0] == "self" and fn.cls is not None:
             cls_q = f"{fn.module}.{fn.cls}"
@@ -101,6 +122,20 @@ class CallGraph:
                     return self._class_method(node, p)
                 else:
                     break
+        # local variable typed by its first binding: `x = Ctor(...)` /
+        # `x = self.a.b` (the receiver-local shape the facade and the
+        # executor use)
+        if parts[0] in fn.local_types and len(parts) >= 2:
+            src = fn.local_types[parts[0]]
+            cls_q = None
+            if src.endswith("()"):
+                cls_q = prog.class_of_ctor(fn.module, src[:-2])
+            elif src.startswith("self.") and fn.cls is not None:
+                cls_q = f"{fn.module}.{fn.cls}"
+                for attr in src.split(".")[1:]:
+                    cls_q = self._attr_type(cls_q, attr) if cls_q else None
+            if cls_q is not None:
+                return self._method_chain(cls_q, parts[1:])
         # variable with a known module-level type in this module
         mod = prog.modules.get(fn.module)
         if mod is not None and parts[0] in mod.var_types and len(parts) >= 2:
@@ -130,8 +165,23 @@ class CallGraph:
     def _attr_type(self, cls_q: str, attr: str) -> str | None:
         for q in self.program._mro(cls_q):
             c = self.program.classes.get(q)
-            if c is not None and attr in c.attr_types:
+            if c is None:
+                continue
+            if attr in c.attr_types:
                 return self.program.class_of_ctor(c.module, c.attr_types[attr])
+            # A property/accessor whose return annotation names a program
+            # class types the attribute access too (`def manager(self) ->
+            # CachingIndexCollectionManager` — the lazy-init shape).
+            m = c.methods.get(attr)
+            if m is not None and m.returns_type:
+                mod = self.program.modules.get(c.module)
+                if mod is not None:
+                    if m.returns_type in mod.classes:
+                        return mod.classes[m.returns_type].qname
+                    if m.returns_type in mod.imports:
+                        t = mod.imports[m.returns_type]
+                        if t in self.program.classes:
+                            return t
         return None
 
     def _method_chain(self, cls_q: str, rest: list[str]) -> str | None:

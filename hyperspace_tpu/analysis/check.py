@@ -28,6 +28,18 @@ engine (analysis/program.py → callgraph.py → locks.py):
   and re-acquired locks (memo-fill and re-check idioms exempt).
 - **HSL015 jit-cache hygiene** — jit call sites manufacturing a fresh
   cache key per call (recompile storm / executable leak).
+- **HSL016 error-contract drift** — every public entry point's
+  statically observed escape set (analysis/raises.py) must be covered
+  by its declared ``exceptions.ERROR_CONTRACTS`` entry, modulo the
+  exception hierarchy; dead entries/types are findings and the
+  generated docs/errors.md table is verified (``--write-error-docs``).
+- **HSL017 swallowed crash/fault** — except clauses absorbing
+  CrashPoint/FaultError/everything without re-raise or signal, and the
+  retry-classification bypass.
+- **HSL018 unwind safety** — every ``faults.KNOWN_POINTS`` entry must
+  have a static propagation path to a recovery construct (witness
+  chains land in the report's ``unwind_proof``), and ``+= 1``/``-= 1``
+  pairs on shared state must be finally-balanced on raising paths.
 - **Validator corpus** — a small set of known-good / known-bad logical
   plans is pushed through the plan validator (analysis/validator.py) as
   a self-test; skipped (with a note) when numpy isn't installed, so the
@@ -76,13 +88,24 @@ from hyperspace_tpu.analysis.races import (
     jit_hygiene_findings,
     lockset_race_findings,
 )
+from hyperspace_tpu.analysis.raises import (
+    DYNAMIC,
+    Raises,
+    declared_contracts,
+    error_contract_findings,
+    swallowed_findings,
+    unwind_findings,
+)
 
 CONFIG_DRIFT = "HSL010"
 FAULT_COVERAGE = "HSL012"
+CONTRACT_DRIFT = "HSL016"
 
 BASELINE_NAME = "ANALYSIS_BASELINE.json"
 DOCS_BEGIN = "<!-- KNOWN_KEYS:begin (generated from config.KNOWN_KEYS — edit config.py, then run python -m hyperspace_tpu.analysis.check --write-config-docs) -->"
 DOCS_END = "<!-- KNOWN_KEYS:end -->"
+ERRORS_BEGIN = "<!-- ERROR_CONTRACTS:begin (generated from exceptions.ERROR_CONTRACTS + the HSL016 escape analysis — edit exceptions.py, then run python -m hyperspace_tpu.analysis.check --write-error-docs) -->"
+ERRORS_END = "<!-- ERROR_CONTRACTS:end -->"
 
 # (path suffix, rule) -> justification. The narrow test-only allowlist:
 # entries must name code that is single-threaded by construction or
@@ -94,6 +117,11 @@ TEST_ALLOWLIST: dict[tuple[str, str], str] = {
     # occur, and locking the datagen would suggest it is serve-safe when
     # it is not meant to be.
     ("benchmarks/tpcds.py", "HSL008"): "single-threaded benchmark datagen memo",
+    # The load-harness client threads collect every error (BaseException
+    # included — a CrashPoint must fail the bench) into a list the main
+    # thread re-raises after join(); nothing is swallowed, the re-raise
+    # just lives outside the handler.
+    ("benchmarks/bench_serve.py", "HSL017"): "client threads store errors; main re-raises after join",
 }
 
 
@@ -292,6 +320,125 @@ def write_config_docs(root: pathlib.Path) -> bool:
     _, tail = rest.split(DOCS_END, 1)
     doc.write_text(f"{head}{DOCS_BEGIN}\n{config_mod.docs_table()}\n{DOCS_END}{tail}")
     return True
+
+
+# -- HSL016: docs/errors.md error-contract table ------------------------------
+
+def errors_table(program, raises_obj: Raises, contracts: dict) -> str:
+    """The generated contract table: one row per entry point, declared
+    surface next to the statically observed escape set (``(dynamic)``
+    marks re-raises of stored/registered exception objects the static
+    analysis cannot type)."""
+    lines = [
+        "| entry point | declared contract | statically observed escapes |",
+        "|---|---|---|",
+    ]
+    for qname in sorted(contracts):
+        types, _, _ = contracts[qname]
+        esc = raises_obj.escapes.get(qname, {})
+        observed = sorted(t for t in esc if t != DYNAMIC)
+        if DYNAMIC in esc:
+            observed.append("(dynamic)")
+        lines.append(
+            f"| `{qname}` | {', '.join(f'`{t}`' for t in types) or '—'} "
+            f"| {', '.join(f'`{t}`' for t in observed) or '—'} |"
+        )
+    return "\n".join(lines)
+
+
+_ERRORS_DOC_SKELETON = """# Error contracts
+
+The typed error surface of every public entry point, declared in
+`exceptions.ERROR_CONTRACTS` and statically verified on every push by
+rule HSL016 (see docs/static_analysis.md): any exception type that can
+escape an entry point without being covered by its declared contract —
+modulo the exception hierarchy — fails the build, and so does a declared
+program-local type that covers nothing. The table below is generated;
+edit `exceptions.py`, then run
+`python -m hyperspace_tpu.analysis.check --write-error-docs`.
+
+{begin}
+{table}
+{end}
+
+An entry covers its subclasses: `HyperspaceError` covers
+`IndexCorruptionError`, `PlanValidationError`, `AdmissionRejected`,
+`QueryTimeout`; `OSError` covers real disk failures and the injected
+`FaultError`. `CrashPoint` (a `BaseException`) is the simulated hard
+process death — it appears in the contracts because it must escape
+these APIs untouched (docs/fault_tolerance.md). `(dynamic)` marks a
+re-raise of a stored exception object the static analysis cannot type.
+"""
+
+
+def errors_docs_findings(root: pathlib.Path, program, raises_obj: Raises,
+                         contracts: dict) -> list[Finding]:
+    """docs/errors.md must exist and its generated table must match the
+    registry + analysis exactly (the HSL010 config-docs pattern)."""
+    if not any(q.startswith("hyperspace_tpu.") for q in contracts):
+        return []  # scanning a corpus subset, not the package
+    doc = root / "docs" / "errors.md"
+    stale = Finding(str(doc), 0, 0, CONTRACT_DRIFT,
+                    "docs/errors.md error-contract table is missing or stale "
+                    "relative to exceptions.ERROR_CONTRACTS — run python -m "
+                    "hyperspace_tpu.analysis.check --write-error-docs")
+    if not doc.exists():
+        return [stale]
+    text = doc.read_text()
+    if ERRORS_BEGIN not in text or ERRORS_END not in text:
+        return [stale]
+    current = text.split(ERRORS_BEGIN, 1)[1].split(ERRORS_END, 1)[0].strip()
+    if current != errors_table(program, raises_obj, contracts).strip():
+        return [stale]
+    return []
+
+
+def write_error_docs(root: pathlib.Path, program, raises_obj: Raises,
+                     contracts: dict) -> bool:
+    doc = root / "docs" / "errors.md"
+    table = errors_table(program, raises_obj, contracts)
+    if not doc.exists() or ERRORS_BEGIN not in doc.read_text():
+        doc.write_text(_ERRORS_DOC_SKELETON.format(
+            begin=ERRORS_BEGIN, table=table, end=ERRORS_END,
+        ))
+        return True
+    text = doc.read_text()
+    head, rest = text.split(ERRORS_BEGIN, 1)
+    _, tail = rest.split(ERRORS_END, 1)
+    doc.write_text(f"{head}{ERRORS_BEGIN}\n{table}\n{ERRORS_END}{tail}")
+    return True
+
+
+# -- dead-symbol report (informational) ---------------------------------------
+
+def dead_symbol_report(program, callgraph, raises_obj: Raises, contracts: dict) -> dict:
+    """Functions unreachable from any public entry point through the
+    dispatch-augmented call graph. Informational ONLY — the resolver is
+    deliberately under-approximate (dynamic dispatch through untyped
+    locals, higher-order uses), so a listing here is a lead for a human,
+    never a finding."""
+    roots = {
+        q for q, fn in program.functions.items() if not fn.name.startswith("_")
+    }
+    roots |= {q for q in contracts if q in program.functions}
+    adj: dict[str, set[str]] = {}
+    for e in callgraph.edges:
+        slot = adj.setdefault(e.caller, set())
+        for t in raises_obj.dispatch_targets(e.callee):
+            slot.add(t)
+    reach = set(roots)
+    stack = list(roots)
+    while stack:
+        q = stack.pop()
+        for nxt in adj.get(q, ()):
+            if nxt not in reach:
+                reach.add(nxt)
+                stack.append(nxt)
+    dead = sorted(
+        q for q, fn in program.functions.items()
+        if q not in reach and not fn.name.startswith("__")
+    )
+    return {"count": len(dead), "functions": dead}
 
 
 # -- HSL012: fault-point coverage ---------------------------------------------
@@ -497,6 +644,8 @@ def run_check(
     callgraph = CallGraph(program)
     lockgraph = LockGraph(program, callgraph)
     effects = Effects(program, callgraph)
+    raises_obj = Raises(program, callgraph)
+    contracts = declared_contracts(program)
     findings.extend(lockgraph.inversions())
     findings.extend(resource_findings(program))
     findings.extend(config_key_findings(program, usage_dirs))
@@ -505,6 +654,11 @@ def run_check(
     findings.extend(lockset_race_findings(program, effects))
     findings.extend(atomicity_findings(program, effects))
     findings.extend(jit_hygiene_findings(program))
+    findings.extend(error_contract_findings(program, raises_obj, contracts))
+    findings.extend(errors_docs_findings(root, program, raises_obj, contracts))
+    findings.extend(swallowed_findings(program, raises_obj))
+    unwind, unwind_proof = unwind_findings(program, callgraph, raises_obj, contracts)
+    findings.extend(unwind)
     allowed = []
     kept = []
     for f in findings:
@@ -524,8 +678,10 @@ def run_check(
                 f"{fail['expected']}, got {fail['got']}",
             ))
     total_calls = len(callgraph.edges) + len(callgraph.unresolved)
+    dead = dead_symbol_report(program, callgraph, raises_obj, contracts)
     return {
         "_findings": kept,
+        "_engine": (program, callgraph, raises_obj, contracts),
         "summary": {
             "files": len(sources),
             "findings": len(kept),
@@ -543,9 +699,20 @@ def run_check(
             "lock_edges": len(lockgraph.order_edges()),
             "shared_states": len(effects.by_state),
             "entry_guaranteed_fns": len(effects.entry_locks),
+            "contract_entry_points": len(contracts),
+            "fault_points_proven": sum(
+                1 for e in unwind_proof.values() if e["covered"]
+            ),
+            "dead_symbols": dead["count"],
         },
         "validator_corpus": corpus,
         "lock_graph": lockgraph.to_json(),
+        # The HSL018 witness chains: per fault point, the recovery
+        # construct that statically reaches each threading site.
+        "unwind_proof": unwind_proof,
+        # Informational (never gated): private functions no public entry
+        # point reaches through the resolved call graph.
+        "dead_symbols": dead,
         "allowlisted": [
             {"rule": f.rule, "path": f.path, "line": f.line} for f in allowed
         ],
@@ -556,7 +723,7 @@ def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m hyperspace_tpu.analysis.check",
         description="Unified static analysis: per-file lint (HSL001-HSL008), "
-                    "whole-program rules (HSL009-HSL012), validator corpus, "
+                    "whole-program rules (HSL009-HSL018), validator corpus, "
                     "findings baseline.",
     )
     ap.add_argument("paths", nargs="*", help="files/directories (default: the "
@@ -574,6 +741,10 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--write-config-docs", action="store_true",
                     help="regenerate the docs/configuration.md key table from "
                          "config.KNOWN_KEYS and exit")
+    ap.add_argument("--write-error-docs", action="store_true",
+                    help="regenerate the docs/errors.md contract table from "
+                         "exceptions.ERROR_CONTRACTS + the escape analysis "
+                         "and exit")
     ap.add_argument("--no-baseline", action="store_true",
                     help="fail on ALL findings, ignoring any baseline")
     args = ap.parse_args(argv)
@@ -588,6 +759,11 @@ def main(argv: list[str] | None = None) -> int:
         usage_dirs = [root / "tests"] if (root / "tests").exists() else []
         report = run_check(paths, root, usage_dirs)
         findings: list[Finding] = report.pop("_findings")
+        program, _cg, raises_obj, contracts = report.pop("_engine")
+        if args.write_error_docs:
+            write_error_docs(root, program, raises_obj, contracts)
+            print("docs/errors.md error-contract table regenerated")
+            return EXIT_CLEAN
         if args.changed:
             got = changed_files(root)
             if got is None:

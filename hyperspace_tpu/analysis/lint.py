@@ -71,8 +71,10 @@ This module is the *per-file* half of the analysis engine. The
 whole-program rules — HSL009 lock-order inversion, HSL010 config-key
 drift, HSL011 resource/exception safety, HSL012 fault-point coverage,
 HSL013 lockset data races, HSL014 torn check-then-act, HSL015
-jit-cache hygiene — need the cross-module index (analysis/program.py,
-callgraph.py, locks.py, effects.py, races.py) and run from the unified
+jit-cache hygiene, HSL016 error-contract drift, HSL017 swallowed
+crash/fault, HSL018 unwind safety — need the cross-module index
+(analysis/program.py, callgraph.py, locks.py, effects.py, races.py,
+raises.py) and run from the unified
 driver ``python -m hyperspace_tpu.analysis.check``, which parses each
 file ONCE and feeds the same tree to this linter and to the program
 index. All rules,
@@ -155,6 +157,15 @@ RULES: dict[str, RuleInfo] = {
                  scope="program"),
         RuleInfo("HSL015", "jit-cache-hygiene",
                  "jit call site manufacturing a fresh cache key per call (recompile storm / executable leak)",
+                 scope="program"),
+        RuleInfo("HSL016", "error-contract-drift",
+                 "statically observed escape not covered by exceptions.ERROR_CONTRACTS (or dead contract entry)",
+                 scope="program"),
+        RuleInfo("HSL017", "swallowed-crash",
+                 "except clause absorbing CrashPoint/FaultError/everything without re-raise or signal",
+                 scope="program"),
+        RuleInfo("HSL018", "unwind-safety",
+                 "fault point with no static path to a recovery construct; +=/-= pair unbalanced on unwind",
                  scope="program"),
     )
 }
@@ -634,9 +645,11 @@ class _Linter(ast.NodeVisitor):
         )
         if held:
             self._lock_depth += 1
-        self.generic_visit(node)
-        if held:
-            self._lock_depth -= 1
+        try:
+            self.generic_visit(node)
+        finally:
+            if held:
+                self._lock_depth -= 1
 
     def visit_Assign(self, node: ast.Assign) -> None:
         for tgt in node.targets:

@@ -21,10 +21,13 @@ RLock does exactly that.
 ``with``/``try-finally`` leak on the first exception between acquire and
 release:
 
-- ``lock.acquire()`` with no ``release()`` in a ``finally`` of an
+- ``lock.acquire()`` — including the ``acquire(timeout=...)`` /
+  ``acquire(blocking=...)`` signature form, recognized whatever the
+  receiver is named — with no ``release()`` in a ``finally`` of an
   enclosing ``try`` (use ``with lock:``);
-- ``f = open(...)`` with no ``with`` and no ``close()`` in a
-  ``finally``;
+- ``f = open(...)`` / ``os.fdopen(...)`` /
+  ``tempfile.NamedTemporaryFile(...)`` with no ``with`` and no
+  ``close()`` in a ``finally``;
 - a tracer span / fault-injection context (``span(...)``, ``trace(...)``,
   ``faults.injected(...)``) created but never entered with ``with`` —
   the span would never close and the fault rule never reset.
@@ -356,28 +359,41 @@ def _scan_function(fn: FunctionInfo, mod) -> list[Finding]:
             callee = sub.func.attr
         elif isinstance(sub.func, ast.Name):
             callee = sub.func.id
-        # bare lock.acquire() with no release() in a finally
+        # bare lock.acquire() with no release() in a finally. Name-based
+        # recognition ("lock"/"cv" in the receiver) plus the signature
+        # form: `.acquire(timeout=...)` / `.acquire(blocking=...)` is the
+        # threading.Lock API whatever the variable is called — and the
+        # timeout form is WORSE un-finallied, because the success branch
+        # must conditionally release.
         if callee == "acquire" and isinstance(sub.func, ast.Attribute):
             base = sub.func.value
             base_txt = base.id if isinstance(base, ast.Name) else getattr(base, "attr", "")
-            if "lock" in base_txt.lower() or "cv" in base_txt.lower():
+            timed = any(kw.arg in ("timeout", "blocking") for kw in sub.keywords)
+            if "lock" in base_txt.lower() or "cv" in base_txt.lower() or timed:
                 if "release" not in finally_sources:
+                    how = ".acquire(timeout=...)" if timed else ".acquire()"
                     _report(
                         sub.lineno,
-                        f"{base_txt}.acquire() with no release() in a finally — "
+                        f"{base_txt}{how} with no release() in a finally — "
                         f"an exception between acquire and release leaves the "
-                        f"lock held forever; use `with {base_txt}:`",
+                        f"lock held forever; use `with {base_txt}:` (or "
+                        f"try/finally with a conditional release for the "
+                        f"timeout form)",
                     )
-        # f = open(...) with no with / finally close
-        elif callee == "open" and isinstance(sub.func, ast.Name):
+        # f = open(...) / os.fdopen(...) / tempfile.NamedTemporaryFile(...)
+        # with no with / finally close — every descriptor producer leaks
+        # the same way.
+        elif (callee == "open" and isinstance(sub.func, ast.Name)) or callee in (
+            "fdopen", "NamedTemporaryFile", "TemporaryFile",
+        ):
             if id(sub) in with_ctx_calls:
                 continue
             if _is_bound_without_close(node, sub) and "close" not in finally_sources:
                 _report(
                     sub.lineno,
-                    "open() bound to a name outside a with/try-finally — the "
-                    "descriptor leaks on any exception before close(); use "
-                    "`with open(...) as f:`",
+                    f"{callee}() bound to a name outside a with/try-finally — "
+                    f"the descriptor leaks on any exception before close(); "
+                    f"use `with {callee}(...) as f:`",
                 )
         # span/trace/injected created but never entered
         elif callee in _CM_FACTORIES:

@@ -11,10 +11,13 @@ substrate every cross-module rule runs on:
   (alias → dotted target), module-level string constants, module-level
   lock definitions, and variable → class type bindings.
 - :class:`FunctionInfo` — one function/method summary extracted in a
-  SINGLE visitor pass: calls made (with the stack of locks held at each
-  call site), locks acquired via ``with`` (with the locks already held),
-  config get/set keys, fault-point references, and the raw AST node for
-  rules that need a closer look (resource safety, HSL011).
+  SINGLE visitor pass: calls made (with the stack of locks held AND the
+  try/except guards enclosing each call site), locks acquired via
+  ``with`` (with the locks already held), raise sites with their guard
+  stacks (the raw material of the exception-flow layer,
+  analysis/raises.py), config get/set keys, fault-point references, and
+  the raw AST node for rules that need a closer look (resource safety,
+  HSL011).
 - :class:`Program` — the package-wide index: symbol tables, lock
   definitions (module-level and ``self.X = threading.Lock()`` class
   attributes), attribute/variable type bindings, and the name-resolution
@@ -75,13 +78,43 @@ class LockRef:
 
 
 @dataclasses.dataclass(frozen=True)
+class Guard:
+    """The handlers of ONE enclosing ``try`` statement, as seen from a
+    site inside its body: for each ``except`` clause, the raw caught
+    type texts (``()`` = bare ``except:``) and whether the handler
+    re-raises what it caught (a bare ``raise`` / ``raise <bound name>``
+    anywhere in its body). The raise-propagation layer
+    (analysis/raises.py) subtracts escaping exception types against
+    these, narrowed by the exception hierarchy."""
+
+    handlers: tuple[tuple[tuple[str, ...], bool], ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class RaiseSite:
+    """One ``raise`` statement: the raw dotted text of the raised
+    expression (``None`` for a bare re-raise), the stack of enclosing
+    try guards (outermost first), and — when the site re-raises the
+    exception an enclosing ``except`` clause bound — that clause's
+    caught type texts."""
+
+    raw: str | None
+    line: int
+    guards: tuple[Guard, ...]
+    handler_types: tuple[str, ...] | None = None
+
+
+@dataclasses.dataclass(frozen=True)
 class CallSite:
     """One call expression: the raw dotted callee text plus the stack of
-    lock references held (lexically, via enclosing ``with``) at the call."""
+    lock references held (lexically, via enclosing ``with``) at the
+    call, and the stack of try/except guards enclosing it (the raise
+    analysis subtracts callee escapes against those)."""
 
     raw: str
     line: int
     held: tuple[LockRef, ...]
+    guards: tuple[Guard, ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -145,7 +178,13 @@ class FunctionInfo:
     config_accesses: list[ConfigAccess] = dataclasses.field(default_factory=list)
     fault_refs: list[tuple[str, int, str]] = dataclasses.field(default_factory=list)
     attr_accesses: list[AttrAccess] = dataclasses.field(default_factory=list)
+    raises: list[RaiseSite] = dataclasses.field(default_factory=list)
     returns_type: str | None = None  # raw annotation text, when a simple name
+    # Local name -> the raw expression that first bound it, when that is
+    # a constructor call ("Executor") or a self-rooted attribute chain
+    # ("self.session.manager") — the call graph types receiver locals
+    # through these (`executor = Executor(...); executor.execute(...)`).
+    local_types: dict[str, str] = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -155,6 +194,7 @@ class ClassInfo:
     name: str
     line: int
     bases: list[str]
+    is_protocol: bool = False  # typing.Protocol seam (structural dispatch)
     methods: dict[str, FunctionInfo] = dataclasses.field(default_factory=dict)
     attr_locks: dict[str, str] = dataclasses.field(default_factory=dict)  # attr -> kind
     attr_types: dict[str, str] = dataclasses.field(default_factory=dict)  # attr -> raw ctor ref
@@ -196,6 +236,15 @@ class _FunctionPass(ast.NodeVisitor):
         self._held: list[LockRef] = []
         self._in_init = info.cls is not None and info.name in self._INIT_NAMES
         self._global_decls: set[str] = set()
+        # Exception-flow context (analysis/raises.py): the stack of
+        # enclosing try guards, the stack of enclosing except-handler
+        # (types, bound name) pairs, and whether we are inside a nested
+        # def/lambda (whose raises execute later, in some other frame —
+        # they never unwind THIS function's callers, so they are not
+        # recorded as this function's raise sites).
+        self._guards: list[Guard] = []
+        self._handler_ctx: list[tuple[tuple[str, ...], str | None]] = []
+        self._nested_fn_depth = 0
         # Attribute/Name nodes already accounted for by an enclosing
         # write form (mutator call, subscript store) — their Load visit
         # must not double-record a read.
@@ -245,12 +294,22 @@ class _FunctionPass(ast.NodeVisitor):
         # with no lock held, so walk them with an empty held stack.
         # Exception: wait_for predicates (marked in _inherit_held) are
         # evaluated by Condition.wait_for WITH the lock held.
+        # The try/except context resets the same way: an enclosing
+        # handler does not guard the closure's later execution, and the
+        # closure's own raises unwind some other frame (not recorded).
         saved = self._held
+        saved_guards, saved_ctx = self._guards, self._handler_ctx
         if id(node) not in self._inherit_held:
             self._held = []
-        for stmt in getattr(node, "body", []) if not isinstance(node, ast.Lambda) else [node.body]:
-            self.visit(stmt)
-        self._held = saved
+        self._guards, self._handler_ctx = [], []
+        self._nested_fn_depth += 1
+        try:
+            for stmt in getattr(node, "body", []) if not isinstance(node, ast.Lambda) else [node.body]:
+                self.visit(stmt)
+        finally:
+            self._nested_fn_depth -= 1
+            self._held = saved
+            self._guards, self._handler_ctx = saved_guards, saved_ctx
 
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
         self._visit_nested_fn(node)
@@ -261,10 +320,121 @@ class _FunctionPass(ast.NodeVisitor):
     def visit_Lambda(self, node: ast.Lambda) -> None:
         self._visit_nested_fn(node)
 
+    # -- exception flow ----------------------------------------------------
+    @staticmethod
+    def _handler_types(handler: ast.ExceptHandler) -> tuple[str, ...]:
+        """Raw dotted texts of the types one except clause catches;
+        ``()`` = bare ``except:`` (catches everything)."""
+        t = handler.type
+        if t is None:
+            return ()
+        elts = t.elts if isinstance(t, ast.Tuple) else [t]
+        return tuple(filter(None, (_dotted(e) for e in elts)))
+
+    @staticmethod
+    def _handler_reraises(handler: ast.ExceptHandler) -> bool:
+        """True when the handler re-raises what it caught: a bare
+        ``raise`` or ``raise <bound name>`` anywhere in its body (a
+        conditional re-raise still means the caught types MAY escape)."""
+        for sub in ast.walk(handler):
+            if not isinstance(sub, ast.Raise):
+                continue
+            if sub.exc is None:
+                return True
+            if (
+                handler.name is not None
+                and isinstance(sub.exc, ast.Name)
+                and sub.exc.id == handler.name
+            ):
+                return True
+        return False
+
+    def visit_Try(self, node: ast.Try) -> None:
+        guard = Guard(tuple(
+            (self._handler_types(h), self._handler_reraises(h))
+            for h in node.handlers
+        ))
+        if node.handlers:
+            self._guards.append(guard)
+        for stmt in node.body:
+            self.visit(stmt)
+        if node.handlers:
+            self._guards.pop()
+        # Handler bodies are guarded only by OUTER tries; `else` and
+        # `finally` bodies are never covered by this try's handlers.
+        for h in node.handlers:
+            self._handler_ctx.append((self._handler_types(h), h.name))
+            for stmt in h.body:
+                self.visit(stmt)
+            self._handler_ctx.pop()
+        for stmt in (*node.orelse, *node.finalbody):
+            self.visit(stmt)
+
+    visit_TryStar = visit_Try
+
+    def visit_Raise(self, node: ast.Raise) -> None:
+        if self._nested_fn_depth == 0:
+            guards = tuple(self._guards)
+            if node.exc is None:
+                # Bare re-raise: legal only inside a handler; record the
+                # caught types so the raise analysis knows what escapes.
+                if self._handler_ctx:
+                    types, _ = self._handler_ctx[-1]
+                    self.info.raises.append(
+                        RaiseSite(None, node.lineno, guards, handler_types=types)
+                    )
+            else:
+                exc = node.exc
+                raw = _dotted(exc.func) if isinstance(exc, ast.Call) else _dotted(exc)
+                handler_types = None
+                if isinstance(exc, ast.Name):
+                    # `raise e` of a bound handler name is a re-raise of
+                    # the caught types, not a raise of a type named `e`.
+                    for types, bound in reversed(self._handler_ctx):
+                        if bound == exc.id:
+                            handler_types = types
+                            break
+                self.info.raises.append(RaiseSite(
+                    raw or None, node.lineno, guards, handler_types=handler_types,
+                ))
+        self.generic_visit(node)
+
     def visit_Call(self, node: ast.Call) -> None:
         raw = _dotted(node.func)
+        if not raw and isinstance(node.func, ast.Attribute):
+            base = node.func.value
+            # `super().m(...)`: the base is a Call, so _dotted sees
+            # nothing — record it as `super.m` and let the call graph
+            # resolve it through the base classes.
+            if (
+                isinstance(base, ast.Call)
+                and isinstance(base.func, ast.Name)
+                and base.func.id == "super"
+                and not base.args
+            ):
+                raw = f"super.{node.func.attr}"
+            # `Ctor(...).m(...)` — the immediate-invoke shape every
+            # manager method uses (`CreateAction(...).run()`): record as
+            # `Ctor().m` so the call graph can type the receiver.
+            elif isinstance(base, ast.Call):
+                ctor = _dotted(base.func)
+                if ctor:
+                    raw = f"{ctor}().{node.func.attr}"
         if raw:
-            self.info.calls.append(CallSite(raw, node.lineno, tuple(self._held)))
+            self.info.calls.append(
+                CallSite(raw, node.lineno, tuple(self._held), tuple(self._guards))
+            )
+            # retry_call(fn, ...) invokes its first argument synchronously
+            # — record the function REFERENCE as a call at this site, so
+            # retried IO primitives stay visible to the exception-flow
+            # and lock analyses (utils/retry.py is the one sanctioned
+            # higher-order invoker on the metadata plane).
+            if raw.split(".")[-1] == "retry_call" and node.args:
+                inner = _dotted(node.args[0])
+                if inner:
+                    self.info.calls.append(CallSite(
+                        inner, node.lineno, tuple(self._held), tuple(self._guards)
+                    ))
         self._check_config_access(node, raw)
         self._check_fault_ref(node, raw)
         # In-place mutator call on shared state: self.X.append(...) /
@@ -323,6 +493,18 @@ class _FunctionPass(ast.NodeVisitor):
     def visit_Assign(self, node: ast.Assign) -> None:
         for tgt in node.targets:
             self._record_store(tgt, node.lineno)
+        # Local receiver types: `x = Ctor(...)` / `x = self.a.b` (first
+        # binding wins; a rebound local stays conservative).
+        if len(node.targets) == 1 and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            if isinstance(node.value, ast.Call):
+                ctor = _dotted(node.value.func)
+                if ctor and ctor != "super":
+                    self.info.local_types.setdefault(name, ctor + "()")
+            elif isinstance(node.value, ast.Attribute):
+                path = _dotted(node.value)
+                if path.startswith("self."):
+                    self.info.local_types.setdefault(name, path)
         self.generic_visit(node)
 
     def visit_AugAssign(self, node: ast.AugAssign) -> None:
@@ -527,16 +709,30 @@ def _index_function(mod: ModuleInfo, cls: str | None, node) -> FunctionInfo:
 
 
 def _index_class(mod: ModuleInfo, node: ast.ClassDef) -> ClassInfo:
+    bases = [_dotted(b) for b in node.bases if _dotted(b)]
     cls = ClassInfo(
         qname=f"{mod.name}.{node.name}", module=mod.name, name=node.name,
-        line=node.lineno, bases=[_dotted(b) for b in node.bases if _dotted(b)],
+        line=node.lineno, bases=bases,
+        is_protocol=any(b.split(".")[-1] == "Protocol" for b in bases),
     )
     for item in node.body:
         if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
             cls.methods[item.name] = _index_function(mod, node.name, item)
             # Attribute locks / attribute types: `self.X = threading.Lock()`
             # and `self.X = SomeClass(...)` anywhere in the class's methods
-            # (constructors usually, but lazy init counts too).
+            # (constructors usually, but lazy init counts too). A plain
+            # `self.X = param` where the parameter carries a simple type
+            # annotation types the attribute too (`def __init__(self,
+            # session: HyperspaceSession)` — the facade-wiring shape).
+            param_anns: dict[str, str] = {}
+            for a in (*item.args.posonlyargs, *item.args.args, *item.args.kwonlyargs):
+                ann = a.annotation
+                if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+                    param_anns[a.arg] = ann.value.strip("'\"")
+                else:
+                    txt = _dotted(ann) if ann is not None else ""
+                    if txt:
+                        param_anns[a.arg] = txt
             for sub in ast.walk(item):
                 if not (isinstance(sub, ast.Assign) and len(sub.targets) == 1):
                     continue
@@ -553,6 +749,8 @@ def _index_class(mod: ModuleInfo, node: ast.ClassDef) -> ClassInfo:
                     cls.attr_locks[tgt.attr] = kind
                 elif isinstance(sub.value, ast.Call):
                     cls.attr_types.setdefault(tgt.attr, _dotted(sub.value.func))
+                elif isinstance(sub.value, ast.Name) and sub.value.id in param_anns:
+                    cls.attr_types.setdefault(tgt.attr, param_anns[sub.value.id])
     return cls
 
 
@@ -713,6 +911,15 @@ class Program:
             target = mod.imports[name]
             if target in self.functions or target in self.classes or target in self.modules:
                 return target
+            # Package re-export: `from hyperspace_tpu.actions import
+            # CreateAction` maps to hyperspace_tpu.actions.CreateAction,
+            # which the package __init__ itself imports from the real
+            # defining module — follow one aliasing hop.
+            pkg, _, leaf = target.rpartition(".")
+            if pkg in self.modules and leaf in self.modules[pkg].imports:
+                t2 = self.modules[pkg].imports[leaf]
+                if t2 in self.functions or t2 in self.classes or t2 in self.modules:
+                    return t2
             # `from hyperspace_tpu.obs import trace as obs_trace` maps the
             # alias to hyperspace_tpu.obs.trace: also try the module map by
             # suffix (modules index under their file-derived dotted name).

@@ -106,6 +106,42 @@ TRANSIENT_ERRNOS = frozenset(
 )
 
 
+# The declared typed-error surface of every public entry point: the
+# exception types (by class name, hierarchy-aware — an entry covers its
+# subclasses) that MAY escape each API. The static rule HSL016
+# (analysis/raises.py) verifies both directions on every push: any
+# statically observed escape not covered here is contract drift, and a
+# declared program-local type covering no observed escape is dead.
+# docs/errors.md renders this table (python -m
+# hyperspace_tpu.analysis.check --write-error-docs regenerates it).
+#
+# Reading guide: HyperspaceError covers the typed framework surface
+# (plan validation, admission, timeouts, corruption); OSError covers
+# real disk failures AND injected FaultError; CrashPoint is the
+# simulated hard death that must NEVER be absorbed below these APIs;
+# ValueError/KeyError/NotImplementedError are the programming-error
+# surface (bad plans, undeclared counters, abstract hooks).
+_QUERY_SURFACE = (
+    "HyperspaceError", "OSError", "CrashPoint",
+    "ValueError", "KeyError", "NotImplementedError",
+)
+ERROR_CONTRACTS: dict[str, tuple[str, ...]] = {
+    "hyperspace_tpu.hyperspace.HyperspaceSession.run": _QUERY_SURFACE,
+    "hyperspace_tpu.hyperspace.HyperspaceSession.run_query": _QUERY_SURFACE,
+    "hyperspace_tpu.serve.scheduler.QueryServer.submit": ("AdmissionRejected",),
+    "hyperspace_tpu.serve.scheduler.QueryHandle.result": (
+        "QueryTimeout", "HyperspaceError", "OSError", "CrashPoint",
+    ),
+    "hyperspace_tpu.hyperspace.Hyperspace.create_index": _QUERY_SURFACE,
+    "hyperspace_tpu.hyperspace.Hyperspace.refresh_index": _QUERY_SURFACE,
+    "hyperspace_tpu.hyperspace.Hyperspace.optimize_index": _QUERY_SURFACE,
+    "hyperspace_tpu.hyperspace.Hyperspace.vacuum_index": _QUERY_SURFACE,
+    "hyperspace_tpu.hyperspace.Hyperspace.recover": _QUERY_SURFACE,
+    "hyperspace_tpu.hyperspace.Hyperspace.explain": ("HyperspaceError",),
+    "hyperspace_tpu.actions.base.Action.run": _QUERY_SURFACE,
+}
+
+
 def is_retryable(exc: BaseException) -> bool:
     """Retryable-exception classification for utils/retry.py: transient
     OS-level IO failures retry; everything else (corruption, missing

@@ -47,8 +47,14 @@ def _enable_persistent_compile_cache() -> None:
         os.makedirs(d, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", d)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
-    except Exception:
-        pass
+    except Exception as e:
+        # Best-effort speedup, never fatal — but leave a trace so a
+        # mysteriously slow first compile is explainable.
+        import logging
+
+        logging.getLogger("hyperspace_tpu").debug(
+            "persistent compile cache unavailable: %s", e
+        )
 
 
 @dataclasses.dataclass

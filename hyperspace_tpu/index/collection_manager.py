@@ -228,7 +228,12 @@ class IndexCollectionManager:
                 self.recover(dir_path.name)
                 entry = lm.get_latest_log()
             except Exception:
-                pass
+                # Lazy repair is best-effort by design (the listing must
+                # not fail because one index is broken) — but count it:
+                # a silent failure here would hide a dead index forever.
+                from hyperspace_tpu import stats
+
+                stats.increment("recover.on_access_failed")
         return entry
 
     def get_indexes(self, states_filter=(states.ACTIVE,)) -> list[IndexLogEntry]:

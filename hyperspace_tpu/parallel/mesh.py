@@ -54,7 +54,7 @@ def enable_compile_cache() -> None:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:  # noqa: BLE001 — cache is an optimization, never fatal
+    except Exception:  # noqa: BLE001,HSL017 — cache is an optimization, never fatal; nothing to repair or surface
         pass
     _cache_enabled = True
 

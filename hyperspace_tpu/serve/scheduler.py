@@ -317,8 +317,12 @@ class QueryServer:
                 with obs_trace.trace("serve.run", priority=ticket.priority):
                     handle._result = self._execute(ticket.plan, handle)
                 _COMPLETED.inc()
-            except BaseException as e:  # CrashPoint (BaseException) included:
-                # a fault-injected query must not take the worker down.
+            except BaseException as e:  # noqa: HSL017 — worker isolation:
+                # a fault-injected CrashPoint must not take the worker
+                # thread down; the exception object (traceback included)
+                # is stored on the handle and re-raised, original frames
+                # intact, by QueryHandle.result() — preserved, not
+                # swallowed.
                 handle.error = e
                 _FAILED.inc()
             finally:

@@ -28,6 +28,13 @@ Counter names in use:
 - ``recover.orphans_removed``      unreferenced version dirs GC'd by recover()
 - ``metadata.cache.hits``    TTL index-entry cache hits (metadata/cache.py)
 - ``metadata.cache.misses``  TTL index-entry cache misses (empty or expired)
+- ``action.rollback_failed``  in-process rollback attempts that themselves
+  failed (recover() finishes the repair from the next process)
+- ``action.cleanup_failed``   partial-data quarantines that failed (the
+  orphan GC in recover() sweeps what they left)
+- ``recover.on_access_failed``  lazy recover-on-access attempts that
+  failed during listing (the entry stays unlisted; explicit recover()
+  still applies)
 """
 
 from __future__ import annotations
@@ -50,6 +57,9 @@ KNOWN_COUNTERS = (
     "recover.orphans_removed",
     "metadata.cache.hits",
     "metadata.cache.misses",
+    "action.rollback_failed",
+    "action.cleanup_failed",
+    "recover.on_access_failed",
 )
 
 _counters = {name: _metrics.counter(name) for name in KNOWN_COUNTERS}

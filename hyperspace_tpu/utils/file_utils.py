@@ -193,7 +193,9 @@ def _locked_rename(tmp: str, path: Path) -> bool:
                 f.write(token)
                 f.flush()
                 os.fsync(f.fileno())
-        except OSError:
+        except OSError:  # noqa: HSL017 — not a retry: an unwritten token
+            # simply fails the lease check below and the claim returns
+            # False in-band
             pass
         try:
             if path.exists():
@@ -210,7 +212,8 @@ def _locked_rename(tmp: str, path: Path) -> bool:
             if _read_lock_text(lock) == token:
                 try:
                     os.unlink(lock)
-                except OSError:
+                except OSError:  # noqa: HSL017 — lease-file cleanup only;
+                    # a leftover lock is reaped by the next claimant
                     pass
     return False
 

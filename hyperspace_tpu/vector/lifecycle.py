@@ -25,6 +25,7 @@ from pathlib import Path
 
 import numpy as np
 
+from hyperspace_tpu import stats as _stats
 from hyperspace_tpu.actions import states
 from hyperspace_tpu.actions.base import Action
 from hyperspace_tpu.config import HyperspaceConf
@@ -199,7 +200,9 @@ class VectorOptimizeAction(Action):
         try:
             self.data_manager.quarantine(self._version_id)
         except Exception:
-            pass
+            # Must-not-raise path, but never silent: recover()'s orphan
+            # GC owns whatever this leaves behind.
+            _stats.increment("action.cleanup_failed")
 
     def build_log_entry(self) -> IndexLogEntry:
         entry = dataclasses.replace(self.previous_entry)

@@ -1,0 +1,22 @@
+"""Fixture mini-package for the exception-flow analysis tests.
+
+NOT imported at runtime — the engine only parses it. Contains, on
+purpose, exactly three planted findings (the HSL016–HSL018 seeded
+regressions):
+
+- ``api.drifting_persist`` lets a ``KeyError`` escape that its declared
+  ``ERROR_CONTRACTS`` entry (``PipelineError`` only) does not cover —
+  the HSL016 error-contract drift, reported with the raise-site witness
+  chain.
+- ``worker.drain`` swallows EVERYTHING with a bare ``except:`` and no
+  re-raise — the HSL017 swallowed-crash shape.
+- ``orphan.scrub`` threads the declared fault point ``demo.orphan``
+  but is reachable from no recovery construct (no contract entry, no
+  ``recover()``, no rollback handler) — the HSL018 unwind-safety hole.
+
+Everything else is the clean counterpart of each pattern: a contract
+entry whose escape set matches exactly, handlers that re-raise or
+record before absorbing, and a fault point (``demo.persist``) proven
+covered through the declared contract entry. The golden raise-summary
+JSON lives in ../goldens/raisedemo_raises.json.
+"""

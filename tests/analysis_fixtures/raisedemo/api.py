@@ -1,0 +1,39 @@
+"""Fixture module: declared error contracts, one kept and one drifting."""
+
+from raisedemo.faults import fault_point
+
+ERROR_CONTRACTS = {
+    "raisedemo.api.persist": ("PipelineError",),
+    "raisedemo.api.drifting_persist": ("PipelineError",),
+}
+
+
+class PipelineError(Exception):
+    """The fixture's typed surface."""
+
+
+class EmptyStoreError(PipelineError):
+    """Subclass: covered by a PipelineError contract entry."""
+
+
+def persist(store):
+    """Clean: everything that escapes is within the declared contract
+    (EmptyStoreError is a PipelineError), and the fault point it
+    threads is covered by this very contract entry (HSL018)."""
+    fault_point("demo.persist")
+    if not store:
+        raise EmptyStoreError("nothing to persist")
+    try:
+        store.flush()
+    except (ValueError, KeyError) as e:
+        # raise-from transformation: the caught types are subtracted,
+        # PipelineError is what escapes.
+        raise PipelineError("flush failed") from e
+
+
+def drifting_persist(store):
+    # DELIBERATE HSL016: KeyError escapes but the declared contract
+    # only covers PipelineError.
+    if store is None:
+        raise KeyError("no store bound")
+    raise PipelineError("unreachable demo tail")

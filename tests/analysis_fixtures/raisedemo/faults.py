@@ -1,0 +1,11 @@
+"""Fixture fault-point registry (the shape analysis/raises.py extracts:
+a top-level KNOWN_POINTS tuple plus a fault_point() entry point)."""
+
+KNOWN_POINTS = (
+    "demo.persist",
+    "demo.orphan",
+)
+
+
+def fault_point(name, path=None):
+    """Inert stand-in for hyperspace_tpu.faults.fault_point."""

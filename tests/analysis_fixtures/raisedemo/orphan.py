@@ -1,0 +1,11 @@
+"""Fixture module: a fault point threaded outside every recovery path."""
+
+from raisedemo.faults import fault_point
+
+
+def scrub(path):
+    # DELIBERATE HSL018: `demo.orphan` is declared in KNOWN_POINTS and
+    # threaded here, but no contract entry point, recover(), or rollback
+    # handler reaches scrub() — an injected crash unwinds into nothing.
+    fault_point("demo.orphan", path)
+    path.unlink()

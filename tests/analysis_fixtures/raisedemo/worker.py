@@ -1,0 +1,28 @@
+"""Fixture module: one swallow-everything handler next to the clean
+store-or-reraise counterparts."""
+
+
+def drain(queue):
+    # DELIBERATE HSL017: a bare except with no re-raise absorbs
+    # CrashPoint and KeyboardInterrupt along with everything else.
+    try:
+        queue.flush()
+    except:
+        return None
+
+
+def careful_drain(queue, log):
+    # Clean: broad catch, but the exception is re-raised after the log.
+    try:
+        queue.flush()
+    except BaseException as e:
+        log(e)
+        raise
+
+
+def recorded_drain(queue, log):
+    # Clean: Exception-level catch that records instead of passing.
+    try:
+        queue.flush()
+    except Exception as e:
+        log(e)

@@ -19,6 +19,24 @@ def acquire_with_finally_is_fine():
         _lock.release()
 
 
+def acquire_timeout_bad(sem):
+    # Signature-form recognition: the receiver is not named "lock", but
+    # .acquire(timeout=) is the threading API and the success branch
+    # must conditionally release.
+    if sem.acquire(timeout=2.0):  # expect: HSL011
+        do_work()
+        sem.release()
+
+
+def acquire_timeout_with_finally_is_fine(sem):
+    got = sem.acquire(timeout=2.0)
+    try:
+        do_work()
+    finally:
+        if got:
+            sem.release()
+
+
 def open_bad(path):
     f = open(path)  # expect: HSL011
     return f.read()
@@ -27,6 +45,29 @@ def open_bad(path):
 def open_with_is_fine(path):
     with open(path) as f:
         return f.read()
+
+
+def fdopen_bad(os, fd):
+    f = os.fdopen(fd, "wb")  # expect: HSL011
+    f.write(b"x")
+
+
+def fdopen_with_is_fine(os, fd):
+    with os.fdopen(fd, "wb") as f:
+        f.write(b"x")
+
+
+def tempfile_bad(tempfile):
+    t = tempfile.NamedTemporaryFile()  # expect: HSL011
+    t.write(b"x")
+
+
+def tempfile_closed_is_fine(tempfile):
+    t = tempfile.NamedTemporaryFile()
+    try:
+        t.write(b"x")
+    finally:
+        t.close()
 
 
 def span_bad(obs_trace):

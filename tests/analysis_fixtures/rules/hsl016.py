@@ -1,0 +1,55 @@
+"""HSL016 error-contract drift corpus."""
+
+ERROR_CONTRACTS = {
+    "hsl016.declared_ok": ("AppError",),
+    "hsl016.drifting": ("AppError",),
+    "hsl016.transforms": ("AppError",),
+    "hsl016.ghost_entry": ("AppError",),  # expect: HSL016
+    "hsl016.dead_type": ("AppError", "UnusedError"),  # expect: HSL016
+}
+
+
+class AppError(Exception):
+    pass
+
+
+class DetailError(AppError):
+    pass
+
+
+class UnusedError(AppError):
+    pass
+
+
+def declared_ok():
+    # Subclass escape covered modulo hierarchy: DetailError ⊆ AppError.
+    raise DetailError("fine")
+
+
+def drifting(flag):  # expect: HSL016
+    if flag:
+        raise AppError("the declared half")
+    raise ValueError("not in the contract")
+
+
+def transforms(op):
+    # raise-from transformation: ValueError/KeyError are subtracted by
+    # the handler, AppError is what escapes — within the contract.
+    try:
+        op()
+    except (ValueError, KeyError) as e:
+        raise AppError("wrapped") from e
+
+
+def dead_type():
+    # UnusedError is declared above but covers no observed escape.
+    raise AppError("only the base ever escapes")
+
+
+def shielded(op):
+    # Handler subtraction: nothing escapes, no contract needed.
+    try:
+        op()
+    except Exception as e:
+        return e
+    return None

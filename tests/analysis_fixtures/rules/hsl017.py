@@ -1,0 +1,90 @@
+"""HSL017 swallowed crash/fault corpus."""
+
+
+class CrashPoint(BaseException):
+    pass
+
+
+class FaultError(OSError):
+    pass
+
+
+def bare_swallow(op):
+    try:
+        op()
+    except:  # expect: HSL017
+        return None
+
+
+def crash_handled(op):
+    try:
+        op()
+    except BaseException:  # expect: HSL017
+        return None
+
+
+def crash_reraised_is_fine(op, log):
+    try:
+        op()
+    except BaseException as e:
+        log(e)
+        raise
+
+
+def crash_noqa_is_suppressed(op):
+    try:
+        op()
+    except BaseException:  # noqa: HSL017 — isolation harness by design
+        return None
+
+
+def fault_swallowed(op):
+    try:
+        op()
+    except FaultError:  # expect: HSL017
+        return -1
+
+
+def except_pass(op):
+    try:
+        op()
+    except Exception:  # expect: HSL017
+        pass
+
+
+def except_recorded_is_fine(op, log):
+    try:
+        op()
+    except Exception as e:
+        log(e)
+
+
+def retry_bypass(op):
+    for _attempt in range(3):
+        try:
+            return op()
+        except OSError:  # expect: HSL017
+            continue
+    return None
+
+
+def retry_classified_is_fine(op, is_retryable):
+    for _attempt in range(3):
+        try:
+            return op()
+        except OSError as e:
+            if not is_retryable(e):
+                raise
+            continue
+    return None
+
+
+def skip_loop_is_fine(paths):
+    # A for-each over work items skips a bad one — not a retry.
+    out = []
+    for p in paths:
+        try:
+            out.append(p.read_text())
+        except OSError:
+            continue
+    return out

@@ -1,0 +1,56 @@
+"""HSL018 unwind-safety corpus.
+
+Uses REAL registry point names (bucket.write / footer.read) so the
+HSL012 name check stays quiet when this file is scanned alone — the
+KNOWN_POINTS tuple below is what the HSL018 proof extracts.
+"""
+
+KNOWN_POINTS = (
+    "bucket.write",
+    "footer.read",
+)
+
+ERROR_CONTRACTS = {
+    "hsl018.public_entry": ("RuntimeError",),
+}
+
+
+def fault_point(name, path=None):
+    pass
+
+
+def public_entry():
+    _persist()
+
+
+def _persist():
+    # Covered: public_entry is a declared contract entry and reaches us.
+    fault_point("bucket.write")
+    raise RuntimeError("boom")
+
+
+def _orphan_helper():
+    fault_point("footer.read")  # expect: HSL018
+    return 0
+
+
+def balanced_gauge(self_like, op):
+    pass
+
+
+class Gaugey:
+    def __init__(self):
+        self._inflight = 0
+        self._lock = None
+
+    def risky_unbalanced(self, op):
+        self._inflight += 1  # expect: HSL018
+        op()
+        self._inflight -= 1
+
+    def risky_balanced(self, op):
+        self._inflight += 1
+        try:
+            op()
+        finally:
+            self._inflight -= 1

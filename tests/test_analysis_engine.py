@@ -41,6 +41,17 @@ from hyperspace_tpu.analysis.races import (
     jit_hygiene_findings,
     lockset_race_findings,
 )
+from hyperspace_tpu.analysis.raises import (
+    DYNAMIC,
+    DYNAMIC_RAISES,
+    Raises,
+    declared_contracts,
+    error_contract_findings,
+    known_fault_points,
+    recovery_roots,
+    swallowed_findings,
+    unwind_findings,
+)
 
 TESTS_DIR = pathlib.Path(__file__).resolve().parent
 FIXTURES = TESTS_DIR / "analysis_fixtures"
@@ -179,6 +190,11 @@ def _corpus_findings(path: pathlib.Path) -> set[tuple[int, str]]:
     findings += lockset_race_findings(program, effects)
     findings += atomicity_findings(program, effects)
     findings += jit_hygiene_findings(program)
+    raises_obj = Raises(program, callgraph)
+    contracts = declared_contracts(program)
+    findings += error_contract_findings(program, raises_obj, contracts)
+    findings += swallowed_findings(program, raises_obj)
+    findings += unwind_findings(program, callgraph, raises_obj, contracts)[0]
     return {(f.line, f.rule) for f in findings}
 
 
@@ -275,6 +291,74 @@ class TestRacedemo:
         effects = Effects(program, CallGraph(program))
         assert effects.entry_locks["entrymod._helper"] == {"entrymod._lock"}
         assert lockset_race_findings(program, effects) == []
+
+
+# -- raisedemo fixture package (raises + exception-flow rules) ----------------
+
+@pytest.fixture(scope="module")
+def raisedemo():
+    program = Program.load([FIXTURES / "raisedemo"])
+    callgraph = CallGraph(program)
+    return program, callgraph, Raises(program, callgraph)
+
+
+class TestRaisedemo:
+    def test_raise_summaries_match_golden(self, raisedemo):
+        _, _, raises_obj = raisedemo
+        golden = json.loads((FIXTURES / "goldens" / "raisedemo_raises.json").read_text())
+        assert json.loads(json.dumps(raises_obj.to_json())) == golden
+
+    def test_exactly_three_planted_findings(self, raisedemo):
+        program, callgraph, raises_obj = raisedemo
+        contracts = declared_contracts(program)
+        findings = (
+            error_contract_findings(program, raises_obj, contracts)
+            + swallowed_findings(program, raises_obj)
+            + unwind_findings(program, callgraph, raises_obj, contracts)[0]
+        )
+        assert sorted(f.rule for f in findings) == ["HSL016", "HSL017", "HSL018"]
+
+    def test_hsl016_witness_names_escape_and_contract(self, raisedemo):
+        program, _, raises_obj = raisedemo
+        (f,) = error_contract_findings(program, raises_obj)
+        assert f.rule == "HSL016"
+        assert "drifting_persist" in f.message
+        assert "KeyError escapes" in f.message
+        assert "PipelineError" in f.message  # the declared-but-narrower surface
+
+    def test_hierarchy_narrowed_subtraction(self, raisedemo):
+        # persist: EmptyStoreError (⊆ PipelineError) and the raise-from
+        # transformation both stay inside the declared contract.
+        _, _, raises_obj = raisedemo
+        esc = raises_obj.escapes["raisedemo.api.persist"]
+        assert sorted(esc) == ["EmptyStoreError", "PipelineError"]
+        assert raises_obj.covers("PipelineError", "EmptyStoreError")
+        assert not raises_obj.covers("EmptyStoreError", "PipelineError")
+
+    def test_hsl017_flags_only_the_bare_swallow(self, raisedemo):
+        program, _, raises_obj = raisedemo
+        (f,) = swallowed_findings(program, raises_obj)
+        assert f.rule == "HSL017"
+        assert f.path.endswith("worker.py")
+        assert "bare `except:`" in f.message
+
+    def test_hsl018_proof_and_hole(self, raisedemo):
+        program, callgraph, raises_obj = raisedemo
+        contracts = declared_contracts(program)
+        findings, proof = unwind_findings(program, callgraph, raises_obj, contracts)
+        assert proof["demo.persist"]["covered"] is True
+        (site,) = proof["demo.persist"]["sites"]
+        assert site["chain"] == ["raisedemo.api.persist"]
+        assert "declared error contract" in site["via"]
+        assert proof["demo.orphan"]["covered"] is False
+        (f,) = findings
+        assert "demo.orphan" in f.message and "scrub" in f.message
+
+    def test_fixture_points_extracted_from_ast(self, raisedemo):
+        program, _, _ = raisedemo
+        points, path = known_fault_points(program)
+        assert points == {"demo.persist", "demo.orphan"}
+        assert path.endswith("raisedemo/faults.py")
 
 
 # -- repo-wide guarantees (what the CI gate asserts) --------------------------
@@ -431,7 +515,155 @@ class TestRepoWideGuarantees:
             assert why
 
 
+# -- exception-flow guarantees (HSL016-HSL018 on the real repo) ---------------
+
+@pytest.fixture(scope="module")
+def repo_raises(repo_program):
+    program, callgraph = repo_program
+    return Raises(program, callgraph)
+
+
+class TestRepoExceptionFlow:
+    def test_every_contract_holds(self, repo_program, repo_raises):
+        """The acceptance proof: each public API's statically observed
+        escape set ⊆ its declared ERROR_CONTRACTS entry."""
+        program, _ = repo_program
+        assert error_contract_findings(program, repo_raises) == []
+
+    def test_contracts_cover_the_serving_surface(self, repo_program):
+        program, _ = repo_program
+        contracts = declared_contracts(program)
+        for q in (
+            "hyperspace_tpu.hyperspace.HyperspaceSession.run",
+            "hyperspace_tpu.hyperspace.HyperspaceSession.run_query",
+            "hyperspace_tpu.serve.scheduler.QueryServer.submit",
+            "hyperspace_tpu.serve.scheduler.QueryHandle.result",
+            "hyperspace_tpu.hyperspace.Hyperspace.recover",
+            "hyperspace_tpu.actions.base.Action.run",
+        ):
+            assert q in contracts, q
+            assert q in program.functions, q  # no dead entries
+
+    def test_crash_point_escapes_the_query_path(self, repo_raises):
+        """CrashPoint must REACH the public APIs: a simulated dying
+        writer that got absorbed below session.run would mean some
+        handler 'survived' a process death."""
+        for q in (
+            "hyperspace_tpu.hyperspace.HyperspaceSession.run",
+            "hyperspace_tpu.actions.base.Action.run",
+        ):
+            esc = repo_raises.escapes[q]
+            assert "CrashPoint" in esc, q
+            # and the witness chain bottoms out in the fault harness
+            assert esc["CrashPoint"].chain[-1] == "hyperspace_tpu.faults._hit"
+
+    def test_hierarchy_grafts_local_types_onto_builtins(self, repo_raises):
+        assert repo_raises.ancestors["FaultError"][:2] == ("FaultError", "OSError")
+        assert "Exception" in repo_raises.ancestors["FaultError"]
+        assert repo_raises.ancestors["CrashPoint"] == ("CrashPoint", "BaseException")
+        assert "HyperspaceError" in repo_raises.ancestors["IndexCorruptionError"]
+
+    def test_repo_has_no_swallowed_crashes(self, repo_program, repo_raises):
+        program, _ = repo_program
+        flagged = [
+            f for f in swallowed_findings(program, repo_raises)
+            if not f.path.endswith("benchmarks/bench_serve.py")  # allowlisted
+        ]
+        assert flagged == []
+
+    def test_unwind_proof_covers_every_known_point(self, repo_program, repo_raises):
+        """HSL018 acceptance: every fault point in faults.KNOWN_POINTS
+        has a static propagation path to a recovery construct."""
+        from hyperspace_tpu import faults as faults_mod
+
+        program, callgraph = repo_program
+        findings, proof = unwind_findings(program, callgraph, repo_raises)
+        assert findings == []
+        assert set(proof) == set(faults_mod.KNOWN_POINTS)
+        for point, entry in proof.items():
+            assert entry["covered"], point
+            assert entry["sites"], point  # HSL012 guarantees this too
+            for site in entry["sites"]:
+                assert site["chain"][-1] == site["fn"]
+
+    def test_recovery_roots_include_the_rollback_handler(self, repo_program):
+        program, _ = repo_program
+        roots = recovery_roots(program)
+        assert "hyperspace_tpu.actions.base.Action.run" in roots
+        assert any(v == "recover()" for v in roots.values())
+        assert any(v == "declared error contract" for v in roots.values())
+        # the rollback-handler detection stands on its own (no contracts)
+        bare = recovery_roots(program, contracts={})
+        assert bare.get("hyperspace_tpu.actions.base.Action.run") == "rollback handler"
+
+    def test_dynamic_raises_table_is_narrow_and_fresh(self, repo_program):
+        program, _ = repo_program
+        for q, (types, why) in DYNAMIC_RAISES.items():
+            assert q in program.functions, f"stale DYNAMIC_RAISES entry: {q}"
+            assert types and why
+
+    def test_result_contract_mirrors_worker_surface(self, repo_raises):
+        # QueryHandle.result's declared surface comes from the
+        # DYNAMIC_RAISES augmentation (raise self.error) + QueryTimeout.
+        esc = repo_raises.escapes["hyperspace_tpu.serve.scheduler.QueryHandle.result"]
+        assert {"QueryTimeout", "HyperspaceError", "OSError", "CrashPoint"} <= set(esc)
+
+    def test_dead_symbol_report_shape(self):
+        report = run_check(default_paths(REPO_ROOT), REPO_ROOT, [TESTS_DIR])
+        dead = report["dead_symbols"]
+        assert dead["count"] == len(dead["functions"])
+        assert report["summary"]["dead_symbols"] == dead["count"]
+        # informational, under-approximate — but it must not claim the
+        # whole program dead, and public entry points are never listed
+        assert dead["count"] < report["summary"]["functions"] // 4
+        assert not any(q.rsplit(".", 1)[-1] == "run_query" for q in dead["functions"])
+
+    def test_check_wall_time_is_bounded(self):
+        """The engine's own cost is regression-gated: a full
+        analysis.check pass (parse + lint + program + callgraph +
+        effects + races + raises + rules) stays under a minute."""
+        import time
+
+        t0 = time.perf_counter()
+        report = run_check(default_paths(REPO_ROOT), REPO_ROOT, [TESTS_DIR])
+        elapsed = time.perf_counter() - t0
+        assert report["summary"]["files"] > 100
+        assert elapsed < 60.0, f"analysis.check took {elapsed:.1f}s"
+
+
 # -- check CLI ----------------------------------------------------------------
+
+def _validate_sarif_required(sarif: dict) -> None:
+    """Assert the SARIF 2.1.0 REQUIRED-property set: sarifLog needs
+    `version` + `runs`; each run needs `tool.driver.name`; each
+    reportingDescriptor needs `id`; each result needs `message` (with
+    text) and — per the artifactLocation/region constraints the spec
+    puts on physicalLocation — a uri and a 1-based startLine. Every
+    result.ruleId must resolve against the driver's rules."""
+    assert sarif["version"] == "2.1.0"
+    assert isinstance(sarif["runs"], list) and sarif["runs"]
+    for run in sarif["runs"]:
+        driver = run["tool"]["driver"]
+        assert isinstance(driver["name"], str) and driver["name"]
+        rule_ids = set()
+        for rule in driver.get("rules", []):
+            assert isinstance(rule["id"], str) and rule["id"]
+            assert rule["shortDescription"]["text"]
+            rule_ids.add(rule["id"])
+        assert len(rule_ids) == len(driver.get("rules", []))  # ids unique
+        assert isinstance(run["results"], list)
+        for res in run["results"]:
+            assert res["ruleId"] in rule_ids
+            assert isinstance(res["message"]["text"], str) and res["message"]["text"]
+            assert res.get("level") in ("none", "note", "warning", "error")
+            assert res.get("baselineState", "new") in (
+                "new", "unchanged", "updated", "absent",
+            )
+            for loc in res["locations"]:
+                phys = loc["physicalLocation"]
+                assert isinstance(phys["artifactLocation"]["uri"], str)
+                assert phys["region"]["startLine"] >= 1
+
 
 class TestCheckCli:
     def test_exit_clean_on_repo(self):
@@ -515,6 +747,30 @@ class TestCheckCli:
         assert result["ruleId"] == "HSL001"
         assert result["baselineState"] == "new"
         assert result["locations"][0]["physicalLocation"]["region"]["startLine"] == 1
+
+    def test_sarif_required_properties_across_all_rules(self, tmp_path):
+        """Validate the SARIF 2.1.0 required-property set (runs/results/
+        rules shape) over the full rule corpus — old and new rules alike
+        — instead of spot-checking one finding."""
+        out = tmp_path / "corpus.sarif"
+        rc = check_main([str(FIXTURES / "rules"), "--no-baseline",
+                         "--format", "sarif", "--output", str(out)])
+        assert rc == EXIT_FINDINGS
+        sarif = json.loads(out.read_text())
+        _validate_sarif_required(sarif)
+        fired = {r["ruleId"] for r in sarif["runs"][0]["results"]}
+        # old rules and the exception-flow rules both appear
+        assert {"HSL001", "HSL011", "HSL013", "HSL016", "HSL017", "HSL018"} <= fired
+
+    def test_sarif_required_properties_on_clean_run(self, tmp_path):
+        clean = tmp_path / "clean.py"
+        clean.write_text("x = 1\n")
+        out = tmp_path / "clean.sarif"
+        assert check_main([str(clean), "--no-baseline", "--format", "sarif",
+                           "--output", str(out)]) == EXIT_CLEAN
+        sarif = json.loads(out.read_text())
+        _validate_sarif_required(sarif)
+        assert sarif["runs"][0]["results"] == []
 
     def test_sarif_internal_error_exit(self, monkeypatch):
         import hyperspace_tpu.analysis.check as check_mod

@@ -261,6 +261,33 @@ class TestAdmission:
         finally:
             server.shutdown()
 
+    def test_result_preserves_original_traceback(self, tmp_system_path):
+        """The HSL017 audit contract for the worker error path: the
+        exception result() re-raises carries the ORIGINAL raising frames
+        (the worker's except BaseException stores the object, traceback
+        intact — preserved, not swallowed)."""
+        import traceback
+
+        session = _session(tmp_system_path)
+
+        def deep_failure():
+            raise ValueError("boom:traceback")
+
+        def run_fn(plan):
+            deep_failure()
+
+        server = QueryServer(session, workers=1, max_queue_depth=4,
+                             plan_cache=False, run_fn=run_fn)
+        try:
+            h = server.submit("x")
+            with pytest.raises(ValueError) as excinfo:
+                h.result(timeout=30)
+            frames = [f.name for f in traceback.extract_tb(excinfo.value.__traceback__)]
+            assert "deep_failure" in frames  # origin frame survives
+            assert "run_fn" in frames        # ...with its caller chain
+        finally:
+            server.shutdown()
+
 
 # -- plan cache ---------------------------------------------------------------
 
