@@ -98,7 +98,8 @@ class CallGraph:
                     return self._method_chain(attr_type, parts[2:])
             return self._unique_method(parts[-1])
         # bare name: local/imported function or class constructor
-        target = prog.resolve_symbol(fn.module, parts[0])
+        # (function-level imports consulted first — deferred-import idiom)
+        target = prog.resolve_symbol(fn.module, parts[0], fn=fn)
         if target is not None:
             if len(parts) == 1:
                 return self._callable_of(target)

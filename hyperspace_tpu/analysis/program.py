@@ -185,6 +185,10 @@ class FunctionInfo:
     # ("self.session.manager") — the call graph types receiver locals
     # through these (`executor = Executor(...); executor.execute(...)`).
     local_types: dict[str, str] = dataclasses.field(default_factory=dict)
+    # Function-LEVEL imports (alias -> dotted target): the deferred-import
+    # idiom the heavy modules use; resolve_symbol consults these before
+    # the module-level map so `_prefetch.prefetch_plan(...)` resolves.
+    imports: dict[str, str] = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -310,6 +314,19 @@ class _FunctionPass(ast.NodeVisitor):
             self._nested_fn_depth -= 1
             self._held = saved
             self._guards, self._handler_ctx = saved_guards, saved_ctx
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            self.info.imports[alias.asname or alias.name.split(".")[0]] = alias.name
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module:
+            if node.level == 0:
+                base = node.module
+            else:
+                base = ".".join(self.module.name.split(".")[: -node.level] + [node.module])
+            for alias in node.names:
+                self.info.imports[alias.asname or alias.name] = f"{base}.{alias.name}"
 
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
         self._visit_nested_fn(node)
@@ -897,36 +914,49 @@ class Program:
         return out
 
     # -- type/symbol resolution (used by the call graph) -------------------
-    def resolve_symbol(self, module: str, name: str) -> str | None:
+    def resolve_symbol(self, module: str, name: str, fn: "FunctionInfo | None" = None) -> str | None:
         """A dotted program qname for a bare name used in `module`:
-        a local function/class, or an imported one."""
+        a local function/class, or an imported one. With `fn`, the
+        function's OWN imports are consulted first — idiomatic deferred
+        imports (`from hyperspace_tpu.execution import prefetch as
+        _prefetch` inside a method) shadow module-level bindings for
+        that function exactly like at runtime."""
         mod = self.modules.get(module)
         if mod is None:
             return None
+        if fn is not None and name in fn.imports:
+            got = self._import_target(fn.imports[name])
+            if got is not None:
+                return got
         if name in mod.functions:
             return mod.functions[name].qname
         if name in mod.classes:
             return mod.classes[name].qname
         if name in mod.imports:
-            target = mod.imports[name]
-            if target in self.functions or target in self.classes or target in self.modules:
-                return target
-            # Package re-export: `from hyperspace_tpu.actions import
-            # CreateAction` maps to hyperspace_tpu.actions.CreateAction,
-            # which the package __init__ itself imports from the real
-            # defining module — follow one aliasing hop.
-            pkg, _, leaf = target.rpartition(".")
-            if pkg in self.modules and leaf in self.modules[pkg].imports:
-                t2 = self.modules[pkg].imports[leaf]
-                if t2 in self.functions or t2 in self.classes or t2 in self.modules:
-                    return t2
-            # `from hyperspace_tpu.obs import trace as obs_trace` maps the
-            # alias to hyperspace_tpu.obs.trace: also try the module map by
-            # suffix (modules index under their file-derived dotted name).
-            for mname in self.modules:
-                if mname == target or mname.endswith("." + target.split(".")[-1]) and target.endswith(mname.split(".")[-1]):
-                    if target == mname or target.endswith(mname) or mname.endswith(target):
-                        return mname
+            return self._import_target(mod.imports[name])
+        return None
+
+    def _import_target(self, target: str) -> str | None:
+        """Resolve one import's dotted target to a known program symbol
+        or module (shared by module- and function-level imports)."""
+        if target in self.functions or target in self.classes or target in self.modules:
+            return target
+        # Package re-export: `from hyperspace_tpu.actions import
+        # CreateAction` maps to hyperspace_tpu.actions.CreateAction,
+        # which the package __init__ itself imports from the real
+        # defining module — follow one aliasing hop.
+        pkg, _, leaf = target.rpartition(".")
+        if pkg in self.modules and leaf in self.modules[pkg].imports:
+            t2 = self.modules[pkg].imports[leaf]
+            if t2 in self.functions or t2 in self.classes or t2 in self.modules:
+                return t2
+        # `from hyperspace_tpu.obs import trace as obs_trace` maps the
+        # alias to hyperspace_tpu.obs.trace: also try the module map by
+        # suffix (modules index under their file-derived dotted name).
+        for mname in self.modules:
+            if mname == target or mname.endswith("." + target.split(".")[-1]) and target.endswith(mname.split(".")[-1]):
+                if target == mname or target.endswith(mname) or mname.endswith(target):
+                    return mname
         return None
 
     def class_of_ctor(self, module: str, ctor_raw: str) -> str | None:

@@ -39,6 +39,19 @@ JOIN_VENUE_MIN_MBPS = "hyperspace.join.venueMinMbps"
 # Build sort venue: same auto/device/host scheme for the bucketize+sort
 # permutation (its only output lands on host).
 BUILD_VENUE = "hyperspace.build.venue"
+# Streaming-build pipeline (docs/architecture.md "build pipeline"): when
+# enabled, p1 overlaps decode/hash with pooled spill encode and spilled
+# buckets flow through a 3-stage p2 pipeline (spill read ‖ key sort ‖
+# final write) behind a bounded bucket-completion queue, instead of the
+# serial two-phase build. maxInflightBytes bounds the decoded bucket
+# bytes resident across the p2 stages (0 = derive 4x chunkBytes).
+BUILD_PIPELINE_ENABLED = "hyperspace.build.pipeline.enabled"
+BUILD_PIPELINE_MAX_INFLIGHT_BYTES = "hyperspace.build.pipeline.maxInflightBytes"
+# Query-tail prefetch: while the optimizer still runs, footers (and the
+# first row-group chunk) of the index bucket files the pruner keeps are
+# fetched on a background pool, so scan-bound queries stop paying serial
+# cold reads. Purely advisory — prefetch failures never fail a query.
+SCAN_PREFETCH_ENABLED = "hyperspace.scan.prefetch.enabled"
 AGG_VENUE = "hyperspace.agg.venue"
 SORT_VENUE = "hyperspace.sort.venue"
 FILTER_VENUE = "hyperspace.filter.venue"
@@ -186,6 +199,24 @@ KNOWN_KEYS: dict[str, ConfKey] = {
         "Where the build's bucketize+sort permutation is computed: threaded C++ "
         "counting/key sort on host vs the device all_to_all exchange (a real "
         "multi-device mesh keeps device in `auto`)."),
+    BUILD_PIPELINE_ENABLED: ConfKey(
+        "true",
+        "Streaming-build pipeline: overlap p1 decode/hash with pooled spill "
+        "encode, and run p2 as a 3-stage spill-read ‖ key-sort ‖ final-write "
+        "pipeline behind a bounded bucket-completion queue. `false` restores "
+        "the serial two-phase build (the byte-for-byte reference path)."),
+    BUILD_PIPELINE_MAX_INFLIGHT_BYTES: ConfKey(
+        "0 (derived)",
+        "Byte budget of decoded spill buckets resident across the p2 pipeline "
+        "stages (the memory bound on small hosts); 0 derives 4x "
+        "`hyperspace.index.build.chunkBytes`. A single bucket above the budget "
+        "is still admitted alone."),
+    SCAN_PREFETCH_ENABLED: ConfKey(
+        "true",
+        "Async index bucket-file prefetch at plan-optimize time: footers (and "
+        "the first row-group chunk) of the files the pruner keeps are read on "
+        "a background pool so the executor's cold reads start warm. Advisory "
+        "— prefetch failures are counted, never surfaced."),
     AGG_VENUE: ConfKey(
         "`auto`",
         "Where the grouped segment-reduce runs: numpy bincount/reduceat on host "
@@ -338,6 +369,9 @@ class HyperspaceConf:
     join_venue: str = DEFAULT_JOIN_VENUE
     join_venue_min_mbps: float = DEFAULT_JOIN_VENUE_MIN_MBPS
     build_venue: str = DEFAULT_JOIN_VENUE
+    build_pipeline_enabled: bool = True
+    build_pipeline_max_inflight_bytes: int = 0  # 0 = derived from chunkBytes
+    scan_prefetch_enabled: bool = True
     agg_venue: str = DEFAULT_JOIN_VENUE
     sort_venue: str = DEFAULT_JOIN_VENUE
     filter_venue: str = DEFAULT_JOIN_VENUE
@@ -383,6 +417,12 @@ class HyperspaceConf:
             self.join_venue_min_mbps = float(value)
         elif key == BUILD_VENUE:
             self.build_venue = str(value)
+        elif key == BUILD_PIPELINE_ENABLED:
+            self.build_pipeline_enabled = _as_bool(value)
+        elif key == BUILD_PIPELINE_MAX_INFLIGHT_BYTES:
+            self.build_pipeline_max_inflight_bytes = int(value)
+        elif key == SCAN_PREFETCH_ENABLED:
+            self.scan_prefetch_enabled = _as_bool(value)
         elif key == AGG_VENUE:
             self.agg_venue = str(value)
         elif key == SORT_VENUE:
@@ -467,6 +507,12 @@ class HyperspaceConf:
             return self.join_venue_min_mbps
         if key == BUILD_VENUE:
             return self.build_venue
+        if key == BUILD_PIPELINE_ENABLED:
+            return self.build_pipeline_enabled
+        if key == BUILD_PIPELINE_MAX_INFLIGHT_BYTES:
+            return self.build_pipeline_max_inflight_bytes
+        if key == SCAN_PREFETCH_ENABLED:
+            return self.scan_prefetch_enabled
         if key == AGG_VENUE:
             return self.agg_venue
         if key == SORT_VENUE:

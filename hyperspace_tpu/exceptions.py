@@ -137,7 +137,10 @@ ERROR_CONTRACTS: dict[str, tuple[str, ...]] = {
     "hyperspace_tpu.hyperspace.Hyperspace.optimize_index": _QUERY_SURFACE,
     "hyperspace_tpu.hyperspace.Hyperspace.vacuum_index": _QUERY_SURFACE,
     "hyperspace_tpu.hyperspace.Hyperspace.recover": _QUERY_SURFACE,
-    "hyperspace_tpu.hyperspace.Hyperspace.explain": ("HyperspaceError",),
+    # explain runs the same planner (and, mode="analyze", the executor)
+    # as run(): it shares the full query surface, including lazy
+    # recover-on-access fault points reachable from index listing.
+    "hyperspace_tpu.hyperspace.Hyperspace.explain": _QUERY_SURFACE,
     "hyperspace_tpu.actions.base.Action.run": _QUERY_SURFACE,
 }
 

@@ -33,9 +33,26 @@ from hyperspace_tpu.dataset import format_suffix, list_data_files
 from hyperspace_tpu.exceptions import HyperspaceError
 from hyperspace_tpu.execution import io as hio
 from hyperspace_tpu.execution.table import ColumnTable
+from hyperspace_tpu.faults import fault_point
+from hyperspace_tpu.obs import metrics as obs_metrics
+from hyperspace_tpu.obs import trace as obs_trace
 from hyperspace_tpu.ops.hashing import bucket_ids, combine_hashes, hash_int_column, string_dict_hashes
 from hyperspace_tpu.parallel.mesh import enable_compile_cache, mesh_size
 from hyperspace_tpu.plan.nodes import LogicalPlan, Scan
+
+# Pipeline telemetry (docs/observability.md): occupancy is the mean busy
+# fraction of the three p2 stages over the pipeline wall (1.0 = every
+# stage saturated — a longer queue window cannot help; ≪1.0 = one stage
+# starves the others); queue depth is observed at each reader put.
+_MET_OCCUPANCY = obs_metrics.gauge(
+    "build.pipeline.occupancy",
+    "mean busy fraction of the p2 read/sort/write stages over the pipeline wall",
+)
+_MET_QDEPTH = obs_metrics.histogram(
+    "build.pipeline.queue_depth",
+    "bucket-completion queue depth at each reader put",
+    buckets=obs_metrics.COUNT_BUCKETS,
+)
 
 
 # The fixed hash contribution of a NULL key slot: nulls bucket
@@ -98,18 +115,26 @@ def _host_sort_perms(tables, indexed_columns: list[str]) -> list[np.ndarray]:
 
 def _prefetched(it):
     """One-ahead prefetch over an iterator: the next item decodes on a
-    worker thread while the caller processes the current one."""
+    worker thread while the caller processes the current one. Each step
+    runs under a `build.p1.decode` span re-planted from the caller
+    (pool workers start with an empty contextvar context)."""
     from concurrent.futures import ThreadPoolExecutor
 
     sentinel = object()
     it = iter(it)
+
+    def step():
+        with obs_trace.span("build.p1.decode"):
+            return next(it, sentinel)
+
+    step = obs_trace.wrap(step)
     with ThreadPoolExecutor(max_workers=1) as ex:
-        fut = ex.submit(next, it, sentinel)
+        fut = ex.submit(step)
         while True:
             cur = fut.result()
             if cur is sentinel:
                 return
-            fut = ex.submit(next, it, sentinel)
+            fut = ex.submit(step)
             yield cur
 
 
@@ -138,6 +163,8 @@ class DeviceIndexBuilder:
         chunk_bytes: int | None = None,
         venue: str = "auto",
         venue_min_mbps: float = 200.0,
+        pipeline_enabled: bool = True,
+        pipeline_max_inflight_bytes: int = 0,
     ):
         self._mesh = mesh
         self.capacity_factor = capacity_factor
@@ -147,6 +174,11 @@ class DeviceIndexBuilder:
         self.chunk_bytes = chunk_bytes or max(16 << 20, memory_budget_bytes // 8)
         self.venue = venue
         self.venue_min_mbps = venue_min_mbps
+        # Streaming-build pipeline (hyperspace.build.pipeline.*): False
+        # restores the serial two-phase build — the byte-for-byte
+        # reference the pipeline is verified against (bench.py --smoke).
+        self.pipeline_enabled = pipeline_enabled
+        self.pipeline_max_inflight_bytes = pipeline_max_inflight_bytes
         self.last_build_stats: dict = {}
         self._last_phases: dict = {}
         enable_compile_cache()
@@ -344,92 +376,108 @@ class DeviceIndexBuilder:
         payload_names = [f.name for f in sub_schema.fields if f.name not in key_names]
         ordered = key_names + payload_names
 
+        pipelined = self.pipeline_enabled
         writers: dict[int, pq.ParquetWriter] = {}
+        spill_bytes: dict[int, int] = {}
         total_rows = 0
         n_chunks = 0
+        pipe_info: dict | None = None
         try:
             # Phase 1: stream decoded chunks (format-aware iterator);
             # decode of chunk i+1 overlaps the hash/partition/spill of
-            # chunk i via the one-ahead prefetcher.
+            # chunk i via the one-ahead prefetcher. Pipelined mode also
+            # fans the per-bucket spill encodes of chunk i out to pool
+            # workers (waiting out chunk i−1's first, so per-bucket write
+            # order stays chunk order and host memory stays ≤ two
+            # chunks) — decode ‖ hash ‖ encode instead of decode ‖ rest.
             t_p1 = time.perf_counter()
             decode_wait = 0.0
             gen = _prefetched(
                 self._decoded_chunks(files, fmt, columns, schema, footers=footers)
             )
             _SENTINEL = object()
-            while True:
-                tw = time.perf_counter()
-                at = next(gen, _SENTINEL)
-                decode_wait += time.perf_counter() - tw
-                if at is _SENTINEL:
-                    break
-                n_chunks += 1
-                ct = ColumnTable.from_arrow(at, sub_schema).select(ordered)
-                total_rows += ct.num_rows
-                bucket = bucket_ids(
-                    compute_row_hashes(ct, indexed_columns), num_buckets, np
-                )
-                order = np.argsort(bucket, kind="stable")
-                sb = bucket[order]
-                starts = np.searchsorted(sb, np.arange(num_buckets + 1))
-                arrow_sorted = ct.take(order).to_arrow()
-                for b in range(num_buckets):
-                    lo, hi = int(starts[b]), int(starts[b + 1])
-                    if hi <= lo:
-                        continue
-                    w = writers.get(b)
-                    if w is None:
-                        # Spill is engine-private scratch: the cheap codec
-                        # (see io.INDEX_WRITE_COMPRESSION) beats snappy on
-                        # encode CPU, which bounds phase 1 on small hosts,
-                        # and dictionary encoding stays strings-only for
-                        # the same reason write_bucket's does.
-                        w = pq.ParquetWriter(
-                            spill / hio.bucket_file_name(b),
-                            arrow_sorted.schema,
-                            compression=hio.INDEX_WRITE_COMPRESSION,
-                            # Stats skipped like write_bucket's: spill
-                            # footers are only read for sizes.
-                            write_statistics=False,
-                            use_dictionary=[
-                                f.name for f in sub_schema.select(ordered).fields if f.is_string
-                            ],
-                        )
-                        writers[b] = w
-                    w.write_table(arrow_sorted.slice(lo, hi - lo))
-            for w in writers.values():
-                w.close()
+            def _encode_chunk(parts: list) -> None:
+                # One pool task per CHUNK (not per bucket): per-bucket
+                # futures cost more churn than the encodes they cover.
+                with obs_trace.span("build.p1.spill", parts=len(parts)):
+                    for w, part in parts:
+                        w.write_table(part)
+
+            _encode_chunk_w = obs_trace.wrap(_encode_chunk)
+            with ThreadPoolExecutor(max_workers=2) as p1_pool:
+                spill_fut = None
+                while True:
+                    tw = time.perf_counter()
+                    at = next(gen, _SENTINEL)
+                    decode_wait += time.perf_counter() - tw
+                    if at is _SENTINEL:
+                        break
+                    n_chunks += 1
+                    ct = ColumnTable.from_arrow(at, sub_schema).select(ordered)
+                    total_rows += ct.num_rows
+                    bucket = bucket_ids(
+                        compute_row_hashes(ct, indexed_columns), num_buckets, np
+                    )
+                    order = np.argsort(bucket, kind="stable")
+                    sb = bucket[order]
+                    starts = np.searchsorted(sb, np.arange(num_buckets + 1))
+                    arrow_sorted = ct.take(order).to_arrow()
+                    parts: list = []
+                    for b in range(num_buckets):
+                        lo, hi = int(starts[b]), int(starts[b + 1])
+                        if hi <= lo:
+                            continue
+                        w = writers.get(b)
+                        if w is None:
+                            # Spill is engine-private scratch: the cheap codec
+                            # (see io.INDEX_WRITE_COMPRESSION) beats snappy on
+                            # encode CPU, which bounds phase 1 on small hosts,
+                            # and dictionary encoding stays strings-only for
+                            # the same reason write_bucket's does.
+                            w = pq.ParquetWriter(
+                                spill / hio.bucket_file_name(b),
+                                arrow_sorted.schema,
+                                compression=hio.INDEX_WRITE_COMPRESSION,
+                                # Stats skipped like write_bucket's: spill
+                                # footers are only read for sizes.
+                                write_statistics=False,
+                                use_dictionary=[
+                                    f.name for f in sub_schema.select(ordered).fields if f.is_string
+                                ],
+                            )
+                            writers[b] = w
+                        part = arrow_sorted.slice(lo, hi - lo)
+                        # Decoded-size ledger: the pipeline's p2 window
+                        # admits buckets by these bytes, so no spill
+                        # footer is ever re-opened (io.footer_cache
+                        # dedupes the rest).
+                        spill_bytes[b] = spill_bytes.get(b, 0) + part.nbytes
+                        parts.append((w, part))
+                    if pipelined:
+                        # Waiting out chunk i−1 HERE (after chunk i's
+                        # hash/partition) keeps per-writer chunk order —
+                        # the spill bytes stay identical to the serial
+                        # path's — while chunk i−1's encode overlapped
+                        # this chunk's decode and hash.
+                        if spill_fut is not None:
+                            spill_fut.result()
+                        spill_fut = p1_pool.submit(_encode_chunk_w, parts)
+                    else:
+                        for w, part in parts:
+                            w.write_table(part)
+                if spill_fut is not None:
+                    spill_fut.result()
+            if not pipelined:
+                for w in writers.values():
+                    w.close()
             t_p2 = time.perf_counter()
 
-            # Phase 2: per-bucket key sort. Batches are planned from the
-            # SPILL FOOTERS (uncompressed bytes per bucket), so at most
-            # ~chunk_bytes of bucket data is resident at once — the memory
-            # bound holds end to end, not just in phase 1. Within a batch,
-            # reads and writes are threaded; the sort is one device call.
+            # Phase 2. Pipelined: writer closes feed a bounded
+            # bucket-completion queue; spill-read of bucket b+1 overlaps
+            # the key sort of b overlaps the final write of b−1 (see
+            # _p2_pipelined). Serial: the original batched two-step.
             dest.mkdir(parents=True, exist_ok=True)
             bucket_rows = [0] * num_buckets
-            spill_files = {
-                b: str(spill / hio.bucket_file_name(b))
-                for b in range(num_buckets)
-                if (spill / hio.bucket_file_name(b)).exists()
-            }
-            spill_footers = hio.read_footers(list(spill_files.values()))
-            bucket_bytes = {
-                b: hio.estimate_uncompressed_bytes([p], footers={p: spill_footers[p]})
-                for b, p in spill_files.items()
-            }
-            batches: list[list[int]] = []
-            cur: list[int] = []
-            cur_bytes = 0
-            for b in sorted(spill_files):
-                if cur and cur_bytes + bucket_bytes[b] > self.chunk_bytes:
-                    batches.append(cur)
-                    cur, cur_bytes = [], 0
-                cur.append(b)
-                cur_bytes += bucket_bytes[b]
-            if cur:
-                batches.append(cur)
-
             key_stats: list = [None] * num_buckets
             col_stats: list = [None] * num_buckets
             stat_cols = [
@@ -438,28 +486,62 @@ class DeviceIndexBuilder:
                 if not f.is_vector and f.name != sub_schema.field(indexed_columns[0]).name
             ]
             sort_venue = self._sort_venue(self._mesh_for(num_buckets))
-            with ThreadPoolExecutor(max_workers=8) as pool:
-                empty = ColumnTable.empty(sub_schema.select(ordered))
-                for b in range(num_buckets):
-                    if b not in spill_files:
-                        hio.write_bucket(dest, b, empty)
-                for ids in batches:
-                    tables = list(pool.map(lambda b: hio.read_parquet([spill_files[b]]), ids))
-                    if sort_venue == "host":
-                        perms = _host_sort_perms(tables, indexed_columns)
-                    else:
-                        perms = device_sort_perms(tables, indexed_columns)
-                    futs = [
-                        pool.submit(hio.write_bucket, dest, b, t.take(p))
-                        for b, t, p in zip(ids, tables, perms)
-                    ]
-                    for b, t in zip(ids, tables):
-                        bucket_rows[b] = t.num_rows
-                        key_stats[b] = hio.bucket_key_stats(t, indexed_columns[0])
-                        if stat_cols:
-                            col_stats[b] = hio.bucket_column_stats(t, stat_cols)
-                    for f in futs:
-                        f.result()
+            if pipelined:
+                pipe_info = self._p2_pipelined(
+                    writers, spill, spill_bytes, dest, sub_schema, ordered,
+                    indexed_columns, num_buckets, stat_cols, sort_venue,
+                    bucket_rows, key_stats, col_stats,
+                )
+            else:
+                # Batches are planned from the SPILL FOOTERS (uncompressed
+                # bytes per bucket), so at most ~chunk_bytes of bucket data
+                # is resident at once — the memory bound holds end to end,
+                # not just in phase 1. Within a batch, reads and writes are
+                # threaded; the sort is one device call.
+                spill_files = {
+                    b: str(spill / hio.bucket_file_name(b))
+                    for b in range(num_buckets)
+                    if (spill / hio.bucket_file_name(b)).exists()
+                }
+                spill_footers = hio.read_footers(list(spill_files.values()))
+                bucket_bytes = {
+                    b: hio.estimate_uncompressed_bytes([p], footers={p: spill_footers[p]})
+                    for b, p in spill_files.items()
+                }
+                batches: list[list[int]] = []
+                cur: list[int] = []
+                cur_bytes = 0
+                for b in sorted(spill_files):
+                    if cur and cur_bytes + bucket_bytes[b] > self.chunk_bytes:
+                        batches.append(cur)
+                        cur, cur_bytes = [], 0
+                    cur.append(b)
+                    cur_bytes += bucket_bytes[b]
+                if cur:
+                    batches.append(cur)
+
+                with ThreadPoolExecutor(max_workers=8) as pool:
+                    empty = ColumnTable.empty(sub_schema.select(ordered))
+                    for b in range(num_buckets):
+                        if b not in spill_files:
+                            hio.write_bucket(dest, b, empty)
+                    for ids in batches:
+                        tables = list(pool.map(lambda b: hio.read_parquet([spill_files[b]]), ids))
+                        if sort_venue == "host":
+                            perms = _host_sort_perms(tables, indexed_columns)
+                        else:
+                            perms = device_sort_perms(tables, indexed_columns)
+                        futs = [
+                            pool.submit(hio.write_bucket, dest, b, t.take(p))
+                            for b, t, p in zip(ids, tables, perms)
+                        ]
+                        for b, t in zip(ids, tables):
+                            bucket_rows[b] = t.num_rows
+                            key_stats[b] = hio.bucket_key_stats(t, indexed_columns[0])
+                            if stat_cols:
+                                col_stats[b] = hio.bucket_column_stats(t, stat_cols)
+                        for f in futs:
+                            f.result()
             hio.write_manifest(
                 dest, num_buckets, indexed_columns, bucket_rows,
                 key_stats if any(s is not None for s in key_stats) else None,
@@ -476,12 +558,196 @@ class DeviceIndexBuilder:
             "rows": total_rows,
             # Phase walls: p1 = decode→hash→partition→spill (decode_wait
             # is the NON-overlapped decode stall inside it — the prefetch
-            # hides the rest); p2 = spill read→key sort→final write.
+            # hides the rest); p2 = spill read→key sort→final write
+            # (pipelined mode overlaps its stages AND the writer closes,
+            # so p2 here is the OVERLAPPED wall, not a sum of stages).
             "phases_s": {
                 "p1_decode_hash_spill": round(t_p2 - t_p1, 4),
                 "p1_decode_wait": round(decode_wait, 4),
                 "p2_sort_encode_write": round(t_end - t_p2, 4),
             },
+        }
+        if pipe_info is not None:
+            self.last_build_stats["pipeline"] = pipe_info
+
+    def _p2_pipelined(
+        self,
+        writers,
+        spill: Path,
+        spill_bytes: dict[int, int],
+        dest: Path,
+        sub_schema,
+        ordered: list[str],
+        indexed_columns: list[str],
+        num_buckets: int,
+        stat_cols: list[str],
+        sort_venue: str,
+        bucket_rows: list,
+        key_stats: list,
+        col_stats: list,
+    ) -> dict:
+        """The 3-stage phase-2 pipeline behind a bounded bucket-completion
+        queue: writer CLOSES fan out to the pool and feed the queue as
+        they land, the reader admits buckets under a byte-budgeted
+        in-flight window and decodes them (`spill.read`), the sort stage
+        (this thread) computes each bucket's key permutation, and write
+        tasks gather+encode the final file — so the spill read of bucket
+        b+1 overlaps the key sort of b overlaps the parquet write of b−1,
+        and the first reads overlap the remaining closes (the only
+        p1→p2 order that hash partitioning permits: every bucket needs
+        every chunk). Crash-safe: reader failures re-raise on this
+        thread via the error sentinel, writers release their window bytes
+        in `finally`, and the stop flag unblocks a parked reader, so the
+        spill dir's cleanup (caller's `finally`) always runs.
+
+        Mutates bucket_rows/key_stats/col_stats in place (distinct slots
+        per bucket) and returns the pipeline telemetry dict."""
+        import queue as _queue
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+
+        from hyperspace_tpu.ops.sortkeys import device_sort_perms
+
+        # The window covers buckets across ALL THREE stages (a bucket's
+        # bytes release only when its final write lands), so it needs
+        # headroom beyond one sort batch or the reader starves.
+        window = self.pipeline_max_inflight_bytes or max(1, 4 * self.chunk_bytes)
+        cv = threading.Condition()
+        inflight = {"bytes": 0}
+        stop = [False]
+        ready: "_queue.Queue" = _queue.Queue()  # bucket ids whose spill writer closed
+        sortq: "_queue.Queue" = _queue.Queue()  # (bucket, table, nbytes) | _DONE | _ERR
+        _DONE, _ERR = object(), object()
+        busy = {"read": 0.0, "sort": 0.0, "write": 0.0}
+        busy_lock = threading.Lock()
+        max_depth = [0]
+        spill_ids = sorted(writers)
+        n_spilled = len(spill_ids)
+
+        def close_one(b: int) -> None:
+            try:
+                writers[b].close()
+            finally:
+                # Enqueue even on a failed close: the reader's decode of
+                # the torn spill file surfaces the error (never a hang).
+                ready.put(b)
+
+        def read_loop() -> None:
+            try:
+                for _ in range(n_spilled):
+                    b = ready.get()
+                    if stop[0]:
+                        return
+                    nb = max(1, spill_bytes.get(b, 1))
+                    with cv:
+                        while not stop[0] and inflight["bytes"] > 0 and inflight["bytes"] + nb > window:
+                            cv.wait()
+                        if stop[0]:
+                            return
+                        inflight["bytes"] += nb
+                    path = str(spill / hio.bucket_file_name(b))
+                    fault_point("spill.read", path)
+                    t0 = time.perf_counter()
+                    with obs_trace.span("build.p2.read", bucket=b, bytes=nb):
+                        t = hio.read_parquet([path])
+                    with busy_lock:
+                        busy["read"] += time.perf_counter() - t0
+                    fault_point("pipeline.put", path)
+                    sortq.put((b, t, nb))
+                    d = sortq.qsize()
+                    _MET_QDEPTH.observe(d)
+                    if d > max_depth[0]:
+                        max_depth[0] = d
+            except BaseException:
+                sortq.put(_ERR)
+                raise
+            sortq.put(_DONE)
+
+        def write_one(b: int, t: ColumnTable, perm: np.ndarray, nb: int) -> None:
+            try:
+                t0 = time.perf_counter()
+                with obs_trace.span("build.p2.write", bucket=b):
+                    # Manifest stats ride the write stage (min/max is
+                    # permutation-invariant, so computing them pre-gather
+                    # matches the serial path exactly) — they parallelize
+                    # across write workers instead of serializing the
+                    # sort stage.
+                    bucket_rows[b] = t.num_rows
+                    key_stats[b] = hio.bucket_key_stats(t, indexed_columns[0])
+                    if stat_cols:
+                        col_stats[b] = hio.bucket_column_stats(t, stat_cols)
+                    hio.write_bucket(dest, b, t.take(perm))
+                with busy_lock:
+                    busy["write"] += time.perf_counter() - t0
+            finally:
+                with cv:
+                    inflight["bytes"] -= nb
+                    cv.notify_all()
+
+        t_start = time.perf_counter()
+        wfuts: list = []
+        with ThreadPoolExecutor(max_workers=8) as pool:
+            empty = ColumnTable.empty(sub_schema.select(ordered))
+            for b in range(num_buckets):
+                if b not in writers:
+                    wfuts.append(pool.submit(obs_trace.wrap(hio.write_bucket), dest, b, empty))
+            for b in spill_ids:
+                pool.submit(obs_trace.wrap(close_one), b)
+            rfut = pool.submit(obs_trace.wrap(read_loop))
+            try:
+                sentinel = None
+                while sentinel is None:
+                    fault_point("pipeline.get")
+                    item = sortq.get()
+                    if item is _DONE or item is _ERR:
+                        break
+                    # Micro-batch: drain whatever the reader has already
+                    # staged (≤8 buckets) into ONE device sort call. Each
+                    # table pads and sorts independently inside the batch
+                    # (ops/sortkeys.device_sort_perms), so every bucket's
+                    # permutation is identical whatever batch it lands in
+                    # — batching amortizes dispatch, never changes bytes.
+                    batch = [item]
+                    while len(batch) < 8:
+                        try:
+                            nxt = sortq.get_nowait()
+                        except _queue.Empty:
+                            break
+                        if nxt is _DONE or nxt is _ERR:
+                            sentinel = nxt
+                            break
+                        batch.append(nxt)
+                    ts = [t for _, t, _ in batch]
+                    t0 = time.perf_counter()
+                    with obs_trace.span(
+                        "build.p2.sort", buckets=len(batch), rows=sum(t.num_rows for t in ts)
+                    ):
+                        if sort_venue == "host":
+                            perms = _host_sort_perms(ts, indexed_columns)
+                        else:
+                            perms = device_sort_perms(ts, indexed_columns)
+                    busy["sort"] += time.perf_counter() - t0
+                    for (b, t, nb), perm in zip(batch, perms):
+                        wfuts.append(pool.submit(obs_trace.wrap(write_one), b, t, perm, nb))
+                item = sentinel if sentinel is not None else item
+                if item is _ERR:
+                    rfut.result()  # re-raises the reader's failure here
+            finally:
+                with cv:
+                    stop[0] = True
+                    cv.notify_all()
+            for f in wfuts:
+                f.result()
+        wall = time.perf_counter() - t_start
+        occ = 0.0
+        if wall > 0:
+            occ = sum(min(v, wall) for v in busy.values()) / (3 * wall)
+        _MET_OCCUPANCY.set(round(occ, 4))
+        return {
+            "occupancy": round(occ, 4),
+            "max_queue_depth": max_depth[0],
+            "window_bytes": window,
+            "stage_busy_s": {k: round(v, 4) for k, v in busy.items()},
         }
 
     def _decoded_chunks(self, files, fmt: str, columns, schema, footers=None):

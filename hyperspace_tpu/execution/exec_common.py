@@ -94,11 +94,16 @@ def _filter_side(side: SideData, predicate, mesh, venue: str = "auto") -> SideDa
     return SideData(t.filter_mask(mask), offsets, side.sorted_within)
 
 
-def _bucket_sorted_codes(codes: np.ndarray, side: SideData):
+def _bucket_sorted_codes(codes: np.ndarray, side: SideData, venue: str = "host"):
     """Ensure codes are non-decreasing within each bucket. Returns
     (sorted codes, perm) where perm maps sorted positions back to the
     side's row order (None when already sorted — the index-file case,
-    verified with one vectorized pass, memoized for stable codes)."""
+    verified with one vectorized pass, memoized for stable codes).
+    `venue` picks where the re-grouping permutation is computed: "device"
+    fuses the bucket lane and the code lanes into ONE lax.sort
+    (ops/sortkeys.device_lanes_perm) instead of the host np.lexsort
+    pass; both produce the identical stable permutation, so the memo
+    cache never keys on the venue."""
     from hyperspace_tpu.execution import device_cache as dc
 
     n = len(codes)
@@ -145,7 +150,13 @@ def _bucket_sorted_codes(codes: np.ndarray, side: SideData):
     def build_sorted(cacheable: bool):
         counts = np.diff(side.offsets)
         bucket_of = np.repeat(np.arange(len(counts), dtype=np.int64), counts)
-        perm = np.lexsort((codes, bucket_of))  # stable; regroups identically
+        if venue == "device":
+            from hyperspace_tpu.ops.sortkeys import device_lanes_perm, value_lanes
+
+            lanes = value_lanes(bucket_of.astype(np.int32)) + value_lanes(codes)
+            perm = device_lanes_perm(lanes).astype(np.int64)
+        else:
+            perm = np.lexsort((codes, bucket_of))  # stable; regroups identically
         sc = codes[perm]
         nbytes = sc.nbytes + perm.nbytes
         if cacheable and nbytes <= dc.HOST_DERIVED.budget // 4:

@@ -213,8 +213,14 @@ class JoinMixin:
                 self.stats["join_kernel"] = "host-broadcast-hash"
                 return res[0], res[1], None
 
-        lcodes, lperm = _bucket_sorted_codes(lcodes, lside)
-        rcodes, rperm = _bucket_sorted_codes(rcodes, rside)
+        # Non-aligned sides re-group through the fused bucket+key device
+        # sort when the sort venue allows (host np.lexsort otherwise —
+        # identical stable permutation either way).
+        regroup_venue = self._venue(
+            "sort_venue", "hyperspace.sort.venue", False, needs_native=False
+        )
+        lcodes, lperm = _bucket_sorted_codes(lcodes, lside, venue=regroup_venue)
+        rcodes, rperm = _bucket_sorted_codes(rcodes, rside, venue=regroup_venue)
         b = len(lside.offsets) - 1
         self.stats["num_buckets"] = b
 

@@ -92,8 +92,11 @@ class FusedJoinAggMixin:
         lc0, rc0 = _factorize_keys_cached(data["left"].table, data["right"].table, lkeys, rkeys)
         codes = {}
         perms = {}
-        codes["left"], perms["left"] = _bucket_sorted_codes(lc0, data["left"])
-        codes["right"], perms["right"] = _bucket_sorted_codes(rc0, data["right"])
+        regroup_venue = self._venue(
+            "sort_venue", "hyperspace.sort.venue", False, needs_native=False
+        )
+        codes["left"], perms["left"] = _bucket_sorted_codes(lc0, data["left"], venue=regroup_venue)
+        codes["right"], perms["right"] = _bucket_sorted_codes(rc0, data["right"], venue=regroup_venue)
         secondary = "right" if primary == "left" else "left"
 
         # Group ids on the primary table (original row order; memoized

@@ -40,11 +40,65 @@ def _corruption(e: BaseException, index_root: str, files: list[str]) -> IndexCor
     )
 
 
+# Bucket pruning reads at most this many point combinations; above it
+# the (still-correct) range/mask machinery takes over.
+MAX_POINT_COMBOS = 64
+
+
+def scan_files(scan: Scan) -> list[str]:
+    if scan.files is not None:
+        return list(scan.files)
+    return [fi.path for fi in list_data_files(scan.root, suffix=format_suffix(scan.format))]
+
+
+def point_prune_names(scan: Scan, predicate: Expr, max_combos: int = MAX_POINT_COMBOS) -> set[str] | None:
+    """Bucket file NAMES owned by the predicate's equality/IN literals on
+    every bucket column, or None when the predicate does not pin them (or
+    the combination count exceeds `max_combos`). Pure — shared by the
+    executor's pruner and the plan-time prefetcher. The analog of
+    partition pruning the reference cannot do (FilterIndexRule keeps a
+    full scan, FilterIndexRule.scala:114-120); IN on the bucket column
+    divides IO by numBuckets/|IN| instead of 1."""
+    import itertools
+    import math
+
+    from hyperspace_tpu.plan.expr import InList
+
+    num_buckets, bucket_cols = scan.bucket_spec
+    cand: dict[str, list] = {}
+    for conj in split_conjuncts(predicate):
+        got: tuple[str, list] | None = None
+        if isinstance(conj, BinOp) and conj.op == "eq":
+            if isinstance(conj.left, Col) and isinstance(conj.right, Lit):
+                got = (conj.left.name.lower(), [conj.right.value])
+            elif isinstance(conj.right, Col) and isinstance(conj.left, Lit):
+                got = (conj.right.name.lower(), [conj.left.value])
+        elif isinstance(conj, InList) and isinstance(conj.child, Col):
+            got = (conj.child.name.lower(), list(conj.values))
+        if got is not None:
+            name, vals = got
+            # Conjunctive constraints: any one conjunct's list is a
+            # valid superset of the reachable values — keep the
+            # smallest.
+            if name not in cand or len(vals) < len(cand[name]):
+                cand[name] = vals
+    try:
+        lists = [cand[c.lower()] for c in bucket_cols]
+    except KeyError:
+        return None
+    if math.prod(len(l) for l in lists) > max_combos:
+        return None
+    fields = [scan.scan_schema.field(c) for c in bucket_cols]
+    names: set[str] = set()
+    for combo in itertools.product(*lists):
+        h = hash_scalar_key(list(combo), fields)
+        names.add(hio.bucket_file_name(int(bucket_ids(h, num_buckets, np)[0])))
+    return names
+
+
 class ScanFilterMixin:
     def _scan_files(self, scan: Scan) -> list[str]:
-        if scan.files is not None:
-            return list(scan.files)
-        return [fi.path for fi in list_data_files(scan.root, suffix=format_suffix(scan.format))]
+        return scan_files(scan)
 
     def _cached_read(self, files: list[str], columns, schema, index_root: str | None = None) -> ColumnTable:
         """Index-file read through the decoded-table cache; files_read
@@ -155,51 +209,13 @@ class ScanFilterMixin:
         self._phys(kernel=mask_kernel)
         return apply_filter(self._execute(child), plan.predicate, mesh=self.mesh, venue=mask_venue)
 
-    # Bucket pruning reads at most this many point combinations; above it
-    # the (still-correct) range/mask machinery takes over.
-    _MAX_POINT_COMBOS = 64
-
     def _prune_bucket_files(self, scan: Scan, predicate: Expr) -> list[str] | None:
         """If the predicate pins every bucket column with equality
         literals — single (eq) or multi-point (IN) — return only the
-        owning buckets' files. The analog of partition pruning the
-        reference cannot do (FilterIndexRule keeps a full scan,
-        FilterIndexRule.scala:114-120); IN on the bucket column divides
-        IO by numBuckets/|IN| instead of 1."""
-        import itertools
-        import math
-
-        from hyperspace_tpu.plan.expr import InList
-
-        num_buckets, bucket_cols = scan.bucket_spec
-        cand: dict[str, list] = {}
-        for conj in split_conjuncts(predicate):
-            got: tuple[str, list] | None = None
-            if isinstance(conj, BinOp) and conj.op == "eq":
-                if isinstance(conj.left, Col) and isinstance(conj.right, Lit):
-                    got = (conj.left.name.lower(), [conj.right.value])
-                elif isinstance(conj.right, Col) and isinstance(conj.left, Lit):
-                    got = (conj.right.name.lower(), [conj.left.value])
-            elif isinstance(conj, InList) and isinstance(conj.child, Col):
-                got = (conj.child.name.lower(), list(conj.values))
-            if got is not None:
-                name, vals = got
-                # Conjunctive constraints: any one conjunct's list is a
-                # valid superset of the reachable values — keep the
-                # smallest.
-                if name not in cand or len(vals) < len(cand[name]):
-                    cand[name] = vals
-        try:
-            lists = [cand[c.lower()] for c in bucket_cols]
-        except KeyError:
+        owning buckets' files (see point_prune_names)."""
+        names = point_prune_names(scan, predicate)
+        if names is None:
             return None
-        if math.prod(len(l) for l in lists) > self._MAX_POINT_COMBOS:
-            return None
-        fields = [scan.scan_schema.field(c) for c in bucket_cols]
-        names = set()
-        for combo in itertools.product(*lists):
-            h = hash_scalar_key(list(combo), fields)
-            names.add(hio.bucket_file_name(int(bucket_ids(h, num_buckets, np)[0])))
         files = self._scan_files(scan)
         matches = [f for f in files if Path(f).name in names]
         if matches:

@@ -217,6 +217,36 @@ def _read_one_file_once(path: str, fmt: str, columns: list[str] | None, schema: 
     raise HyperspaceError(f"unsupported source format {fmt!r} (parquet|orc|csv|json)")
 
 
+# Cold reads at or above this many on-disk bytes decode as parallel
+# row-group chunks instead of one serial pq.read_table per file (only
+# engaged when the file count alone cannot saturate the pool).
+_CHUNKED_READ_MIN_BYTES = 32 << 20
+
+
+def _read_parquet_chunked(files: list[str], columns: list[str] | None):
+    """Row-group-parallel decode of a small file set, or None when the
+    footer plan yields no parallelism (single row group, tiny estimate,
+    unreadable footers — every fallback lands on the per-file path)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    try:
+        footers = read_footers(files)
+    except (OSError, pa.ArrowException):
+        return None
+    est = estimate_uncompressed_bytes(files, columns, footers=footers)
+    if est <= 0:
+        return None
+    units = plan_row_group_chunks(files, max(4 << 20, est // 16), columns, footers=footers)
+    if len(units) < 2:
+        return None
+    read = obs_trace.wrap(lambda c: read_chunk(c, columns))
+    with ThreadPoolExecutor(max_workers=min(8, len(units))) as ex:
+        parts = list(ex.map(read, units))
+    # Units are planned in file order with row groups in order, so the
+    # ordered concat reproduces the serial read's row order exactly.
+    return pa.concat_tables(parts, promote_options="default")
+
+
 def read_table_files(
     files: list[str],
     fmt: str = "parquet",
@@ -235,18 +265,26 @@ def read_table_files(
     except OSError:
         nbytes = 0
     with obs_trace.span("io.read", files=len(files), fmt=fmt, bytes=nbytes):
-        if len(files) == 1:
-            tables = [_read_one_file(files[0], fmt, columns, schema)]
-        else:
-            from concurrent.futures import ThreadPoolExecutor
+        table = None
+        if fmt == "parquet" and len(files) <= 4 and nbytes >= _CHUNKED_READ_MIN_BYTES:
+            # A cold read of one (or few) big bucket files used to decode
+            # serially — one pq.read_table per pool worker with most of
+            # the pool idle. Split it into footer-planned row-group
+            # chunks instead so the decode parallelizes within the file.
+            table = _read_parquet_chunked(files, columns)
+        if table is None:
+            if len(files) == 1:
+                tables = [_read_one_file(files[0], fmt, columns, schema)]
+            else:
+                from concurrent.futures import ThreadPoolExecutor
 
-            # wrap(): pool workers start with an empty contextvar
-            # context — re-plant the caller's active span so per-file
-            # retry/fault events attribute to this read.
-            read = obs_trace.wrap(lambda f: _read_one_file(f, fmt, columns, schema))
-            with ThreadPoolExecutor(max_workers=min(8, len(files))) as ex:
-                tables = list(ex.map(read, files))
-        table = pa.concat_tables(tables, promote_options="default") if len(tables) > 1 else tables[0]
+                # wrap(): pool workers start with an empty contextvar
+                # context — re-plant the caller's active span so per-file
+                # retry/fault events attribute to this read.
+                read = obs_trace.wrap(lambda f: _read_one_file(f, fmt, columns, schema))
+                with ThreadPoolExecutor(max_workers=min(8, len(files))) as ex:
+                    tables = list(ex.map(read, files))
+            table = pa.concat_tables(tables, promote_options="default") if len(tables) > 1 else tables[0]
     if schema is not None and columns is not None:
         schema = schema.select(columns)
     return ColumnTable.from_arrow(table, schema)
@@ -257,19 +295,69 @@ def _read_footer(path: str) -> "pq.FileMetaData":
     return pq.ParquetFile(path).metadata
 
 
+# -- footer cache -------------------------------------------------------------
+# Every size estimate, chunk plan, spill batch, and stats lookup used to
+# re-open footers already parsed moments earlier (the build opened each
+# source footer up to three times). One mtime-validated map dedupes them;
+# the prefetcher warms it so the executor's footer reads are hits.
+_FOOTER_CACHE_MAX = 4096
+_footer_cache: "dict[str, tuple[int, pq.FileMetaData]]" = {}
+_footer_lock = threading.Lock()
+
+
+def clear_footer_cache() -> None:
+    with _footer_lock:
+        _footer_cache.clear()
+
+
 def read_footers(files: list[str]) -> dict[str, "pq.FileMetaData"]:
     """One footer parse per file, reused by the size estimate, the chunk
-    planner, and the spill batcher (footers can be remote round-trips —
-    hence the transient-IO retry)."""
+    planner, the spill batcher, and the query-tail prefetcher (footers
+    can be remote round-trips — hence the transient-IO retry and the
+    mtime-validated cache; `io.footer_cache.*` counts the dedup)."""
+    import os
+
     from concurrent.futures import ThreadPoolExecutor
 
-    if len(files) == 1:
-        return {files[0]: retry.retry_call(_read_footer, files[0])}
-    with obs_trace.span("io.footers", files=len(files)):
-        read = obs_trace.wrap(lambda f: retry.retry_call(_read_footer, f))
-        with ThreadPoolExecutor(max_workers=min(8, len(files))) as ex:
-            mds = list(ex.map(read, files))
-    return dict(zip(files, mds))
+    if not files:
+        return {}
+    out: dict[str, "pq.FileMetaData"] = {}
+    todo: list[tuple[str, int | None]] = []
+    for f in files:
+        try:
+            mt = os.stat(f).st_mtime_ns
+        except OSError:
+            mt = None
+        hit = None
+        if mt is not None:
+            with _footer_lock:
+                cached = _footer_cache.get(f)
+            if cached is not None and cached[0] == mt:
+                hit = cached[1]
+        if hit is not None:
+            out[f] = hit
+        else:
+            todo.append((f, mt))
+    if len(out):
+        stats.increment("io.footer_cache.hits", len(out))
+    if not todo:
+        return {f: out[f] for f in files}
+    stats.increment("io.footer_cache.misses", len(todo))
+    if len(todo) == 1:
+        mds = [retry.retry_call(_read_footer, todo[0][0])]
+    else:
+        with obs_trace.span("io.footers", files=len(todo)):
+            read = obs_trace.wrap(lambda f: retry.retry_call(_read_footer, f))
+            with ThreadPoolExecutor(max_workers=min(8, len(todo))) as ex:
+                mds = list(ex.map(read, (f for f, _ in todo)))
+    with _footer_lock:
+        for (f, mt), md in zip(todo, mds):
+            out[f] = md
+            if mt is not None:
+                _footer_cache[f] = (mt, md)
+        while len(_footer_cache) > _FOOTER_CACHE_MAX:
+            _footer_cache.pop(next(iter(_footer_cache)))
+    return {f: out[f] for f in files}
 
 
 def _row_group_bytes(md, rg: int, want: set | None) -> int:
@@ -322,13 +410,27 @@ def plan_row_group_chunks(
     return chunks
 
 
+def _read_chunk_file(f: str, rgs: list[int], columns: list[str] | None):
+    fault_point("bucket.read", f)
+    pf = pq.ParquetFile(f)
+    if columns is not None:
+        # Tolerate per-file schema skew: a column absent from THIS file is
+        # skipped here and null-filled by the caller's promoting concat —
+        # the same union semantics read_table_files gets from
+        # concat_tables, and what lets the prefetcher probe any file.
+        names = set(pf.schema_arrow.names)
+        columns = [c for c in columns if c in names]
+    return pf.read_row_groups(rgs, columns=columns)
+
+
 def read_chunk(chunk: list[tuple[str, int]], columns: list[str] | None = None):
-    """Decode one planned chunk to a pyarrow Table."""
+    """Decode one planned chunk to a pyarrow Table (transient-IO retried
+    per file; columns missing from a file are null-filled)."""
     by_file: dict[str, list[int]] = {}
     for f, rg in chunk:
         by_file.setdefault(f, []).append(rg)
     parts = [
-        pq.ParquetFile(f).read_row_groups(rgs, columns=columns)
+        retry.retry_call(_read_chunk_file, f, rgs, columns)
         for f, rgs in by_file.items()
     ]
     return pa.concat_tables(parts, promote_options="default") if len(parts) > 1 else parts[0]

@@ -42,8 +42,12 @@ Fault point names in use (see each call site):
 ``manifest.read``     io.read_manifest, before the JSON parse
 ``bucket.write``      io.write_bucket, before the parquet encode
 ``bucket.written``    after a bucket file lands (truncate ⇒ corrupt bucket)
-``bucket.read``       io._read_one_file, before any data-file decode
+``bucket.read``       io._read_one_file / io.read_chunk, before a data decode
 ``footer.read``       io.read_footers, before a footer parse
+``spill.read``        builder p2 pipeline, before a bucket's spill read
+``pipeline.put``      builder, before a read bucket enters the sort queue
+``pipeline.get``      builder, before the sort stage dequeues a bucket
+``prefetch.issue``    execution/prefetch.py, before an async prefetch job
 ====================  =====================================================
 """
 
@@ -71,6 +75,10 @@ KNOWN_POINTS = (
     "bucket.written",
     "bucket.read",
     "footer.read",
+    "spill.read",
+    "pipeline.put",
+    "pipeline.get",
+    "prefetch.issue",
 )
 
 

@@ -140,6 +140,8 @@ class HyperspaceSession:
                             chunk_bytes=self.conf.build_chunk_bytes or None,
                             venue=self.conf.build_venue,
                             venue_min_mbps=self.conf.join_venue_min_mbps,
+                            pipeline_enabled=self.conf.build_pipeline_enabled,
+                            pipeline_max_inflight_bytes=self.conf.build_pipeline_max_inflight_bytes,
                         )
                         self._last_writer = w
                         return w
@@ -248,6 +250,15 @@ class HyperspaceSession:
                         optimized = plan_cache.get_or_optimize(self, plan)
                     else:
                         optimized = self.optimized_plan(plan)
+                    if use_indexes and self._enabled and self.conf.scan_prefetch_enabled:
+                        # Query-tail prefetch: footers + first chunk of
+                        # the index files the pruner keeps start loading
+                        # on a background pool NOW, so the executor's
+                        # cold reads below begin warm (advisory — see
+                        # execution/prefetch.py).
+                        from hyperspace_tpu.execution import prefetch as _prefetch
+
+                        _prefetch.prefetch_plan(optimized)
                 try:
                     if profile_dir is not None:
                         import jax

@@ -245,7 +245,11 @@ def bucketize_perm(
         if not bool(_np.asarray(overflow_h).max()):
             break
         if capacity >= per_dev:
-            raise AssertionError("bucketize overflow with full capacity — impossible")
+            # Typed (not assert): the invariant breaking would cross the
+            # action API surface, and asserts vanish under -O.
+            from hyperspace_tpu.exceptions import HyperspaceError
+
+            raise HyperspaceError("bucketize overflow with full capacity — impossible")
         capacity_factor *= 2.0
     perm_h = _np.asarray(perm_h)
     counts_h = _np.asarray(counts_h)  # [D, num_buckets]
@@ -293,5 +297,9 @@ def bucketize(
         if not bool(jax.device_get(overflow).max()):
             return list(out_cols), out_bucket, out_valid
         if capacity >= per_dev:
-            raise AssertionError("bucketize overflow with full capacity — impossible")
+            # Typed (not assert): the invariant breaking would cross the
+            # action API surface, and asserts vanish under -O.
+            from hyperspace_tpu.exceptions import HyperspaceError
+
+            raise HyperspaceError("bucketize overflow with full capacity — impossible")
         capacity_factor *= 2.0

@@ -148,16 +148,19 @@ def order_lanes(table, by: list[tuple[str, bool]]) -> list[np.ndarray]:
     return out
 
 
-def device_order_perm(table, by: list[tuple[str, bool]]) -> np.ndarray:
-    """Stable permutation ordering `table` by the (column, ascending)
-    keys — one device lax.sort over the decomposed lanes."""
+def device_lanes_perm(lanes: list[np.ndarray]) -> np.ndarray:
+    """Stable permutation sorting rows by pre-decomposed 32-bit lanes —
+    ONE device lax.sort (pads to a power of two; a leading is_pad lane
+    sinks pads). This is the fused bucket+key encode the query-time
+    re-grouping uses instead of a separate host np.lexsort pass: callers
+    stack e.g. [bucket lane, *key lanes] and get the grouped order in a
+    single device dispatch."""
     import jax
     import jax.numpy as jnp
 
-    n = table.num_rows
+    n = len(lanes[0]) if lanes else 0
     if n <= 1:
         return np.arange(n)
-    lanes = order_lanes(table, by)
     l_pad = 1 << (int(n - 1).bit_length())
     is_pad = np.zeros((1, l_pad), np.int32)
     is_pad[0, n:] = 1
@@ -171,6 +174,14 @@ def device_order_perm(table, by: list[tuple[str, bool]]) -> np.ndarray:
     fn = _make_batch_sort(len(ops), 1 + len(lanes))
     perm = np.asarray(jax.device_get(fn(*ops)))
     return perm[0, :n]
+
+
+def device_order_perm(table, by: list[tuple[str, bool]]) -> np.ndarray:
+    """Stable permutation ordering `table` by the (column, ascending)
+    keys — one device lax.sort over the decomposed lanes."""
+    if table.num_rows <= 1:
+        return np.arange(table.num_rows)
+    return device_lanes_perm(order_lanes(table, by))
 
 
 @functools.lru_cache(maxsize=32)
@@ -313,4 +324,4 @@ def device_sort_perms(tables, key_columns: list[str]) -> list[np.ndarray]:
     ops = [jnp.asarray(is_pad)] + [jnp.asarray(s) for s in stacked] + [jnp.asarray(np.ascontiguousarray(iota))]
     fn = _make_batch_sort(len(ops), 1 + num_lanes)
     perm = np.asarray(jax.device_get(fn(*ops)))
-    return [perm[i, : lens[i]] for i in range(b)]
+    return [perm[i, : lens[i]] for i in range(len(tables))]

@@ -35,6 +35,9 @@ Counter names in use:
 - ``recover.on_access_failed``  lazy recover-on-access attempts that
   failed during listing (the entry stays unlisted; explicit recover()
   still applies)
+- ``io.footer_cache.hits``    parquet footer parses skipped by the
+  mtime-validated footer cache (execution/io.py)
+- ``io.footer_cache.misses``  footer parses that actually opened the file
 """
 
 from __future__ import annotations
@@ -60,6 +63,8 @@ KNOWN_COUNTERS = (
     "action.rollback_failed",
     "action.cleanup_failed",
     "recover.on_access_failed",
+    "io.footer_cache.hits",
+    "io.footer_cache.misses",
 )
 
 _counters = {name: _metrics.counter(name) for name in KNOWN_COUNTERS}

@@ -525,3 +525,78 @@ def test_crash_sweep_mid_schedule_calls(tmp_path_factory):
         finally:
             faults.reset()
         _assert_crash_consistent(tmp, source, "create", f"{point}@{k}")
+
+
+# ---------------------------------------------------------------------------
+# Streaming-build pipeline fault points (docs/architecture.md "build
+# pipeline"): a hard crash anywhere inside the p2 pipeline — the spill
+# read, the queue put, the queue get — must leave no spill scratch
+# behind, a recoverable log, and correct query answers. These points
+# only exist on the pipelined out-of-core path, so the generic sweep
+# above (in-memory builds) cannot reach them.
+# ---------------------------------------------------------------------------
+
+
+def _streaming_session(tmp_path):
+    from hyperspace_tpu.config import INDEX_BUILD_CHUNK_BYTES, INDEX_BUILD_MEMORY_BUDGET
+
+    source = _write_source(tmp_path / "src", n=600)
+    session = HyperspaceSession(system_path=str(tmp_path / "sys"), num_buckets=2)
+    # A budget far below the source forces the streaming (and therefore
+    # pipelined) build inside CreateAction.
+    session.conf.set(INDEX_BUILD_MEMORY_BUDGET, 2_000)
+    session.conf.set(INDEX_BUILD_CHUNK_BYTES, 4_000)
+    return source, session, Hyperspace(session)
+
+
+@pytest.mark.parametrize("point", ["spill.read", "pipeline.put", "pipeline.get"])
+def test_crash_mid_pipeline_streaming_build(tmp_path, point):
+    source, session, hs = _streaming_session(tmp_path)
+    faults.inject(point, crash=True, at_call=1)
+    crashed = False
+    try:
+        hs.create_index(session.parquet(source), IndexConfig("idx1", ["key"], ["value"]))
+    except CrashPoint:
+        crashed = True
+    finally:
+        faults.reset()
+    assert crashed, f"crash at {point} never fired (pipeline not exercised?)"
+    # The spill scratch dir must not survive the crash (the pipeline's
+    # stop flag unblocks every stage so the builder's cleanup runs).
+    leftovers = list((tmp_path / "sys").rglob("*.spill"))
+    assert not leftovers, f"spill scratch survived the crash: {leftovers}"
+    _assert_crash_consistent(tmp_path, source, "create", point)
+
+
+def test_transient_spill_read_fault_rolls_back(tmp_path):
+    """A persistent FaultError in the pipeline surfaces through the
+    builder (reader → sort stage re-raise), Action.run rolls back, and a
+    clean retry succeeds."""
+    source, session, hs = _streaming_session(tmp_path)
+    with faults.injected("spill.read"):
+        with pytest.raises(OSError):
+            hs.create_index(session.parquet(source), IndexConfig("idx1", ["key"], ["value"]))
+    assert not list((tmp_path / "sys").rglob("*.spill"))
+    hs.create_index(session.parquet(source), IndexConfig("idx1", ["key"], ["value"]))
+    session.enable_hyperspace()
+    _query_matches(session, source)
+
+
+def test_prefetch_fault_is_advisory(tmp_path):
+    """Injected failures at prefetch.issue must never fail a query — the
+    prefetcher counts the error and the executor's own read path serves
+    the data (the advisory contract of execution/prefetch.py)."""
+    from hyperspace_tpu.execution import prefetch
+    from hyperspace_tpu.obs import metrics as obs_metrics
+
+    source = _write_source(tmp_path / "src")
+    session = HyperspaceSession(system_path=str(tmp_path / "sys"), num_buckets=2)
+    hs = Hyperspace(session)
+    hs.create_index(session.parquet(source), IndexConfig("idx1", ["key"], ["value"]))
+    prefetch.reset()  # forget any issue history from the build-time session
+    session.enable_hyperspace()
+    with faults.injected("prefetch.issue"):
+        _query_matches(session, source)
+        prefetch.drain()
+    errors = obs_metrics.REGISTRY.get("io.prefetch.errors")
+    assert errors is not None and errors.value >= 1

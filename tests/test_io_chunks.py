@@ -1,0 +1,163 @@
+"""Chunked-read planning, footer cache, and prefetch (execution/io.py +
+execution/prefetch.py): the row-group chunk planner and reader gained a
+second caller (the query-tail prefetcher) and a third (the chunked cold
+read), so their edge cases are pinned here directly instead of only
+through the streaming build."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import stats
+from hyperspace_tpu.execution import io as hio
+
+
+def _write(path, n, cols=("a", "b"), row_group_size=None):
+    data = {}
+    rng = np.random.default_rng(n + 1)
+    for c in cols:
+        data[c] = rng.integers(0, 1000, n).astype(np.int64)
+    t = pa.table(data)
+    pq.write_table(t, path, row_group_size=row_group_size or max(n, 1))
+    return str(path)
+
+
+class TestChunkPlanning:
+    def test_empty_file_list(self):
+        assert hio.read_footers([]) == {}
+        assert hio.plan_row_group_chunks([], chunk_bytes=1024) == []
+        assert hio.estimate_uncompressed_bytes([]) == 0
+
+    def test_single_row_group_larger_than_budget(self, tmp_path):
+        """A row group above chunk_bytes still gets a chunk of its own
+        (each chunk holds at least one row group — the planner never
+        splits below row-group granularity)."""
+        f = _write(tmp_path / "big.parquet", 10_000)
+        chunks = hio.plan_row_group_chunks([f], chunk_bytes=16)
+        assert chunks == [[(f, 0)]]
+        got = hio.read_chunk(chunks[0])
+        assert got.num_rows == 10_000
+
+    def test_every_row_group_exactly_once(self, tmp_path):
+        f1 = _write(tmp_path / "a.parquet", 8_000, row_group_size=1_000)
+        f2 = _write(tmp_path / "b.parquet", 4_000, row_group_size=1_000)
+        est = hio.estimate_uncompressed_bytes([f1, f2])
+        chunks = hio.plan_row_group_chunks([f1, f2], chunk_bytes=est // 6)
+        units = [u for c in chunks for u in c]
+        assert len(units) == len(set(units)) == 12
+        total = sum(hio.read_chunk(c).num_rows for c in chunks)
+        assert total == 12_000
+
+    def test_zero_row_file_contributes_nothing(self, tmp_path):
+        fz = str(tmp_path / "zero.parquet")
+        pq.write_table(pa.table({"a": pa.array([], type=pa.int64()),
+                                 "b": pa.array([], type=pa.int64())}), fz)
+        f = _write(tmp_path / "real.parquet", 500)
+        chunks = hio.plan_row_group_chunks([fz, f], chunk_bytes=1 << 20)
+        rows = sum(hio.read_chunk(c).num_rows for c in chunks)
+        assert rows == 500
+
+    def test_column_missing_from_one_file_null_fills(self, tmp_path):
+        """Schema skew: a column absent from one file is skipped for
+        that file and null-filled by the promoting concat — the contract
+        the prefetcher relies on to probe any file without raising."""
+        f1 = _write(tmp_path / "full.parquet", 100, cols=("a", "b"))
+        f2 = _write(tmp_path / "narrow.parquet", 50, cols=("a",))
+        chunks = hio.plan_row_group_chunks([f1, f2], chunk_bytes=1 << 30, columns=["a", "b"])
+        assert len(chunks) == 1
+        t = hio.read_chunk(chunks[0], columns=["a", "b"])
+        assert t.num_rows == 150
+        assert t.column("b").null_count == 50
+
+
+class TestFooterCache:
+    def test_hits_and_mtime_invalidation(self, tmp_path):
+        f = _write(tmp_path / "x.parquet", 200)
+        hio.clear_footer_cache()
+        h0, m0 = stats.get("io.footer_cache.hits"), stats.get("io.footer_cache.misses")
+        hio.read_footers([f])
+        assert stats.get("io.footer_cache.misses") == m0 + 1
+        md = hio.read_footers([f])[f]
+        assert stats.get("io.footer_cache.hits") == h0 + 1
+        assert md.num_rows == 200
+        # Rewrite the file: the stale entry must not serve.
+        import os
+
+        _write(tmp_path / "x.parquet", 300)
+        os.utime(f, ns=(1, 1))  # force a distinct mtime even on coarse clocks
+        md = hio.read_footers([f])[f]
+        assert md.num_rows == 300
+        assert stats.get("io.footer_cache.misses") == m0 + 2
+
+    def test_consumers_share_one_parse(self, tmp_path):
+        f = _write(tmp_path / "y.parquet", 400, row_group_size=100)
+        hio.clear_footer_cache()
+        m0 = stats.get("io.footer_cache.misses")
+        est = hio.estimate_uncompressed_bytes([f])
+        hio.plan_row_group_chunks([f], chunk_bytes=est)
+        hio.read_footers([f])
+        assert stats.get("io.footer_cache.misses") == m0 + 1
+
+
+class TestChunkedColdRead:
+    def test_matches_per_file_read(self, tmp_path, monkeypatch):
+        """The row-group-parallel cold read must return exactly what the
+        serial per-file path returns (same rows, same order)."""
+        f1 = _write(tmp_path / "p1.parquet", 6_000, row_group_size=500)
+        f2 = _write(tmp_path / "p2.parquet", 3_000, row_group_size=500)
+        expected = hio.read_parquet([f1, f2])  # below threshold: per-file path
+        monkeypatch.setattr(hio, "_CHUNKED_READ_MIN_BYTES", 1)
+        got = hio.read_parquet([f1, f2])
+        assert got.num_rows == expected.num_rows
+        for name in expected.columns:
+            np.testing.assert_array_equal(got.columns[name], expected.columns[name])
+
+
+class TestPrefetch:
+    def test_issues_once_per_file_version(self, tmp_path):
+        from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col
+        from hyperspace_tpu.execution import prefetch
+        from hyperspace_tpu.obs import metrics as obs_metrics
+
+        root = tmp_path / "src"
+        root.mkdir()
+        _write(root / "p0.parquet", 4_000, cols=("k", "v"))
+        session = HyperspaceSession(system_path=str(tmp_path / "sys"), num_buckets=4)
+        hs = Hyperspace(session)
+        df = session.parquet(root)
+        hs.create_index(df, IndexConfig("i1", ["k"], ["v"]))
+        prefetch.reset()
+        session.enable_hyperspace()
+        issued = obs_metrics.REGISTRY.get("io.prefetch.issued")
+        base = issued.value
+        q = df.filter(col("k") == 7).select("k", "v")
+        session.run(q)
+        prefetch.drain()
+        first = issued.value - base
+        assert first >= 1  # the pruned bucket file was prefetched
+        session.run(q)
+        prefetch.drain()
+        assert issued.value - base == first  # dedup: unchanged files re-issue nothing
+
+    def test_disabled_by_config(self, tmp_path):
+        from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col
+        from hyperspace_tpu.config import SCAN_PREFETCH_ENABLED
+        from hyperspace_tpu.execution import prefetch
+        from hyperspace_tpu.obs import metrics as obs_metrics
+
+        root = tmp_path / "src"
+        root.mkdir()
+        _write(root / "p0.parquet", 2_000, cols=("k", "v"))
+        session = HyperspaceSession(system_path=str(tmp_path / "sys"), num_buckets=2)
+        session.conf.set(SCAN_PREFETCH_ENABLED, False)
+        hs = Hyperspace(session)
+        df = session.parquet(root)
+        hs.create_index(df, IndexConfig("i1", ["k"], ["v"]))
+        prefetch.reset()
+        session.enable_hyperspace()
+        issued = obs_metrics.REGISTRY.get("io.prefetch.issued")
+        base = issued.value
+        session.run(df.filter(col("k") == 3).select("k", "v"))
+        prefetch.drain()
+        assert issued.value == base

@@ -80,6 +80,57 @@ def test_streaming_build_matches_in_memory(tmp_path, key):
         pd.testing.assert_frame_equal(df1, df2)
 
 
+def test_pipelined_build_matches_serial_byte_for_byte(tmp_path):
+    """The pipelined streaming build must be indistinguishable from the
+    serial two-phase reference on disk: identical manifest AND identical
+    bucket-file BYTES (same spill content, same per-bucket stable sort,
+    same deterministic parquet encode) — the bench.py --smoke invariant,
+    pinned here at test scale."""
+    _gen_source(tmp_path / "src", n=24_000, files=3, row_group_size=2_000)
+    ds = Dataset.parquet(tmp_path / "src")
+    num_buckets = 16
+    mesh = make_mesh()
+    kw = dict(mesh=mesh, memory_budget_bytes=50_000, chunk_bytes=80_000)
+
+    serial = DeviceIndexBuilder(pipeline_enabled=False, **kw)
+    d_serial = tmp_path / "idx_serial" / "v__=0"
+    serial.write(ds.scan(), ["k", "s", "v"], ["k"], num_buckets, d_serial)
+    assert serial.last_build_stats["path"] == "streaming"
+    assert "pipeline" not in serial.last_build_stats
+
+    pipe = DeviceIndexBuilder(pipeline_enabled=True, **kw)
+    d_pipe = tmp_path / "idx_pipe" / "v__=0"
+    pipe.write(ds.scan(), ["k", "s", "v"], ["k"], num_buckets, d_pipe)
+    assert pipe.last_build_stats["path"] == "streaming"
+    pinfo = pipe.last_build_stats["pipeline"]
+    assert pinfo["window_bytes"] > 0 and 0.0 <= pinfo["occupancy"] <= 1.0
+    assert not (d_pipe.parent / "v__=0.spill").exists()
+
+    assert hio.read_manifest(d_serial) == hio.read_manifest(d_pipe)
+    for b in range(num_buckets):
+        s_bytes = (d_serial / hio.bucket_file_name(b)).read_bytes()
+        p_bytes = (d_pipe / hio.bucket_file_name(b)).read_bytes()
+        assert s_bytes == p_bytes, f"bucket {b} bytes differ serial vs pipelined"
+
+
+def test_pipeline_window_of_one_bucket_still_completes(tmp_path):
+    """A window smaller than any single bucket must admit buckets one at
+    a time (never deadlock) and still produce the identical index."""
+    _gen_source(tmp_path / "src", n=6_000, files=2, row_group_size=1_000)
+    ds = Dataset.parquet(tmp_path / "src")
+    mesh = make_mesh()
+    kw = dict(mesh=mesh, memory_budget_bytes=20_000, chunk_bytes=30_000)
+    serial = DeviceIndexBuilder(pipeline_enabled=False, **kw)
+    d1 = tmp_path / "i1" / "v__=0"
+    serial.write(ds.scan(), ["k", "v"], ["k"], 4, d1)
+    tiny = DeviceIndexBuilder(pipeline_enabled=True, pipeline_max_inflight_bytes=1, **kw)
+    d2 = tmp_path / "i2" / "v__=0"
+    tiny.write(ds.scan(), ["k", "v"], ["k"], 4, d2)
+    assert hio.read_manifest(d1) == hio.read_manifest(d2)
+    for b in range(4):
+        assert (d1 / hio.bucket_file_name(b)).read_bytes() == (d2 / hio.bucket_file_name(b)).read_bytes()
+
+
 def test_streamed_index_serves_queries(tmp_path):
     """End-to-end: an index built out-of-core answers rewritten queries
     identically to the raw scan."""
