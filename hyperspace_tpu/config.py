@@ -111,6 +111,28 @@ RETRY_CAS_ATTEMPTS = "hyperspace.retry.casAttempts"
 FALLBACK_ENABLED = "hyperspace.fallback.enabled"
 RECOVER_ON_ACCESS = "hyperspace.recover.onAccess"
 RECOVER_GRACE_SECONDS = "hyperspace.recover.graceSeconds"
+# Workload-driven index advisor (docs/advisor.md). routing.* gate the
+# adaptive query router: a per-plan-signature ledger of measured indexed
+# vs raw wall times that demotes rewrites which measured slower
+# (advisor/routing.py) — off by default because it changes plan choice.
+# workload.maxRecords bounds the in-memory workload ring the what-if
+# analyzer learns from. lifecycle.* gate the autonomous policy engine
+# (advisor/lifecycle.py): all three default off — the advisor observes
+# by default and acts only on explicit opt-in; minConfidence /
+# minBenefitSeconds are the evidence floor any auto-applied
+# recommendation must clear; lifecycle.maxDeltas is the fragmentation
+# threshold past which an optimize recommendation fires.
+ADVISOR_ROUTING_ENABLED = "hyperspace.advisor.routing.enabled"
+ADVISOR_ROUTING_DEMOTE_RATIO = "hyperspace.advisor.routing.demoteRatio"
+ADVISOR_ROUTING_ALPHA = "hyperspace.advisor.routing.alpha"
+ADVISOR_ROUTING_MIN_SAMPLES = "hyperspace.advisor.routing.minSamples"
+ADVISOR_WORKLOAD_MAX_RECORDS = "hyperspace.advisor.workload.maxRecords"
+ADVISOR_AUTO_CREATE = "hyperspace.advisor.lifecycle.autoCreate"
+ADVISOR_AUTO_VACUUM = "hyperspace.advisor.lifecycle.autoVacuum"
+ADVISOR_AUTO_OPTIMIZE = "hyperspace.advisor.lifecycle.autoOptimize"
+ADVISOR_LIFECYCLE_MAX_DELTAS = "hyperspace.advisor.lifecycle.maxDeltas"
+ADVISOR_MIN_CONFIDENCE = "hyperspace.advisor.minConfidence"
+ADVISOR_MIN_BENEFIT_SECONDS = "hyperspace.advisor.minBenefitSeconds"
 # Explain rendering (explain/display_mode.py re-exports these; declared
 # here so every hyperspace.* key lives in ONE registry — HSL010).
 EXPLAIN_DISPLAY_MODE = "hyperspace.explain.displayMode"
@@ -138,6 +160,12 @@ DEFAULT_SERVE_WORKERS = 4
 DEFAULT_SERVE_MAX_QUEUE_DEPTH = 32
 DEFAULT_SERVE_PLAN_CACHE_MAX_ENTRIES = 128
 DEFAULT_SERVE_RESULT_CACHE_MAX_BYTES = 256 << 20
+DEFAULT_ADVISOR_ROUTING_DEMOTE_RATIO = 1.0
+DEFAULT_ADVISOR_ROUTING_ALPHA = 0.5
+DEFAULT_ADVISOR_ROUTING_MIN_SAMPLES = 1
+DEFAULT_ADVISOR_WORKLOAD_MAX_RECORDS = 512
+DEFAULT_ADVISOR_LIFECYCLE_MAX_DELTAS = 4
+DEFAULT_ADVISOR_MIN_CONFIDENCE = 0.5
 
 
 @dataclasses.dataclass(frozen=True)
@@ -327,6 +355,53 @@ KNOWN_KEYS: dict[str, ConfKey] = {
         "256 MiB",
         "Result-cache byte budget; LRU eviction past it, no single entry above "
         "a quarter of it."),
+    ADVISOR_ROUTING_ENABLED: ConfKey(
+        "false",
+        "Adaptive query routing ([advisor.md](advisor.md)): a per-plan-"
+        "signature ledger of measured indexed vs raw wall times demotes "
+        "rewrites that measured slower to source scans. Changes plan choice, "
+        "so explicit opt-in; the ledger invalidates structurally on any index "
+        "mutation."),
+    ADVISOR_ROUTING_DEMOTE_RATIO: ConfKey(
+        "1.0",
+        "Demotion threshold: a signature routes raw once its indexed EMA "
+        "exceeds ratio x its raw EMA (both sides sampled)."),
+    ADVISOR_ROUTING_ALPHA: ConfKey(
+        "0.5",
+        "EMA smoothing of the routing ledger's wall-time estimates (higher = "
+        "newer samples dominate)."),
+    ADVISOR_ROUTING_MIN_SAMPLES: ConfKey(
+        "1",
+        "Evidence floor: both the indexed and raw path need at least this "
+        "many samples before a signature can be demoted."),
+    ADVISOR_WORKLOAD_MAX_RECORDS: ConfKey(
+        "512",
+        "Bound of the in-memory per-session workload ring the what-if "
+        "analyzer learns from; old traffic ages out."),
+    ADVISOR_AUTO_CREATE: ConfKey(
+        "false",
+        "Lifecycle gate: let `LifecyclePolicy.sweep()` build recommended "
+        "indexes autonomously (crash-safe through the normal create action)."),
+    ADVISOR_AUTO_VACUUM: ConfKey(
+        "false",
+        "Lifecycle gate: let the sweep delete+vacuum indexes the observed "
+        "workload never touched."),
+    ADVISOR_AUTO_OPTIMIZE: ConfKey(
+        "false",
+        "Lifecycle gate: let the sweep compact indexes fragmented past "
+        "`hyperspace.advisor.lifecycle.maxDeltas`."),
+    ADVISOR_LIFECYCLE_MAX_DELTAS: ConfKey(
+        "4",
+        "Fragmentation threshold: an index spanning more version dirs than "
+        "this earns an optimize recommendation."),
+    ADVISOR_MIN_CONFIDENCE: ConfKey(
+        "0.5",
+        "Policy floor: recommendations below this confidence are reported "
+        "but never auto-applied."),
+    ADVISOR_MIN_BENEFIT_SECONDS: ConfKey(
+        "0",
+        "Policy floor: recommendations whose estimated benefit is below this "
+        "many seconds are reported but never auto-applied."),
 }
 
 
@@ -388,6 +463,17 @@ class HyperspaceConf:
     serve_plan_cache_max_entries: int = DEFAULT_SERVE_PLAN_CACHE_MAX_ENTRIES
     serve_result_cache_enabled: bool = False  # opt-in: results pin host memory
     serve_result_cache_max_bytes: int = DEFAULT_SERVE_RESULT_CACHE_MAX_BYTES
+    advisor_routing_enabled: bool = False  # opt-in: routing changes plan choice
+    advisor_routing_demote_ratio: float = DEFAULT_ADVISOR_ROUTING_DEMOTE_RATIO
+    advisor_routing_alpha: float = DEFAULT_ADVISOR_ROUTING_ALPHA
+    advisor_routing_min_samples: int = DEFAULT_ADVISOR_ROUTING_MIN_SAMPLES
+    advisor_workload_max_records: int = DEFAULT_ADVISOR_WORKLOAD_MAX_RECORDS
+    advisor_auto_create: bool = False
+    advisor_auto_vacuum: bool = False
+    advisor_auto_optimize: bool = False
+    advisor_lifecycle_max_deltas: int = DEFAULT_ADVISOR_LIFECYCLE_MAX_DELTAS
+    advisor_min_confidence: float = DEFAULT_ADVISOR_MIN_CONFIDENCE
+    advisor_min_benefit_seconds: float = 0.0
     overrides: dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def __post_init__(self):
@@ -455,6 +541,28 @@ class HyperspaceConf:
             self.serve_result_cache_enabled = _as_bool(value)
         elif key == SERVE_RESULT_CACHE_MAX_BYTES:
             self.serve_result_cache_max_bytes = int(value)
+        elif key == ADVISOR_ROUTING_ENABLED:
+            self.advisor_routing_enabled = _as_bool(value)
+        elif key == ADVISOR_ROUTING_DEMOTE_RATIO:
+            self.advisor_routing_demote_ratio = float(value)
+        elif key == ADVISOR_ROUTING_ALPHA:
+            self.advisor_routing_alpha = float(value)
+        elif key == ADVISOR_ROUTING_MIN_SAMPLES:
+            self.advisor_routing_min_samples = int(value)
+        elif key == ADVISOR_WORKLOAD_MAX_RECORDS:
+            self.advisor_workload_max_records = int(value)
+        elif key == ADVISOR_AUTO_CREATE:
+            self.advisor_auto_create = _as_bool(value)
+        elif key == ADVISOR_AUTO_VACUUM:
+            self.advisor_auto_vacuum = _as_bool(value)
+        elif key == ADVISOR_AUTO_OPTIMIZE:
+            self.advisor_auto_optimize = _as_bool(value)
+        elif key == ADVISOR_LIFECYCLE_MAX_DELTAS:
+            self.advisor_lifecycle_max_deltas = int(value)
+        elif key == ADVISOR_MIN_CONFIDENCE:
+            self.advisor_min_confidence = float(value)
+        elif key == ADVISOR_MIN_BENEFIT_SECONDS:
+            self.advisor_min_benefit_seconds = float(value)
         elif key == FAULTS_ENABLED:
             # Process-global kill switch for the injection harness —
             # matches the process-global filesystem state it guards.
@@ -545,6 +653,28 @@ class HyperspaceConf:
             return self.serve_result_cache_enabled
         if key == SERVE_RESULT_CACHE_MAX_BYTES:
             return self.serve_result_cache_max_bytes
+        if key == ADVISOR_ROUTING_ENABLED:
+            return self.advisor_routing_enabled
+        if key == ADVISOR_ROUTING_DEMOTE_RATIO:
+            return self.advisor_routing_demote_ratio
+        if key == ADVISOR_ROUTING_ALPHA:
+            return self.advisor_routing_alpha
+        if key == ADVISOR_ROUTING_MIN_SAMPLES:
+            return self.advisor_routing_min_samples
+        if key == ADVISOR_WORKLOAD_MAX_RECORDS:
+            return self.advisor_workload_max_records
+        if key == ADVISOR_AUTO_CREATE:
+            return self.advisor_auto_create
+        if key == ADVISOR_AUTO_VACUUM:
+            return self.advisor_auto_vacuum
+        if key == ADVISOR_AUTO_OPTIMIZE:
+            return self.advisor_auto_optimize
+        if key == ADVISOR_LIFECYCLE_MAX_DELTAS:
+            return self.advisor_lifecycle_max_deltas
+        if key == ADVISOR_MIN_CONFIDENCE:
+            return self.advisor_min_confidence
+        if key == ADVISOR_MIN_BENEFIT_SECONDS:
+            return self.advisor_min_benefit_seconds
         if key == OBS_ENABLED:
             from hyperspace_tpu.obs import trace as _obs_trace
 

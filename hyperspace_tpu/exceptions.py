@@ -142,6 +142,20 @@ ERROR_CONTRACTS: dict[str, tuple[str, ...]] = {
     # recover-on-access fault points reachable from index listing.
     "hyperspace_tpu.hyperspace.Hyperspace.explain": _QUERY_SURFACE,
     "hyperspace_tpu.actions.base.Action.run": _QUERY_SURFACE,
+    # Advisor plane (docs/advisor.md). recommend() replays observed plans
+    # through the rules/validator (planner surface) and reads the index
+    # log; sweep() additionally executes lifecycle actions — individual
+    # apply failures are absorbed (recorded, sweep continues), but the
+    # recommendation pass, CrashPoint, and policy programming errors
+    # escape with the standard query surface.
+    "hyperspace_tpu.advisor.whatif.WhatIfAnalyzer.recommend": _QUERY_SURFACE,
+    # sweep absorbs per-apply Exceptions (recorded, the sweep continues),
+    # so the typed framework surface does not statically escape it — what
+    # remains is injected IO faults at advisor.* fault points, CrashPoint,
+    # and the programming-error surface.
+    "hyperspace_tpu.advisor.lifecycle.LifecyclePolicy.sweep": (
+        "OSError", "CrashPoint", "ValueError", "KeyError", "NotImplementedError",
+    ),
 }
 
 

@@ -733,7 +733,8 @@ def _agg_channels_cached(tbl: ColumnTable, spec):
     return dc.HOST_DERIVED.get_or_build(key, refs, build)
 
 
-def _factorize_keys_cached(lt: ColumnTable, rt: ColumnTable, lkeys, rkeys):
+def _factorize_keys_cached(lt: ColumnTable, rt: ColumnTable, lkeys, rkeys,
+                           null_safe: bool = False):
     """Pairwise key factorization memoized on the IDENTITY of every input
     it reads (key columns, dictionaries, validity) — valid only when all
     are stable (frozen index-cache arrays). Repeat joins over the same
@@ -744,13 +745,13 @@ def _factorize_keys_cached(lt: ColumnTable, rt: ColumnTable, lkeys, rkeys):
     lrefs, lparts = _stable_table_refs(lt, {k.lower() for k in lkeys})
     rrefs, rparts = _stable_table_refs(rt, {k.lower() for k in rkeys})
     if lrefs is None or rrefs is None:
-        lc, rc = _factorize_keys([lt], [rt], lkeys, rkeys)
+        lc, rc = _factorize_keys([lt], [rt], lkeys, rkeys, null_safe=null_safe)
         return lc[0], rc[0]
     refs = lrefs + rrefs
-    parts = (lparts, rparts)
+    parts = (lparts, rparts, null_safe)
 
     def build():
-        lc, rc = _factorize_keys([lt], [rt], lkeys, rkeys)
+        lc, rc = _factorize_keys([lt], [rt], lkeys, rkeys, null_safe=null_safe)
         out = (dc.freeze(lc[0]), dc.freeze(rc[0]))
         return out, int(lc[0].nbytes + rc[0].nbytes)
 
@@ -812,11 +813,18 @@ def _apply_null_codes(lcodes, rcodes, lnulls, rnulls):
     return lcodes, rcodes
 
 
-def _factorize_keys(ltables, rtables, lkeys, rkeys):
+def _factorize_keys(ltables, rtables, lkeys, rkeys, null_safe=False):
     """Map each partition's key tuples to a shared int32 rank-code space
     whose order matches the lexicographic order of the raw key tuples.
     int32 keeps the device merge-join kernels on native 32-bit lanes (TPU
-    emulates 64-bit); ranks always fit (bounded by total row count)."""
+    emulates 64-bit); ranks always fit (bounded by total row count).
+
+    `null_safe` switches the NULL treatment from SQL join equality (a
+    null-keyed row never matches — side-distinct negative codes) to SQL
+    set/IS NOT DISTINCT FROM equality: per key column, NULL becomes one
+    extra domain value SHARED across sides (code `len(uniq)`), so
+    (1, NULL) matches (1, NULL) but still not (1, 0) — the physical
+    zero/"" a null slot holds can no longer collide with a real value."""
     lnulls = [_key_null_mask(t, lkeys) for t in ltables]
     rnulls = [_key_null_mask(t, rkeys) for t in rtables]
     has_nulls = any(m is not None for m in lnulls + rnulls)
@@ -849,7 +857,24 @@ def _factorize_keys(ltables, rtables, lkeys, rkeys):
         rvals = [_logical_key(t, rname) for t in rtables]
         allv = np.concatenate(lvals + rvals) if (lvals or rvals) else np.array([])
         uniq, inv = np.unique(allv, return_inverse=True)
-        cards.append(max(len(uniq), 1))
+        card = max(len(uniq), 1)
+        if null_safe and has_nulls:
+            # NULL = one extra per-column domain value shared across
+            # sides, so the physical zero/"" a null slot holds cannot
+            # alias a real value of this column.
+            masks = [t.valid_mask(lname) for t in ltables] + [
+                t.valid_mask(rname) for t in rtables
+            ]
+            if any(m is not None for m in masks):
+                alln = np.concatenate([
+                    (~m if m is not None else np.zeros(len(v), dtype=bool))
+                    for m, v in zip(masks, lvals + rvals)
+                ])
+                if alln.any():
+                    inv = inv.copy()
+                    inv[alln] = len(uniq)
+                    card = len(uniq) + 1
+        cards.append(card)
         pos = 0
         for i, v in enumerate(lvals):
             per_col_codes_l[i].append(inv[pos : pos + len(v)])
@@ -880,12 +905,13 @@ def _factorize_keys(ltables, rtables, lkeys, rkeys):
     # Mixed-radix codes that provably fit int32 cast directly — no
     # re-rank pass needed (math.prod is exact, arbitrary precision).
     if math.prod(cards) < int32_max:
-        return _apply_null_codes(
-            [c.astype(np.int32) for c in lcomb],
-            [c.astype(np.int32) for c in rcomb],
-            lnulls,
-            rnulls,
-        )
+        lc = [c.astype(np.int32) for c in lcomb]
+        rc = [c.astype(np.int32) for c in rcomb]
+        if null_safe:
+            # NULLs are already real domain values in the codes — the
+            # never-match negative-code scheme must not touch them.
+            return lc, rc
+        return _apply_null_codes(lc, rc, lnulls, rnulls)
     # Otherwise re-rank the combined codes down to int32 (order preserved
     # by np.unique).
     allc = np.concatenate(lcomb + rcomb) if (lcomb or rcomb) else np.zeros(0, np.int64)
@@ -903,6 +929,8 @@ def _factorize_keys(ltables, rtables, lkeys, rkeys):
     for c in rcomb:
         out_r.append(inv[pos : pos + len(c)])
         pos += len(c)
+    if null_safe:
+        return out_l, out_r
     return _apply_null_codes(out_l, out_r, lnulls, rnulls)
 
 

@@ -172,11 +172,13 @@ class JoinMixin:
         O((n+m) log m) on host, no pair expansion, no device round-trip
         (the result is |L| bits the mask filter consumes on host anyway).
         Null-keyed rows carry side-distinct negative codes and never
-        match (SQL: NULL = NULL is not true), so anti keeps them."""
+        match (SQL: NULL = NULL is not true), so anti keeps them —
+        unless the join is null-safe (set-op desugar), where NULL is a
+        real per-column domain value and matches its twin."""
         lt, rt = lside.table, rside.table
         lkeys = [lt.schema.field(c).name for c in plan.left_on]
         rkeys = [rt.schema.field(c).name for c in plan.right_on]
-        lc0, rc0 = _factorize_keys_cached(lt, rt, lkeys, rkeys)
+        lc0, rc0 = _factorize_keys_cached(lt, rt, lkeys, rkeys, null_safe=plan.null_safe)
         lcodes = lc0.astype(np.int64)
         rcodes = rc0.astype(np.int64)
         b = len(lside.offsets) - 1
@@ -203,7 +205,9 @@ class JoinMixin:
         rkeys = [rt.schema.field(c).name for c in plan.right_on]
 
         # Shared order-preserving factorization of the key tuples.
-        lcodes, rcodes = _factorize_keys_cached(lt, rt, lkeys, rkeys)
+        lcodes, rcodes = _factorize_keys_cached(
+            lt, rt, lkeys, rkeys, null_safe=plan.null_safe
+        )
 
         b0 = len(lside.offsets) - 1
         if b0 == 1 and self._should_broadcast(lt.num_rows, rt.num_rows):

@@ -89,7 +89,10 @@ class FusedJoinAggMixin:
 
         lkeys = [data["left"].table.schema.field(c).name for c in join.left_on]
         rkeys = [data["right"].table.schema.field(c).name for c in join.right_on]
-        lc0, rc0 = _factorize_keys_cached(data["left"].table, data["right"].table, lkeys, rkeys)
+        lc0, rc0 = _factorize_keys_cached(
+            data["left"].table, data["right"].table, lkeys, rkeys,
+            null_safe=join.null_safe,
+        )
         codes = {}
         perms = {}
         regroup_venue = self._venue(

@@ -123,12 +123,28 @@ class ColumnTable:
                 enc = arr if pa.types.is_dictionary(arr.type) else pc.dictionary_encode(arr)
                 if isinstance(enc, pa.ChunkedArray):
                     enc = enc.combine_chunks() if enc.num_chunks != 1 else enc.chunk(0)
-                dvals = enc.dictionary.to_numpy(zero_copy_only=False)
+                dict_arr = enc.dictionary
+                dict_null = None
+                if dict_arr.null_count:
+                    # Arrow permits nulls IN the dictionary (entry-level
+                    # nulls): rows referencing such an entry are logically
+                    # NULL but invisible to the top-level null_count above.
+                    # Fill the entry before the str cast (np.asarray would
+                    # bake the literal string 'None') and fold the
+                    # referencing rows into the validity mask below.
+                    dict_null = ~np.asarray(pc.is_valid(dict_arr))
+                    dict_arr = pc.fill_null(dict_arr, "")
+                dvals = dict_arr.to_numpy(zero_copy_only=False)
                 svals = np.asarray(dvals, dtype=str)
                 idx = enc.indices
                 if idx.null_count:
                     idx = pc.fill_null(idx, 0)
                 codes0 = np.asarray(idx).astype(np.int64, copy=False)
+                if dict_null is not None and dict_null.any():
+                    row_null = dict_null[codes0]
+                    if row_null.any():
+                        valid = ~row_null if valid is None else (valid & ~row_null)
+                        validity[f.name] = valid
                 if valid is not None and not (svals == "").any():
                     # Null slots take the deterministic "" value (added to
                     # the dictionary when absent), as the decode always has.

@@ -254,6 +254,23 @@ def explain_string(
     from hyperspace_tpu.plan.prune import prune_columns
     from hyperspace_tpu.plan.pushdown import push_down_filters
 
+    # Adaptive-routing verdict (docs/advisor.md): keyed on the ORIGINAL
+    # plan's signature, exactly as run_query keys the ledger.
+    routing_line = None
+    conf = getattr(session, "conf", None)
+    if conf is not None and getattr(conf, "advisor_routing_enabled", False):
+        from hyperspace_tpu.signature import plan_signature
+
+        demoted = plan_signature(plan) in set(
+            session.routing_ledger().demoted_signatures()
+        )
+        routing_line = (
+            "Adaptive routing: raw (indexed path measured slower; the "
+            "rewrite below would NOT run)"
+            if demoted
+            else "Adaptive routing: indexed"
+        )
+
     was_enabled = session.is_hyperspace_enabled()
     try:
         session.enable_hyperspace()
@@ -281,6 +298,9 @@ def explain_string(
     out.append("Indexes used:")
     for name in _used_indexes(with_plan, session):
         out.append(f"  {name}")
+    if routing_line is not None:
+        out.append("=" * 64)
+        out.append(routing_line)
     if verbose:
         cb = _operator_counts(plan)
         ca = _operator_counts(with_plan)

@@ -48,6 +48,8 @@ Fault point names in use (see each call site):
 ``pipeline.put``      builder, before a read bucket enters the sort queue
 ``pipeline.get``      builder, before the sort stage dequeues a bucket
 ``prefetch.issue``    execution/prefetch.py, before an async prefetch job
+``advisor.recommend`` advisor/whatif.py, at the head of a recommendation pass
+``advisor.apply``     advisor/lifecycle.py, before each policy mutation
 ====================  =====================================================
 """
 
@@ -79,6 +81,8 @@ KNOWN_POINTS = (
     "pipeline.put",
     "pipeline.get",
     "prefetch.issue",
+    "advisor.recommend",
+    "advisor.apply",
 )
 
 

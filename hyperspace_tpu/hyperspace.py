@@ -112,6 +112,11 @@ class HyperspaceSession:
         # Mutations go through _state_lock; per-query snapshots keep one
         # query's replan decisions consistent.
         self.index_health: dict[str, dict] = {}
+        # Advisor plane (docs/advisor.md): the bounded workload ring every
+        # run_query appends to, and the adaptive-routing ledger. Both lazy
+        # (constructed under _state_lock on first use).
+        self._workload = None
+        self._routing = None
 
     # -- rule toggle (package.scala:46-70) --------------------------------
     def enable_hyperspace(self) -> "HyperspaceSession":
@@ -148,6 +153,32 @@ class HyperspaceSession:
 
                     self._manager = CachingIndexCollectionManager(self.conf, writer_factory)
         return self._manager
+
+    @property
+    def workload(self):
+        """The session's bounded workload log (docs/advisor.md): one
+        :class:`~hyperspace_tpu.advisor.workload.WorkloadRecord` per
+        run_query, the advisor's learning input."""
+        if self._workload is None:
+            with self._state_lock:
+                if self._workload is None:
+                    from hyperspace_tpu.advisor.workload import WorkloadLog
+
+                    self._workload = WorkloadLog(self.conf.advisor_workload_max_records)
+        return self._workload
+
+    def routing_ledger(self):
+        """The adaptive-routing outcome ledger (advisor/routing.py);
+        constructed lazily — sessions that never enable
+        ``hyperspace.advisor.routing.enabled`` still get a readable view
+        of it for reports."""
+        if self._routing is None:
+            with self._state_lock:
+                if self._routing is None:
+                    from hyperspace_tpu.advisor.routing import RoutingLedger
+
+                    self._routing = RoutingLedger(self)
+        return self._routing
 
     @property
     def last_build_stats(self) -> dict:
@@ -236,9 +267,29 @@ class HyperspaceSession:
         from hyperspace_tpu.obs import profile as obs_profile
         from hyperspace_tpu.obs import trace as obs_trace
 
+        from hyperspace_tpu.signature import plan_signature
+
         cache_before = self._cache_counts(hio, device_cache)
         replans = 0
         use_indexes = True
+        # Advisor plane (docs/advisor.md): the plan's structural
+        # signature keys both the workload record and the routing
+        # ledger. Adaptive routing (opt-in) consults measured history
+        # BEFORE planning: a signature whose indexed path measured
+        # slower than raw is demoted to a straight source scan.
+        sig = plan_signature(plan)
+        routing_on = self.conf.advisor_routing_enabled
+        routed = routing_stamp = ledger = None
+        if routing_on:
+            from hyperspace_tpu.advisor import routing as adv_routing
+
+            ledger = self.routing_ledger()
+            routing_stamp = adv_routing.collection_stamp(self)
+            if self._enabled:
+                routed = ledger.decide(sig, stamp=routing_stamp)
+                if routed == "raw":
+                    use_indexes = False
+                    obs_trace.event("advisor.routing.demoted", signature=sig)
         t_start = time.perf_counter()
         with obs_trace.trace("query") as root_span:
             while True:
@@ -294,6 +345,15 @@ class HyperspaceSession:
         query_stats = executor.stats
         if degraded:
             query_stats["degraded_indexes"] = degraded
+        if routing_on and ledger is not None:
+            # Fold the measured outcome back into the ledger (EMA per
+            # signature per mode) — the demotion evidence of future runs.
+            mode = "indexed" if (self._enabled and use_indexes) else "raw"
+            ledger.record(sig, mode, total_s, stamp=routing_stamp)
+            query_stats["advisor_routing"] = {
+                "decision": mode,
+                "demoted": routed == "raw",
+            }
         cache_after = self._cache_counts(hio, device_cache)
         profile = obs_profile.build_profile(
             total_s=total_s,
@@ -308,6 +368,18 @@ class HyperspaceSession:
             },
             trace_root=root_span if isinstance(root_span, obs_trace.Span) else None,
         )
+        from hyperspace_tpu.advisor.workload import WorkloadRecord, used_index_names
+
+        self.workload.record(WorkloadRecord(
+            signature=sig,
+            plan=plan,
+            total_s=total_s,
+            bytes_scanned=int(query_stats.get("bytes_scanned", 0) or 0),
+            used_indexes=use_indexes and self._enabled,
+            index_names=used_index_names(optimized),
+            profile=profile,
+            routed=routed,
+        ))
         return QueryOutcome(
             result=result,
             stats=query_stats,
@@ -450,6 +522,25 @@ class Hyperspace:
 
     def indexes(self):
         return self.session.manager.indexes()
+
+    # -- advisor (docs/advisor.md) ----------------------------------------
+    def recommend(self):
+        """Ranked create/drop/rebucket/optimize recommendations for the
+        session's observed workload — the what-if analyzer replaying
+        recorded plans through the real rewrite rules against
+        hypothetical indexes. Pure analysis; nothing is mutated."""
+        from hyperspace_tpu.advisor.whatif import WhatIfAnalyzer
+
+        return WhatIfAnalyzer(self.session).recommend()
+
+    def lifecycle(self):
+        """The autonomous lifecycle policy engine over this API
+        (advisor/lifecycle.py). All its gates
+        (`hyperspace.advisor.lifecycle.*`) default off — construct it and
+        call `.sweep()` after opting in."""
+        from hyperspace_tpu.advisor.lifecycle import LifecyclePolicy
+
+        return LifecyclePolicy(self)
 
     def explain(
         self,

@@ -27,6 +27,15 @@ class IndexConfig:
     def _validate(self) -> None:
         if not self.index_name.strip():
             raise HyperspaceError("index name cannot be empty")
+        if self.index_name.strip().startswith("_"):
+            # Underscore-prefixed directories under the system path are
+            # metadata-plane state (the advisor ledger dir), invisible to
+            # the catalog listing — an index named that way could never
+            # be found again.
+            raise HyperspaceError(
+                f"index name {self.index_name!r} cannot start with '_' "
+                "(reserved for metadata directories)"
+            )
         if not self.indexed_columns:
             raise HyperspaceError("indexed columns cannot be empty")
         low_indexed = [c.lower() for c in self.indexed_columns]

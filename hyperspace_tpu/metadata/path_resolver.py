@@ -36,7 +36,15 @@ class PathResolver:
         return root / name
 
     def list_index_paths(self) -> list[Path]:
+        """Every index directory under the system path. Underscore-
+        prefixed directories are metadata-plane state, not indexes
+        (`_hyperspace_log` inside an index dir set the convention; the
+        advisor's `_advisor/` ledger dir lives at THIS level), so they
+        are excluded — listing one as an index would make lazy recovery
+        try to "repair" it on every catalog scan."""
         root = self.system_path
         if not root.is_dir():
             return []
-        return sorted(d for d in root.iterdir() if d.is_dir())
+        return sorted(
+            d for d in root.iterdir() if d.is_dir() and not d.name.startswith("_")
+        )

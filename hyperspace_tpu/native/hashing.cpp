@@ -185,8 +185,17 @@ void hs_md5_prefix(const uint8_t* bytes, const int64_t* offsets, uint32_t* out,
 // dst[i, :] = src[idx[i], :] for row_bytes-wide rows (any dtype/2D shape).
 void hs_take_rows(const uint8_t* src, uint8_t* dst, const int64_t* idx,
                   int64_t n_idx, int64_t row_bytes) {
+  // The fixed-width fast paths reinterpret src/dst as wider lanes, which
+  // is UB (and a SIGBUS on strict-alignment targets) unless both base
+  // pointers are aligned to the lane width. Callers normally pass
+  // allocator-aligned numpy buffers, but sliced/offset views can start
+  // anywhere — route those through the memcpy loop.
+  const bool aligned =
+      row_bytes <= 1 ||
+      (reinterpret_cast<uintptr_t>(src) % static_cast<uintptr_t>(row_bytes) == 0 &&
+       reinterpret_cast<uintptr_t>(dst) % static_cast<uintptr_t>(row_bytes) == 0);
   parallel_for(n_idx, 1 << 14, [&](int64_t lo, int64_t hi) {
-    switch (row_bytes) {
+    switch (aligned ? row_bytes : int64_t{0}) {
       case 1:
         take_fixed(src, dst, idx, lo, hi);
         break;

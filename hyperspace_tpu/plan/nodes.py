@@ -129,17 +129,18 @@ class LogicalPlan:
         """SQL INTERSECT (set semantics, positional columns like the
         reference round-trips via Catalyst's Intersect node,
         LogicalPlanSerDeUtils.scala:82-145): distinct left rows that also
-        appear in `other`. Desugars to DISTINCT + SEMI JOIN on every
-        column — so rows whose compared columns contain NULL follow the
-        engine's join NULL semantics (never equal) rather than SQL's
-        null-safe set comparison."""
+        appear in `other`. Desugars to DISTINCT + NULL-SAFE SEMI JOIN on
+        every column: set comparison treats NULL as equal to NULL (SQL's
+        IS NOT DISTINCT FROM), so a NULL-bearing row intersects with its
+        NULL-bearing twin — unlike the engine's ordinary join semantics
+        where NULL never equals anything."""
         return self._set_op(other, "semi")
 
     def except_(self, other: "LogicalPlan") -> "Join":
         """SQL EXCEPT: distinct left rows absent from `other`. Desugars
-        to DISTINCT + ANTI JOIN on every column (same NULL caveat as
-        intersect: left NULL-bearing rows never match, so they are
-        kept)."""
+        to DISTINCT + NULL-SAFE ANTI JOIN on every column (same NULL
+        semantics as intersect: a left NULL-bearing row is removed when
+        `other` holds an identical NULL-bearing row)."""
         return self._set_op(other, "anti")
 
     def _set_op(self, other: "LogicalPlan", how: str) -> "Join":
@@ -156,8 +157,9 @@ class LogicalPlan:
                     f"set operation column types are incompatible: "
                     f"{lf.name} ({lf.dtype}) vs {rf.name} ({rf.dtype})"
                 )
-        return self.distinct().join(
-            other, list(self.schema.names), list(other.schema.names), how=how
+        return Join(
+            self.distinct(), other, list(self.schema.names),
+            list(other.schema.names), how, null_safe=True,
         )
 
     def distinct(self) -> "Aggregate":
@@ -361,6 +363,12 @@ class Join(LogicalPlan):
     # pairs. Inner joins filter; outer/semi/anti joins treat a failing
     # pair as NO MATCH (null-extension / existence semantics).
     condition: Expr | None = None
+    # NULL-safe key equality (SQL IS NOT DISTINCT FROM): NULL matches
+    # NULL per key column instead of never matching. The set operations
+    # (intersect/except_) desugar with this on; the key factorization
+    # gives NULL its own code-domain value per column, shared across
+    # sides (execution/exec_common.py).
+    null_safe: bool = False
 
     def __post_init__(self):
         if len(self.left_on) != len(self.right_on):
@@ -427,6 +435,10 @@ class Join(LogicalPlan):
         }
         if self.condition is not None:
             d["condition"] = self.condition.to_json()
+        if self.null_safe:
+            # Emitted only when set, so pre-existing plan signatures and
+            # logged lineage stay byte-identical for ordinary joins.
+            d["nullSafe"] = True
         return d
 
 
@@ -764,6 +776,7 @@ def plan_from_json(d: dict[str, Any]) -> LogicalPlan:
             list(d["rightOn"]),
             d.get("how", "inner"),
             condition=expr_from_json(d["condition"]) if "condition" in d else None,
+            null_safe=bool(d.get("nullSafe", False)),
         )
     if t == "aggregate":
         gs = d.get("groupingSets")

@@ -61,7 +61,7 @@ def push_down_filters(plan: LogicalPlan) -> LogicalPlan:
                 )
                 out: LogicalPlan = Join(
                     new_left, new_right, child.left_on, child.right_on, child.how,
-                    condition=child.condition,
+                    condition=child.condition, null_safe=child.null_safe,
                 )
                 return Filter(out, _conjoin(residual)) if residual else out
         return Filter(child, plan.predicate)

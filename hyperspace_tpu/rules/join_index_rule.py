@@ -91,6 +91,7 @@ class JoinIndexRule(Rule):
                 plan.right_on,
                 plan.how,
                 condition=plan.condition,
+                null_safe=plan.null_safe,
             )
             return new
         if isinstance(plan, Project):
@@ -152,12 +153,12 @@ class JoinIndexRule(Rule):
                 new_left = _replace_scan(plan.left, self._side_plan(best_l, lscan))
                 return Join(new_left, self._rewrite(plan.right, indexes, matcher),
                             plan.left_on, plan.right_on, plan.how,
-                            condition=plan.condition)
+                            condition=plan.condition, null_safe=plan.null_safe)
             m = best_r
             new_right = _replace_scan(plan.right, self._side_plan(m, rscan))
             return Join(self._rewrite(plan.left, indexes, matcher), new_right,
                         plan.left_on, plan.right_on, plan.how,
-                        condition=plan.condition)
+                        condition=plan.condition, null_safe=plan.null_safe)
         best_l, best_r = JoinIndexRanker.rank(
             [(lm.entry, rm.entry) for lm, rm in pairs],
         )[0]
@@ -167,7 +168,7 @@ class JoinIndexRule(Rule):
         new_left = _replace_scan(plan.left, self._side_plan(lmatch, lscan))
         new_right = _replace_scan(plan.right, self._side_plan(rmatch, rscan))
         return Join(new_left, new_right, plan.left_on, plan.right_on, plan.how,
-                    condition=plan.condition)
+                    condition=plan.condition, null_safe=plan.null_safe)
 
     @staticmethod
     def _side_plan(match, scan: Scan) -> LogicalPlan:

@@ -3,6 +3,11 @@
 Reference parity: index/rankers/JoinIndexRanker.scala:24-56 — prefer pairs
 with EQUAL bucket counts (zero-exchange join), then larger bucket counts
 (more parallelism).
+
+The advisor's what-if analyzer (advisor/whatif.py) replays hypothetical
+index pairs through the same :meth:`JoinIndexRanker.score`, so a
+re-bucket recommendation is justified by exactly the criterion the real
+rewrite will rank by — not a parallel reimplementation that could drift.
 """
 
 from __future__ import annotations
@@ -12,10 +17,14 @@ from hyperspace_tpu.metadata.log_entry import IndexLogEntry
 
 class JoinIndexRanker:
     @staticmethod
-    def rank(pairs: list[tuple[IndexLogEntry, IndexLogEntry]]) -> list[tuple[IndexLogEntry, IndexLogEntry]]:
-        def score(pair):
-            l, r = pair
-            equal = l.num_buckets == r.num_buckets
-            return (0 if equal else 1, -(l.num_buckets + r.num_buckets))
+    def score(pair: tuple[IndexLogEntry, IndexLogEntry]) -> tuple[int, int]:
+        """Sort key of a candidate pair — smaller ranks first: equal
+        bucket counts beat unequal (the merge needs no re-bucketing
+        exchange), then more total buckets beat fewer (parallelism)."""
+        l, r = pair
+        equal = l.num_buckets == r.num_buckets
+        return (0 if equal else 1, -(l.num_buckets + r.num_buckets))
 
-        return sorted(pairs, key=score)
+    @staticmethod
+    def rank(pairs: list[tuple[IndexLogEntry, IndexLogEntry]]) -> list[tuple[IndexLogEntry, IndexLogEntry]]:
+        return sorted(pairs, key=JoinIndexRanker.score)

@@ -1,0 +1,535 @@
+"""Advisor subsystem tests (docs/advisor.md).
+
+Covers the three layers end to end: what-if recommendation correctness
+on a synthetic workload (hot predicate => create, never-hit index =>
+drop, fragmentation => optimize, mismatched join buckets => rebucket),
+adaptive-routing demotion / structural re-promotion on index mutation,
+the lifecycle crash sweep through the new ``advisor.recommend`` /
+``advisor.apply`` fault points, and cost-model monotonicity — plus the
+round-5 satellite regressions: null-safe set-op semantics and the Arrow
+dictionary-entry-null round trip.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col, faults
+from hyperspace_tpu.advisor.cost import CostModel
+from hyperspace_tpu.advisor.lifecycle import LifecyclePolicy
+from hyperspace_tpu.advisor.whatif import WhatIfAnalyzer
+from hyperspace_tpu.obs import metrics as obs_metrics
+from hyperspace_tpu.signature import plan_signature
+
+
+@pytest.fixture
+def session(tmp_system_path):
+    return HyperspaceSession(system_path=tmp_system_path, num_buckets=8)
+
+
+@pytest.fixture
+def hs(session):
+    return Hyperspace(session)
+
+
+def _write(tmp_path, name, table: pa.Table, parts: int = 1):
+    root = tmp_path / name
+    root.mkdir()
+    n = len(table)
+    step = max(1, n // parts)
+    for i in range(parts):
+        pq.write_table(table.slice(i * step, step), root / f"p{i}.parquet")
+    return root
+
+
+def _hot_table(tmp_path, n=20_000, seed=7):
+    rng = np.random.default_rng(seed)
+    return _write(tmp_path, "hot", pa.table({
+        "k": rng.integers(0, 500, n),
+        "v": rng.standard_normal(n),
+        "tag": pa.array([f"t{i % 37}" for i in range(n)]),
+    }), parts=2)
+
+
+def _cold_index(session, hs, tmp_path, name="coldidx"):
+    rng = np.random.default_rng(3)
+    root = _write(tmp_path, f"cold_{name}", pa.table({
+        "x": rng.integers(0, 9, 1000),
+        "y": rng.standard_normal(1000),
+    }))
+    hs.create_index(session.parquet(root), IndexConfig(name, ["x"], ["y"]))
+    return root
+
+
+# -- what-if -----------------------------------------------------------------
+
+class TestWhatIf:
+    def test_hot_predicate_earns_create_rec(self, session, hs, tmp_path):
+        root = _hot_table(tmp_path)
+        df = session.parquet(root)
+        session.enable_hyperspace()
+        for i in range(6):
+            session.run(df.filter(col("k") == (i * 17) % 500).select("k", "v"))
+        recs = hs.recommend()
+        creates = [r for r in recs if r.kind == "create"]
+        assert creates, [r.to_json() for r in recs]
+        rec = creates[0]
+        assert rec.source_root == str(root)
+        assert [c.lower() for c in rec.index_config.indexed_columns] == ["k"]
+        assert "v" in [c.lower() for c in rec.index_config.included_columns]
+        assert rec.estimated_benefit_s > 0
+        assert 0.0 < rec.confidence <= 1.0
+        assert rec.queries_matched == 6
+
+    def test_never_hit_index_earns_drop_rec(self, session, hs, tmp_path):
+        _cold_index(session, hs, tmp_path)
+        root = _hot_table(tmp_path)
+        df = session.parquet(root)
+        session.enable_hyperspace()
+        for i in range(4):
+            session.run(df.filter(col("k") == i).select("k", "v"))
+        recs = hs.recommend()
+        drops = [r for r in recs if r.kind == "drop"]
+        assert [r.index_name for r in drops] == ["coldidx"]
+        assert drops[0].estimated_benefit_s > 0
+
+    def test_empty_workload_never_recommends_drops(self, session, hs, tmp_path):
+        """With zero observed queries, "unused" is vacuous — a drop
+        recommendation would be destructive guesswork."""
+        _cold_index(session, hs, tmp_path)
+        assert hs.recommend() == []
+
+    def test_covered_predicate_earns_no_create_rec(self, session, hs, tmp_path):
+        """A predicate an existing index already serves must not yield a
+        duplicate create recommendation (the replay consults the real
+        catalog first)."""
+        root = _hot_table(tmp_path)
+        df = session.parquet(root)
+        hs.create_index(df, IndexConfig("kidx", ["k"], ["v"]))
+        session.enable_hyperspace()
+        for i in range(5):
+            session.run(df.filter(col("k") == i).select("k", "v"))
+        recs = hs.recommend()
+        assert not [r for r in recs if r.kind == "create"], [r.to_json() for r in recs]
+        # ... and the index that served the queries is not a drop target.
+        assert not [r for r in recs if r.kind == "drop"]
+
+    def test_fragmented_index_earns_optimize_rec(self, session, hs, tmp_path):
+        root = _hot_table(tmp_path, n=4000)
+        df = session.parquet(root)
+        hs.create_index(df, IndexConfig("fragidx", ["k"], ["v"]))
+        rng = np.random.default_rng(11)
+        for i in range(session.conf.advisor_lifecycle_max_deltas + 1):
+            pq.write_table(pa.table({
+                "k": rng.integers(0, 500, 200),
+                "v": rng.standard_normal(200),
+                "tag": pa.array([f"d{i}"] * 200),
+            }), root / f"delta{i}.parquet")
+            hs.refresh_index("fragidx", mode="incremental")
+        session.enable_hyperspace()
+        session.run(df.filter(col("k") == 1).select("k", "v"))
+        recs = hs.recommend()
+        opts = [r for r in recs if r.kind == "optimize"]
+        assert [r.index_name for r in opts] == ["fragidx"]
+
+    def test_mismatched_join_buckets_earn_rebucket_rec(self, session, hs, tmp_path):
+        rng = np.random.default_rng(13)
+        lroot = _write(tmp_path, "facts", pa.table({
+            "fk": rng.integers(0, 200, 8000),
+            "amt": rng.standard_normal(8000),
+        }))
+        rroot = _write(tmp_path, "dims", pa.table({
+            "dk": np.arange(200, dtype=np.int64),
+            "label": pa.array([f"d{i}" for i in range(200)]),
+        }))
+        facts, dims = session.parquet(lroot), session.parquet(rroot)
+        hs.create_index(facts, IndexConfig("fact_by_fk", ["fk"], ["amt"]))
+        session.conf.num_buckets = 4  # second index lands at a different count
+        hs.create_index(dims, IndexConfig("dim_by_dk", ["dk"], ["label"]))
+        session.conf.num_buckets = 8
+        session.enable_hyperspace()
+        for _ in range(3):
+            session.run(facts.join(dims, ["fk"], ["dk"]))
+        recs = hs.recommend()
+        rb = [r for r in recs if r.kind == "rebucket"]
+        assert rb, [r.to_json() for r in recs]
+        assert rb[0].index_name == "dim_by_dk"  # the smaller one re-buckets
+        assert rb[0].num_buckets == 8
+
+    def test_recommend_fault_point_fires(self, session, hs, tmp_path):
+        root = _hot_table(tmp_path, n=2000)
+        df = session.parquet(root)
+        session.run(df.filter(col("k") == 1).select("k", "v"))
+        with faults.injected("advisor.recommend"):
+            with pytest.raises(OSError):
+                hs.recommend()
+        # Disarmed again: the pass succeeds.
+        assert isinstance(hs.recommend(), list)
+
+
+# -- cost model --------------------------------------------------------------
+
+class TestCostModel:
+    def test_estimates_monotonic_in_bytes(self):
+        m = CostModel()
+        sizes = [0, 1, 10**3, 10**6, 10**9, 10**12]
+        scans = [m.estimate_scan_s(b) for b in sizes]
+        assert scans == sorted(scans)
+        queries = [m.estimate_query_s(b, 3) for b in sizes]
+        assert queries == sorted(queries)
+        assert all(b >= 0 for b in scans + queries)
+
+    def test_indexed_benefit_positive_and_monotonic(self):
+        m = CostModel()
+        benefits = [m.indexed_benefit_s(b, 8) for b in (10**6, 10**8, 10**10)]
+        assert benefits == sorted(benefits)
+        assert benefits[-1] > 0
+        # More buckets prune more -> at least as much benefit.
+        assert m.indexed_benefit_s(10**9, 64) >= m.indexed_benefit_s(10**9, 8)
+
+    def test_fit_from_measured_profiles(self, session, tmp_path):
+        root = _hot_table(tmp_path, n=8000)
+        df = session.parquet(root)
+        for i in range(3):
+            session.run(df.filter(col("k") == i).select("k", "v"))
+        profiles = [r.profile for r in session.workload.snapshot()]
+        m = CostModel.fit(profiles)
+        assert m.samples >= 1
+        assert m.scan_seconds_per_byte > 0
+        # Still monotonic after fitting (the invariant the advisor rides on).
+        assert m.estimate_scan_s(2e9) > m.estimate_scan_s(1e6)
+
+
+# -- adaptive routing --------------------------------------------------------
+
+class TestRouting:
+    def _setup(self, session, hs, tmp_path):
+        root = _hot_table(tmp_path, n=5000)
+        df = session.parquet(root)
+        hs.create_index(df, IndexConfig("kidx", ["k"], ["v"]))
+        session.conf.set("hyperspace.advisor.routing.enabled", True)
+        return df.filter(col("k") == 3).select("k", "v")
+
+    def test_demotion_and_repromotion_on_mutation(self, session, hs, tmp_path):
+        q = self._setup(session, hs, tmp_path)
+        sig = plan_signature(q)
+        led = session.routing_ledger()
+        session.disable_hyperspace()
+        r_raw = session.run(q)
+        session.enable_hyperspace()
+        led.record(sig, "indexed", 10.0)  # indexed path "measured" slower
+        assert led.decide(sig) == "raw"
+        r_routed = session.run(q)
+        st = dict(session.last_query_stats)
+        assert st["advisor_routing"] == {"decision": "raw", "demoted": True}
+        np.testing.assert_allclose(
+            np.sort(r_routed.decode()["v"]), np.sort(r_raw.decode()["v"])
+        )
+        # Structural re-promotion: any index mutation bumps the log
+        # versions, the stamp mismatches, the ledger wipes.
+        hs.refresh_index("kidx")
+        assert led.decide(sig) == "indexed"
+        session.run(q)
+        st = dict(session.last_query_stats)
+        assert st["advisor_routing"]["decision"] == "indexed"
+        assert st["advisor_routing"]["demoted"] is False
+
+    def test_fast_indexed_path_keeps_its_plan(self, session, hs, tmp_path):
+        q = self._setup(session, hs, tmp_path)
+        sig = plan_signature(q)
+        led = session.routing_ledger()
+        led.record(sig, "raw", 1.0)
+        led.record(sig, "indexed", 0.2)
+        assert led.decide(sig) == "indexed"
+
+    def test_ledger_persists_and_reloads(self, session, hs, tmp_path):
+        q = self._setup(session, hs, tmp_path)
+        sig = plan_signature(q)
+        led = session.routing_ledger()
+        led.record(sig, "raw", 1.0)
+        led.record(sig, "indexed", 5.0)  # verdict flip persists immediately
+        assert led.path.exists()
+        # A fresh session over the same system path reloads the verdict.
+        s2 = HyperspaceSession(system_path=session.conf.system_path)
+        s2.conf.set("hyperspace.advisor.routing.enabled", True)
+        assert s2.routing_ledger().decide(sig) == "raw"
+
+    def test_persist_failure_is_advisory(self, session, hs, tmp_path, monkeypatch):
+        q = self._setup(session, hs, tmp_path)
+        led = session.routing_ledger()
+        from hyperspace_tpu.utils import file_utils
+
+        def boom(path, obj, **kw):
+            raise OSError("disk on fire")
+
+        monkeypatch.setattr(file_utils, "write_json", boom)
+        before = obs_metrics.counter("advisor.routing.persist_failed").value
+        led.record(plan_signature(q), "raw", 1.0)
+        led.flush()  # both writes fail, neither raises
+        assert obs_metrics.counter("advisor.routing.persist_failed").value > before
+
+    def test_explain_shows_routing_decision(self, session, hs, tmp_path):
+        q = self._setup(session, hs, tmp_path)
+        sig = plan_signature(q)
+        led = session.routing_ledger()
+        session.enable_hyperspace()
+        assert "Adaptive routing: indexed" in hs.explain(q)
+        led.record(sig, "raw", 0.01)
+        led.record(sig, "indexed", 10.0)
+        assert "Adaptive routing: raw" in hs.explain(q)
+
+    def test_underscore_dirs_invisible_to_catalog(self, session, hs, tmp_path):
+        """The ledger dir lives under the system path but must never be
+        listed as an index (or lazy recovery would poke at it forever)."""
+        self._setup(session, hs, tmp_path)
+        session.routing_ledger().flush()
+        names = [p.name for p in session.manager.path_resolver.list_index_paths()]
+        assert "_advisor" not in names
+        assert "kidx" in names
+        with pytest.raises(Exception):
+            IndexConfig("_sneaky", ["k"])  # reserved namespace
+
+
+# -- lifecycle ---------------------------------------------------------------
+
+class TestLifecycle:
+    def _workload(self, session, hs, tmp_path, queries=6):
+        root = _hot_table(tmp_path)
+        df = session.parquet(root)
+        session.enable_hyperspace()
+        for i in range(queries):
+            session.run(df.filter(col("k") == (i * 17) % 500).select("k", "v"))
+        return df
+
+    def test_gates_off_sweep_applies_nothing(self, session, hs, tmp_path):
+        _cold_index(session, hs, tmp_path)
+        self._workload(session, hs, tmp_path)
+        report = hs.lifecycle().sweep()
+        assert report["applied"] == []
+        assert report["failed"] == []
+        assert len(report["skipped"]) >= 2  # create + drop both gated off
+
+    def test_auto_create_and_auto_vacuum(self, session, hs, tmp_path):
+        cold_root = _cold_index(session, hs, tmp_path)
+        df = self._workload(session, hs, tmp_path)
+        session.conf.set("hyperspace.advisor.lifecycle.autoCreate", True)
+        session.conf.set("hyperspace.advisor.lifecycle.autoVacuum", True)
+        session.conf.set("hyperspace.advisor.minConfidence", 0.1)
+        report = hs.lifecycle().sweep()
+        kinds = [a["kind"] for a in report["applied"]]
+        assert "create" in kinds and "drop" in kinds, report
+        # The auto-created index now serves the hot query...
+        session.run(df.filter(col("k") == 17).select("k", "v"))
+        assert session.workload.snapshot()[-1].used_indexes
+        assert session.workload.snapshot()[-1].index_names
+        # ...and the cold index is physically gone (vacuumed).
+        from hyperspace_tpu import states
+
+        active = session.manager.get_indexes(states_filter=tuple(states.ALL_STATES))
+        cold = [e for e in active if e.name == "coldidx"]
+        assert not cold or cold[0].state == states.DOESNOTEXIST
+
+    def test_auto_optimize_compacts_fragmented(self, session, hs, tmp_path):
+        root = _hot_table(tmp_path, n=4000)
+        df = session.parquet(root)
+        hs.create_index(df, IndexConfig("fragidx", ["k"], ["v"]))
+        rng = np.random.default_rng(11)
+        for i in range(session.conf.advisor_lifecycle_max_deltas + 1):
+            pq.write_table(pa.table({
+                "k": rng.integers(0, 500, 200),
+                "v": rng.standard_normal(200),
+                "tag": pa.array([f"d{i}"] * 200),
+            }), root / f"delta{i}.parquet")
+            hs.refresh_index("fragidx", mode="incremental")
+        session.enable_hyperspace()
+        session.run(df.filter(col("k") == 1).select("k", "v"))
+        session.conf.set("hyperspace.advisor.lifecycle.autoOptimize", True)
+        report = hs.lifecycle().sweep()
+        assert "optimize" in [a["kind"] for a in report["applied"]], report
+        entry = next(e for e in session.manager.get_indexes() if e.name == "fragidx")
+        assert len(entry.content.directories) == 1  # compacted
+
+    def test_apply_crash_is_crash_safe(self, session, hs, tmp_path):
+        """CrashPoint at advisor.apply: the sweep dies BEFORE mutating
+        (nothing to repair), the process-level recover() converges, and
+        a later sweep completes the work."""
+        _cold_index(session, hs, tmp_path)
+        df = self._workload(session, hs, tmp_path)
+        session.conf.set("hyperspace.advisor.lifecycle.autoCreate", True)
+        session.conf.set("hyperspace.advisor.lifecycle.autoVacuum", True)
+        session.conf.set("hyperspace.advisor.minConfidence", 0.1)
+        with faults.injected("advisor.apply", crash=True):
+            with pytest.raises(faults.CrashPoint):
+                hs.lifecycle().sweep()
+        # Nothing mutated mid-sweep: recover() is a no-op repair and the
+        # catalog still answers.
+        reports = hs.recover()
+        assert all(not r["rolled"] for r in reports.values())
+        report = hs.lifecycle().sweep()
+        assert report["applied"], report
+        session.run(df.filter(col("k") == 17).select("k", "v"))
+        assert session.workload.snapshot()[-1].used_indexes
+
+    def test_apply_crash_mid_create_recovers(self, session, hs, tmp_path):
+        """CrashPoint INSIDE the auto-created index's build (log.written):
+        the advisor inherits the Action machine's crash safety — the
+        transient entry rolls back via recover() and queries still run."""
+        df = self._workload(session, hs, tmp_path)
+        session.conf.set("hyperspace.advisor.lifecycle.autoCreate", True)
+        session.conf.set("hyperspace.advisor.minConfidence", 0.1)
+        with faults.injected("log.write", crash=True, at_call=1):
+            with pytest.raises(faults.CrashPoint):
+                hs.lifecycle().sweep()
+        hs.recover()
+        r = session.run(df.filter(col("k") == 17).select("k", "v"))
+        assert r.num_rows >= 0  # query plane healthy post-recovery
+
+    def test_apply_transient_fault_recorded_not_fatal(self, session, hs, tmp_path):
+        """A transient FaultError at advisor.apply surfaces through the
+        declared sweep contract (OSError)."""
+        self._workload(session, hs, tmp_path)
+        session.conf.set("hyperspace.advisor.lifecycle.autoCreate", True)
+        session.conf.set("hyperspace.advisor.minConfidence", 0.1)
+        with faults.injected("advisor.apply"):
+            with pytest.raises(OSError):
+                hs.lifecycle().sweep()
+
+    def test_rebucket_is_report_only(self, session, hs, tmp_path):
+        from hyperspace_tpu.advisor.whatif import Recommendation
+
+        session.conf.set("hyperspace.advisor.lifecycle.autoCreate", True)
+        session.conf.set("hyperspace.advisor.lifecycle.autoVacuum", True)
+        session.conf.set("hyperspace.advisor.lifecycle.autoOptimize", True)
+        rec = Recommendation(
+            kind="rebucket", estimated_benefit_s=99.0, confidence=1.0,
+            reason="test", index_name="whatever", num_buckets=64,
+        )
+        report = hs.lifecycle().sweep([rec])
+        assert report["applied"] == [] and len(report["skipped"]) == 1
+
+
+# -- workload log ------------------------------------------------------------
+
+class TestWorkload:
+    def test_records_are_bounded_and_accurate(self, session, hs, tmp_path):
+        root = _hot_table(tmp_path, n=3000)
+        df = session.parquet(root)
+        hs.create_index(df, IndexConfig("kidx", ["k"], ["v"]))
+        session.enable_hyperspace()
+        q = df.filter(col("k") == 3).select("k", "v")
+        session.run(q)
+        rec = session.workload.snapshot()[-1]
+        assert rec.signature == plan_signature(q)
+        assert rec.used_indexes and rec.index_names == ("kidx",)
+        assert rec.total_s > 0 and rec.bytes_scanned >= 0
+        session.disable_hyperspace()
+        session.run(q)
+        rec = session.workload.snapshot()[-1]
+        assert not rec.used_indexes and rec.index_names == ()
+
+    def test_ring_is_bounded(self, tmp_system_path):
+        s = HyperspaceSession(system_path=tmp_system_path)
+        s.conf.set("hyperspace.advisor.workload.maxRecords", 4)
+        assert s.workload._records.maxlen == 4
+
+
+# -- satellite regressions ---------------------------------------------------
+
+class TestNullSafeSetOps:
+    """plan/nodes.py round-5 fix: INTERSECT/EXCEPT follow SQL set
+    semantics on NULLs (NULL-safe positional equality) instead of the
+    engine's join semantics (NULL never equal)."""
+
+    def _tables(self, session, tmp_path):
+        l = _write(tmp_path, "setl", pa.table({
+            "k": pa.array([1, 1, None, None, 2], type=pa.int64()),
+            "s": pa.array(["a", None, "b", None, "c"]),
+        }))
+        r = _write(tmp_path, "setr", pa.table({
+            "k": pa.array([1, None, None, 3], type=pa.int64()),
+            "s": pa.array([None, "b", None, "z"]),
+        }))
+        return session.parquet(l), session.parquet(r)
+
+    @staticmethod
+    def _rows(res):
+        d = res.decode()
+        return sorted(zip(*(d[c] for c in d)), key=repr)
+
+    def test_intersect_keeps_null_bearing_matches(self, session, tmp_path):
+        L, R = self._tables(session, tmp_path)
+        got = self._rows(session.run(L.intersect(R)))
+        assert got == sorted([(1, None), (None, "b"), (None, None)], key=repr)
+
+    def test_except_removes_null_bearing_matches(self, session, tmp_path):
+        L, R = self._tables(session, tmp_path)
+        got = self._rows(session.run(L.except_(R)))
+        assert got == sorted([(1, "a"), (2, "c")], key=repr)
+
+    def test_null_safe_survives_json_round_trip(self, session, tmp_path):
+        from hyperspace_tpu.plan.nodes import plan_from_json
+
+        L, R = self._tables(session, tmp_path)
+        p = L.intersect(R)
+        assert p.null_safe is True
+        rt = plan_from_json(p.to_json())
+        assert rt.null_safe is True
+        # Ordinary joins stay null-UNSAFE and serialize without the flag.
+        j = L.join(R, ["k"])
+        assert j.null_safe is False and "nullSafe" not in j.to_json()
+
+    def test_ordinary_join_null_semantics_unchanged(self, session, tmp_path):
+        L, R = self._tables(session, tmp_path)
+        out = session.run(L.select("k").join(R.select("k"), ["k"]))
+        assert not any(v is None for v in out.decode()["k"])
+
+    def test_null_never_matches_physical_zero(self, session, tmp_path):
+        """The null-safe lane must not let NULL alias the deterministic
+        0 a null slot physically holds."""
+        l = _write(tmp_path, "zl", pa.table({"k": pa.array([0, None], type=pa.int64())}))
+        r = _write(tmp_path, "zr", pa.table({"k": pa.array([0], type=pa.int64())}))
+        L, R = session.parquet(l), session.parquet(r)
+        got = self._rows(session.run(L.intersect(R)))
+        assert got == [(0,)]  # NULL does not intersect with 0
+
+
+class TestDictionaryNullRoundTrip:
+    """execution/table.py round-5 fix: a null Arrow dictionary ENTRY must
+    decode as a null row, not the literal string 'None'."""
+
+    def test_dictionary_entry_null_round_trip(self):
+        from hyperspace_tpu.execution.table import ColumnTable
+        from hyperspace_tpu.schema import Schema
+
+        ind = pa.array([0, 1, 2, 0, 1], type=pa.int32())
+        dic = pa.array(["a", None, "b"])
+        arr = pa.DictionaryArray.from_arrays(ind, dic)
+        t = pa.table({"s": arr})
+        ct = ColumnTable.from_arrow(t, Schema.from_arrow(t.schema))
+        got = list(ct.decode()["s"])
+        assert got == ["a", None, "b", "a", None]
+        assert "None" not in set(ct.dictionaries["s"])
+        back = ct.to_arrow()
+        assert back.column("s").null_count == 2
+
+    def test_dictionary_and_index_nulls_compose(self):
+        from hyperspace_tpu.execution.table import ColumnTable
+        from hyperspace_tpu.schema import Schema
+
+        ind = pa.array([0, None, 1, 0], type=pa.int32())
+        dic = pa.array(["x", None])
+        arr = pa.DictionaryArray.from_arrays(ind, dic)
+        t = pa.table({"s": arr})
+        ct = ColumnTable.from_arrow(t, Schema.from_arrow(t.schema))
+        assert list(ct.decode()["s"]) == ["x", None, None, "x"]
+
+    def test_parquet_round_trip_with_dictionary_nulls(self, session, tmp_path):
+        root = _write(tmp_path, "dictnull", pa.table({
+            "s": pa.array(["a", None, "b", "a", None]),
+            "v": pa.array([1.0, 2.0, 3.0, 4.0, 5.0]),
+        }))
+        out = session.run(session.parquet(root).filter(col("v") > 0).select("s", "v"))
+        assert list(out.decode()["s"]) == ["a", None, "b", "a", None]
