@@ -11,7 +11,11 @@ over a point-lookup workload of distinct plans, cold vs warm plan cache:
 XLA compilation and the decoded-table/device caches are warmed before
 measurement, so the cold-vs-warm delta isolates exactly the work the
 plan cache amortizes. Writes BENCH_SERVE.json; `--smoke` runs a quick
-4-client correctness pass (the CI `serving` job).
+4-client correctness pass (the CI `serving` job) and additionally boots
+the runtime health plane (`hyperspace.obs.http.enabled`), scrapes
+/metrics + /healthz over the real socket mid-load, and asserts the
+serve gauges and a computed SLO burn rate are present — the CI
+`observability` job's live-endpoint gate (docs/observability.md).
 """
 
 from __future__ import annotations
@@ -61,6 +65,39 @@ def _stats(lat_s: list[float], wall_s: float) -> dict:
         "p99_ms": round(float(np.percentile(arr, 99)) * 1e3, 3),
         "mean_ms": round(float(arr.mean()) * 1e3, 3),
     }
+
+
+def _scrape(endpoint, expect_burn: bool) -> dict:
+    """Scrape /metrics and /healthz over the real socket and assert the
+    health plane is live: serve gauges in the Prometheus text, scheduler
+    saturation in the healthz document, and — once traffic has flowed
+    between two scrapes — a computed (non-sentinel) SLO burn rate."""
+    import json as _json
+    import urllib.request
+
+    with urllib.request.urlopen(endpoint.url("/metrics"), timeout=10) as r:
+        metrics_text = r.read().decode()
+    for needle in (
+        "hyperspace_serve_inflight",
+        "hyperspace_serve_queue_depth",
+        "hyperspace_serve_latency_seconds_bucket",
+        "hyperspace_slo_serve_availability_burn_rate",
+        "hyperspace_proc_map_count",
+        "hyperspace_jit_live_executables",
+    ):
+        assert needle in metrics_text, f"{needle} missing from /metrics"
+    with urllib.request.urlopen(endpoint.url("/healthz"), timeout=10) as r:
+        doc = _json.loads(r.read().decode())
+    assert doc["status"] in ("ok", "degraded"), doc["status"]
+    assert doc["scheduler"] and doc["scheduler"][0]["workers"] == 4, doc["scheduler"]
+    burn = [
+        ln.rsplit(" ", 1)[1]
+        for ln in metrics_text.splitlines()
+        if ln.startswith("hyperspace_slo_serve_availability_burn_rate ")
+    ][0]
+    if expect_burn:
+        assert float(burn) >= 0.0, f"burn rate not computed: {burn}"
+    return {"status": doc["status"], "availability_burn": float(burn)}
 
 
 def _run_phase(server, queries, n_clients: int, reps: int) -> dict:
@@ -127,7 +164,10 @@ def main(smoke: bool = False) -> int:
         serial = [session.run(q) for q in queries[: min(4, n_keys)]]
 
         if smoke:
+            session.conf.set("hyperspace.obs.http.enabled", "true")
             with session.serve(workers=4, max_queue_depth=256) as server:
+                endpoint = server.health_endpoint
+                _scrape(endpoint, expect_burn=False)  # first SLO sample
                 for i, q in enumerate(queries[: len(serial)]):
                     out = server.submit(q).result(timeout=600).decode()
                     ref = serial[i].decode()
@@ -137,8 +177,10 @@ def main(smoke: bool = False) -> int:
                             np.asarray(out[c]), np.asarray(ref[c])
                         ), f"smoke mismatch in {c}"
                 st = _run_phase(server, queries, n_clients=4, reps=2)
+                scraped = _scrape(endpoint, expect_burn=True)
             log(f"smoke OK: 4 clients, {st['queries']} queries, "
-                f"p95 {st['p95_ms']}ms, {st['throughput_qps']} qps")
+                f"p95 {st['p95_ms']}ms, {st['throughput_qps']} qps; "
+                f"health plane OK: {scraped}")
             return 0
 
         results: dict = {

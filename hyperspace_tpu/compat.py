@@ -13,6 +13,12 @@ move) is resolved HERE and nowhere else:
   older ``check_rep=`` when the resolved function predates the rename.
 - Pallas: ``resolve_pallas()`` returns the ``pallas`` module from its
   current home (``jax.experimental.pallas`` today).
+- ``jit``: the package's one jit entry point. Same surface as
+  ``jax.jit``, plus a ``key=`` call-site identity used by the runtime
+  health plane (``obs/runtime.py``) to count compiles per call site and
+  detect recompile storms while the process runs — the dynamic mirror
+  of lint rule HSL015, and the observable form of the XLA:CPU
+  map-count segfault ``utils/jit_memory.py`` guards against.
 
 The trace-safety linter (``analysis/lint.py``, rule HSL001) makes this
 arrangement permanent: any ``from jax import shard_map`` or
@@ -65,6 +71,29 @@ def shard_map(f=None, **kwargs):
     if f is None:
         return functools.partial(shard_map, **kwargs)
     return _SHARD_MAP(f, **kwargs)
+
+
+def jit(fn=None, *, key: "str | None" = None, **jit_kwargs):
+    """``jax.jit`` with per-call-site compile accounting (obs/runtime.py).
+
+    Usable exactly like ``jax.jit``: as a decorator, through
+    ``functools.partial(jit, static_argnames=...)``, or called directly
+    on a function. ``key`` names the call site in the runtime jit
+    report and in recompile-storm events; it defaults to the wrapped
+    function's module-qualified name — pass it explicitly when the
+    function is a lambda or a local closure (whose qualnames collide).
+    """
+    if fn is None:
+        return functools.partial(jit, key=key, **jit_kwargs)
+    import jax
+
+    from hyperspace_tpu.obs import runtime as obs_runtime
+
+    if key is None:
+        module = getattr(fn, "__module__", None) or "<unknown>"
+        qual = getattr(fn, "__qualname__", None) or getattr(fn, "__name__", "<fn>")
+        key = f"{module}.{qual}"
+    return obs_runtime.instrument(jax.jit(fn, **jit_kwargs), key)
 
 
 def enable_x64(new_val: bool = True):

@@ -89,6 +89,22 @@ FAULTS_ENABLED = "hyperspace.faults.enabled"
 # export feed (`python -m hyperspace_tpu.obs.export --sink <path>`).
 OBS_ENABLED = "hyperspace.obs.enabled"
 OBS_SINK = "hyperspace.obs.sink"
+# Runtime health plane (docs/observability.md "live endpoints"): an
+# opt-in stdlib HTTP server exposing /metrics (Prometheus text),
+# /healthz (index health + scheduler saturation + SLO burn verdict),
+# /debug/events, and /debug/trace. Started/stopped with the QueryServer
+# lifecycle; port 0 binds an ephemeral port (read it back from
+# `server.health_endpoint.port`). Off by default: no thread, no socket.
+OBS_HTTP_ENABLED = "hyperspace.obs.http.enabled"
+OBS_HTTP_HOST = "hyperspace.obs.http.host"
+OBS_HTTP_PORT = "hyperspace.obs.http.port"
+# Bounded structured-event ring (obs/events.py) — process-global, like
+# the metrics registry it complements.
+OBS_EVENTS_MAX = "hyperspace.obs.events.maxEvents"
+# Declared SLO objectives (obs/slo.py): availability target of admitted
+# queries, and the latency threshold the p99 objective holds serves to.
+OBS_SLO_AVAILABILITY_TARGET = "hyperspace.obs.slo.availabilityTarget"
+OBS_SLO_LATENCY_P99_SECONDS = "hyperspace.obs.slo.latencyP99Seconds"
 # Concurrent query-serving plane (docs/serving.md). The subsystem is OFF
 # by default: nothing changes for direct `session.run()` callers; a
 # QueryServer is constructed explicitly (or via `session.serve()`) and
@@ -319,6 +335,34 @@ KNOWN_KEYS: dict[str, ConfKey] = {
         "JSON-lines path receiving one event per finished root trace (query or "
         "action) — the export feed for `python -m hyperspace_tpu.obs.export "
         "--sink <path>`."),
+    OBS_HTTP_ENABLED: ConfKey(
+        "false",
+        "Runtime health plane ([observability.md](observability.md)): serve "
+        "`/metrics`, `/healthz`, `/debug/events`, and `/debug/trace` over a "
+        "zero-dependency HTTP server that starts/stops with the QueryServer "
+        "lifecycle. Off ⇒ no thread, no socket, nothing imported."),
+    OBS_HTTP_HOST: ConfKey(
+        "`127.0.0.1`",
+        "Bind address of the health endpoints (loopback by default — expose "
+        "deliberately, not accidentally)."),
+    OBS_HTTP_PORT: ConfKey(
+        "0 (ephemeral)",
+        "Port of the health endpoints; 0 binds an ephemeral port, read back "
+        "from `QueryServer.health_endpoint.port`."),
+    OBS_EVENTS_MAX: ConfKey(
+        "256",
+        "Bound of the structured event ring (`/debug/events`): old events age "
+        "out (counted in `obs.events.dropped`), memory stays constant."),
+    OBS_SLO_AVAILABILITY_TARGET: ConfKey(
+        "0.999",
+        "Availability objective over admitted queries (completed vs "
+        "failed/timed-out/cancelled); burn rates are computed against "
+        "1 - target (obs/slo.py)."),
+    OBS_SLO_LATENCY_P99_SECONDS: ConfKey(
+        "1.0",
+        "Latency threshold of the `serve.latency_p99` objective: 99% of served "
+        "queries must finish under it (measured from the latency histogram's "
+        "bucket bounds)."),
     RECOVER_ON_ACCESS: ConfKey(
         "true",
         "Index listing lazily repairs a crashed writer's log (torn entries "
@@ -474,6 +518,9 @@ class HyperspaceConf:
     advisor_lifecycle_max_deltas: int = DEFAULT_ADVISOR_LIFECYCLE_MAX_DELTAS
     advisor_min_confidence: float = DEFAULT_ADVISOR_MIN_CONFIDENCE
     advisor_min_benefit_seconds: float = 0.0
+    obs_http_enabled: bool = False  # opt-in: binds a socket
+    obs_http_host: str = "127.0.0.1"
+    obs_http_port: int = 0  # 0 = ephemeral
     overrides: dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def __post_init__(self):
@@ -578,6 +625,25 @@ class HyperspaceConf:
             from hyperspace_tpu.obs import trace as _obs_trace
 
             _obs_trace.configure(sink=str(value) if value else None)
+        elif key == OBS_HTTP_ENABLED:
+            self.obs_http_enabled = _as_bool(value)
+        elif key == OBS_HTTP_HOST:
+            self.obs_http_host = str(value)
+        elif key == OBS_HTTP_PORT:
+            self.obs_http_port = int(value)
+        elif key == OBS_EVENTS_MAX:
+            # Process-global ring, like the metrics registry it joins.
+            from hyperspace_tpu.obs import events as _obs_events
+
+            _obs_events.configure(max_events=int(value))
+        elif key == OBS_SLO_AVAILABILITY_TARGET:
+            from hyperspace_tpu.obs import slo as _obs_slo
+
+            _obs_slo.configure(availability_target=float(value))
+        elif key == OBS_SLO_LATENCY_P99_SECONDS:
+            from hyperspace_tpu.obs import slo as _obs_slo
+
+            _obs_slo.configure(latency_threshold_s=float(value))
         elif key == RETRY_MAX_ATTEMPTS:
             from hyperspace_tpu.utils import retry
 
@@ -683,4 +749,22 @@ class HyperspaceConf:
             from hyperspace_tpu.obs import trace as _obs_trace
 
             return _obs_trace.sink_path()
+        if key == OBS_HTTP_ENABLED:
+            return self.obs_http_enabled
+        if key == OBS_HTTP_HOST:
+            return self.obs_http_host
+        if key == OBS_HTTP_PORT:
+            return self.obs_http_port
+        if key == OBS_EVENTS_MAX:
+            from hyperspace_tpu.obs import events as _obs_events
+
+            return _obs_events.max_events()
+        if key == OBS_SLO_AVAILABILITY_TARGET:
+            from hyperspace_tpu.obs import slo as _obs_slo
+
+            return _obs_slo.TRACKER.availability_target
+        if key == OBS_SLO_LATENCY_P99_SECONDS:
+            from hyperspace_tpu.obs import slo as _obs_slo
+
+            return _obs_slo.TRACKER.latency_threshold_s
         return default

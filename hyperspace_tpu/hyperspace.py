@@ -20,8 +20,16 @@ from hyperspace_tpu.dataset import Dataset
 from hyperspace_tpu.exceptions import HyperspaceError
 from hyperspace_tpu.index.collection_manager import CachingIndexCollectionManager
 from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.obs import events as obs_events
 from hyperspace_tpu.plan.nodes import LogicalPlan, Scan
 from hyperspace_tpu.rules.base import apply_rules
+
+# Structured health-plane events (obs/events.py): the query plane's
+# degradations become operator-visible records on /debug/events, each
+# carrying the active trace id.
+_EVT_FALLBACK = obs_events.declare("fallback.replan")
+_EVT_QUARANTINED = obs_events.declare("index.quarantined")
+_EVT_DEMOTED = obs_events.declare("advisor.routing.demoted")
 
 
 def _enable_persistent_compile_cache() -> None:
@@ -290,6 +298,7 @@ class HyperspaceSession:
                 if routed == "raw":
                     use_indexes = False
                     obs_trace.event("advisor.routing.demoted", signature=sig)
+                    _EVT_DEMOTED.emit(signature=sig)
         t_start = time.perf_counter()
         with obs_trace.trace("query") as root_span:
             while True:
@@ -324,6 +333,7 @@ class HyperspaceSession:
                         raise
                     root = str(Path(e.index_root)) if e.index_root is not None else None
                     with self._state_lock:
+                        newly_quarantined = root is not None and root not in self.index_health
                         if root is None or root in self.index_health:
                             # No provenance to quarantine by (or quarantining
                             # it didn't help): indexes go off wholesale for
@@ -334,6 +344,9 @@ class HyperspaceSession:
                     stats.increment("fallback.queries")
                     replans += 1
                     obs_trace.event("fallback.replan", index=root, reason=e.msg)
+                    _EVT_FALLBACK.emit(index=root, reason=e.msg)
+                    if newly_quarantined:
+                        _EVT_QUARANTINED.emit(index=root, reason=e.msg)
                     import logging
 
                     logging.getLogger("hyperspace_tpu").warning(

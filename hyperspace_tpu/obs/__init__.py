@@ -27,18 +27,39 @@ what it cost. This package is that explanation, in three layers
 Export: a JSON-lines event sink (``hyperspace.obs.sink``) receives one
 line per finished root trace, and ``python -m hyperspace_tpu.obs.export``
 renders Prometheus-style text exposition (of the live registry, or
-aggregated from a sink file).
+aggregated from a sink file) or — ``--format chrome`` — a Chrome Trace
+Event timeline of the span trees (Perfetto/chrome://tracing).
+
+The **runtime health plane** layers live visibility on top
+(docs/observability.md "live endpoints"):
+
+- **events** — a bounded, severity-leveled structured event ring
+  (fallback taken, index quarantined, recompile storm, ...), each
+  record carrying the active trace id;
+- **runtime** — JIT/compile introspection: per-call-site compile
+  counts via the ``compat.jit`` entry point, recompile-storm detection
+  (the dynamic mirror of lint rule HSL015), and the
+  ``jit.live_executables`` / ``proc.map_count`` / RSS gauges behind the
+  XLA:CPU map-count segfault guard;
+- **slo** — declared objectives (availability, p99 latency) with
+  multi-window error-budget burn rates;
+- **http** — ``/metrics``, ``/healthz``, ``/debug/events``, and
+  ``/debug/trace`` over a zero-dependency stdlib server riding the
+  QueryServer lifecycle (``hyperspace.obs.http.*``).
 """
 
-from hyperspace_tpu.obs import metrics, trace
+from hyperspace_tpu.obs import events, metrics, runtime, slo, trace
 from hyperspace_tpu.obs.trace import annotate, current_span, event, set_enabled, span
 
 __all__ = [
     "annotate",
     "current_span",
     "event",
+    "events",
     "metrics",
+    "runtime",
     "set_enabled",
+    "slo",
     "span",
     "trace",
 ]

@@ -1,0 +1,185 @@
+"""Bounded, severity-leveled structured event ring.
+
+Counters say *how often*; spans say *how long*; this module records
+*that something notable happened* — a fallback taken, an index
+quarantined, a recompile storm — as one structured record an operator
+can read live from ``/debug/events`` (obs/http.py) while the system is
+running, instead of reconstructing it from counter deltas after the
+fact. Each event carries the active root-trace id (obs/trace.py) so an
+anomaly links straight to the query that caused it.
+
+Event names are **declared** in :data:`KNOWN_EVENTS`, the event analog
+of ``stats.KNOWN_COUNTERS``: instrumented modules obtain a handle at
+import time via :func:`declare`, which raises immediately for an
+undeclared name — the typo dies at import, and the handle's ``emit``
+itself can never raise (several call sites sit inside narrow declared
+error contracts, e.g. ``QueryServer.submit``; emitting telemetry must
+not widen them).
+
+The ring is process-global and bounded (``hyperspace.obs.events
+.maxEvents``): old events age out, ``obs.events.dropped`` counts how
+many did, and memory stays O(max) forever — the same constant-memory
+contract the bounded histograms make.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+import time
+
+from hyperspace_tpu.obs import metrics as _metrics
+from hyperspace_tpu.obs import trace as _trace
+
+# Severity order, least to most severe (filter threshold semantics).
+SEVERITIES = ("debug", "info", "warn", "error")
+
+# The declared event set: name -> default severity. Keep this a plain
+# dict literal of string constants (house style for declared
+# registries — config.KNOWN_KEYS, stats.KNOWN_COUNTERS); new events are
+# added by extending it.
+KNOWN_EVENTS: dict[str, str] = {
+    # Query plane (docs/fault_tolerance.md): a query hit unreadable
+    # index data and re-planned; the index that served it got
+    # quarantined for the session.
+    "fallback.replan": "warn",
+    "index.quarantined": "warn",
+    # Advisor plane (docs/advisor.md): adaptive routing demoted a plan
+    # signature to a raw source scan.
+    "advisor.routing.demoted": "info",
+    # Serving plane (docs/serving.md): admission control refused a
+    # submit; the result cache evicted a burst of entries for one put.
+    "serve.admission_rejected": "warn",
+    "serve.result_cache.eviction_storm": "warn",
+    # JIT plane (docs/observability.md): a call-site key is compiling on
+    # most calls (the runtime mirror of lint rule HSL015), or the
+    # map-count guard dropped jax's caches to stay under
+    # vm.max_map_count (utils/jit_memory.py).
+    "jit.recompile_storm": "warn",
+    "jit.cache_drop": "warn",
+    # SLO plane (obs/slo.py): an objective's multi-window burn rate
+    # crossed its page threshold.
+    "slo.burn": "error",
+}
+
+DEFAULT_MAX_EVENTS = 256
+
+_EMITTED = _metrics.counter("obs.events.emitted", "structured events recorded")
+_DROPPED = _metrics.counter("obs.events.dropped", "events aged out of the bounded ring")
+
+_seq = itertools.count(1)  # itertools.count is GIL-atomic
+
+
+class _Ring:
+    """The bounded ring itself; one process-global instance."""
+
+    def __init__(self, max_events: int = DEFAULT_MAX_EVENTS):
+        self._lock = threading.Lock()
+        self._events: collections.deque = collections.deque(maxlen=int(max_events))
+
+    def resize(self, max_events: int) -> None:
+        with self._lock:
+            self._events = collections.deque(self._events, maxlen=int(max_events))
+
+    def append(self, event: dict) -> None:
+        with self._lock:
+            if len(self._events) == self._events.maxlen:
+                _DROPPED.inc()
+            self._events.append(event)
+        _EMITTED.inc()
+
+    def recent(self, level: str | None = None, limit: int | None = None) -> list[dict]:
+        with self._lock:
+            out = list(self._events)
+        if level is not None:
+            floor = SEVERITIES.index(level)  # unknown level -> ValueError
+            out = [e for e in out if SEVERITIES.index(e["severity"]) >= floor]
+        if limit is not None:
+            out = out[-int(limit):]
+        return out
+
+    def counts_by_severity(self) -> dict[str, int]:
+        with self._lock:
+            out = dict.fromkeys(SEVERITIES, 0)
+            for e in self._events:
+                out[e["severity"]] += 1
+        return out
+
+    def max_events(self) -> int:
+        with self._lock:
+            return int(self._events.maxlen or 0)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+
+RING = _Ring()
+
+
+class Event:
+    """A declared event's emit handle (obtained via :func:`declare` at
+    module import). ``emit`` never raises — validation happened at
+    declaration — so it is safe inside narrow error contracts."""
+
+    __slots__ = ("name", "severity")
+
+    def __init__(self, name: str, severity: str):
+        self.name = name
+        self.severity = severity
+
+    def emit(self, severity: str | None = None, **fields) -> dict:
+        record = {
+            "seq": next(_seq),
+            "ts": time.time(),  # wall clock: correlated with external logs
+            "name": self.name,
+            "severity": severity or self.severity,
+            "trace_id": _trace.current_trace_id(),
+            "fields": fields,
+        }
+        RING.append(record)
+        return record
+
+
+def declare(name: str) -> Event:
+    """The emit handle for a declared event name; an undeclared name
+    raises here — at the instrumented module's import — not at the
+    (possibly contract-constrained) emit site."""
+    severity = KNOWN_EVENTS.get(name)
+    if severity is None:
+        raise KeyError(
+            f"undeclared event {name!r} — declare it in obs.events.KNOWN_EVENTS "
+            f"(declared registries are how silent-typo telemetry dies here)"
+        )
+    return Event(name, severity)
+
+
+def recent(level: str | None = None, limit: int | None = None) -> list[dict]:
+    """Recorded events, oldest first; `level` keeps events at or above
+    that severity, `limit` keeps the newest N."""
+    return RING.recent(level=level, limit=limit)
+
+
+def counts_by_severity() -> dict[str, int]:
+    """How many resident ring events sit at each severity (healthz)."""
+    return RING.counts_by_severity()
+
+
+def max_events() -> int:
+    """The ring's current bound (config get path)."""
+    return RING.max_events()
+
+
+def configure(max_events: int | None = None) -> None:
+    """Adjust the process-global ring (`hyperspace.obs.events.maxEvents`
+    routes here). Shrinking keeps the newest events."""
+    if max_events is not None:
+        RING.resize(max_events)
+
+
+def reset() -> None:
+    """Drop every recorded event and restore the default bound (test
+    isolation)."""
+    RING.clear()
+    RING.resize(DEFAULT_MAX_EVENTS)

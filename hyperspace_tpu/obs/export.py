@@ -1,20 +1,28 @@
-"""Metrics export: Prometheus-style text exposition.
+"""Metrics export: Prometheus text exposition + Chrome trace timelines.
 
-Two modes::
+Modes::
 
-    python -m hyperspace_tpu.obs.export            # live process registry
-    python -m hyperspace_tpu.obs.export --sink q.jsonl   # aggregate a sink file
+    python -m hyperspace_tpu.obs.export                      # live registry
+    python -m hyperspace_tpu.obs.export --sink q.jsonl       # aggregate a sink
+    python -m hyperspace_tpu.obs.export --format chrome \
+        --sink q.jsonl --output trace.json                   # span timelines
 
-The first renders whatever this process's registry holds (useful from a
-long-lived server REPL or an embedding application that execs it). The
-second replays a JSON-lines trace sink (`hyperspace.obs.sink`) into a
-fresh registry — every `execute.*` span becomes an operator wall-time
-observation, every root a query observation — so offline trajectories
-(bench runs, soak tests) export the same way live processes do.
+Prometheus: renders whatever the registry holds (the /metrics endpoint
+in obs/http.py serves exactly this), or replays a JSON-lines trace sink
+(`hyperspace.obs.sink`) into a fresh registry so offline trajectories
+export the same way live processes do. Metric names are sanitized to
+the Prometheus grammar (`hyperspace_` prefix, dots → underscores);
+HELP text and label values are escaped per the text exposition format
+(`\\` → `\\\\`, newline → `\\n`, and `"` → `\\"` inside label values) —
+a hostile metric description can no longer tear the exposition apart.
 
-Metric names are sanitized to the Prometheus grammar
-(`hyperspace_` prefix, dots → underscores); histograms render classic
-cumulative `_bucket{le="..."}` series plus `_sum`/`_count`.
+Chrome: converts span trees (from a sink file, or the in-process
+recent-root ring) to the Chrome Trace Event format — open the output in
+`chrome://tracing` or https://ui.perfetto.dev. Spans carry their start
+offset and OS thread id (obs/trace.py), so genuinely concurrent work —
+the overlapped build-pipeline stages, pool-fanned IO — renders as
+overlapping slices on separate thread lanes instead of a flattened
+tree.
 """
 
 from __future__ import annotations
@@ -32,6 +40,17 @@ def _prom_name(name: str) -> str:
     )
 
 
+def escape_help(text: str) -> str:
+    """HELP/TYPE comment escaping per the Prometheus text exposition
+    format: backslash and newline only."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def escape_label_value(value: str) -> str:
+    """Label-value escaping: backslash, double-quote, newline."""
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
 def _fmt(v: float) -> str:
     if v == float("inf"):
         return "+Inf"
@@ -45,14 +64,14 @@ def render_prometheus(registry: "m.MetricsRegistry | None" = None) -> str:
     for metric in reg.collect():
         name = _prom_name(metric.name)
         if metric.help:
-            out.append(f"# HELP {name} {metric.help}")
+            out.append(f"# HELP {name} {escape_help(metric.help)}")
         out.append(f"# TYPE {name} {metric.kind}")
         if metric.kind in ("counter", "gauge"):
             out.append(f"{name} {_fmt(metric.value)}")
         else:  # histogram
             for le, cum in metric.bucket_counts():
                 le_s = "+Inf" if le == float("inf") else repr(float(le))
-                out.append(f'{name}_bucket{{le="{le_s}"}} {cum}')
+                out.append(f'{name}_bucket{{le="{escape_label_value(le_s)}"}} {cum}')
             out.append(f"{name}_sum {float(metric.sum)!r}")
             out.append(f"{name}_count {metric.count}")
     return "\n".join(out) + "\n"
@@ -97,25 +116,135 @@ def registry_from_sink(path: str) -> "m.MetricsRegistry":
     return reg
 
 
+# -- Chrome trace export ------------------------------------------------------
+
+def roots_from_sink(path: str) -> list[dict]:
+    """Every root-span dict in a JSON-lines sink file (torn lines
+    skipped, same contract as registry_from_sink)."""
+    roots: list[dict] = []
+    with open(path) as f:
+        for line in f:
+            try:
+                event = json.loads(line)
+            except ValueError:
+                continue
+            root = event.get("trace")
+            if root:
+                roots.append(root)
+    return roots
+
+
+def live_roots() -> list[dict]:
+    """The in-process recent-root ring as span dicts (the no-sink
+    source: /debug/trace and programmatic export share it)."""
+    from hyperspace_tpu.obs import trace as _trace
+
+    return [r.to_json() for r in _trace.recent_roots()]
+
+
+def chrome_trace(roots: "list[dict]") -> dict:
+    """Span trees as a Chrome Trace Event document (Perfetto/
+    chrome://tracing). Each span becomes one complete ("X") event laned
+    by the OS thread it ran on; timestamps are normalized so the
+    earliest span starts at 0. Spans from old sinks without timeline
+    fields inherit their parent's start (rendering nested, zero-offset).
+    """
+    events: list[dict] = []
+    starts = [
+        s["t0_s"] for r in roots for s in _walk_span(r) if s.get("t0_s") is not None
+    ]
+    base = min(starts) if starts else 0.0
+    tid_alias: dict = {}
+
+    def lane(raw_tid) -> int:
+        if raw_tid not in tid_alias:
+            tid_alias[raw_tid] = len(tid_alias) + 1
+        return tid_alias[raw_tid]
+
+    def emit(span: dict, pid: int, trace_id: "str | None", parent_ts: float) -> None:
+        ts = (
+            (span["t0_s"] - base) * 1e6 if span.get("t0_s") is not None else parent_ts
+        )
+        args = dict(span.get("attrs") or {})
+        if span.get("error") is not None:
+            args["error"] = span["error"]
+        if trace_id is not None:
+            args["trace_id"] = trace_id
+        events.append(
+            {
+                "ph": "X",
+                "name": span.get("name", "?"),
+                "cat": "span",
+                "ts": round(ts, 3),
+                "dur": round((span.get("wall_s") or 0.0) * 1e6, 3),
+                "pid": pid,
+                "tid": lane(span.get("tid", 0)),
+                "args": args,
+            }
+        )
+        for child in span.get("children", ()):
+            emit(child, pid, trace_id, ts)
+
+    for root in roots:
+        trace_id = root.get("trace_id")
+        # Root ids are "<pid>-<seq>" (obs/trace.py): keep sink lines from
+        # several processes on separate pid tracks.
+        pid = 1
+        if trace_id and "-" in str(trace_id):
+            head = str(trace_id).split("-", 1)[0]
+            if head.isdigit():
+                pid = int(head)
+        emit(root, pid, trace_id, 0.0)
+    alias_of = {alias: raw for raw, alias in tid_alias.items()}
+    meta = [
+        {
+            "ph": "M",
+            "name": "thread_name",
+            "pid": pid,
+            "tid": alias,
+            "args": {"name": f"thread-{alias} (os:{alias_of[alias]})"},
+        }
+        for pid, alias in sorted({(e["pid"], e["tid"]) for e in events})
+    ]
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
 def main(argv: "list[str] | None" = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m hyperspace_tpu.obs.export",
-        description="Prometheus-style text exposition of hyperspace metrics.",
+        description="Export hyperspace telemetry: Prometheus text or Chrome trace.",
     )
     ap.add_argument(
-        "--sink", help="aggregate a JSON-lines trace sink file instead of the live registry"
+        "--sink", help="read a JSON-lines trace sink file instead of live process state"
     )
+    ap.add_argument(
+        "--format",
+        choices=("prom", "chrome"),
+        default="prom",
+        help="prom = Prometheus text exposition; chrome = Chrome Trace Events "
+        "(open in chrome://tracing or Perfetto)",
+    )
+    ap.add_argument("--output", help="write here instead of stdout")
     args = ap.parse_args(argv)
-    if args.sink:
-        reg = registry_from_sink(args.sink)
+    if args.format == "chrome":
+        roots = roots_from_sink(args.sink) if args.sink else live_roots()
+        text = json.dumps(chrome_trace(roots))
+    elif args.sink:
+        text = render_prometheus(registry_from_sink(args.sink))
     else:
         # Declare the core metric families so a fresh process exposes
         # the full schema (zeros) instead of an empty page.
         import hyperspace_tpu.obs.profile  # noqa: F401 — declares query.* metrics
+        import hyperspace_tpu.obs.runtime  # noqa: F401 — declares jit./proc. gauges
+        import hyperspace_tpu.obs.slo  # noqa: F401 — declares slo.* burn gauges
         import hyperspace_tpu.stats  # noqa: F401 — declares fault-plane counters
 
-        reg = None
-    sys.stdout.write(render_prometheus(reg))
+        text = render_prometheus()
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text if text.endswith("\n") else text + "\n")
+    else:
+        sys.stdout.write(text if text.endswith("\n") else text + "\n")
     return 0
 
 

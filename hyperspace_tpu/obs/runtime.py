@@ -1,0 +1,217 @@
+"""JIT/compile introspection and process-health gauges.
+
+Every XLA executable a long-lived process compiles pins code mappings
+for the life of jax's jit cache — the invisible signal behind the
+XLA:CPU ``vm.max_map_count`` segfault that ``utils/jit_memory.py``
+guards against and lint rule HSL015 forbids statically. This module
+makes that signal *observable at runtime*:
+
+- **Per-call-site compile accounting.** ``compat.jit`` (the one jit
+  entry point the package uses) routes every jitted callable through
+  :func:`instrument`, keyed by its call site. Each call samples the
+  underlying jit cache size (``_cache_size()``, ~0.1 µs); growth means
+  a compile happened, attributed to that key.
+- **Recompile-storm detection** — the dynamic mirror of HSL015: a key
+  whose compile count reaches :data:`STORM_THRESHOLD` while at least
+  half its calls compiled is pathological (fresh-callable-per-call or
+  unstable static args), and emits a structured ``jit.recompile_storm``
+  event *naming the key*, plus a counter. Legitimate warm-up (a handful
+  of shapes over thousands of calls) never trips it.
+- **Process gauges**: ``jit.live_executables`` (sum of live jit cache
+  sizes across instrumented sites), ``proc.map_count`` (memory mappings
+  — the resource the segfault exhausts), and ``proc.rss_watermark
+  .bytes`` (peak RSS). ``utils/jit_memory.py`` refreshes them on its
+  sampled checks; the /metrics endpoint refreshes them per scrape.
+
+Stdlib-only: jax is never imported here — the instrumented callables
+close over it, and cache-size introspection is a duck-typed getattr.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+
+from hyperspace_tpu.obs import events as _events
+from hyperspace_tpu.obs import metrics as _metrics
+
+# A key storms once its compiles reach the threshold AND at least this
+# fraction of its calls compiled (so many-calls/few-compiles warm-up
+# never qualifies). Deterministic — no clocks, no windows to flake.
+STORM_THRESHOLD = 8
+STORM_MIN_COMPILE_RATIO = 0.5
+
+_COMPILES = _metrics.counter("jit.compiles", "XLA compiles observed at instrumented jit sites")
+_STORMS = _metrics.counter("jit.recompile_storms", "recompile-storm events emitted")
+_LIVE = _metrics.gauge("jit.live_executables", "live executables across instrumented jit caches")
+_MAP_COUNT = _metrics.gauge("proc.map_count", "memory mappings of this process (/proc/self/maps)")
+_RSS_WATERMARK = _metrics.gauge("proc.rss_watermark.bytes", "peak resident set size")
+
+_EVT_STORM = _events.declare("jit.recompile_storm")
+
+
+def _cache_size(jitted) -> int:
+    """The jitted callable's executable-cache population; 0 where the
+    installed jax does not expose it (the accounting degrades to
+    call counting, never to an error)."""
+    probe = getattr(jitted, "_cache_size", None)
+    if probe is None:
+        return 0
+    try:
+        return int(probe())
+    except Exception:
+        return 0
+
+
+class _SiteStats:
+    """Aggregated per-call-site-key accounting. Several jitted objects
+    can share one key (a factory re-jitting inside an lru_cache miss is
+    still ONE call site), so the registry aggregates by key, not by
+    callable identity."""
+
+    __slots__ = ("key", "calls", "compiles", "storms")
+
+    def __init__(self, key: str):
+        self.key = key
+        self.calls = 0
+        self.compiles = 0
+        self.storms = 0
+
+
+class _Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sites: dict[str, _SiteStats] = {}
+        # Live jitted callables (weak: a dropped factory product must
+        # not be pinned by its own telemetry) for the executable gauge.
+        self._live: list = []
+
+    def note_call(self, key: str, compiled: int) -> None:
+        storm = None
+        with self._lock:
+            site = self._sites.get(key)
+            if site is None:
+                site = self._sites[key] = _SiteStats(key)
+            site.calls += 1
+            if compiled > 0:
+                site.compiles += compiled
+                if (
+                    site.compiles >= STORM_THRESHOLD * (site.storms + 1)
+                    and site.compiles >= site.calls * STORM_MIN_COMPILE_RATIO
+                ):
+                    # Re-arm at the next threshold multiple so a
+                    # persisting storm re-reports instead of spamming
+                    # one event per compile.
+                    site.storms += 1
+                    storm = (site.calls, site.compiles)
+        if compiled > 0:
+            _COMPILES.inc(compiled)
+        if storm is not None:
+            _STORMS.inc()
+            _EVT_STORM.emit(key=key, calls=storm[0], compiles=storm[1])
+
+    def track(self, jitted) -> None:
+        with self._lock:
+            self._live.append(weakref.ref(jitted))
+
+    def live_executables(self) -> int:
+        with self._lock:
+            refs = list(self._live)
+        alive, total = [], 0
+        for r in refs:
+            fn = r()
+            if fn is not None:
+                alive.append(r)
+                total += _cache_size(fn)
+        with self._lock:
+            self._live = alive
+        return total
+
+    def report(self) -> dict:
+        with self._lock:
+            return {
+                s.key: {"calls": s.calls, "compiles": s.compiles, "storms": s.storms}
+                for s in sorted(self._sites.values(), key=lambda s: s.key)
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._sites.clear()
+            self._live = []
+
+
+REGISTRY = _Registry()
+
+
+class _InstrumentedJit:
+    """A jitted callable plus per-call compile accounting. Transparent:
+    unknown attributes (``lower``, ``clear_cache``, ``_cache_size``)
+    forward to the wrapped callable."""
+
+    __slots__ = ("_jitted", "_key", "_last_size", "__weakref__")
+
+    def __init__(self, jitted, key: str):
+        self._jitted = jitted
+        self._key = key
+        self._last_size = _cache_size(jitted)
+        REGISTRY.track(jitted)
+
+    def __call__(self, *args, **kwargs):
+        out = self._jitted(*args, **kwargs)
+        size = _cache_size(self._jitted)
+        # A cache drop (jit_memory relieving map pressure) shrinks the
+        # cache; only growth counts as compiles.
+        compiled = max(0, size - self._last_size)
+        self._last_size = size
+        REGISTRY.note_call(self._key, compiled)
+        return out
+
+    @property
+    def jit_key(self) -> str:
+        return self._key
+
+    def __getattr__(self, name):
+        return getattr(self._jitted, name)
+
+
+def instrument(jitted, key: str):
+    """Wrap one jitted callable with per-call-site compile accounting
+    (compat.jit routes every jit through here)."""
+    return _InstrumentedJit(jitted, key)
+
+
+def jit_report() -> dict:
+    """Per-call-site-key {calls, compiles, storms} (healthz / tests)."""
+    return REGISTRY.report()
+
+
+def refresh_process_gauges() -> dict:
+    """Re-sample the process-health gauges (map count, RSS watermark,
+    live executables) and return their values. Called by the /metrics
+    scrape path and by jit_memory's sampled pressure checks."""
+    from hyperspace_tpu.utils.jit_memory import map_count
+
+    maps = map_count()
+    rss = _rss_watermark_bytes()
+    live = REGISTRY.live_executables()
+    _MAP_COUNT.set(maps)
+    if rss:
+        _RSS_WATERMARK.set(rss)
+    _LIVE.set(live)
+    return {"map_count": maps, "rss_watermark_bytes": rss, "live_executables": live}
+
+
+def _rss_watermark_bytes() -> int:
+    """Peak RSS in bytes (ru_maxrss is KiB on Linux); 0 where
+    unavailable."""
+    try:
+        import resource
+
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    except (ImportError, ValueError, OSError):
+        return 0
+
+
+def reset() -> None:
+    """Drop per-site accounting and tracked callables (test isolation)."""
+    REGISTRY.reset()

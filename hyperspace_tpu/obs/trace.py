@@ -30,8 +30,11 @@ Finished root traces go to the JSON-lines sink when one is configured
 
 from __future__ import annotations
 
+import collections
 import contextvars
+import itertools
 import json
+import os
 import threading
 import time
 from typing import Any, Callable
@@ -39,18 +42,34 @@ from typing import Any, Callable
 _current: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
     "hyperspace_obs_span", default=None
 )
+# Root-trace id of the active trace (None outside one). Distinct from
+# _current so events/children anywhere in the tree can cite the ROOT id
+# without a parent-pointer walk (spans only link downward).
+_trace_id: contextvars.ContextVar["str | None"] = contextvars.ContextVar(
+    "hyperspace_obs_trace_id", default=None
+)
 
 _enabled = True  # hyperspace.obs.enabled; module-global fast path
 _sink_path: str | None = None  # hyperspace.obs.sink; None = no export
 _sink_lock = threading.Lock()
 _last_trace: "Span | None" = None  # most recently finished ROOT span
+# Bounded ring of recently finished root spans — the live feed behind
+# /debug/trace and the chrome exporter (docs/observability.md). Kept
+# small: a root span tree is a few KB; 32 of them is bounded memory.
+RECENT_ROOTS_MAX = 32
+_recent_lock = threading.Lock()
+_recent_roots: collections.deque = collections.deque(maxlen=RECENT_ROOTS_MAX)
+_trace_seq = itertools.count(1)  # itertools.count is GIL-atomic
 
 
 class Span:
     """One timed unit of work. Use as a context manager; attributes via
     ``set(k=v)`` (chainable), point events via ``add_event``."""
 
-    __slots__ = ("name", "attrs", "children", "events", "start_s", "wall_s", "error", "_token")
+    __slots__ = (
+        "name", "attrs", "children", "events", "start_s", "wall_s",
+        "error", "tid", "trace_id", "_token",
+    )
 
     def __init__(self, name: str, attrs: dict):
         self.name = name
@@ -60,6 +79,8 @@ class Span:
         self.start_s: float | None = None
         self.wall_s: float | None = None
         self.error: str | None = None
+        self.tid: int | None = None  # OS thread the span ran on
+        self.trace_id: str | None = None  # set on ROOT spans only
         self._token = None
 
     def set(self, **attrs) -> "Span":
@@ -81,6 +102,7 @@ class Span:
             # concurrently without a lock.
             parent.children.append(self)
         self._token = _current.set(self)
+        self.tid = threading.get_ident()
         self.start_s = time.perf_counter()
         return self
 
@@ -105,6 +127,16 @@ class Span:
 
     def to_json(self) -> dict:
         out: dict[str, Any] = {"name": self.name, "wall_s": self.wall_s}
+        # Timeline fields for the chrome exporter (obs/export.py):
+        # start_s is this process's perf_counter clock (comparable across
+        # spans of one process; the exporter normalizes), tid lanes the
+        # span onto the OS thread it ran on.
+        if self.start_s is not None:
+            out["t0_s"] = self.start_s
+        if self.tid is not None:
+            out["tid"] = self.tid
+        if self.trace_id is not None:
+            out["trace_id"] = self.trace_id
         if self.attrs:
             out["attrs"] = dict(self.attrs)
         if self.error is not None:
@@ -147,14 +179,20 @@ class _TraceHandle:
     the root span; exiting a true root records it as the last trace and
     emits one JSON line to the sink."""
 
-    __slots__ = ("_span", "_is_root")
+    __slots__ = ("_span", "_is_root", "_id_token")
 
     def __init__(self, span: Span):
         self._span = span
         self._is_root = False
+        self._id_token = None
 
     def __enter__(self) -> Span:
         self._is_root = _current.get() is None
+        if self._is_root:
+            # Root id: pid-qualified so sink lines from several processes
+            # stay distinguishable after aggregation.
+            self._span.trace_id = f"{os.getpid()}-{next(_trace_seq)}"
+            self._id_token = _trace_id.set(self._span.trace_id)
         return self._span.__enter__()
 
     def __exit__(self, exc_type, exc, tb) -> bool:
@@ -162,6 +200,9 @@ class _TraceHandle:
         if self._is_root:
             global _last_trace
             _last_trace = self._span
+            _trace_id.reset(self._id_token)
+            with _recent_lock:
+                _recent_roots.append(self._span)
             _emit(self._span)
         return False
 
@@ -271,11 +312,28 @@ def last_trace() -> "Span | None":
     return _last_trace
 
 
+def current_trace_id() -> "str | None":
+    """The active root trace's id (None outside a trace) — the
+    correlation key structured events carry (obs/events.py)."""
+    return _trace_id.get()
+
+
+def recent_roots(limit: int | None = None) -> "list[Span]":
+    """The most recently finished root spans, oldest first (bounded at
+    RECENT_ROOTS_MAX). Feeds /debug/trace and the chrome exporter."""
+    with _recent_lock:
+        roots = list(_recent_roots)
+    return roots if limit is None else roots[-int(limit):]
+
+
 def reset() -> None:
-    """Drop the last trace and sink config (test isolation)."""
+    """Drop the last trace, recent roots, and sink config (test
+    isolation)."""
     global _last_trace, _sink_path
     _last_trace = None
     _sink_path = None
+    with _recent_lock:
+        _recent_roots.clear()
 
 
 def _emit(root: Span) -> None:

@@ -21,6 +21,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from hyperspace_tpu.compat import jit
 from hyperspace_tpu.exceptions import HyperspaceError
 from hyperspace_tpu.execution.table import ColumnTable
 from hyperspace_tpu.plan.expr import Col, evaluate
@@ -31,7 +32,7 @@ def _pow2(n: int) -> int:
     return 1 << max(int(n - 1).bit_length(), 0) if n > 1 else 1
 
 
-@functools.partial(jax.jit, static_argnames=("num_segments", "fns"))
+@functools.partial(jit, static_argnames=("num_segments", "fns"))
 def _segment_reduce_many(vals, gid, num_segments: int, fns: tuple):
     """One device program reducing several (value, fn) pairs over shared
     segment ids. vals: [A, n_pad]; returns [A, num_segments]."""
@@ -87,7 +88,7 @@ def _make_sharded_segment_reduce(mesh, axes: tuple, num_segments: int, fns: tupl
                 raise ValueError(f)
         return jnp.stack(outs)
 
-    return jax.jit(fn)
+    return jit(fn, key="ops.aggregate.sharded_reduce")
 
 
 def _dense_codes(arr: np.ndarray, valid) -> tuple[np.ndarray, int] | None:

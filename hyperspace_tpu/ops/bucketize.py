@@ -39,7 +39,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from hyperspace_tpu.compat import shard_map
+from hyperspace_tpu.compat import jit, shard_map
 
 AXIS = "x"
 
@@ -147,7 +147,7 @@ def make_bucketize_fn(
         overflow = lax.pmax(overflow.astype(jnp.int32), axes)
         return tuple(rc), rb, rv, overflow[None] if overflow.ndim == 0 else overflow
 
-    return jax.jit(fn)
+    return jit(fn, key="ops.bucketize.exchange")
 
 
 @functools.lru_cache(maxsize=64)
@@ -204,7 +204,7 @@ def make_bucketize_perm_fn(
         overflow = lax.pmax(overflow.astype(jnp.int32), axes)
         return perm, counts[None, :], overflow[None] if overflow.ndim == 0 else overflow
 
-    return jax.jit(fn)
+    return jit(fn, key="ops.bucketize.perm")
 
 
 def bucketize_perm(

@@ -863,7 +863,9 @@ def eval_predicate_mask(
             t, _f = _eval3(expr, cols, iter(lits_tuple))
             return jnp.broadcast_to(t, (n_pad,))
 
-        fn = jax.jit(raw)
+        from hyperspace_tpu.compat import jit
+
+        fn = jit(raw, key="ops.filter.mask")
         with _MASK_FN_LOCK:
             _MASK_FN_CACHE[key] = fn
 

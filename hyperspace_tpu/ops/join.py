@@ -47,7 +47,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from hyperspace_tpu.compat import shard_map
+from hyperspace_tpu.compat import jit, shard_map
 
 SENTINEL = np.iinfo(np.int64).max
 
@@ -72,7 +72,7 @@ def _count_one(lk, rk):
     return start, cum, cum[-1] if cum.shape[0] else jnp.int32(0)
 
 
-@jax.jit
+@jit
 def join_counts(lkeys: jnp.ndarray, rkeys: jnp.ndarray):
     """Per-bucket match counts. lkeys/rkeys: [B, L]/[B, R] sorted integer
     codes padded with their dtype's max (sentinel_for). Returns
@@ -80,7 +80,7 @@ def join_counts(lkeys: jnp.ndarray, rkeys: jnp.ndarray):
     return jax.vmap(_count_one)(lkeys, rkeys)
 
 
-@functools.partial(jax.jit, static_argnames=("cap",))
+@functools.partial(jit, static_argnames=("cap",))
 def join_expand(start: jnp.ndarray, cum: jnp.ndarray, totals: jnp.ndarray, cap: int):
     """Emit (li, ri, valid) of shape [B, cap] from the count phase."""
 
@@ -113,7 +113,7 @@ def pack_shift(l_len: int, r_len: int) -> int | None:
     return None
 
 
-@functools.partial(jax.jit, static_argnames=("m_pad", "shift"))
+@functools.partial(jit, static_argnames=("m_pad", "shift"))
 def _compact_pairs(li, ri, totals, m_pad: int, shift: int | None):
     """[B, cap] padded match pairs → dense bucket-major [m_pad] arrays.
 
@@ -166,7 +166,7 @@ def _rank_codes_to_int32(lkeys_np: np.ndarray, rkeys_np: np.ndarray):
     return codes[:nl].reshape(lkeys_np.shape), codes[nl:].reshape(rkeys_np.shape)
 
 
-@functools.partial(jax.jit, static_argnames=("cap", "m_pad", "shift"))
+@functools.partial(jit, static_argnames=("cap", "m_pad", "shift"))
 def _fused_join(lk, rk, cap: int, m_pad: int, shift: int | None):
     """count → expand → compact in ONE program with speculative static
     capacities, plus an overflow flag. One dispatch, one readback."""
@@ -280,7 +280,7 @@ def _make_sharded_count(mesh: Mesh, axes: tuple):
         _, _, totals = _count_local(lk, rk)
         return totals
 
-    return jax.jit(fn)
+    return jit(fn, key="ops.join.sharded_count")
 
 
 @functools.lru_cache(maxsize=64)
@@ -313,7 +313,7 @@ def _make_sharded_emit(mesh: Mesh, axes: tuple, cap: int, out_cap: int, shift: i
         inter = jnp.stack([lf, rf], axis=1).reshape(-1)  # [2*out_cap]
         return inter, totals
 
-    return jax.jit(fn)
+    return jit(fn, key="ops.join.sharded_emit")
 
 
 def merge_join_sharded(lkeys_np: np.ndarray, rkeys_np: np.ndarray, mesh: Mesh):

@@ -27,6 +27,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from hyperspace_tpu.compat import jit
+
 
 def _seg_scan_extremum(vals, new_seg, op):
     """Segmented inclusive prefix min/max along the last axis: the scan
@@ -42,7 +44,7 @@ def _seg_scan_extremum(vals, new_seg, op):
     return out
 
 
-@functools.partial(jax.jit, static_argnames=("num_segments", "channels"))
+@functools.partial(jit, static_argnames=("num_segments", "channels"))
 def _fused_join_agg(pk, sk, pvals, svals, gid, num_segments: int, channels: tuple):
     """pk/sk: [B, Lp]/[B, Ls] per-bucket sorted int32 codes (pads carry
     the dtype max). pvals [Ap, B, Lp] / svals [As, B, Ls]: float64

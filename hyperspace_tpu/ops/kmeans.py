@@ -18,11 +18,13 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from hyperspace_tpu.compat import jit
+
 _TRAIN_SAMPLE = 131_072
 _ASSIGN_CHUNK = 262_144
 
 
-@functools.partial(jax.jit, static_argnames=("iters",))
+@functools.partial(jit, static_argnames=("iters",))
 def _lloyd(x: jnp.ndarray, init: jnp.ndarray, iters: int) -> jnp.ndarray:
     """x [n, d] f32, init [C, d] f32 → trained centroids [C, d]."""
     xsq = jnp.sum(x * x, axis=1, keepdims=True)  # [n, 1]
@@ -59,7 +61,7 @@ def train_centroids(
     return np.asarray(jax.device_get(out))
 
 
-@jax.jit
+@jit
 def _assign(x: jnp.ndarray, c: jnp.ndarray) -> jnp.ndarray:
     d2 = (
         jnp.sum(x * x, axis=1, keepdims=True)

@@ -192,7 +192,9 @@ def _make_batch_sort(num_operands: int, num_keys: int):
     def f(*ops):
         return lax.sort(ops, num_keys=num_keys, is_stable=True)[-1]
 
-    return jax.jit(f)
+    from hyperspace_tpu.compat import jit
+
+    return jit(f, key="ops.sortkeys.batch_sort")
 
 
 @functools.lru_cache(maxsize=16)
@@ -216,7 +218,9 @@ def _make_sharded_topn(mesh, axes, n: int):
         s = lax.sort((hi, lo, idx), num_keys=2, is_stable=True)
         return s[0][:n], s[1][:n], s[2][:n]
 
-    return jax.jit(fn)
+    from hyperspace_tpu.compat import jit
+
+    return jit(fn, key="ops.sortkeys.sharded_topn")
 
 
 @functools.lru_cache(maxsize=16)
@@ -236,7 +240,9 @@ def _make_sharded_le(mesh, axes):
     def fn(hi, lo, thi, tlo):
         return (hi < thi) | ((hi == thi) & (lo <= tlo))
 
-    return jax.jit(fn)
+    from hyperspace_tpu.compat import jit
+
+    return jit(fn, key="ops.sortkeys.sharded_le")
 
 
 def distributed_top_n_candidates(lanes_u32: np.ndarray, n: int, mesh) -> np.ndarray | None:

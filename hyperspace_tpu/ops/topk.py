@@ -79,7 +79,9 @@ def _make_tile_kernel(k: int, tile: int, interpret: bool):
 
     # jit so repeated calls with the same shape hit the executable cache
     # instead of re-lowering the pallas_call every invocation.
-    return jax.jit(run), out_lanes
+    from hyperspace_tpu.compat import jit
+
+    return jit(run, key="ops.topk.pallas_tile"), out_lanes
 
 
 def _pallas_topk(scores: jnp.ndarray, k: int) -> tuple[jnp.ndarray, jnp.ndarray]:

@@ -25,8 +25,16 @@ from __future__ import annotations
 
 import threading
 
+from hyperspace_tpu.obs import events as obs_events
 from hyperspace_tpu.obs import metrics as obs_metrics
 from hyperspace_tpu.serve.plan_cache import versioned_plan_key
+
+# One admission flushing this many resident entries is a storm: either
+# the budget is far too small for the workload or one huge result is
+# churning the whole cache — worth a structured WARN, not just a
+# counter tick (obs/events.py).
+EVICTION_STORM_THRESHOLD = 8
+_EVT_EVICTION_STORM = obs_events.declare("serve.result_cache.eviction_storm")
 
 
 def table_nbytes(table) -> int:
@@ -90,6 +98,8 @@ class ResultCache:
             self._gauge_bytes.set(self._bytes)
         if evicted:
             self._evictions.inc(evicted)
+            if evicted >= EVICTION_STORM_THRESHOLD:
+                _EVT_EVICTION_STORM.emit(evicted=evicted, admitted_bytes=nb)
         return True
 
     def clear(self) -> None:

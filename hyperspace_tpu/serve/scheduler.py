@@ -45,10 +45,15 @@ import threading
 import time
 
 from hyperspace_tpu.exceptions import AdmissionRejected, QueryTimeout
+from hyperspace_tpu.obs import events as obs_events
 from hyperspace_tpu.obs import metrics as obs_metrics
 from hyperspace_tpu.obs import trace as obs_trace
 from hyperspace_tpu.serve.plan_cache import PlanCache
 from hyperspace_tpu.serve.result_cache import ResultCache
+
+# Declared at import so submit's narrow error contract (AdmissionRejected
+# only) stays narrow: Event.emit never raises (obs/events.py).
+_EVT_REJECTED = obs_events.declare("serve.admission_rejected")
 
 _ADMITTED = obs_metrics.counter("serve.admitted", "queries accepted into the queue")
 _REJECTED = obs_metrics.counter("serve.rejected", "submits refused by admission control")
@@ -171,6 +176,19 @@ class QueryServer:
         ]
         for t in self._threads:
             t.start()
+        # Runtime health plane (docs/observability.md): opt-in /metrics +
+        # /healthz endpoints sharing this server's lifecycle. Zero
+        # overhead when disabled — one conf read, no import, no thread,
+        # no socket.
+        self._http = None
+        if getattr(conf, "obs_http_enabled", False):
+            from hyperspace_tpu.obs import http as obs_http
+
+            self._http = obs_http.acquire(
+                host=conf.obs_http_host, port=conf.obs_http_port
+            )
+            self._http.attach_session(session)
+            self._http.attach_server(self)
 
     # -- client API -------------------------------------------------------
     def submit(self, plan, priority: bool = False, timeout: float | None = None) -> QueryHandle:
@@ -187,10 +205,14 @@ class QueryServer:
             with self._cv:
                 if not self._accepting:
                     _REJECTED.inc()
+                    _EVT_REJECTED.emit(reason="not_accepting")
                     raise AdmissionRejected("server is not accepting queries (draining or shut down)")
                 depth = len(self._prio) + len(self._fifo)
                 if depth >= self.max_queue_depth:
                     _REJECTED.inc()
+                    _EVT_REJECTED.emit(
+                        reason="queue_full", depth=depth, max_depth=self.max_queue_depth
+                    )
                     raise AdmissionRejected(
                         f"admission queue full ({depth} >= max depth {self.max_queue_depth})",
                         depth=depth, max_depth=self.max_queue_depth,
@@ -216,6 +238,27 @@ class QueryServer:
     def queue_depth(self) -> int:
         with self._cv:
             return len(self._prio) + len(self._fifo)
+
+    def saturation(self) -> dict:
+        """Point-in-time scheduler load — the /healthz overload signal
+        (docs/serving.md): how full the admission queue is and how many
+        workers are busy tells a balancer to back off BEFORE submits
+        start bouncing off AdmissionRejected."""
+        with self._cv:
+            return {
+                "workers": self.workers,
+                "inflight": self._inflight,
+                "queue_depth": len(self._prio) + len(self._fifo),
+                "max_queue_depth": self.max_queue_depth,
+                "accepting": self._accepting,
+            }
+
+    @property
+    def health_endpoint(self):
+        """The attached HealthServer (None unless
+        `hyperspace.obs.http.enabled` was true at construction)."""
+        with self._cv:
+            return self._http
 
     # -- lifecycle --------------------------------------------------------
     def drain(self, timeout: float | None = None) -> bool:
@@ -259,6 +302,17 @@ class QueryServer:
                 self._cv.notify_all()
         for t in self._threads:
             t.join(timeout=5.0)
+        # Health plane rides the server lifecycle: drop this server from
+        # /healthz and release the shared endpoint (the last QueryServer
+        # out closes the socket). Claimed exactly once across repeated
+        # shutdown() calls.
+        with self._cv:
+            http, self._http = self._http, None
+        if http is not None:
+            from hyperspace_tpu.obs import http as obs_http
+
+            http.detach_server(self)
+            obs_http.release()
 
     def __enter__(self) -> "QueryServer":
         return self

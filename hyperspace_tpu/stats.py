@@ -38,6 +38,9 @@ Counter names in use:
 - ``io.footer_cache.hits``    parquet footer parses skipped by the
   mtime-validated footer cache (execution/io.py)
 - ``io.footer_cache.misses``  footer parses that actually opened the file
+- ``jit_memory.cache_drops``  jax cache drops by the map-count guard
+  (utils/jit_memory.py) — each one is a narrowly avoided XLA:CPU
+  map-exhaustion segfault, paired with a WARN ``jit.cache_drop`` event
 """
 
 from __future__ import annotations
@@ -65,6 +68,7 @@ KNOWN_COUNTERS = (
     "recover.on_access_failed",
     "io.footer_cache.hits",
     "io.footer_cache.misses",
+    "jit_memory.cache_drops",
 )
 
 _counters = {name: _metrics.counter(name) for name in KNOWN_COUNTERS}

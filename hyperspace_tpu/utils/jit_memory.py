@@ -11,6 +11,14 @@ occasional recompile is far cheaper than a dead process.
 
 The check reads /proc/self/maps, so it is sampled (every
 `_CHECK_EVERY` calls) and is a no-op on platforms without procfs.
+
+Observability (docs/observability.md): the guard used to be a silent
+save — the only evidence was the absence of a segfault. Every sampled
+check now feeds the ``proc.map_count`` gauge, and every cache drop
+counts (``jit_memory.cache_drops``) and emits a WARN ``jit.cache_drop``
+event carrying the observed map count and the limit, so the /metrics
+and /debug/events endpoints (obs/http.py) show the pressure building
+*before* it becomes a dead process.
 """
 
 from __future__ import annotations
@@ -18,10 +26,15 @@ from __future__ import annotations
 import itertools
 import threading
 
+from hyperspace_tpu import stats
+from hyperspace_tpu.obs import events as _events
+
 _CHECK_EVERY = 16
 _counter = itertools.count()
 _limit_cache: list = []  # [int] once resolved
 _limit_lock = threading.Lock()
+
+_EVT_CACHE_DROP = _events.declare("jit.cache_drop")
 
 
 def _map_limit() -> int:
@@ -36,7 +49,9 @@ def _map_limit() -> int:
         return _limit_cache[0]
 
 
-def _map_count() -> int:
+def map_count() -> int:
+    """Memory mappings of this process (0 where /proc is unreadable) —
+    the resource the XLA:CPU jit cache exhausts."""
     try:
         with open("/proc/self/maps", "rb") as f:
             return sum(1 for _ in f)
@@ -49,10 +64,18 @@ def maybe_relieve_jit_pressure() -> bool:
     nears the kernel mapping limit. Returns True when a clear ran."""
     if next(_counter) % _CHECK_EVERY:
         return False
+    from hyperspace_tpu.obs import runtime as obs_runtime
+
     limit = _map_limit()
-    if not limit or _map_count() <= limit:
+    maps = obs_runtime.refresh_process_gauges()["map_count"]
+    if not limit or maps <= limit:
         return False
     import jax
 
     jax.clear_caches()
+    stats.increment("jit_memory.cache_drops")
+    _EVT_CACHE_DROP.emit(map_count=maps, limit=limit)
+    # The drop emptied every instrumented jit cache — re-sample the
+    # gauges so jit.live_executables reflects the post-drop state.
+    obs_runtime.refresh_process_gauges()
     return True

@@ -1,0 +1,74 @@
+"""Chrome-trace export smoke — the CI observability artifact.
+
+Run as ``python tests/_chrome_trace_smoke.py [out.json]``: builds one
+real (smoke-scale) index with the streaming pipeline on and runs one
+TPC-DS query, both under a JSON-lines sink, then exports the span trees
+with ``obs.export --format chrome`` and asserts the document is a valid
+Chrome Trace Event file whose build-pipeline stages *visibly overlap*
+(≥2 stage slices concurrent in time) — the property Perfetto renders as
+parallel lanes. Kept out of pytest collection (leading underscore):
+tier-1 covers the exporter's unit semantics; this is the end-to-end
+"a real build's timeline renders and shows the overlap" check."""
+
+import json
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    from benchmarks.tpcds import cached_tpcds, tpcds_indexes, tpcds_queries
+    from hyperspace_tpu import Hyperspace, HyperspaceSession
+    from hyperspace_tpu.obs import export
+
+    out_path = sys.argv[1] if len(sys.argv) > 1 else "chrome-trace.json"
+    base = Path(tempfile.mkdtemp(prefix="hs_chrome_smoke_"))
+    sink = base / "events.jsonl"
+    roots = cached_tpcds(sf=0.01, cache_root=base)
+    session = HyperspaceSession(system_path=str(base / "idx"), num_buckets=8)
+    session.conf.set("hyperspace.obs.sink", str(sink))
+    # Smoke-scale data fits in memory, which would take the in-memory
+    # build path; a tiny budget forces the streaming pipeline whose
+    # overlapped stages are exactly what this artifact must show.
+    session.conf.set("hyperspace.index.build.memoryBudgetBytes", 1 << 20)
+    session.conf.set("hyperspace.index.build.chunkBytes", 256 << 10)
+    hs = Hyperspace(session)
+    scans = {name: session.parquet(root) for name, root in roots.items()}
+    tpcds_indexes(hs, scans)  # smoke build(s): action traces land in the sink
+    session.enable_hyperspace()
+    name, plan = sorted(tpcds_queries(scans).items())[0]
+    session.run(plan)  # one TPC-DS query trace
+
+    rc = export.main(["--format", "chrome", "--sink", str(sink), "--output", out_path])
+    assert rc == 0
+    doc = json.loads(Path(out_path).read_text())
+    xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert xs, "no complete events exported"
+    for e in xs:  # well-formed: Perfetto rejects malformed events
+        assert {"name", "ts", "dur", "pid", "tid", "args"} <= set(e)
+        assert e["ts"] >= 0 and e["dur"] >= 0
+
+    build = [e for e in xs if e["name"].startswith("build.")]
+    assert build, "no build-pipeline stage spans in the trace"
+    intervals = [(e["ts"], e["ts"] + e["dur"], e["name"]) for e in build]
+    overlaps = [
+        (a[2], b[2])
+        for i, a in enumerate(intervals)
+        for b in intervals[i + 1:]
+        if a[0] < b[1] and b[0] < a[1]
+    ]
+    assert overlaps, f"no overlapping build stages among {len(build)} spans"
+    query = [e for e in xs if e["name"].startswith("execute.")]
+    assert query, "no executed-operator spans from the TPC-DS query"
+    print(
+        f"OK: {len(xs)} spans -> {out_path}; {len(build)} build-stage slices, "
+        f"{len(overlaps)} overlapping pairs (e.g. {overlaps[0][0]} ~ {overlaps[0][1]}); "
+        f"{len(query)} query operator slices"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

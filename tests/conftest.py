@@ -68,12 +68,15 @@ def _obs_reset():
     test, so cross-test counter drift can't leak into assertions and a
     test that configures a sink can't make a later test write to it."""
     from hyperspace_tpu import stats
-    from hyperspace_tpu.obs import metrics, trace
+    from hyperspace_tpu.obs import events, metrics, runtime, slo, trace
 
     stats.reset()
     metrics.REGISTRY.reset()
     trace.reset()
     trace.set_enabled(True)
+    events.reset()
+    slo.reset()
+    runtime.reset()
     yield
 
 

@@ -1,0 +1,563 @@
+"""Runtime health plane: structured events, JIT/compile introspection,
+SLO burn rates, the /metrics + /healthz HTTP endpoints, and the
+chrome-trace exporter (docs/observability.md "live endpoints")."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.obs import events, metrics, runtime, slo, trace
+from hyperspace_tpu.obs import http as obs_http
+from hyperspace_tpu.obs.export import (
+    chrome_trace,
+    escape_help,
+    escape_label_value,
+    render_prometheus,
+    roots_from_sink,
+)
+
+
+class FakeSession:
+    """The session surface the health plane reads: conf + the
+    lock-guarded index_health map."""
+
+    def __init__(self, **conf_overrides):
+        self.conf = HyperspaceConf()
+        for k, v in conf_overrides.items():
+            self.conf.set(k, v)
+        self._state_lock = threading.RLock()
+        self.index_health = {}
+
+
+def _get(url, timeout=10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read().decode()
+
+
+# -- structured events -----------------------------------------------------
+
+
+def test_event_ring_records_and_bounds():
+    evt = events.declare("fallback.replan")
+    events.configure(max_events=4)
+    for i in range(7):
+        evt.emit(index=f"i{i}")
+    recent = events.recent()
+    assert len(recent) == 4
+    assert [e["fields"]["index"] for e in recent] == ["i3", "i4", "i5", "i6"]
+    assert metrics.REGISTRY.get("obs.events.dropped").value == 3
+    assert all(e["severity"] == "warn" for e in recent)
+    # seq strictly increases; ts is wall-clock
+    seqs = [e["seq"] for e in recent]
+    assert seqs == sorted(seqs)
+
+
+def test_undeclared_event_raises_at_declare():
+    with pytest.raises(KeyError, match="undeclared event"):
+        events.declare("fallbck.replan")
+
+
+def test_event_severity_filter_and_counts():
+    events.declare("advisor.routing.demoted").emit(signature="s")
+    events.declare("index.quarantined").emit(index="x")
+    assert len(events.recent(level="warn")) == 1
+    assert len(events.recent(level="info")) == 2
+    counts = events.counts_by_severity()
+    assert counts["info"] == 1 and counts["warn"] == 1
+    with pytest.raises(ValueError):
+        events.recent(level="loud")
+
+
+def test_event_carries_active_trace_id():
+    evt = events.declare("fallback.replan")
+    with trace.trace("query"):
+        inside = evt.emit(index="a")
+    outside = evt.emit(index="b")
+    assert inside["trace_id"] is not None
+    assert outside["trace_id"] is None
+    root = trace.last_trace()
+    assert root.trace_id == inside["trace_id"]
+
+
+# -- JIT/compile introspection ---------------------------------------------
+
+
+def test_compat_jit_counts_compiles_per_key():
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.compat import jit
+
+    f = jit(lambda x: x + 1, key="test.stable")
+    for _ in range(5):
+        f(jnp.ones(3))
+    report = runtime.jit_report()["test.stable"]
+    assert report["calls"] == 5
+    assert report["compiles"] == 1  # one shape, one executable
+    assert report["storms"] == 0
+    # a second shape compiles once more
+    f(jnp.ones((2, 2)))
+    assert runtime.jit_report()["test.stable"]["compiles"] == 2
+
+
+def test_jit_in_a_loop_trips_recompile_storm_naming_the_key():
+    """The dynamic mirror of lint rule HSL015: a fresh callable jitted
+    per call at one call site must emit jit.recompile_storm naming it."""
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.compat import jit
+
+    for i in range(runtime.STORM_THRESHOLD + 2):
+        f = jit(lambda x, _i=i: x + _i, key="test.jit_loop")  # noqa: HSL015 — deliberate storm
+        f(jnp.ones(2))
+    storms = [e for e in events.recent() if e["name"] == "jit.recompile_storm"]
+    assert len(storms) == 1  # re-armed per threshold multiple, not per compile
+    assert storms[0]["fields"]["key"] == "test.jit_loop"
+    assert storms[0]["fields"]["compiles"] >= runtime.STORM_THRESHOLD
+    assert metrics.REGISTRY.get("jit.recompile_storms").value == 1
+    assert runtime.jit_report()["test.jit_loop"]["storms"] == 1
+
+
+def test_warm_call_sites_never_storm():
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.compat import jit
+
+    f = jit(lambda x: x * 2, key="test.warm")
+    # Many distinct shapes (legitimate warm-up) but far more warm calls.
+    for n in range(1, 1 + runtime.STORM_THRESHOLD + 4):
+        for _ in range(4):
+            f(jnp.ones(n))
+    site = runtime.jit_report()["test.warm"]
+    assert site["compiles"] >= runtime.STORM_THRESHOLD
+    assert site["storms"] == 0  # compile ratio stays under the floor
+
+
+def test_instrumented_jit_forwards_attributes_and_default_key():
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.compat import jit
+
+    def doubler(x):
+        return x * 2
+
+    f = jit(doubler)
+    f(jnp.ones(2))
+    assert f.jit_key.endswith("doubler")
+    assert callable(getattr(f, "lower", None))  # pjit attr forwarded
+    assert f.jit_key in runtime.jit_report()
+
+
+def test_process_gauges_refresh():
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.compat import jit
+
+    f = jit(lambda x: x + 3, key="test.gauges")
+    f(jnp.ones(2))
+    vals = runtime.refresh_process_gauges()
+    assert vals["map_count"] > 0
+    assert vals["rss_watermark_bytes"] > 0
+    assert vals["live_executables"] >= 1
+    assert metrics.REGISTRY.get("proc.map_count").value == vals["map_count"]
+    assert metrics.REGISTRY.get("jit.live_executables").value == vals["live_executables"]
+
+
+def test_jit_memory_drop_is_observable(monkeypatch):
+    from hyperspace_tpu import stats
+    from hyperspace_tpu.utils import jit_memory
+
+    monkeypatch.setattr(jit_memory, "_limit_cache", [1])  # force "over limit"
+    dropped = False
+    for _ in range(jit_memory._CHECK_EVERY + 1):  # sampled: hit the stride once
+        dropped = jit_memory.maybe_relieve_jit_pressure() or dropped
+    assert dropped
+    assert stats.get("jit_memory.cache_drops") >= 1
+    drops = [e for e in events.recent() if e["name"] == "jit.cache_drop"]
+    assert drops and drops[0]["fields"]["limit"] == 1
+    assert drops[0]["fields"]["map_count"] > 1
+
+
+# -- SLO burn rates --------------------------------------------------------
+
+
+def _serve_counters():
+    return (
+        metrics.counter("serve.completed"),
+        metrics.counter("serve.failed"),
+        metrics.counter("serve.timeouts"),
+        metrics.counter("serve.cancelled"),
+        metrics.histogram("serve.latency.seconds"),
+    )
+
+
+def test_burn_rate_math_is_exact():
+    completed, failed, *_ = _serve_counters()
+    slo.sample(now=100.0)
+    completed.inc(980)
+    failed.inc(20)  # bad fraction 0.02; budget 0.001 -> burn 20
+    slo.sample(now=160.0)
+    burn = slo.objective("serve.availability").window_burn(60.0, now=160.0)
+    assert burn == pytest.approx(20.0)
+
+
+def test_burn_windows_clamp_to_observed_span():
+    completed, failed, *_ = _serve_counters()
+    slo.sample(now=0.0)
+    completed.inc(9)
+    failed.inc(1)
+    slo.sample(now=10.0)  # only 10s of history; the 3600s window clamps
+    burn = slo.objective("serve.availability").window_burn(3600.0, now=10.0)
+    assert burn == pytest.approx(0.1 / 0.001)
+
+
+def _availability_burn_events():
+    return [
+        e for e in events.recent()
+        if e["name"] == "slo.burn" and e["fields"]["objective"] == "serve.availability"
+    ]
+
+
+def test_verdicts_ok_page_recover_and_event_rearm():
+    completed, failed, *_ = _serve_counters()
+    slo.sample(now=0.0)
+    completed.inc(10_000)
+    slo.sample(now=4000.0)
+    out = slo.evaluate(now=4000.0)
+    assert out["serve.availability"]["verdict"] == "ok"
+    # a hard failure burst, judged while it is still inside every window
+    failed.inc(3_000)
+    slo.sample(now=4030.0)
+    out = slo.evaluate(now=4030.0)
+    assert out["serve.availability"]["verdict"] == "page"
+    assert len(_availability_burn_events()) == 1
+    # still paging: no duplicate event
+    slo.evaluate(now=4030.0)
+    assert len(_availability_burn_events()) == 1
+    # recovery: clean traffic pushes the burst out of the PAGE windows;
+    # the long warn window still remembers it — exactly the SRE shape
+    # (stop paging fast, keep warning while the budget is still burnt)
+    completed.inc(50_000)
+    slo.sample(now=4100.0)
+    out = slo.evaluate(now=4100.0)
+    assert out["serve.availability"]["verdict"] == "warn"
+    # a second burst re-arms the event
+    failed.inc(5_000)
+    slo.sample(now=4130.0)
+    assert slo.evaluate(now=4130.0)["serve.availability"]["verdict"] == "page"
+    assert len(_availability_burn_events()) == 2
+
+
+def test_latency_objective_counts_goods_from_buckets():
+    *_, latency = _serve_counters()
+    slo.configure(latency_threshold_s=0.1)
+    slo.sample(now=0.0)
+    for _ in range(99):
+        latency.observe(0.01)
+    latency.observe(50.0)  # one terrible tail query
+    slo.sample(now=60.0)
+    burn = slo.objective("serve.latency_p99").window_burn(60.0, now=60.0)
+    # bad fraction 1/100 = budget exactly -> burn 1.0
+    assert burn == pytest.approx(1.0)
+
+
+def test_undeclared_objective_raises():
+    with pytest.raises(KeyError, match="undeclared SLO objective"):
+        slo.objective("serve.availabilty")
+
+
+def test_insufficient_data_is_none_not_zero():
+    _serve_counters()
+    assert slo.objective("serve.availability").window_burn(60.0) is None
+    slo.sample(now=0.0)
+    assert slo.objective("serve.availability").window_burn(60.0, now=0.0) is None
+
+
+# -- histogram percentile edge shapes (SLO math depends on these) ----------
+
+
+def test_histogram_empty_quantiles_are_none():
+    h = metrics.Histogram("t.empty", buckets=(1.0, 2.0))
+    assert h.quantile(0.5) is None
+    assert h.percentiles() == {"p50": None, "p95": None, "p99": None}
+    assert h.bucket_counts()[-1] == (float("inf"), 0)
+
+
+def test_histogram_single_sample_returns_that_value():
+    h = metrics.Histogram("t.one", buckets=(1.0, 2.0, 4.0))
+    h.observe(1.7)
+    for q in (0.01, 0.5, 0.99):
+        assert h.quantile(q) == pytest.approx(1.7)
+
+
+def test_histogram_all_in_one_bucket_interpolates_min_max():
+    h = metrics.Histogram("t.tight", buckets=(1.0, 10.0))
+    for v in (2.0, 3.0, 4.0):
+        h.observe(v)
+    # owning bucket is (1, 10] but observed range is [2, 4] — quantiles
+    # must stay inside the observed range, not smear across the bucket.
+    assert 2.0 <= h.quantile(0.5) <= 4.0
+    assert h.quantile(0.0) == pytest.approx(2.0)
+    assert h.quantile(1.0) == pytest.approx(4.0)
+
+
+def test_histogram_overflow_bucket_uses_observed_max():
+    h = metrics.Histogram("t.over", buckets=(1.0, 2.0))
+    for v in (5.0, 7.0, 9.0):
+        h.observe(v)  # all past the last bound
+    assert h.bucket_counts() == [(1.0, 0), (2.0, 0), (float("inf"), 3)]
+    assert 5.0 <= h.quantile(0.5) <= 9.0
+    assert h.quantile(1.0) == pytest.approx(9.0)
+
+
+# -- Prometheus escaping ---------------------------------------------------
+
+
+def test_prometheus_escapes_help_and_labels():
+    assert escape_help("a\\b\nc") == "a\\\\b\\nc"
+    assert escape_label_value('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
+    reg = metrics.MetricsRegistry()
+    reg.counter("hostile", 'line1\nline2 "q" \\slash')
+    reg.histogram("hostile.h", "multi\nline", buckets=(1.0,))
+    text = render_prometheus(reg)
+    for line in text.splitlines():
+        # the exposition must stay line-structured: every line is a
+        # comment or `name{labels} value`
+        assert line.startswith("#") or len(line.split(" ")) == 2, line
+    assert "# HELP hyperspace_hostile line1\\nline2" in text
+
+
+def test_prometheus_round_trip_recovers_values():
+    reg = metrics.MetricsRegistry()
+    c = reg.counter("rt.count", "with\nnewline")
+    c.inc(41)
+    g = reg.gauge("rt.gauge")
+    g.set(2.5)
+    text = render_prometheus(reg)
+    parsed = {}
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        name, value = line.rsplit(" ", 1)
+        parsed[name] = float(value)
+    assert parsed["hyperspace_rt_count"] == 41
+    assert parsed["hyperspace_rt_gauge"] == 2.5
+
+
+# -- HTTP endpoints --------------------------------------------------------
+
+
+@pytest.fixture
+def http_server():
+    """A QueryServer with the health plane enabled on an ephemeral port
+    (DI run_fn: scheduler semantics without a real dataset)."""
+    from hyperspace_tpu.serve.scheduler import QueryServer
+
+    session = FakeSession(**{"hyperspace.obs.http.enabled": "true"})
+    server = QueryServer(session, workers=4, max_queue_depth=512, run_fn=lambda p: p * 2)
+    try:
+        yield session, server, server.health_endpoint
+    finally:
+        server.shutdown()
+
+
+def test_endpoints_scrape_under_16_client_hammer(http_server):
+    session, server, ep = http_server
+    stop = threading.Event()
+    errors = []
+
+    def client(cid):
+        try:
+            while not stop.is_set():
+                assert server.submit(cid).result(timeout=30) == cid * 2
+        except Exception as e:  # pragma: no cover - surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(16)]
+    for t in threads:
+        t.start()
+    try:
+        deadline = time.monotonic() + 3.0
+        scrapes = 0
+        while time.monotonic() < deadline:
+            code, body = _get(ep.url("/metrics"))
+            assert code == 200
+            assert "hyperspace_serve_completed" in body
+            assert "hyperspace_slo_serve_availability_burn_rate" in body
+            code, body = _get(ep.url("/healthz"))
+            assert code == 200
+            doc = json.loads(body)
+            assert doc["status"] in ("ok", "degraded")
+            assert doc["scheduler"][0]["workers"] == 4
+            scrapes += 1
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+    assert not errors
+    assert scrapes >= 2
+    # enough scrape samples accumulated to compute a burn rate
+    assert slo.objective("serve.availability").window_burn(60.0) is not None
+
+
+def test_disabled_http_means_no_thread_no_socket():
+    from hyperspace_tpu.serve.scheduler import QueryServer
+
+    session = FakeSession()  # hyperspace.obs.http.enabled defaults false
+    server = QueryServer(session, workers=1, run_fn=lambda p: p)
+    try:
+        assert server.health_endpoint is None
+        assert obs_http.shared() is None
+        assert not any(t.name == "hs-obs-http" for t in threading.enumerate())
+    finally:
+        server.shutdown()
+
+
+def test_http_lifecycle_refcounts_across_servers():
+    from hyperspace_tpu.serve.scheduler import QueryServer
+
+    session = FakeSession(**{"hyperspace.obs.http.enabled": "true"})
+    s1 = QueryServer(session, workers=1, run_fn=lambda p: p)
+    s2 = QueryServer(session, workers=1, run_fn=lambda p: p)
+    try:
+        assert s1.health_endpoint is s2.health_endpoint  # one port per process
+        port = s1.health_endpoint.port
+        s1.shutdown()
+        # still serving for s2
+        code, _ = _get(f"http://127.0.0.1:{port}/metrics")
+        assert code == 200
+    finally:
+        s2.shutdown()
+    assert obs_http.shared() is None
+    assert not any(t.name == "hs-obs-http" for t in threading.enumerate())
+
+
+def test_healthz_reports_quarantine_and_jit_sites(http_server):
+    session, server, ep = http_server
+    with session._state_lock:
+        session.index_health["/idx/broken"] = {"reason": "torn bucket", "path": "b0"}
+    code, body = _get(ep.url("/healthz"))
+    doc = json.loads(body)
+    assert doc["status"] == "degraded"
+    assert doc["indexes"]["/idx/broken"]["reason"] == "torn bucket"
+    assert "sites" in doc["jit"] and "map_count" in doc["jit"]
+
+
+def test_debug_events_and_trace_endpoints(http_server):
+    session, server, ep = http_server
+    events.declare("index.quarantined").emit(index="x")
+    events.declare("advisor.routing.demoted").emit(signature="s")
+    with trace.trace("query"):
+        with trace.span("execute.Filter"):
+            pass
+    code, body = _get(ep.url("/debug/events?level=warn"))
+    doc = json.loads(body)
+    assert code == 200
+    assert [e["name"] for e in doc["events"]] == ["index.quarantined"]
+    code, body = _get(ep.url("/debug/trace?limit=4"))
+    doc = json.loads(body)
+    assert [t["name"] for t in doc["traces"]] == ["query"]
+    assert doc["traces"][0]["children"][0]["name"] == "execute.Filter"
+    assert doc["traces"][0]["trace_id"]
+
+
+def test_http_unknown_path_404_and_bad_query_400(http_server):
+    _, _, ep = http_server
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(ep.url("/nope"))
+    assert e.value.code == 404
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(ep.url("/debug/events?limit=banana"))
+    assert e.value.code == 400
+
+
+def test_healthz_standalone_server_without_session():
+    hs = obs_http.HealthServer().start()
+    try:
+        code, body = _get(hs.url("/healthz"))
+        doc = json.loads(body)
+        assert doc["status"] == "ok"
+        assert doc["indexes"] == {} and doc["scheduler"] == []
+    finally:
+        hs.stop()
+
+
+# -- chrome trace export ---------------------------------------------------
+
+
+def test_chrome_trace_lanes_and_overlap(tmp_path):
+    sink = tmp_path / "events.jsonl"
+    trace.configure(sink=str(sink))
+    with trace.trace("root"):
+        def work():
+            with trace.span("stage"):
+                time.sleep(0.03)
+
+        threads = [threading.Thread(target=trace.wrap(work)) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    doc = chrome_trace(roots_from_sink(str(sink)))
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    stages = [e for e in xs if e["name"] == "stage"]
+    assert len(stages) == 2
+    assert len({e["tid"] for e in stages}) == 2  # separate thread lanes
+    a, b = [(e["ts"], e["ts"] + e["dur"]) for e in stages]
+    assert a[0] < b[1] and b[0] < a[1]  # genuinely overlapping slices
+    # every event is a well-formed complete event
+    for e in xs:
+        assert e["ts"] >= 0 and e["dur"] >= 0 and e["pid"] and e["tid"]
+    metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert {(m["pid"], m["tid"]) for m in metas} >= {(e["pid"], e["tid"]) for e in xs}
+
+
+def test_chrome_trace_tolerates_missing_timeline_fields():
+    legacy = {
+        "name": "root", "wall_s": 0.5,
+        "children": [{"name": "child", "wall_s": 0.2}],
+    }
+    doc = chrome_trace([legacy])
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert [e["name"] for e in xs] == ["root", "child"]
+    assert all(e["ts"] == 0.0 for e in xs)
+
+
+def test_export_cli_chrome_and_prom(tmp_path, capsys):
+    from hyperspace_tpu.obs import export
+
+    sink = tmp_path / "s.jsonl"
+    trace.configure(sink=str(sink))
+    with trace.trace("query"):
+        with trace.span("execute.Scan"):
+            pass
+    out = tmp_path / "trace.json"
+    assert export.main(["--format", "chrome", "--sink", str(sink), "--output", str(out)]) == 0
+    doc = json.loads(out.read_text())
+    assert any(e["name"] == "execute.Scan" for e in doc["traceEvents"])
+    assert export.main(["--sink", str(sink)]) == 0
+    assert "hyperspace_query_count 1" in capsys.readouterr().out
+
+
+# -- config plumbing -------------------------------------------------------
+
+
+def test_new_config_keys_round_trip():
+    conf = HyperspaceConf()
+    assert conf.get("hyperspace.obs.http.enabled") is False
+    conf.set("hyperspace.obs.http.enabled", "true")
+    conf.set("hyperspace.obs.http.port", 19464)
+    conf.set("hyperspace.obs.http.host", "0.0.0.0")
+    assert conf.obs_http_enabled is True
+    assert conf.get("hyperspace.obs.http.port") == 19464
+    assert conf.get("hyperspace.obs.http.host") == "0.0.0.0"
+    conf.set("hyperspace.obs.events.maxEvents", 8)
+    assert conf.get("hyperspace.obs.events.maxEvents") == 8
+    conf.set("hyperspace.obs.slo.availabilityTarget", 0.99)
+    conf.set("hyperspace.obs.slo.latencyP99Seconds", 0.25)
+    assert slo.TRACKER.availability_target == pytest.approx(0.99)
+    assert conf.get("hyperspace.obs.slo.latencyP99Seconds") == pytest.approx(0.25)
