@@ -121,6 +121,29 @@ SERVE_PLAN_CACHE_ENABLED = "hyperspace.serve.planCache.enabled"
 SERVE_PLAN_CACHE_MAX_ENTRIES = "hyperspace.serve.planCache.maxEntries"
 SERVE_RESULT_CACHE_ENABLED = "hyperspace.serve.resultCache.enabled"
 SERVE_RESULT_CACHE_MAX_BYTES = "hyperspace.serve.resultCache.maxBytes"
+# Per-tenant admission quotas + graceful saturation (serve/fleet/quota.py,
+# docs/serving.md "fleet topology"). Token-bucket admission per tenant id
+# (submits carrying a tenant bounce with QuotaExceeded once the bucket is
+# dry); shedDepthRatio sheds NON-priority submits once the queue reaches
+# that fraction of maxQueueDepth, so the priority lane keeps a bounded
+# p99 while the server saturates instead of collapsing.
+SERVE_TENANT_QUOTA_ENABLED = "hyperspace.serve.tenant.quota.enabled"
+SERVE_TENANT_QUOTA_RATE = "hyperspace.serve.tenant.quota.ratePerSecond"
+SERVE_TENANT_QUOTA_BURST = "hyperspace.serve.tenant.quota.burst"
+SERVE_SHED_DEPTH_RATIO = "hyperspace.serve.shedDepthRatio"
+# Multi-process serving fleet (serve/fleet/, docs/serving.md "fleet
+# topology"): N QueryServer processes over one index store share a
+# disk-backed plan/result cache under the SAME versioned keys the
+# in-process caches use (any process's index mutation structurally
+# invalidates every process's entries), dedup cold builds through a
+# lease-file single-flight protocol, and are spawned/monitored/restarted
+# by a FleetSupervisor.
+FLEET_CACHE_DIR = "hyperspace.fleet.cache.dir"
+FLEET_CACHE_MAX_BYTES = "hyperspace.fleet.cache.maxBytes"
+FLEET_LEASE_SECONDS = "hyperspace.fleet.lease.seconds"
+FLEET_SINGLEFLIGHT_WAIT_SECONDS = "hyperspace.fleet.singleflight.waitSeconds"
+FLEET_WORKERS = "hyperspace.fleet.workers"
+FLEET_MAX_RESTARTS = "hyperspace.fleet.maxRestarts"
 RETRY_MAX_ATTEMPTS = "hyperspace.retry.maxAttempts"
 RETRY_BACKOFF_BASE = "hyperspace.retry.backoffBaseSeconds"
 RETRY_CAS_ATTEMPTS = "hyperspace.retry.casAttempts"
@@ -182,6 +205,14 @@ DEFAULT_ADVISOR_ROUTING_MIN_SAMPLES = 1
 DEFAULT_ADVISOR_WORKLOAD_MAX_RECORDS = 512
 DEFAULT_ADVISOR_LIFECYCLE_MAX_DELTAS = 4
 DEFAULT_ADVISOR_MIN_CONFIDENCE = 0.5
+DEFAULT_SERVE_TENANT_QUOTA_RATE = 100.0
+DEFAULT_SERVE_TENANT_QUOTA_BURST = 200
+DEFAULT_SERVE_SHED_DEPTH_RATIO = 1.0
+DEFAULT_FLEET_CACHE_MAX_BYTES = 1 << 30
+DEFAULT_FLEET_LEASE_SECONDS = 10.0
+DEFAULT_FLEET_SINGLEFLIGHT_WAIT_SECONDS = 15.0
+DEFAULT_FLEET_WORKERS = 2
+DEFAULT_FLEET_MAX_RESTARTS = 3
 
 
 @dataclasses.dataclass(frozen=True)
@@ -399,6 +430,56 @@ KNOWN_KEYS: dict[str, ConfKey] = {
         "256 MiB",
         "Result-cache byte budget; LRU eviction past it, no single entry above "
         "a quarter of it."),
+    SERVE_TENANT_QUOTA_ENABLED: ConfKey(
+        "false",
+        "Per-tenant token-bucket admission ([serving.md](serving.md) \"fleet "
+        "topology\"): a `submit(..., tenant=id)` whose bucket is dry raises "
+        "`QuotaExceeded` (an `AdmissionRejected` carrying `retry_after_s`) "
+        "before costing a queue slot. Tenant-less submits are unmetered."),
+    SERVE_TENANT_QUOTA_RATE: ConfKey(
+        "100",
+        "Default refill rate (queries/second) of each tenant's token bucket; "
+        "override per tenant via `TenantQuotas.set_limit`."),
+    SERVE_TENANT_QUOTA_BURST: ConfKey(
+        "200",
+        "Default bucket capacity: how many queries a tenant may burst above "
+        "its sustained rate."),
+    SERVE_SHED_DEPTH_RATIO: ConfKey(
+        "1.0 (off)",
+        "Graceful saturation: non-priority submits are shed (typed "
+        "`AdmissionRejected`) once the queue reaches this fraction of "
+        "`hyperspace.serve.maxQueueDepth`, keeping a bounded p99 for the "
+        "priority lane instead of collapsing under overload. 1.0 disables "
+        "early shedding (only the hard depth limit applies)."),
+    FLEET_CACHE_DIR: ConfKey(
+        "`<system.path>/_fleet`",
+        "Root of the fleet's shared on-disk state (plan/result cache entries, "
+        "single-flight leases, worker registrations). Underscore-prefixed, so "
+        "index listing never mistakes it for an index."),
+    FLEET_CACHE_MAX_BYTES: ConfKey(
+        "1 GiB",
+        "Byte budget of the shared result cache; past it the oldest entries "
+        "are evicted under a cross-process file lease (plans get 1/16 of the "
+        "budget). No single result above a quarter of the budget is admitted."),
+    FLEET_LEASE_SECONDS: ConfKey(
+        "10",
+        "TTL of cross-process lease files (single-flight claims, eviction "
+        "lease): a holder that dies is presumed dead after this long and its "
+        "lease is reaped by the next claimant — a crashed process can never "
+        "wedge the fleet."),
+    FLEET_SINGLEFLIGHT_WAIT_SECONDS: ConfKey(
+        "15",
+        "How long a cold process waits for another process's in-flight build "
+        "before giving up and building locally (correct either way — the "
+        "wait only dedups work)."),
+    FLEET_WORKERS: ConfKey(
+        "2",
+        "Default worker-process count of a `FleetSupervisor` "
+        "(serve/fleet/supervisor.py)."),
+    FLEET_MAX_RESTARTS: ConfKey(
+        "3",
+        "How many times the supervisor respawns a crashed worker before "
+        "leaving its slot down (counted in `fleet.supervisor.restarts`)."),
     ADVISOR_ROUTING_ENABLED: ConfKey(
         "false",
         "Adaptive query routing ([advisor.md](advisor.md)): a per-plan-"
@@ -507,6 +588,16 @@ class HyperspaceConf:
     serve_plan_cache_max_entries: int = DEFAULT_SERVE_PLAN_CACHE_MAX_ENTRIES
     serve_result_cache_enabled: bool = False  # opt-in: results pin host memory
     serve_result_cache_max_bytes: int = DEFAULT_SERVE_RESULT_CACHE_MAX_BYTES
+    serve_tenant_quota_enabled: bool = False  # opt-in: meters tenant-keyed submits
+    serve_tenant_quota_rate: float = DEFAULT_SERVE_TENANT_QUOTA_RATE
+    serve_tenant_quota_burst: int = DEFAULT_SERVE_TENANT_QUOTA_BURST
+    serve_shed_depth_ratio: float = DEFAULT_SERVE_SHED_DEPTH_RATIO
+    fleet_cache_dir: str = ""  # "" = <system_path>/_fleet
+    fleet_cache_max_bytes: int = DEFAULT_FLEET_CACHE_MAX_BYTES
+    fleet_lease_seconds: float = DEFAULT_FLEET_LEASE_SECONDS
+    fleet_singleflight_wait_seconds: float = DEFAULT_FLEET_SINGLEFLIGHT_WAIT_SECONDS
+    fleet_workers: int = DEFAULT_FLEET_WORKERS
+    fleet_max_restarts: int = DEFAULT_FLEET_MAX_RESTARTS
     advisor_routing_enabled: bool = False  # opt-in: routing changes plan choice
     advisor_routing_demote_ratio: float = DEFAULT_ADVISOR_ROUTING_DEMOTE_RATIO
     advisor_routing_alpha: float = DEFAULT_ADVISOR_ROUTING_ALPHA
@@ -588,6 +679,26 @@ class HyperspaceConf:
             self.serve_result_cache_enabled = _as_bool(value)
         elif key == SERVE_RESULT_CACHE_MAX_BYTES:
             self.serve_result_cache_max_bytes = int(value)
+        elif key == SERVE_TENANT_QUOTA_ENABLED:
+            self.serve_tenant_quota_enabled = _as_bool(value)
+        elif key == SERVE_TENANT_QUOTA_RATE:
+            self.serve_tenant_quota_rate = float(value)
+        elif key == SERVE_TENANT_QUOTA_BURST:
+            self.serve_tenant_quota_burst = int(value)
+        elif key == SERVE_SHED_DEPTH_RATIO:
+            self.serve_shed_depth_ratio = float(value)
+        elif key == FLEET_CACHE_DIR:
+            self.fleet_cache_dir = str(value)
+        elif key == FLEET_CACHE_MAX_BYTES:
+            self.fleet_cache_max_bytes = int(value)
+        elif key == FLEET_LEASE_SECONDS:
+            self.fleet_lease_seconds = float(value)
+        elif key == FLEET_SINGLEFLIGHT_WAIT_SECONDS:
+            self.fleet_singleflight_wait_seconds = float(value)
+        elif key == FLEET_WORKERS:
+            self.fleet_workers = int(value)
+        elif key == FLEET_MAX_RESTARTS:
+            self.fleet_max_restarts = int(value)
         elif key == ADVISOR_ROUTING_ENABLED:
             self.advisor_routing_enabled = _as_bool(value)
         elif key == ADVISOR_ROUTING_DEMOTE_RATIO:
@@ -719,6 +830,26 @@ class HyperspaceConf:
             return self.serve_result_cache_enabled
         if key == SERVE_RESULT_CACHE_MAX_BYTES:
             return self.serve_result_cache_max_bytes
+        if key == SERVE_TENANT_QUOTA_ENABLED:
+            return self.serve_tenant_quota_enabled
+        if key == SERVE_TENANT_QUOTA_RATE:
+            return self.serve_tenant_quota_rate
+        if key == SERVE_TENANT_QUOTA_BURST:
+            return self.serve_tenant_quota_burst
+        if key == SERVE_SHED_DEPTH_RATIO:
+            return self.serve_shed_depth_ratio
+        if key == FLEET_CACHE_DIR:
+            return self.fleet_cache_dir
+        if key == FLEET_CACHE_MAX_BYTES:
+            return self.fleet_cache_max_bytes
+        if key == FLEET_LEASE_SECONDS:
+            return self.fleet_lease_seconds
+        if key == FLEET_SINGLEFLIGHT_WAIT_SECONDS:
+            return self.fleet_singleflight_wait_seconds
+        if key == FLEET_WORKERS:
+            return self.fleet_workers
+        if key == FLEET_MAX_RESTARTS:
+            return self.fleet_max_restarts
         if key == ADVISOR_ROUTING_ENABLED:
             return self.advisor_routing_enabled
         if key == ADVISOR_ROUTING_DEMOTE_RATIO:

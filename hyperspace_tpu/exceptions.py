@@ -50,6 +50,22 @@ class AdmissionRejected(HyperspaceError):
         self.max_depth = max_depth
 
 
+class QuotaExceeded(AdmissionRejected):
+    """A tenant's token-bucket admission quota is exhausted
+    (serve/fleet/quota.py): the submit was refused before it cost a
+    queue slot, exactly like a depth rejection — but scoped to one
+    tenant id, so a single noisy tenant cannot starve the rest of the
+    fleet. Carries `retry_after_s`, the earliest time a token will be
+    available again, for client-side backoff. Subclasses
+    :class:`AdmissionRejected` so `QueryServer.submit`'s declared error
+    contract covers it structurally."""
+
+    def __init__(self, msg: str, tenant: str | None = None, retry_after_s: float | None = None):
+        super().__init__(msg)
+        self.tenant = tenant
+        self.retry_after_s = retry_after_s
+
+
 class UnknownConfigKeyError(HyperspaceError):
     """A `hyperspace.*` config key was get/set that is not declared in
     `config.KNOWN_KEYS` — almost always a typo (`hyperspace.srve.workers`),
@@ -156,6 +172,27 @@ ERROR_CONTRACTS: dict[str, tuple[str, ...]] = {
     "hyperspace_tpu.advisor.lifecycle.LifecyclePolicy.sweep": (
         "OSError", "CrashPoint", "ValueError", "KeyError", "NotImplementedError",
     ),
+    # Fleet plane (docs/serving.md "fleet topology"). The shared caches
+    # are advisory by contract — IO failures are counted and answered
+    # with a miss — so what escapes is the injected hard-death surface
+    # (CrashPoint via the fleet.* fault points) plus, for the plan
+    # cache, the planner surface its cold path runs. Tenant quota
+    # admission is exactly one typed rejection. SingleFlight.run's own
+    # protocol raises nothing — whatever the caller's build() raises
+    # passes through it (the scheduler's contracts cover those).
+    # (KeyError is the declared-registry surface: stats.increment raises
+    # it for an undeclared counter name — a programming error.)
+    "hyperspace_tpu.serve.fleet.quota.TenantQuotas.admit": ("QuotaExceeded",),
+    "hyperspace_tpu.serve.fleet.singleflight.SingleFlight.run": (
+        "OSError", "CrashPoint", "KeyError",
+    ),
+    "hyperspace_tpu.serve.fleet.shared_cache.SharedResultCache.get": (
+        "OSError", "CrashPoint", "KeyError",
+    ),
+    "hyperspace_tpu.serve.fleet.shared_cache.SharedResultCache.put": (
+        "OSError", "CrashPoint", "KeyError",
+    ),
+    "hyperspace_tpu.serve.fleet.shared_cache.SharedPlanCache.get_or_optimize": _QUERY_SURFACE,
 }
 
 

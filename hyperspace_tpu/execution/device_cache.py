@@ -51,12 +51,19 @@ class RefCache:
         self._met_evictions = obs_metrics.counter(f"{name}.evictions")
         self._met_bytes = obs_metrics.gauge(f"{name}.bytes", "resident cached bytes")
 
-    def get_or_build(self, key: tuple, base_refs: tuple, build):
+    def get_or_build(self, key: tuple, base_refs: tuple, build, wait_timeout: float | None = None):
         """`build() -> (value, nbytes)`; value cached under `key` while
         `base_refs` are pinned. Concurrent misses on the same key are
         single-flighted: one caller builds, the rest wait on its event
         and then hit (a waiter re-builds only if the value turned out
-        too large to cache — same cost as before the dedup)."""
+        too large to cache — same cost as before the dedup).
+
+        `wait_timeout` bounds each single-flight wait: a waiter whose
+        wait expires builds LOCALLY without claiming the building slot
+        (the slot still belongs to the stuck builder), so an abandoned
+        in-process build — a builder thread wedged in device staging, or
+        killed in a way that never sets its event — cannot block waiters
+        forever. None preserves the original unbounded wait."""
         while True:
             with self._lock:
                 hit = self._entries.get(key)
@@ -70,7 +77,18 @@ class RefCache:
                     self._building[key] = threading.Event()
                     self.misses += 1
                     break  # this caller builds
-            ev.wait()
+            if not ev.wait(wait_timeout):
+                # Timed out on another caller's build: fall through to a
+                # local build. No slot ownership — the original builder
+                # (if it ever finishes) still sets and clears its event.
+                with self._lock:
+                    self.misses += 1
+                self._met_misses.inc()
+                value, nbytes = build()
+                evicted = self._insert(key, base_refs, value, nbytes)
+                if evicted:
+                    self._met_evictions.inc(evicted)
+                return value
             # Re-check: usually a hit now. If the builder failed or the
             # value was uncacheable, the building slot is free again and
             # this caller becomes the builder on the next lap.
@@ -81,21 +99,31 @@ class RefCache:
             with self._lock:
                 self._building.pop(key).set()
             raise
-        evicted = 0
         with self._lock:
-            if nbytes <= self.budget // 4 and key not in self._entries:
-                self._entries[key] = (nbytes, base_refs, value)
-                self._bytes += nbytes
-                while self._bytes > self.budget and self._entries:
-                    k = next(iter(self._entries))
-                    nb, _, _ = self._entries.pop(k)
-                    self._bytes -= nb
-                    evicted += 1
-            self._met_bytes.set(self._bytes)
+            evicted = self._insert_locked(key, base_refs, value, nbytes)
             self._building.pop(key).set()
         if evicted:
             self._met_evictions.inc(evicted)
         return value
+
+    def _insert(self, key: tuple, base_refs: tuple, value, nbytes: int) -> int:
+        with self._lock:
+            return self._insert_locked(key, base_refs, value, nbytes)
+
+    def _insert_locked(self, key: tuple, base_refs: tuple, value, nbytes: int) -> int:
+        """Admit a built value under the byte budget; returns evictions.
+        Caller holds `self._lock`."""
+        evicted = 0
+        if nbytes <= self.budget // 4 and key not in self._entries:
+            self._entries[key] = (nbytes, base_refs, value)
+            self._bytes += nbytes
+            while self._bytes > self.budget and self._entries:
+                k = next(iter(self._entries))
+                nb, _, _ = self._entries.pop(k)
+                self._bytes -= nb
+                evicted += 1
+        self._met_bytes.set(self._bytes)
+        return evicted
 
     def clear(self) -> None:
         with self._lock:

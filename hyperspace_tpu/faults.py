@@ -50,6 +50,10 @@ Fault point names in use (see each call site):
 ``prefetch.issue``    execution/prefetch.py, before an async prefetch job
 ``advisor.recommend`` advisor/whatif.py, at the head of a recommendation pass
 ``advisor.apply``     advisor/lifecycle.py, before each policy mutation
+``fleet.lease.acquire`` fleet/lease.py, before a cross-process lease claim
+``fleet.cache.read``  fleet/shared_cache.py, before a shared-entry read
+``fleet.cache.write`` fleet/shared_cache.py, before a shared-entry publish
+``fleet.cache.evict`` fleet/shared_cache.py, before each lease-held eviction
 ====================  =====================================================
 """
 
@@ -83,6 +87,10 @@ KNOWN_POINTS = (
     "prefetch.issue",
     "advisor.recommend",
     "advisor.apply",
+    "fleet.lease.acquire",
+    "fleet.cache.read",
+    "fleet.cache.write",
+    "fleet.cache.evict",
 )
 
 

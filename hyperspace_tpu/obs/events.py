@@ -52,6 +52,15 @@ KNOWN_EVENTS: dict[str, str] = {
     # submit; the result cache evicted a burst of entries for one put.
     "serve.admission_rejected": "warn",
     "serve.result_cache.eviction_storm": "warn",
+    # Fleet plane (docs/serving.md "fleet topology"): a tenant bounced
+    # off its token-bucket quota; queue-depth shedding refused a
+    # non-priority submit before the queue filled; a crashed
+    # single-flight holder's lease was reaped by another process; the
+    # supervisor respawned a dead worker.
+    "serve.quota_rejected": "warn",
+    "serve.shed": "warn",
+    "fleet.singleflight.takeover": "warn",
+    "fleet.worker.restarted": "warn",
     # JIT plane (docs/observability.md): a call-site key is compiling on
     # most calls (the runtime mirror of lint rule HSL015), or the
     # map-count guard dropped jax's caches to stay under

@@ -134,7 +134,12 @@ class HealthServer:
 
     # -- views ------------------------------------------------------------
     def healthz(self) -> dict:
-        """The health verdict document (also the /healthz body)."""
+        """The health verdict document (also the /healthz body). Carries
+        the ACTUALLY-BOUND endpoint address: with ``port=0`` (the
+        default — N fleet processes on one host must not fight over one
+        configured port) the ephemeral port the kernel picked is
+        reported here and via :attr:`port`/:func:`shared`, so a
+        supervisor or service discovery can address this member."""
         with self._lock:
             sessions = list(self._sessions)
             servers = list(self._servers)
@@ -153,6 +158,7 @@ class HealthServer:
             status = "critical"
         return {
             "status": status,
+            "endpoint": {"host": self.host, "port": self.port},
             "indexes": indexes,
             "scheduler": scheduler,
             "slo": slo_verdicts,
@@ -227,8 +233,12 @@ _shared_refs = 0
 
 def acquire(host: str = DEFAULT_HOST, port: int = DEFAULT_PORT) -> HealthServer:
     """The process-shared HealthServer, started on first acquire. Later
-    acquirers share the first binding (one port per process); every
-    acquire must be paired with a :func:`release`."""
+    acquirers share the first binding (one port per process — the
+    refcounted in-process sharing); every acquire must be paired with a
+    :func:`release`. With ``port=0`` the kernel picks an ephemeral port:
+    read it back from the returned instance's ``.port`` (or
+    ``shared().port``, or the /healthz ``endpoint`` section) — the fleet
+    default, so N worker processes on one host never collide."""
     global _shared, _shared_refs
     with _shared_lock:
         if _shared is None:

@@ -25,3 +25,8 @@ __all__ = [
     "versioned_plan_key",
     "table_nbytes",
 ]
+
+# The multi-process fleet layer (shared disk caches, cross-process
+# single-flight, tenant quotas, supervisor) lives in
+# `hyperspace_tpu.serve.fleet` — imported explicitly by fleet deployments
+# (docs/serving.md "fleet topology"), never on the single-process path.

@@ -1,0 +1,108 @@
+"""Cross-process single-flight: N cold processes, ONE build.
+
+`device_cache.RefCache` already dedups concurrent in-process misses on
+one cold key (one thread builds, the rest wait on its event —
+docs/serving.md). A fleet of processes has the same thundering-herd
+problem one level up: N freshly started workers all miss the shared
+plan/result cache on the same hot key and would each pay the same
+optimize/execute/stage cost. This module extends the dedup across
+process boundaries with a lease-file protocol (fleet/lease.py):
+
+- the first claimant wins the lease and becomes the **leader** — it
+  runs ``build()`` (which normally publishes its artifact into the
+  shared cache) and releases the lease;
+- every other process is a **follower**: it polls ``check()`` (the
+  shared-cache read) and returns as soon as the leader's artifact
+  appears;
+- a follower whose wait expires (``wait_s``) falls back to a **local
+  build** — correctness never depends on the leader, the wait only
+  dedups work;
+- a leader that is SIGKILLed mid-build leaves a lease whose epoch goes
+  stale after the TTL; the next claimant **reaps** it and takes over
+  (`fleet.singleflight.takeovers`) — a crashed holder can never wedge
+  the fleet.
+
+Every outcome is counted (`fleet.singleflight.*`, stats.KNOWN_COUNTERS)
+and a takeover additionally emits a WARN ``fleet.singleflight.takeover``
+event naming the key — reaping a dead process's lease is worth an
+operator's attention even though the fleet healed itself.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from pathlib import Path
+from typing import Callable
+
+from hyperspace_tpu import stats
+from hyperspace_tpu.obs import events as obs_events
+from hyperspace_tpu.serve.fleet.lease import FileLease
+
+_EVT_TAKEOVER = obs_events.declare("fleet.singleflight.takeover")
+
+# Follower poll cadence: cheap (one stat / small read per lap) and fast
+# enough that a follower observes the leader's publish promptly.
+_POLL_S = 0.02
+
+
+def key_name(key: object) -> str:
+    """Filesystem-safe digest of an arbitrary (reprable) key."""
+    return hashlib.md5(repr(key).encode()).hexdigest()
+
+
+class SingleFlight:
+    """Lease-backed cross-process build dedup rooted at one directory
+    (every fleet member must point at the same dir — the factory in
+    serve/fleet/__init__.py derives it from the shared store path)."""
+
+    def __init__(self, root: str | Path, lease_ttl_s: float = 10.0, wait_s: float = 15.0):
+        self.root = Path(root)
+        self.lease_ttl_s = float(lease_ttl_s)
+        self.wait_s = float(wait_s)
+
+    def run(self, name: str, build: Callable, check: Callable | None = None):
+        """Run `build()` at most once across the fleet for `name`,
+        returning its value. `check() -> value | None` observes the
+        leader's published artifact (e.g. a shared-cache read); without
+        it every claimant that loses the lease waits for the lease to
+        clear and then builds (pure serialization, no artifact reuse).
+        Exceptions from `build` propagate to the caller that ran it;
+        the lease is always released."""
+        lease = FileLease(self.root / f"{key_name(name)}.lease", self.lease_ttl_s)
+        deadline = time.monotonic() + self.wait_s
+        while True:
+            # Check BEFORE claiming: once the leader releases, every
+            # waiter's next acquire would succeed — without this order a
+            # waiter that raced past its last check would win the freed
+            # lease and redo the build it was waiting for.
+            if check is not None:
+                value = check()
+                if value is not None:
+                    stats.increment("fleet.singleflight.follower_hits")
+                    return value
+            claim = lease.try_acquire()
+            if claim is not None:
+                token, reaped = claim
+                try:
+                    if check is not None:
+                        # Double-check after winning: the previous
+                        # leader may have published between our check
+                        # and the claim.
+                        value = check()
+                        if value is not None:
+                            stats.increment("fleet.singleflight.follower_hits")
+                            return value
+                    if reaped:
+                        stats.increment("fleet.singleflight.takeovers")
+                        _EVT_TAKEOVER.emit(key=str(name))
+                    stats.increment("fleet.singleflight.leader")
+                    return build()
+                finally:
+                    lease.release(token)
+            if time.monotonic() >= deadline:
+                # The leader is slow (or its artifact is uncacheable):
+                # build locally. Same cost as a world without dedup.
+                stats.increment("fleet.singleflight.local_fallbacks")
+                return build()
+            time.sleep(_POLL_S)

@@ -1,0 +1,281 @@
+"""Fleet supervisor: spawn, monitor, restart, and drain worker processes.
+
+One :class:`FleetSupervisor` owns N worker *processes* (the "millions of
+users" topology, ROADMAP item 1): each worker runs its own
+`HyperspaceSession` + `QueryServer` over the SAME index store, shares
+the fleet's on-disk plan/result cache (fleet/shared_cache.py), and —
+with ``hyperspace.obs.http.enabled`` — binds its own ephemeral health
+port (obs/http.py `port=0`) and registers it in the fleet directory so
+the supervisor (or a load balancer's service discovery) can find every
+member's `/metrics` and `/healthz`.
+
+The supervisor's contract:
+
+- **spawn**: workers start via the ``spawn`` multiprocessing context (a
+  fork of a jax-initialized parent is not safe); the target is called as
+  ``target(ctx, *args)`` with a :class:`WorkerContext` carrying the
+  worker id, the fleet directory, and the shared stop event.
+- **monitor/restart**: a daemon thread watches liveness; a worker that
+  dies with a non-zero exit (including a SIGKILL's negative exitcode)
+  is respawned until its restart budget (``hyperspace.fleet.maxRestarts``)
+  is spent — each respawn counted in `fleet.supervisor.restarts` and
+  announced as a WARN ``fleet.worker.restarted`` event. Workers that
+  exit 0 are considered done and stay down.
+- **drain/stop**: `stop()` sets the shared stop event (workers exit
+  their serve loops, QueryServers drain) and joins with a timeout;
+  stragglers are terminated. The supervisor is a context manager.
+- **fleet health**: `fleet_health()` scrapes every registered member's
+  `/healthz` and aggregates scheduler saturation (summed workers /
+  inflight / queue depth) plus the worst member status — the fleet-wide
+  overload signal a balancer consumes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from pathlib import Path
+
+from hyperspace_tpu import stats
+from hyperspace_tpu.obs import events as obs_events
+from hyperspace_tpu.utils import file_utils
+
+_EVT_RESTARTED = obs_events.declare("fleet.worker.restarted")
+
+_MONITOR_POLL_S = 0.1
+_HEALTH_TIMEOUT_S = 5.0
+
+WORKERS_DIRNAME = "workers"
+
+
+@dataclasses.dataclass
+class WorkerContext:
+    """What a worker target receives: its identity, the fleet's shared
+    directory, and the supervisor's stop event (a multiprocessing.Event
+    — poll `ctx.stop_event.is_set()` in the serve loop)."""
+
+    worker_id: int
+    fleet_dir: str
+    stop_event: object
+
+
+def register_worker(
+    fleet_dir: str | os.PathLike, worker_id: int, port: int | None, host: str = "127.0.0.1"
+) -> None:
+    """Publish this worker's pid + bound health port into the fleet dir
+    (atomic write_json) — how ephemeral `port=0` bindings become
+    discoverable (obs/http.py)."""
+    path = Path(fleet_dir) / WORKERS_DIRNAME / f"{int(worker_id)}.json"
+    file_utils.write_json(path, {"pid": os.getpid(), "port": port, "host": host})
+
+
+def read_workers(fleet_dir: str | os.PathLike) -> dict[int, dict]:
+    """Every registered worker's {pid, port, host}, by worker id."""
+    root = Path(fleet_dir) / WORKERS_DIRNAME
+    out: dict[int, dict] = {}
+    try:
+        entries = sorted(root.glob("*.json"))
+    except OSError:
+        return out
+    for p in entries:
+        try:
+            out[int(p.stem)] = file_utils.read_json(p)
+        except (OSError, ValueError):
+            continue  # torn registration: the worker re-publishes
+    return out
+
+
+def _worker_entry(target, worker_id: int, fleet_dir: str, stop_event, args: tuple) -> None:
+    """Module-level shim (spawn needs a picklable top-level callable)."""
+    target(WorkerContext(worker_id, fleet_dir, stop_event), *args)
+
+
+def _scrape_json(host: str, port: int, path: str, timeout: float = _HEALTH_TIMEOUT_S) -> dict | None:
+    """GET a JSON document from a member's health endpoint; None when
+    unreachable. A 503 (SLO page) still carries the healthz body —
+    read it from the HTTPError."""
+    import urllib.error
+    import urllib.request
+
+    url = f"http://{host}:{port}{path}"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        try:
+            return json.loads(e.read().decode())
+        except (OSError, ValueError):
+            return None
+    except (OSError, ValueError):
+        return None
+
+
+def _scrape_text(host: str, port: int, path: str, timeout: float = _HEALTH_TIMEOUT_S) -> str | None:
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(f"http://{host}:{port}{path}", timeout=timeout) as r:
+            return r.read().decode()
+    except (OSError, ValueError):
+        return None
+
+
+class FleetSupervisor:
+    """Spawn and babysit N worker processes over one index store."""
+
+    def __init__(
+        self,
+        target,
+        fleet_dir: str | os.PathLike,
+        n: int | None = None,
+        args: tuple = (),
+        max_restarts: int | None = None,
+        conf=None,
+    ):
+        n = int(n if n is not None else getattr(conf, "fleet_workers", 2))
+        self._target = target
+        self.fleet_dir = str(fleet_dir)
+        self.n = n
+        self._args = tuple(args)
+        self.max_restarts = int(
+            max_restarts if max_restarts is not None else getattr(conf, "fleet_max_restarts", 3)
+        )
+        import multiprocessing as mp
+
+        self._mp = mp.get_context("spawn")
+        self._stop = self._mp.Event()
+        self._lock = threading.Lock()
+        self._procs: dict[int, object] = {}
+        self._restarts: dict[int, int] = {}
+        self._monitor_thread: threading.Thread | None = None
+        self._stopping = False
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "FleetSupervisor":
+        Path(self.fleet_dir, WORKERS_DIRNAME).mkdir(parents=True, exist_ok=True)
+        with self._lock:
+            for wid in range(self.n):
+                self._procs[wid] = self._spawn(wid)
+            self._monitor_thread = threading.Thread(
+                target=self._monitor, name="hs-fleet-monitor", daemon=True
+            )
+            self._monitor_thread.start()
+        return self
+
+    def _spawn(self, worker_id: int):
+        p = self._mp.Process(
+            target=_worker_entry,
+            args=(self._target, worker_id, self.fleet_dir, self._stop, self._args),
+            name=f"hs-fleet-{worker_id}",
+        )
+        p.start()
+        return p
+
+    def _monitor(self) -> None:
+        """Respawn crashed members until their restart budget is spent.
+        exit 0 = completed (left down); any other exit, including a
+        SIGKILL's negative code, = crash."""
+        while True:
+            with self._lock:
+                if self._stopping:
+                    return
+                dead = [
+                    (wid, p) for wid, p in self._procs.items()
+                    if not p.is_alive() and p.exitcode not in (0, None)
+                ]
+                for wid, p in dead:
+                    used = self._restarts.get(wid, 0)
+                    if used >= self.max_restarts:
+                        continue
+                    self._restarts[wid] = used + 1
+                    self._procs[wid] = self._spawn(wid)
+                    stats.increment("fleet.supervisor.restarts")
+                    _EVT_RESTARTED.emit(
+                        worker_id=wid, exitcode=p.exitcode, restarts=used + 1
+                    )
+            time.sleep(_MONITOR_POLL_S)
+
+    def stop(self, timeout: float = 30.0) -> None:
+        """Graceful drain: signal every worker's stop event, join, then
+        terminate stragglers. Idempotent."""
+        with self._lock:
+            self._stopping = True
+            procs = list(self._procs.values())
+            t = self._monitor_thread
+        self._stop.set()
+        for p in procs:
+            p.join(timeout=timeout)
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=5.0)
+        if t is not None:
+            t.join(timeout=5.0)
+
+    def __enter__(self) -> "FleetSupervisor":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.stop()
+        return False
+
+    # -- views ------------------------------------------------------------
+    def alive_count(self) -> int:
+        with self._lock:
+            return sum(1 for p in self._procs.values() if p.is_alive())
+
+    def pids(self) -> dict[int, int | None]:
+        with self._lock:
+            return {wid: p.pid for wid, p in self._procs.items()}
+
+    def restarts(self) -> dict[int, int]:
+        with self._lock:
+            return dict(self._restarts)
+
+    def fleet_health(self) -> dict:
+        """Aggregate fleet view: every registered member's /healthz plus
+        summed scheduler saturation and the worst member status — what a
+        load balancer reads to decide where (and whether) to send
+        traffic."""
+        members: dict[int, dict] = {}
+        agg = {"workers": 0, "inflight": 0, "queue_depth": 0, "max_queue_depth": 0}
+        rank = {"ok": 0, "degraded": 1, "critical": 2, "unreachable": 2}
+        worst = "ok"
+        with self._lock:
+            procs = list(self._procs.values())
+        alive_pids = {p.pid for p in procs if p.is_alive()}
+        for wid, reg in read_workers(self.fleet_dir).items():
+            port = reg.get("port")
+            doc = None
+            if port and reg.get("pid") in alive_pids:
+                doc = _scrape_json(reg.get("host", "127.0.0.1"), port, "/healthz")
+            status = doc["status"] if doc else "unreachable"
+            members[wid] = {"pid": reg.get("pid"), "port": port, "status": status,
+                            "healthz": doc}
+            if rank.get(status, 2) > rank.get(worst, 0):
+                worst = status
+            for sched in (doc or {}).get("scheduler", []):
+                for k in agg:
+                    agg[k] += int(sched.get(k, 0))
+        return {"status": worst, "saturation": agg, "members": members,
+                "alive": self.alive_count(), "spawned": self.n}
+
+    def aggregate_metrics(self) -> dict[int, str]:
+        """Raw Prometheus text per registered live member (a scrape
+        federation shim; each page is already namespaced per process by
+        its scrape origin)."""
+        out: dict[int, str] = {}
+        with self._lock:
+            procs = list(self._procs.values())
+        alive_pids = {p.pid for p in procs if p.is_alive()}
+        for wid, reg in read_workers(self.fleet_dir).items():
+            port = reg.get("port")
+            if not port or reg.get("pid") not in alive_pids:
+                continue
+            text = _scrape_text(reg.get("host", "127.0.0.1"), port, "/metrics")
+            if text is not None:
+                out[wid] = text
+        return out

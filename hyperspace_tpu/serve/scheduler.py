@@ -54,9 +54,13 @@ from hyperspace_tpu.serve.result_cache import ResultCache
 # Declared at import so submit's narrow error contract (AdmissionRejected
 # only) stays narrow: Event.emit never raises (obs/events.py).
 _EVT_REJECTED = obs_events.declare("serve.admission_rejected")
+_EVT_SHED = obs_events.declare("serve.shed")
 
 _ADMITTED = obs_metrics.counter("serve.admitted", "queries accepted into the queue")
 _REJECTED = obs_metrics.counter("serve.rejected", "submits refused by admission control")
+_SHED = obs_metrics.counter(
+    "serve.shed.rejected", "non-priority submits shed at the saturation threshold"
+)
 _TIMEOUTS = obs_metrics.counter("serve.timeouts", "queries expired before/while executing")
 _COMPLETED = obs_metrics.counter("serve.completed", "queries finished successfully")
 _FAILED = obs_metrics.counter("serve.failed", "queries finished with an error")
@@ -139,6 +143,8 @@ class QueryServer:
         plan_cache: "PlanCache | bool | None" = None,
         result_cache: "ResultCache | bool | None" = None,
         run_fn=None,
+        quotas=None,
+        shed_depth_ratio: float | None = None,
     ):
         conf = session.conf
         self.session = session
@@ -149,6 +155,30 @@ class QueryServer:
         self.timeout_seconds = float(
             timeout_seconds if timeout_seconds is not None else conf.serve_query_timeout_seconds
         )
+        # Graceful saturation (docs/serving.md "fleet topology"): shed
+        # NON-priority submits once the queue reaches ratio x maxDepth,
+        # so the priority lane keeps a bounded p99 while the server
+        # saturates instead of queueing toward collapse. ratio >= 1
+        # leaves only the hard depth limit.
+        ratio = float(
+            shed_depth_ratio if shed_depth_ratio is not None else conf.serve_shed_depth_ratio
+        )
+        self.shed_depth = (
+            self.max_queue_depth if ratio >= 1.0
+            else max(1, int(self.max_queue_depth * ratio))
+        )
+        # Per-tenant token-bucket admission (serve/fleet/quota.py). An
+        # explicit TenantQuotas instance is shareable across servers;
+        # True/None follow `hyperspace.serve.tenant.quota.enabled`.
+        if quotas is None:
+            quotas = conf.serve_tenant_quota_enabled
+        if quotas is True:
+            from hyperspace_tpu.serve.fleet.quota import TenantQuotas
+
+            quotas = TenantQuotas(
+                rate=conf.serve_tenant_quota_rate, burst=conf.serve_tenant_quota_burst
+            )
+        self.quotas = quotas or None
         # True/False force the caches on/off; None follows config; an
         # instance is used as-is (shareable across servers).
         if plan_cache is None:
@@ -191,10 +221,23 @@ class QueryServer:
             self._http.attach_server(self)
 
     # -- client API -------------------------------------------------------
-    def submit(self, plan, priority: bool = False, timeout: float | None = None) -> QueryHandle:
+    def submit(
+        self,
+        plan,
+        priority: bool = False,
+        timeout: float | None = None,
+        tenant: str | None = None,
+    ) -> QueryHandle:
         """Enqueue a plan; returns its :class:`QueryHandle` immediately.
-        Raises :class:`AdmissionRejected` when the queue is full or the
-        server is draining/shut down."""
+        Raises :class:`AdmissionRejected` when the queue is full, the
+        saturation threshold sheds a non-priority submit, or the server
+        is draining/shut down — and its :class:`QuotaExceeded` subclass
+        when `tenant`'s token bucket is dry (tenant-less submits are
+        unmetered)."""
+        if self.quotas is not None and tenant is not None:
+            # Quota admission runs FIRST: a throttled tenant never costs
+            # a queue slot, a lock acquisition, or a trace span.
+            self.quotas.admit(tenant)
         timeout_s = self.timeout_seconds if timeout is None else float(timeout)
         handle = QueryHandle(timeout_s)
         with obs_trace.span("serve.enqueue", priority=bool(priority)):
@@ -217,15 +260,32 @@ class QueryServer:
                         f"admission queue full ({depth} >= max depth {self.max_queue_depth})",
                         depth=depth, max_depth=self.max_queue_depth,
                     )
+                if depth >= self.shed_depth and not priority:
+                    # Graceful saturation: the queue is past its shed
+                    # threshold — refuse ordinary traffic (typed, with
+                    # the observed depth for backoff) while the priority
+                    # lane keeps admitting. p99 stays bounded; the
+                    # server never queues toward collapse.
+                    _REJECTED.inc()
+                    _SHED.inc()
+                    _EVT_SHED.emit(depth=depth, shed_depth=self.shed_depth)
+                    raise AdmissionRejected(
+                        f"load shed: queue depth {depth} >= shed threshold "
+                        f"{self.shed_depth} (max {self.max_queue_depth})",
+                        depth=depth, max_depth=self.max_queue_depth,
+                    )
                 (self._prio if priority else self._fifo).append(ticket)
                 _ADMITTED.inc()
                 _QUEUE_DEPTH.set(depth + 1)
                 self._cv.notify()
         return handle
 
-    def run(self, plan, priority: bool = False, timeout: float | None = None):
+    def run(self, plan, priority: bool = False, timeout: float | None = None,
+            tenant: str | None = None):
         """Submit and block for the result — the one-call client path."""
-        return self.submit(plan, priority=priority, timeout=timeout).result(timeout=timeout)
+        return self.submit(
+            plan, priority=priority, timeout=timeout, tenant=tenant
+        ).result(timeout=timeout)
 
     @property
     def plan_cache(self) -> PlanCache | None:
@@ -250,6 +310,7 @@ class QueryServer:
                 "inflight": self._inflight,
                 "queue_depth": len(self._prio) + len(self._fifo),
                 "max_queue_depth": self.max_queue_depth,
+                "shed_depth": self.shed_depth,
                 "accepting": self._accepting,
             }
 
@@ -390,14 +451,37 @@ class QueryServer:
             return self._run_fn(plan)
         session = self.session
         rc = self._result_cache
-        key = None
-        if rc is not None:
-            key = rc.key(session, plan)
-            hit = rc.get(key)
+        if rc is None:
+            return self._run_and_cache(plan, handle, None, None)
+        key = rc.key(session, plan)
+
+        def observe(hit):
             if hit is not None:
                 handle.cache_hit = True
                 handle.stats = {"result_cache": "hit"}
-                return hit
+            return hit
+
+        first = observe(rc.get(key))
+        if first is not None:
+            return first
+        sf = getattr(rc, "single_flight", None)
+        if sf is not None:
+            # Fleet-wide cold miss (docs/serving.md "fleet topology"):
+            # one process across the fleet executes and publishes the
+            # shared entry; the rest observe it via the poll — or fall
+            # back to a local run when the wait budget expires.
+            from hyperspace_tpu.serve.fleet.singleflight import key_name
+
+            peek = getattr(rc, "peek", rc.get)
+            return sf.run(
+                f"result-{key_name(key)}",
+                build=lambda: self._run_and_cache(plan, handle, rc, key),
+                check=lambda: observe(peek(key)),
+            )
+        return self._run_and_cache(plan, handle, rc, key)
+
+    def _run_and_cache(self, plan, handle: QueryHandle, rc, key):
+        session = self.session
         outcome = session.run_query(plan, plan_cache=self._plan_cache)
         handle.profile = outcome.profile
         handle.stats = outcome.stats

@@ -41,6 +41,23 @@ Counter names in use:
 - ``jit_memory.cache_drops``  jax cache drops by the map-count guard
   (utils/jit_memory.py) — each one is a narrowly avoided XLA:CPU
   map-exhaustion segfault, paired with a WARN ``jit.cache_drop`` event
+- ``fleet.shared_cache.hits``    disk-backed shared plan/result cache hits
+  (serve/fleet/shared_cache.py)
+- ``fleet.shared_cache.misses``  shared-cache lookups that found no entry
+- ``fleet.shared_cache.evictions``  entries removed by the lease-held
+  byte-budget eviction
+- ``fleet.shared_cache.errors``  advisory shared-cache IO failures
+  (unreadable/unwritable entries — the caller recomputes locally)
+- ``fleet.singleflight.leader``  cross-process single-flight claims won
+  (this process did the build)
+- ``fleet.singleflight.follower_hits``  waits that ended by observing the
+  leader's published artifact
+- ``fleet.singleflight.takeovers``  stale leases reaped from a crashed
+  holder (the fleet un-wedged itself)
+- ``fleet.singleflight.local_fallbacks``  waits that expired and fell
+  back to a local build (no dedup, full correctness)
+- ``fleet.supervisor.restarts``  crashed fleet workers respawned by the
+  supervisor (serve/fleet/supervisor.py)
 """
 
 from __future__ import annotations
@@ -69,6 +86,15 @@ KNOWN_COUNTERS = (
     "io.footer_cache.hits",
     "io.footer_cache.misses",
     "jit_memory.cache_drops",
+    "fleet.shared_cache.hits",
+    "fleet.shared_cache.misses",
+    "fleet.shared_cache.evictions",
+    "fleet.shared_cache.errors",
+    "fleet.singleflight.leader",
+    "fleet.singleflight.follower_hits",
+    "fleet.singleflight.takeovers",
+    "fleet.singleflight.local_fallbacks",
+    "fleet.supervisor.restarts",
 )
 
 _counters = {name: _metrics.counter(name) for name in KNOWN_COUNTERS}
