@@ -47,6 +47,12 @@ BUILD_VENUE = "hyperspace.build.venue"
 # bytes resident across the p2 stages (0 = derive 4x chunkBytes).
 BUILD_PIPELINE_ENABLED = "hyperspace.build.pipeline.enabled"
 BUILD_PIPELINE_MAX_INFLIGHT_BYTES = "hyperspace.build.pipeline.maxInflightBytes"
+# Scale-out pooled build (docs/architecture.md "scale-out build"): N
+# spawn-context worker PROCESSES split the build by bucket id → owner,
+# exchanging rows through per-owner spill files. 0 (the default) keeps
+# the in-process build paths exactly as they are.
+BUILD_WORKERS = "hyperspace.build.workers"
+BUILD_EXCHANGE_DIR = "hyperspace.build.exchange.dir"
 # Query-tail prefetch: while the optimizer still runs, footers (and the
 # first row-group chunk) of the index bucket files the pruner keeps are
 # fetched on a background pool, so scan-bound queries stop paying serial
@@ -285,7 +291,21 @@ KNOWN_KEYS: dict[str, ConfKey] = {
         "Byte budget of decoded spill buckets resident across the p2 pipeline "
         "stages (the memory bound on small hosts); 0 derives 4x "
         "`hyperspace.index.build.chunkBytes`. A single bucket above the budget "
-        "is still admitted alone."),
+        "is still admitted alone. The pooled build derives each p2 owner's "
+        "one-ahead spill-read window from the same budget."),
+    BUILD_WORKERS: ConfKey(
+        "0 (in-process)",
+        "Scale-out pooled build: split the build across this many spawn-context "
+        "worker processes — p1 shards each decode a contiguous file slice and "
+        "spill per destination bucket-owner, p2 owners sort/encode/write their "
+        "buckets in parallel (bucket id → owner is the shard key, the analogue "
+        "of Spark's hash shuffle), byte-identical to the in-process streaming "
+        "build. 0 keeps the in-process paths."),
+    BUILD_EXCHANGE_DIR: ConfKey(
+        "`` (derived)",
+        "Root of the pooled build's cross-process spill exchange; empty derives "
+        "`<dest>.exchange` next to the index version dir (same filesystem as "
+        "the output). Always swept when the build ends, success or abort."),
     SCAN_PREFETCH_ENABLED: ConfKey(
         "true",
         "Async index bucket-file prefetch at plan-optimize time: footers (and "
@@ -571,6 +591,8 @@ class HyperspaceConf:
     build_venue: str = DEFAULT_JOIN_VENUE
     build_pipeline_enabled: bool = True
     build_pipeline_max_inflight_bytes: int = 0  # 0 = derived from chunkBytes
+    build_workers: int = 0  # 0 = in-process build (no worker pool)
+    build_exchange_dir: str = ""  # "" = <dest>.exchange next to the version dir
     scan_prefetch_enabled: bool = True
     agg_venue: str = DEFAULT_JOIN_VENUE
     sort_venue: str = DEFAULT_JOIN_VENUE
@@ -645,6 +667,10 @@ class HyperspaceConf:
             self.build_pipeline_enabled = _as_bool(value)
         elif key == BUILD_PIPELINE_MAX_INFLIGHT_BYTES:
             self.build_pipeline_max_inflight_bytes = int(value)
+        elif key == BUILD_WORKERS:
+            self.build_workers = int(value)
+        elif key == BUILD_EXCHANGE_DIR:
+            self.build_exchange_dir = str(value)
         elif key == SCAN_PREFETCH_ENABLED:
             self.scan_prefetch_enabled = _as_bool(value)
         elif key == AGG_VENUE:
@@ -796,6 +822,10 @@ class HyperspaceConf:
             return self.build_pipeline_enabled
         if key == BUILD_PIPELINE_MAX_INFLIGHT_BYTES:
             return self.build_pipeline_max_inflight_bytes
+        if key == BUILD_WORKERS:
+            return self.build_workers
+        if key == BUILD_EXCHANGE_DIR:
+            return self.build_exchange_dir
         if key == SCAN_PREFETCH_ENABLED:
             return self.scan_prefetch_enabled
         if key == AGG_VENUE:

@@ -96,6 +96,36 @@ class QueryTimeout(HyperspaceError):
         self.elapsed_s = elapsed_s
 
 
+class WorkerCrashed(HyperspaceError):
+    """A pooled-build worker process died without posting its result —
+    a real ``kill -9``, an OOM kill, or an injected
+    :class:`~hyperspace_tpu.faults.CrashPoint` unwinding out of the
+    worker. Raised by the coordinator's bounded join
+    (`parallel/procpool.py`) so a crashed worker aborts the build with a
+    typed error instead of hanging the coordinator on a result queue
+    that will never fill; `Action.run` then rolls the build back like
+    any other op() failure."""
+
+    def __init__(self, msg: str, task_id=None, exitcode: int | None = None):
+        super().__init__(msg)
+        self.task_id = task_id
+        self.exitcode = exitcode
+
+
+class WorkerFailed(HyperspaceError):
+    """A pooled-build worker's task body raised: the worker posted the
+    error (type, message, full traceback text) through the result queue
+    and the coordinator re-raises it as this typed abort, preserving the
+    worker-side traceback in the message. Distinct from
+    :class:`WorkerCrashed`: the worker process stayed alive and reported
+    its own failure."""
+
+    def __init__(self, msg: str, task_id=None, error_type: str | None = None):
+        super().__init__(msg)
+        self.task_id = task_id
+        self.error_type = error_type
+
+
 class TransientIOError(OSError):
     """Marker for IO failures worth retrying (lease contention, flaky
     remote filesystems). Carries errno EIO so `is_retryable` classifies
@@ -193,6 +223,15 @@ ERROR_CONTRACTS: dict[str, tuple[str, ...]] = {
         "OSError", "CrashPoint", "KeyError",
     ),
     "hyperspace_tpu.serve.fleet.shared_cache.SharedPlanCache.get_or_optimize": _QUERY_SURFACE,
+    # Scale-out build worker entry points (docs/architecture.md
+    # "scale-out build"). These module-level functions ARE process entry
+    # points — parallel/procpool.py runs them in spawned workers and the
+    # coordinator's typed abort (WorkerFailed/WorkerCrashed) relies on
+    # their surface: framework errors and injected IO faults post back
+    # through the result queue; CrashPoint deliberately kills the worker
+    # (the coordinator's liveness check converts that into WorkerCrashed).
+    "hyperspace_tpu.execution.build_exchange.p1_shard": _QUERY_SURFACE,
+    "hyperspace_tpu.execution.build_exchange.p2_owner": _QUERY_SURFACE,
 }
 
 

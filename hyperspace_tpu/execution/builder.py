@@ -32,11 +32,20 @@ from hyperspace_tpu.config import DEFAULT_BUILD_MEMORY_BUDGET
 from hyperspace_tpu.dataset import format_suffix, list_data_files
 from hyperspace_tpu.exceptions import HyperspaceError
 from hyperspace_tpu.execution import io as hio
+
+# Host hash/sort helpers live in build_exchange (the jax-free module the
+# pooled build's worker processes import); re-exported here for the
+# query plane's historical import path (executor/exec_side/exec_scan).
+from hyperspace_tpu.execution.build_exchange import (  # noqa: F401 — re-exports
+    NULL_HASH,
+    compute_row_hashes,
+    hash_scalar_key,
+)
 from hyperspace_tpu.execution.table import ColumnTable
 from hyperspace_tpu.faults import fault_point
 from hyperspace_tpu.obs import metrics as obs_metrics
 from hyperspace_tpu.obs import trace as obs_trace
-from hyperspace_tpu.ops.hashing import bucket_ids, combine_hashes, hash_int_column, string_dict_hashes
+from hyperspace_tpu.ops.hashing import bucket_ids
 from hyperspace_tpu.parallel.mesh import enable_compile_cache, mesh_size
 from hyperspace_tpu.plan.nodes import LogicalPlan, Scan
 
@@ -53,43 +62,10 @@ _MET_QDEPTH = obs_metrics.histogram(
     "bucket-completion queue depth at each reader put",
     buckets=obs_metrics.COUNT_BUCKETS,
 )
-
-
-# The fixed hash contribution of a NULL key slot: nulls bucket
-# deterministically (they can never match an equality literal, so bucket
-# pruning by literal hash stays correct regardless).
-NULL_HASH = np.uint32(0x9E3779B9)
-
-
-def compute_row_hashes(table: ColumnTable, key_columns: list[str]) -> np.ndarray:
-    """Host-side uint32 row hash over the key columns. Deterministic and
-    dictionary-independent (ops/hashing.py), so the query plane can prune
-    buckets by recomputing the same hash on a literal."""
-    hashes = []
-    for name in key_columns:
-        f = table.schema.field(name)
-        arr = table.columns[f.name]
-        if f.is_string:
-            dh = string_dict_hashes(table.dictionaries[f.name])
-            h = dh[arr]
-        else:
-            h = hash_int_column(arr, np)
-        valid = table.valid_mask(name)
-        if valid is not None:
-            h = np.where(valid, h, NULL_HASH)
-        hashes.append(h)
-    return combine_hashes(hashes, np)
-
-
-def hash_scalar_key(values: list, fields) -> np.ndarray:
-    """Hash one key tuple (for bucket pruning at query time)."""
-    hs = []
-    for v, f in zip(values, fields):
-        if f.is_string:
-            hs.append(string_dict_hashes(np.array([v], dtype=object)))
-        else:
-            hs.append(hash_int_column(np.array([v], dtype=f.device_dtype), np))
-    return combine_hashes(hs, np)
+_MET_POOL_WORKERS = obs_metrics.gauge(
+    "build.workers.active",
+    "worker processes the pooled build currently has spawned (0 between builds)",
+)
 
 
 def _pad_to(arr: np.ndarray, n: int, fill=0) -> np.ndarray:
@@ -165,6 +141,8 @@ class DeviceIndexBuilder:
         venue_min_mbps: float = 200.0,
         pipeline_enabled: bool = True,
         pipeline_max_inflight_bytes: int = 0,
+        workers: int = 0,
+        exchange_dir: str | None = None,
     ):
         self._mesh = mesh
         self.capacity_factor = capacity_factor
@@ -179,6 +157,13 @@ class DeviceIndexBuilder:
         # reference the pipeline is verified against (bench.py --smoke).
         self.pipeline_enabled = pipeline_enabled
         self.pipeline_max_inflight_bytes = pipeline_max_inflight_bytes
+        # Scale-out pooled build (hyperspace.build.workers, docs/
+        # architecture.md "scale-out build"): 0 = in-process (the paths
+        # above, unchanged); N > 0 splits the build across N spawned
+        # worker processes exchanging rows through per-owner spill files
+        # — byte-identical to the serial streaming reference.
+        self.workers = int(workers)
+        self.exchange_dir = str(exchange_dir) if exchange_dir else None
         self.last_build_stats: dict = {}
         self._last_phases: dict = {}
         enable_compile_cache()
@@ -221,6 +206,16 @@ class DeviceIndexBuilder:
             files = list(plan.files)
         else:
             files = [fi.path for fi in list_data_files(plan.root, suffix=format_suffix(plan.format))]
+        if self.workers > 0 and files:
+            # Scale-out path: the pooled build IS a streaming build (it
+            # exchanges through spill files), so it runs regardless of
+            # the memory estimate and stays byte-identical to the serial
+            # streaming reference at every source size.
+            self._write_pooled(
+                files, plan.scan_schema, columns, indexed_columns, num_buckets,
+                dest_path, fmt=plan.format,
+            )
+            return
         if plan.format == "parquet":
             footers = hio.read_footers(files)
             est = hio.estimate_uncompressed_bytes(files, columns, footers=footers)
@@ -570,6 +565,153 @@ class DeviceIndexBuilder:
         if pipe_info is not None:
             self.last_build_stats["pipeline"] = pipe_info
 
+    # -- scale-out pooled build ------------------------------------------
+    def _exchange_root(self, dest: Path) -> Path:
+        """Where this build's cross-process spill exchange lives:
+        `hyperspace.build.exchange.dir` (suffixed with the dest name so
+        concurrent builds never collide), or `<dest>.exchange` next to
+        the version dir (same filesystem as the output)."""
+        if self.exchange_dir:
+            return Path(self.exchange_dir) / f"{dest.parent.name}-{dest.name}.exchange"
+        return dest.parent / (dest.name + ".exchange")
+
+    def _write_pooled(
+        self,
+        files: list[str],
+        schema,
+        columns: list[str],
+        indexed_columns: list[str],
+        num_buckets: int,
+        dest_path: Path,
+        fmt: str = "parquet",
+    ) -> None:
+        """The scale-out build (docs/architecture.md "scale-out build"):
+        bucket id → owner is the shard key, spill files are the
+        cross-process exchange format, and the only things crossing the
+        process boundary are paths plus the decoded-byte ledger.
+
+        - **p1** — ≤ `workers` shard processes, each decoding a disjoint
+          *contiguous* slice of the input files, hashing/partitioning
+          rows, and appending per-bucket spill parquet into the
+          destination owners' exchange dirs (build_exchange.p1_shard);
+        - **p2** — ≤ min(workers, num_buckets) owner processes, each
+          reading its buckets' spill in shard order (reproducing the
+          global row order), key-sorting, and writing the final bucket
+          files + stats in parallel (build_exchange.p2_owner);
+        - **coordinator** — slices files, babysits the pools (a dead
+          worker is a typed WorkerCrashed abort, never a hang), merges
+          the per-owner manifest stats, and writes the manifest. The
+          surrounding Action 2-phase protocol is untouched, so commit
+          semantics — and the output bytes — match the in-process
+          streaming build exactly.
+
+        The exchange dir is swept in `finally`, success or abort."""
+        import os
+        import shutil
+
+        from hyperspace_tpu import stats
+        from hyperspace_tpu.execution import build_exchange as bx
+        from hyperspace_tpu.parallel.procpool import TaskPool
+
+        dest = Path(dest_path)
+        exchange = self._exchange_root(dest)
+        if exchange.exists():
+            shutil.rmtree(exchange)
+        exchange.mkdir(parents=True, exist_ok=True)
+        try:
+            sizes = [os.stat(f).st_size for f in files]
+        except OSError:
+            sizes = [1] * len(files)
+        slices = bx.slice_files(files, sizes, self.workers)
+        n_shards = len(slices)
+        num_owners = max(1, min(self.workers, num_buckets))
+        # Per-owner one-ahead read window: the same maxInflightBytes
+        # budget the p2 pipeline uses, fed from p1's decoded-byte ledger.
+        window = self.pipeline_max_inflight_bytes or max(1, 4 * self.chunk_bytes)
+        total_rows = 0
+        n_chunks = 0
+        spill_bytes: dict[int, int] = {}
+        try:
+            t0 = time.perf_counter()
+            with obs_trace.span("build.pool.p1", shards=n_shards):
+                with TaskPool("hs-build-p1") as pool:
+                    for w, slc in enumerate(slices):
+                        fault_point("build.worker.spawn", str(exchange))
+                        pool.submit(w, bx.p1_shard, bx.P1Task(
+                            worker=w, files=slc, fmt=fmt, columns=list(columns),
+                            schema=schema, indexed_columns=list(indexed_columns),
+                            num_buckets=num_buckets, num_owners=num_owners,
+                            chunk_bytes=self.chunk_bytes,
+                            memory_budget_bytes=self.memory_budget_bytes,
+                            exchange_dir=str(exchange),
+                        ))
+                        _MET_POOL_WORKERS.set(w + 1)
+                    p1 = pool.join()
+            _MET_POOL_WORKERS.set(0)
+            for _, res in sorted(p1.items()):
+                total_rows += res["rows"]
+                n_chunks += res["chunks"]
+                for b, nb in res["spill_bytes"].items():
+                    spill_bytes[b] = spill_bytes.get(b, 0) + nb
+            exchange_bytes = sum(spill_bytes.values())
+            stats.increment("build.exchange.bytes", exchange_bytes)
+            t_p2 = time.perf_counter()
+
+            dest.mkdir(parents=True, exist_ok=True)
+            with obs_trace.span("build.pool.p2", owners=num_owners):
+                with TaskPool("hs-build-p2") as pool:
+                    for o in range(num_owners):
+                        fault_point("build.worker.spawn", str(exchange))
+                        pool.submit(o, bx.p2_owner, bx.P2Task(
+                            owner=o, num_owners=num_owners, n_shards=n_shards,
+                            num_buckets=num_buckets, exchange_dir=str(exchange),
+                            dest_dir=str(dest), columns=list(columns),
+                            schema=schema, indexed_columns=list(indexed_columns),
+                            spill_bytes={
+                                b: nb for b, nb in spill_bytes.items()
+                                if bx.owner_of(b, num_owners) == o
+                            },
+                            window_bytes=window,
+                        ))
+                        _MET_POOL_WORKERS.set(o + 1)
+                    p2 = pool.join()
+            _MET_POOL_WORKERS.set(0)
+
+            fault_point("build.manifest.merge", str(dest))
+            bucket_rows = [0] * num_buckets
+            key_stats: list = [None] * num_buckets
+            col_stats: list = [None] * num_buckets
+            for _, res in sorted(p2.items()):
+                for b, r in res["bucket_rows"].items():
+                    bucket_rows[b] = r
+                for b, s in res["key_stats"].items():
+                    key_stats[b] = s
+                for b, s in res["col_stats"].items():
+                    col_stats[b] = s
+            hio.write_manifest(
+                dest, num_buckets, indexed_columns, bucket_rows,
+                key_stats if any(s is not None for s in key_stats) else None,
+                col_stats if any(s is not None for s in col_stats) else None,
+            )
+            t_end = time.perf_counter()
+        finally:
+            _MET_POOL_WORKERS.set(0)
+            shutil.rmtree(exchange, ignore_errors=True)
+        self.last_build_stats = {
+            "path": "pooled",
+            "format": fmt,
+            "workers": self.workers,
+            "p1_shards": n_shards,
+            "p2_owners": num_owners,
+            "rows": total_rows,
+            "chunks": n_chunks,
+            "exchange_bytes": exchange_bytes,
+            "phases_s": {
+                "p1_decode_hash_spill": round(t_p2 - t0, 4),
+                "p2_sort_encode_write": round(t_end - t_p2, 4),
+            },
+        }
+
     def _p2_pipelined(
         self,
         writers,
@@ -751,77 +893,16 @@ class DeviceIndexBuilder:
         }
 
     def _decoded_chunks(self, files, fmt: str, columns, schema, footers=None):
-        """Yield pyarrow Tables of ≤ ~chunk_bytes decoded source data,
-        format-aware: parquet by footer-planned row groups, CSV by
-        streamed record batches, ORC by stripes, JSON per file (pyarrow
-        has no incremental JSON reader)."""
-        import pyarrow as pa
+        """Yield pyarrow Tables of ≤ ~chunk_bytes decoded source data —
+        the shared format-aware chunked decode in build_exchange.py (the
+        pooled build's p1 shard workers drive the same generator over
+        their own file slices)."""
+        from hyperspace_tpu.execution.build_exchange import decoded_chunks
 
-        if fmt == "parquet":
-            chunks = hio.plan_row_group_chunks(
-                files, self.chunk_bytes, columns, footers=footers
-            )
-            for c in chunks:
-                yield hio.read_chunk(c, columns)
-            return
-        if fmt == "csv":
-            from pyarrow import csv as pcsv
-
-            types = hio._arrow_types_for(schema)
-            for f in files:
-                opts = pcsv.ConvertOptions(
-                    include_columns=list(columns) if columns is not None else None,
-                    column_types=types,
-                )
-                ropts = pcsv.ReadOptions(
-                    block_size=int(max(16 << 10, min(self.chunk_bytes // 4, (1 << 31) - 1)))
-                )
-                with pcsv.open_csv(f, read_options=ropts, convert_options=opts) as reader:
-                    buf, size = [], 0
-                    for batch in reader:
-                        buf.append(batch)
-                        size += batch.nbytes
-                        if size >= self.chunk_bytes:
-                            yield pa.Table.from_batches(buf)
-                            buf, size = [], 0
-                    if buf:
-                        yield pa.Table.from_batches(buf)
-            return
-        if fmt == "orc":
-            from pyarrow import orc
-
-            for f in files:
-                o = orc.ORCFile(f)
-                buf, size = [], 0
-                for s in range(o.nstripes):
-                    rb = o.read_stripe(s, columns=list(columns) if columns is not None else None)
-                    buf.append(rb)
-                    size += rb.nbytes
-                    if size >= self.chunk_bytes:
-                        yield pa.Table.from_batches(buf)
-                        buf, size = [], 0
-                if buf:
-                    yield pa.Table.from_batches(buf)
-            return
-        if fmt == "json":
-            import os
-
-            for f in files:
-                # No incremental JSON reader exists in pyarrow: the bound
-                # holds per FILE. A single file above the budget would
-                # silently break it — fail with the actionable message
-                # instead of OOMing.
-                if os.stat(f).st_size * 4 > self.memory_budget_bytes:
-                    raise HyperspaceError(
-                        f"json file {f} (~{os.stat(f).st_size * 4 >> 20} MiB decoded "
-                        "estimate) exceeds the build memory budget and JSON has no "
-                        "incremental reader; raise "
-                        "hyperspace.index.build.memoryBudgetBytes, split the file, "
-                        "or convert the source to parquet"
-                    )
-                yield hio._read_one_file(f, "json", list(columns) if columns is not None else None, schema)
-            return
-        raise HyperspaceError(f"unsupported streaming source format {fmt!r}")
+        yield from decoded_chunks(
+            files, fmt, columns, schema,
+            self.chunk_bytes, self.memory_budget_bytes, footers=footers,
+        )
 
     # -- OptimizeAction's compactor seam ---------------------------------
     def compact(self, entry, src_paths: list[Path] | Path, dest_path: Path) -> None:

@@ -12,7 +12,7 @@ import pyarrow as pa
 from hyperspace_tpu import stats as _ft_stats
 from hyperspace_tpu.exceptions import IndexCorruptionError
 from hyperspace_tpu.execution import io as hio
-from hyperspace_tpu.execution.builder import hash_scalar_key
+from hyperspace_tpu.execution.build_exchange import hash_scalar_key
 from hyperspace_tpu.execution.table import ColumnTable
 from hyperspace_tpu.dataset import format_suffix, list_data_files
 from hyperspace_tpu.ops.filter import apply_filter

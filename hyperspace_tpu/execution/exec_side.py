@@ -9,7 +9,7 @@ import numpy as np
 
 from hyperspace_tpu.exceptions import HyperspaceError
 from hyperspace_tpu.execution import io as hio
-from hyperspace_tpu.execution.builder import compute_row_hashes
+from hyperspace_tpu.execution.build_exchange import compute_row_hashes
 from hyperspace_tpu.execution.table import ColumnTable
 from hyperspace_tpu.ops.hashing import bucket_ids
 from hyperspace_tpu.plan.expr import And
@@ -327,7 +327,7 @@ class JoinSidesMixin:
         native counting sort; device venue: one device sort of the
         bucket ids. None when the key shapes cannot share a hash domain
         (string vs non-string)."""
-        from hyperspace_tpu.execution.builder import NULL_HASH
+        from hyperspace_tpu.execution.build_exchange import NULL_HASH
         from hyperspace_tpu.ops.hashing import (
             combine_hashes,
             hash_int_column,

@@ -32,7 +32,7 @@ from hyperspace_tpu.obs import trace as obs_trace
 
 from hyperspace_tpu.exceptions import HyperspaceError
 from hyperspace_tpu.execution import io as hio
-from hyperspace_tpu.execution.builder import compute_row_hashes, hash_scalar_key
+from hyperspace_tpu.execution.build_exchange import compute_row_hashes, hash_scalar_key
 from hyperspace_tpu.execution.table import ColumnTable
 from hyperspace_tpu.dataset import format_suffix, list_data_files
 from hyperspace_tpu.ops.filter import apply_filter, eval_predicate_mask

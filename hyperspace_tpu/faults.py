@@ -54,7 +54,19 @@ Fault point names in use (see each call site):
 ``fleet.cache.read``  fleet/shared_cache.py, before a shared-entry read
 ``fleet.cache.write`` fleet/shared_cache.py, before a shared-entry publish
 ``fleet.cache.evict`` fleet/shared_cache.py, before each lease-held eviction
+``build.worker.spawn`` builder coordinator, before each pooled worker spawn
+``build.exchange.write`` build_exchange p1 shard, before a spill file finalizes
+``build.exchange.read`` build_exchange p2 owner, before a bucket's spill read
+``build.manifest.merge`` builder coordinator, before the per-owner stats merge
 ====================  =====================================================
+
+Cross-process injection: the pooled build's workers are SPAWNED
+processes with fresh module state, so the coordinator's registered
+rules would never fire inside them. `parallel/procpool.py` ships
+:func:`export_state` into each worker (installed via
+:func:`install_state` — fresh per-process call/fire schedules) and
+merges the worker's observed points back on join, so the deterministic
+crash sweep sees through the process boundary.
 """
 
 from __future__ import annotations
@@ -91,6 +103,10 @@ KNOWN_POINTS = (
     "fleet.cache.read",
     "fleet.cache.write",
     "fleet.cache.evict",
+    "build.worker.spawn",
+    "build.exchange.write",
+    "build.exchange.read",
+    "build.manifest.merge",
 )
 
 
@@ -227,6 +243,41 @@ def observed_points() -> set[str]:
     """Fault points hit while the harness was armed (recording or rules)."""
     with _lock:
         return set(_observed)
+
+
+def export_state() -> dict:
+    """Picklable snapshot of the harness (rules with FRESH call/fire
+    schedules, the kill switch, and whether the fast path is armed) for
+    shipping into a spawned worker process. Schedules count per process:
+    `at_call=1` fires at each worker's first arrival."""
+    with _lock:
+        return {
+            "enabled": _enabled,
+            "armed": _armed,
+            "rules": [dataclasses.replace(r, calls=0, fired=0) for r in _rules],
+        }
+
+
+def install_state(state: dict) -> None:
+    """Install a coordinator's :func:`export_state` snapshot into this
+    (worker) process. `armed` is honored even with zero rules so a
+    coordinator-side `recording()` pass observes worker-side points
+    too."""
+    global _armed, _enabled
+    with _lock:
+        _rules.clear()
+        _rules.extend(state.get("rules") or ())
+        _enabled = bool(state.get("enabled", True))
+        _armed = _enabled and (bool(_rules) or bool(state.get("armed")))
+
+
+def merge_observed(points) -> None:
+    """Fold a worker's observed points back into this process's set (the
+    return leg of the cross-process recording contract)."""
+    if not points:
+        return
+    with _lock:
+        _observed.update(points)
 
 
 def fault_point(name: str, path: str | os.PathLike | None = None) -> None:

@@ -155,6 +155,8 @@ class HyperspaceSession:
                             venue_min_mbps=self.conf.join_venue_min_mbps,
                             pipeline_enabled=self.conf.build_pipeline_enabled,
                             pipeline_max_inflight_bytes=self.conf.build_pipeline_max_inflight_bytes,
+                            workers=self.conf.build_workers,
+                            exchange_dir=self.conf.build_exchange_dir or None,
                         )
                         self._last_writer = w
                         return w

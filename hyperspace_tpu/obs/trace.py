@@ -307,6 +307,39 @@ def wrap(fn: Callable) -> Callable:
     return run
 
 
+def span_from_json(d: dict) -> Span:
+    """Rebuild a Span tree from its :meth:`Span.to_json` dict — the
+    inverse used to adopt a worker PROCESS's finished trace into this
+    process (spans only ship across process boundaries as dicts)."""
+    s = Span(str(d.get("name", "?")), dict(d.get("attrs") or {}))
+    s.wall_s = d.get("wall_s")
+    s.start_s = d.get("t0_s")
+    s.tid = d.get("tid")
+    s.trace_id = d.get("trace_id")
+    s.error = d.get("error")
+    s.events = list(d.get("events") or [])
+    s.children = [span_from_json(c) for c in d.get("children") or ()]
+    return s
+
+
+def adopt_root(root: "dict | None") -> None:
+    """Adopt a FOREIGN root span — a worker process's finished trace,
+    shipped back as its ``to_json()`` dict — into this process's
+    recent-root ring and sink. The root keeps its own pid-qualified
+    trace_id, so the chrome exporter lanes it on the worker's pid track
+    (one lane per worker process). Never raises on a malformed dict
+    (adoption is telemetry, not control flow)."""
+    if not _enabled or not root:
+        return
+    try:
+        span = span_from_json(root)
+    except (TypeError, ValueError, AttributeError):
+        return
+    with _recent_lock:
+        _recent_roots.append(span)
+    _emit(span)
+
+
 def last_trace() -> "Span | None":
     """The most recently finished root span (None before the first)."""
     return _last_trace

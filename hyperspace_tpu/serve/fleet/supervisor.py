@@ -11,8 +11,10 @@ member's `/metrics` and `/healthz`.
 
 The supervisor's contract:
 
-- **spawn**: workers start via the ``spawn`` multiprocessing context (a
-  fork of a jax-initialized parent is not safe); the target is called as
+- **spawn**: workers start via the shared spawn-context lifecycle in
+  `parallel/procpool.py` (:class:`~hyperspace_tpu.parallel.procpool.ProcessHost`
+  — a fork of a jax-initialized parent is never safe; the scale-out
+  build's TaskPool rides the same primitive); the target is called as
   ``target(ctx, *args)`` with a :class:`WorkerContext` carrying the
   worker id, the fleet directory, and the shared stop event.
 - **monitor/restart**: a daemon thread watches liveness; a worker that
@@ -41,6 +43,7 @@ from pathlib import Path
 
 from hyperspace_tpu import stats
 from hyperspace_tpu.obs import events as obs_events
+from hyperspace_tpu.parallel.procpool import ProcessHost
 from hyperspace_tpu.utils import file_utils
 
 _EVT_RESTARTED = obs_events.declare("fleet.worker.restarted")
@@ -143,12 +146,13 @@ class FleetSupervisor:
         self.max_restarts = int(
             max_restarts if max_restarts is not None else getattr(conf, "fleet_max_restarts", 3)
         )
-        import multiprocessing as mp
-
-        self._mp = mp.get_context("spawn")
-        self._stop = self._mp.Event()
+        # The shared spawn-context worker lifecycle (parallel/procpool.py):
+        # the host owns the spawn context, the stop event, and the keyed
+        # process registry; the supervisor layers fleet policy (restart
+        # budgets, health aggregation) on top.
+        self._host = ProcessHost(name="hs-fleet")
+        self._stop = self._host.stop_event
         self._lock = threading.Lock()
-        self._procs: dict[int, object] = {}
         self._restarts: dict[int, int] = {}
         self._monitor_thread: threading.Thread | None = None
         self._stopping = False
@@ -158,7 +162,7 @@ class FleetSupervisor:
         Path(self.fleet_dir, WORKERS_DIRNAME).mkdir(parents=True, exist_ok=True)
         with self._lock:
             for wid in range(self.n):
-                self._procs[wid] = self._spawn(wid)
+                self._spawn(wid)
             self._monitor_thread = threading.Thread(
                 target=self._monitor, name="hs-fleet-monitor", daemon=True
             )
@@ -166,13 +170,12 @@ class FleetSupervisor:
         return self
 
     def _spawn(self, worker_id: int):
-        p = self._mp.Process(
-            target=_worker_entry,
+        return self._host.spawn(
+            worker_id,
+            _worker_entry,
             args=(self._target, worker_id, self.fleet_dir, self._stop, self._args),
             name=f"hs-fleet-{worker_id}",
         )
-        p.start()
-        return p
 
     def _monitor(self) -> None:
         """Respawn crashed members until their restart budget is spent.
@@ -183,7 +186,7 @@ class FleetSupervisor:
                 if self._stopping:
                     return
                 dead = [
-                    (wid, p) for wid, p in self._procs.items()
+                    (wid, p) for wid, p in self._host.processes().items()
                     if not p.is_alive() and p.exitcode not in (0, None)
                 ]
                 for wid, p in dead:
@@ -191,7 +194,7 @@ class FleetSupervisor:
                     if used >= self.max_restarts:
                         continue
                     self._restarts[wid] = used + 1
-                    self._procs[wid] = self._spawn(wid)
+                    self._spawn(wid)
                     stats.increment("fleet.supervisor.restarts")
                     _EVT_RESTARTED.emit(
                         worker_id=wid, exitcode=p.exitcode, restarts=used + 1
@@ -200,18 +203,11 @@ class FleetSupervisor:
 
     def stop(self, timeout: float = 30.0) -> None:
         """Graceful drain: signal every worker's stop event, join, then
-        terminate stragglers. Idempotent."""
+        terminate stragglers (ProcessHost.stop). Idempotent."""
         with self._lock:
             self._stopping = True
-            procs = list(self._procs.values())
             t = self._monitor_thread
-        self._stop.set()
-        for p in procs:
-            p.join(timeout=timeout)
-        for p in procs:
-            if p.is_alive():
-                p.terminate()
-                p.join(timeout=5.0)
+        self._host.stop(timeout=timeout, grace=5.0)
         if t is not None:
             t.join(timeout=5.0)
 
@@ -224,12 +220,10 @@ class FleetSupervisor:
 
     # -- views ------------------------------------------------------------
     def alive_count(self) -> int:
-        with self._lock:
-            return sum(1 for p in self._procs.values() if p.is_alive())
+        return self._host.alive_count()
 
     def pids(self) -> dict[int, int | None]:
-        with self._lock:
-            return {wid: p.pid for wid, p in self._procs.items()}
+        return {wid: p.pid for wid, p in self._host.processes().items()}
 
     def restarts(self) -> dict[int, int]:
         with self._lock:
@@ -244,8 +238,7 @@ class FleetSupervisor:
         agg = {"workers": 0, "inflight": 0, "queue_depth": 0, "max_queue_depth": 0}
         rank = {"ok": 0, "degraded": 1, "critical": 2, "unreachable": 2}
         worst = "ok"
-        with self._lock:
-            procs = list(self._procs.values())
+        procs = list(self._host.processes().values())
         alive_pids = {p.pid for p in procs if p.is_alive()}
         for wid, reg in read_workers(self.fleet_dir).items():
             port = reg.get("port")
@@ -268,8 +261,7 @@ class FleetSupervisor:
         federation shim; each page is already namespaced per process by
         its scrape origin)."""
         out: dict[int, str] = {}
-        with self._lock:
-            procs = list(self._procs.values())
+        procs = list(self._host.processes().values())
         alive_pids = {p.pid for p in procs if p.is_alive()}
         for wid, reg in read_workers(self.fleet_dir).items():
             port = reg.get("port")

@@ -58,6 +58,12 @@ Counter names in use:
   back to a local build (no dedup, full correctness)
 - ``fleet.supervisor.restarts``  crashed fleet workers respawned by the
   supervisor (serve/fleet/supervisor.py)
+- ``build.exchange.bytes``  decoded bytes exchanged through spill files
+  between the pooled build's p1 shards and p2 owners (the cross-process
+  ledger, execution/build_exchange.py)
+- ``build.worker.crashes``  pooled-build workers found dead without a
+  posted result — each one became a typed WorkerCrashed abort instead
+  of a hung coordinator (parallel/procpool.py)
 """
 
 from __future__ import annotations
@@ -95,6 +101,8 @@ KNOWN_COUNTERS = (
     "fleet.singleflight.takeovers",
     "fleet.singleflight.local_fallbacks",
     "fleet.supervisor.restarts",
+    "build.exchange.bytes",
+    "build.worker.crashes",
 )
 
 _counters = {name: _metrics.counter(name) for name in KNOWN_COUNTERS}

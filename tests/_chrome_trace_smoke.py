@@ -6,7 +6,10 @@ TPC-DS query, both under a JSON-lines sink, then exports the span trees
 with ``obs.export --format chrome`` and asserts the document is a valid
 Chrome Trace Event file whose build-pipeline stages *visibly overlap*
 (≥2 stage slices concurrent in time) — the property Perfetto renders as
-parallel lanes. Kept out of pytest collection (leading underscore):
+parallel lanes. Also rebuilds one index with the POOLED scale-out build
+(``hyperspace.build.workers=2``) and asserts the adopted worker-process
+traces land on ≥2 distinct pid lanes that overlap in time — one lane
+per worker process. Kept out of pytest collection (leading underscore):
 tier-1 covers the exporter's unit semantics; this is the end-to-end
 "a real build's timeline renders and shows the overlap" check."""
 
@@ -41,6 +44,13 @@ def main() -> int:
     name, plan = sorted(tpcds_queries(scans).items())[0]
     session.run(plan)  # one TPC-DS query trace
 
+    # One POOLED rebuild: worker-process traces are adopted back into
+    # the coordinator (pid-qualified trace ids), so the chrome export
+    # shows one lane per worker process.
+    session.conf.set("hyperspace.build.workers", 2)
+    first = sorted(hs.indexes()["name"])[0]
+    hs.refresh_index(first)
+
     rc = export.main(["--format", "chrome", "--sink", str(sink), "--output", out_path])
     assert rc == 0
     doc = json.loads(Path(out_path).read_text())
@@ -62,10 +72,31 @@ def main() -> int:
     assert overlaps, f"no overlapping build stages among {len(build)} spans"
     query = [e for e in xs if e["name"].startswith("execute.")]
     assert query, "no executed-operator spans from the TPC-DS query"
+
+    # Scale-out build lanes: the pooled rebuild's worker-process roots
+    # carry their own pid (trace_id "<pid>-<seq>"), so they land on
+    # distinct pid tracks — and, as genuinely concurrent processes,
+    # their slices must overlap in time (perf_counter is the shared
+    # CLOCK_MONOTONIC on Linux, comparable across processes).
+    workers = [
+        e for e in xs if e["name"] in ("build.p1.worker", "build.p2.worker")
+    ]
+    assert workers, "no pooled worker-process spans in the trace"
+    lanes = {e["pid"] for e in workers}
+    assert len(lanes) >= 2, f"expected >=2 worker pid lanes, got {lanes}"
+    w_intervals = [(e["ts"], e["ts"] + e["dur"], e["pid"]) for e in workers]
+    w_overlaps = [
+        (a[2], b[2])
+        for i, a in enumerate(w_intervals)
+        for b in w_intervals[i + 1:]
+        if a[2] != b[2] and a[0] < b[1] and b[0] < a[1]
+    ]
+    assert w_overlaps, f"no cross-process overlap among {len(workers)} worker spans"
     print(
         f"OK: {len(xs)} spans -> {out_path}; {len(build)} build-stage slices, "
         f"{len(overlaps)} overlapping pairs (e.g. {overlaps[0][0]} ~ {overlaps[0][1]}); "
-        f"{len(query)} query operator slices"
+        f"{len(query)} query operator slices; {len(workers)} worker slices on "
+        f"{len(lanes)} pid lanes, {len(w_overlaps)} cross-process overlaps"
     )
     return 0
 

@@ -1,0 +1,286 @@
+"""Scale-out pooled index build (docs/architecture.md "scale-out
+build"): bucket-sharded worker-process pool + spill-file exchange must
+be BYTE-identical to the serial streaming reference at every worker
+count, and the exchange format itself round-trips."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import stats
+from hyperspace_tpu.dataset import Dataset
+from hyperspace_tpu.execution import build_exchange as bx
+from hyperspace_tpu.execution import io as hio
+from hyperspace_tpu.execution.builder import DeviceIndexBuilder
+
+
+def _gen_source(root, n=12_000, files=3, row_group_size=2_000, with_nulls=True):
+    root.mkdir(parents=True, exist_ok=True)
+    rng = np.random.default_rng(11)
+    per = n // files
+    for i in range(files):
+        m = per if i < files - 1 else n - per * (files - 1)
+        k = rng.integers(-(10**12), 10**12, m).astype(np.int64)
+        nulls = (rng.random(m) < 0.08) if with_nulls else None
+        pq.write_table(
+            pa.table(
+                {
+                    "k": pa.array(k, mask=nulls),
+                    "s": pa.array([f"s{j % 41:02d}" for j in range(m)]),
+                    "v": pa.array(rng.standard_normal(m)),
+                }
+            ),
+            root / f"p{i}.parquet",
+            row_group_size=row_group_size,
+        )
+
+
+def _assert_identical_index(d_ref, d_got, num_buckets):
+    assert hio.read_manifest(d_ref) == hio.read_manifest(d_got)
+    for b in range(num_buckets):
+        rb = (d_ref / hio.bucket_file_name(b)).read_bytes()
+        gb = (d_got / hio.bucket_file_name(b)).read_bytes()
+        assert rb == gb, f"bucket {b} bytes differ from the serial reference"
+
+
+# kw shared by reference and pooled builders: the tiny budget forces the
+# serial builder down the streaming path (the pooled build's reference).
+_KW = dict(memory_budget_bytes=50_000, chunk_bytes=80_000)
+
+
+def test_pooled_build_matches_serial_byte_for_byte_across_worker_counts(tmp_path):
+    """1, 2, and 4 workers — every pooled layout must reproduce the
+    serial streaming reference exactly (manifest AND bucket bytes)."""
+    _gen_source(tmp_path / "src")
+    ds = Dataset.parquet(tmp_path / "src")
+    num_buckets = 16
+    serial = DeviceIndexBuilder(pipeline_enabled=False, **_KW)
+    d_ref = tmp_path / "ref" / "v__=0"
+    serial.write(ds.scan(), ["k", "s", "v"], ["k"], num_buckets, d_ref)
+    assert serial.last_build_stats["path"] == "streaming"
+
+    for w in (1, 2, 4):
+        pooled = DeviceIndexBuilder(workers=w, **_KW)
+        d = tmp_path / f"pool{w}" / "v__=0"
+        pooled.write(ds.scan(), ["k", "s", "v"], ["k"], num_buckets, d)
+        st = pooled.last_build_stats
+        assert st["path"] == "pooled" and st["workers"] == w
+        assert st["p1_shards"] <= w and st["p2_owners"] <= w
+        assert st["rows"] == 12_000 and st["exchange_bytes"] > 0
+        assert not (d.parent / "v__=0.exchange").exists(), "exchange dir must be swept"
+        _assert_identical_index(d_ref, d, num_buckets)
+
+
+def test_worker_count_exceeds_bucket_count(tmp_path):
+    """More workers than buckets: owners clamp to the bucket count and
+    the output stays identical."""
+    _gen_source(tmp_path / "src", n=4_000, files=2, row_group_size=1_000)
+    ds = Dataset.parquet(tmp_path / "src")
+    serial = DeviceIndexBuilder(pipeline_enabled=False, memory_budget_bytes=20_000, chunk_bytes=30_000)
+    d_ref = tmp_path / "ref" / "v__=0"
+    serial.write(ds.scan(), ["k", "v"], ["k"], 2, d_ref)
+    pooled = DeviceIndexBuilder(workers=4, memory_budget_bytes=20_000, chunk_bytes=30_000)
+    d = tmp_path / "pool" / "v__=0"
+    pooled.write(ds.scan(), ["k", "v"], ["k"], 2, d)
+    assert pooled.last_build_stats["p2_owners"] == 2  # clamped to buckets
+    assert pooled.last_build_stats["p1_shards"] == 2  # clamped to files
+    _assert_identical_index(d_ref, d, 2)
+
+
+def test_single_bucket_index(tmp_path):
+    _gen_source(tmp_path / "src", n=3_000, files=2, row_group_size=1_000)
+    ds = Dataset.parquet(tmp_path / "src")
+    serial = DeviceIndexBuilder(pipeline_enabled=False, memory_budget_bytes=10_000, chunk_bytes=20_000)
+    d_ref = tmp_path / "ref" / "v__=0"
+    serial.write(ds.scan(), ["k", "v"], ["k"], 1, d_ref)
+    pooled = DeviceIndexBuilder(workers=2, memory_budget_bytes=10_000, chunk_bytes=20_000)
+    d = tmp_path / "pool" / "v__=0"
+    pooled.write(ds.scan(), ["k", "v"], ["k"], 1, d)
+    _assert_identical_index(d_ref, d, 1)
+
+
+def test_zero_row_input(tmp_path):
+    """Zero-row source files: every bucket lands empty, manifest all
+    zeros, identical to the serial reference."""
+    root = tmp_path / "src"
+    root.mkdir(parents=True)
+    empty = pa.table({"k": pa.array([], type=pa.int64()), "v": pa.array([], type=pa.float64())})
+    pq.write_table(empty, root / "p0.parquet")
+    pq.write_table(empty, root / "p1.parquet")
+    ds = Dataset.parquet(root)
+    serial = DeviceIndexBuilder(pipeline_enabled=False, memory_budget_bytes=1, chunk_bytes=1_000)
+    d_ref = tmp_path / "ref" / "v__=0"
+    serial.write(ds.scan(), ["k", "v"], ["k"], 4, d_ref)
+    pooled = DeviceIndexBuilder(workers=2, memory_budget_bytes=1, chunk_bytes=1_000)
+    d = tmp_path / "pool" / "v__=0"
+    pooled.write(ds.scan(), ["k", "v"], ["k"], 4, d)
+    assert pooled.last_build_stats["rows"] == 0
+    assert hio.read_manifest(d)["bucketRows"] == [0, 0, 0, 0]
+    _assert_identical_index(d_ref, d, 4)
+
+
+# -- exchange-format unit tests ----------------------------------------------
+
+
+def test_slice_files_contiguous_ordered_balanced():
+    files = [f"f{i}" for i in range(10)]
+    sizes = [100] * 10
+    for w in (1, 2, 3, 4, 10, 16):
+        slices = bx.slice_files(files, sizes, w)
+        assert len(slices) == min(w, len(files))
+        assert all(s for s in slices), "no empty slices"
+        # Contiguity + order: concatenation reproduces the input exactly.
+        assert [f for s in slices for f in s] == files
+    # Byte balance: a huge first file takes a slice of its own.
+    slices = bx.slice_files(files, [10_000] + [100] * 9, 3)
+    assert slices[0] == ["f0"]
+    assert bx.slice_files([], [], 4) == []
+
+
+def test_owner_map_is_bucket_mod_owners():
+    assert [bx.owner_of(b, 3) for b in range(7)] == [0, 1, 2, 0, 1, 2, 0]
+
+
+def test_spill_path_layout_groups_by_owner(tmp_path):
+    p = bx.spill_path(tmp_path, owner=2, shard=1, bucket=7)
+    assert p.parent == tmp_path / "owner-00002"
+    assert p.name == "shard-00001.bucket-00007.parquet"
+
+
+def test_exchange_roundtrip_in_process(tmp_path):
+    """p1_shard → p2_owner run in-process (no pool): the exchange format
+    round-trips rows exactly, shard-order concatenation preserves the
+    global row order, and the ledger matches what p2 budgets from."""
+    _gen_source(tmp_path / "src", n=2_000, files=2, row_group_size=500, with_nulls=False)
+    ds = Dataset.parquet(tmp_path / "src")
+    schema = ds.scan().scan_schema
+    files = sorted(str(p) for p in (tmp_path / "src").glob("*.parquet"))
+    ex = tmp_path / "ex"
+    num_buckets, num_owners = 4, 2
+    ledgers = []
+    for w, f in enumerate(files):
+        res = bx.p1_shard(bx.P1Task(
+            worker=w, files=[f], fmt="parquet", columns=["k", "s", "v"],
+            schema=schema, indexed_columns=["k"], num_buckets=num_buckets,
+            num_owners=num_owners, chunk_bytes=20_000, memory_budget_bytes=10_000,
+            exchange_dir=str(ex),
+        ))
+        assert res["rows"] == 1_000 and res["chunks"] >= 1
+        ledgers.append(res["spill_bytes"])
+        for b, path in res["spill_files"].items():
+            assert bx.owner_of(b, num_owners) == int(path.split("owner-")[1][:5])
+    merged = {}
+    for led in ledgers:
+        for b, nb in led.items():
+            merged[b] = merged.get(b, 0) + nb
+    dest = tmp_path / "out"
+    dest.mkdir()
+    rows = {}
+    for o in range(num_owners):
+        res = bx.p2_owner(bx.P2Task(
+            owner=o, num_owners=num_owners, n_shards=len(files),
+            num_buckets=num_buckets, exchange_dir=str(ex), dest_dir=str(dest),
+            columns=["k", "s", "v"], schema=schema, indexed_columns=["k"],
+            spill_bytes={b: nb for b, nb in merged.items() if bx.owner_of(b, num_owners) == o},
+            window_bytes=1,  # a window below any bucket still admits one at a time
+        ))
+        rows.update(res["bucket_rows"])
+    assert sum(rows.values()) == 2_000
+    # Row multiset survives the exchange + sort.
+    got = pd.concat([
+        pd.DataFrame(hio.read_parquet([str(dest / hio.bucket_file_name(b))]).decode())
+        for b in range(num_buckets)
+    ])
+    exp = pd.concat([pd.read_parquet(f) for f in files])
+    cols = ["k", "s", "v"]
+    pd.testing.assert_frame_equal(
+        got[cols].sort_values(cols).reset_index(drop=True),
+        exp[cols].sort_values(cols).reset_index(drop=True),
+        check_dtype=False,
+    )
+
+
+def test_host_sort_perm_matches_lexsort(tmp_path):
+    from hyperspace_tpu.execution.table import ColumnTable
+    from hyperspace_tpu.ops.sortkeys import key_lanes, lexsort_lanes
+
+    rng = np.random.default_rng(3)
+    t = ColumnTable.from_arrow(pa.table({
+        "k": rng.integers(-100, 100, 500).astype(np.int64),
+        "v": rng.standard_normal(500),
+    }))
+    perm = bx.host_sort_perm(t, ["k"])
+    expected = lexsort_lanes(key_lanes(t, ["k"]))
+    assert np.array_equal(np.asarray(perm), np.asarray(expected))
+
+
+# -- end-to-end through the session/config surface ----------------------------
+
+
+def test_create_index_with_workers_conf_serves_queries(tmp_path):
+    """hyperspace.build.workers=2 end-to-end: CreateAction commits a
+    pooled build through the unchanged 2-phase protocol and the index
+    answers rewritten queries identically to the raw scan."""
+    from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col
+    from hyperspace_tpu.config import BUILD_WORKERS
+
+    _gen_source(tmp_path / "src", n=6_000, files=2, with_nulls=False)
+    session = HyperspaceSession(system_path=str(tmp_path / "idx"), num_buckets=4)
+    session.conf.set(BUILD_WORKERS, 2)
+    hs = Hyperspace(session)
+    df = session.parquet(tmp_path / "src")
+    before = stats.get("build.exchange.bytes")
+    hs.create_index(df, IndexConfig("sidx", ["k"], ["s", "v"]))
+    assert session.last_build_stats["path"] == "pooled"
+    assert stats.get("build.exchange.bytes") > before
+
+    some_key = int(session.run(df.select("k")).columns["k"][7])
+    q = df.filter(col("k") == some_key).select("k", "s", "v")
+    session.disable_hyperspace()
+    expected = session.to_pandas(q).sort_values(["s", "v"]).reset_index(drop=True)
+    session.enable_hyperspace()
+    got = session.to_pandas(q).sort_values(["s", "v"]).reset_index(drop=True)
+    assert len(got) > 0
+    pd.testing.assert_frame_equal(got, expected[got.columns.tolist()])
+
+
+def test_configured_exchange_dir_is_used_and_swept(tmp_path):
+    """hyperspace.build.exchange.dir: the exchange lands under the
+    configured root (suffixed per build so concurrent builds never
+    collide) and is swept either way."""
+    _gen_source(tmp_path / "src", n=2_000, files=2, row_group_size=500, with_nulls=False)
+    ds = Dataset.parquet(tmp_path / "src")
+    ex_root = tmp_path / "scratch"
+    b = DeviceIndexBuilder(workers=2, exchange_dir=str(ex_root),
+                           memory_budget_bytes=10_000, chunk_bytes=20_000)
+    dest = tmp_path / "i" / "v__=0"
+    assert b._exchange_root(dest) == ex_root / "i-v__=0.exchange"
+    b.write(ds.scan(), ["k", "v"], ["k"], 4, dest)
+    assert b.last_build_stats["path"] == "pooled"
+    assert not any(ex_root.glob("*")), "configured exchange dir not swept"
+    assert hio.read_manifest(dest)["bucketRows"] and sum(
+        hio.read_manifest(dest)["bucketRows"]) == 2_000
+
+
+def test_pooled_build_adopts_worker_traces(tmp_path):
+    """Each worker process's root span ships back and lands in this
+    process's recent-root ring with the WORKER's pid-qualified trace id
+    — the chrome exporter's one-lane-per-worker-process evidence."""
+    import os
+
+    from hyperspace_tpu.obs import trace as obs_trace
+
+    _gen_source(tmp_path / "src", n=3_000, files=2, row_group_size=1_000, with_nulls=False)
+    ds = Dataset.parquet(tmp_path / "src")
+    obs_trace.reset()
+    pooled = DeviceIndexBuilder(workers=2, memory_budget_bytes=20_000, chunk_bytes=30_000)
+    with obs_trace.trace("test.build"):
+        pooled.write(ds.scan(), ["k", "v"], ["k"], 4, tmp_path / "i" / "v__=0")
+    roots = obs_trace.recent_roots()
+    worker_roots = [r for r in roots if r.name in ("build.p1.worker", "build.p2.worker")]
+    assert len(worker_roots) >= 3  # 2 p1 shards + >=1 p2 owner adopted
+    my_pid = str(os.getpid())
+    pids = {str(r.trace_id).split("-", 1)[0] for r in worker_roots}
+    assert my_pid not in pids and len(pids) >= 2, pids

@@ -600,3 +600,97 @@ def test_prefetch_fault_is_advisory(tmp_path):
         prefetch.drain()
     errors = obs_metrics.REGISTRY.get("io.prefetch.errors")
     assert errors is not None and errors.value >= 1
+
+
+# ---------------------------------------------------------------------------
+# Scale-out pooled build fault points (docs/architecture.md "scale-out
+# build"): the crash sweep extended across the PROCESS boundary. The
+# coordinator ships its registered rules into every spawned worker
+# (faults.export_state / install_state via parallel/procpool.py), so a
+# crash rule at a worker-side point (`build.exchange.write` in a p1
+# shard, `build.exchange.read` in a p2 owner) kills the worker process
+# for real — no result ever posts — and the coordinator's bounded join
+# must convert that into a typed WorkerCrashed abort, sweep the
+# exchange/spill scratch, roll the action back, and leave recover()
+# convergent with queries still correct.
+# ---------------------------------------------------------------------------
+
+
+def _pooled_session(tmp_path):
+    from hyperspace_tpu.config import BUILD_WORKERS
+
+    source = _write_source(tmp_path / "src", n=600)
+    session = HyperspaceSession(system_path=str(tmp_path / "sys"), num_buckets=2)
+    session.conf.set(BUILD_WORKERS, 2)
+    return source, session, Hyperspace(session)
+
+
+def _assert_no_build_scratch(tmp_path):
+    leftovers = [
+        p for pat in ("*.exchange", "*.spill") for p in (tmp_path / "sys").rglob(pat)
+    ]
+    assert not leftovers, f"build scratch survived the abort: {leftovers}"
+
+
+@pytest.mark.parametrize("point", ["build.exchange.write", "build.exchange.read"])
+def test_worker_killed_mid_build_typed_abort(tmp_path, point):
+    """Worker killed mid-p1 (exchange.write) / mid-p2 (exchange.read):
+    the CrashPoint unwinds out of the WORKER process (a real process
+    death — spawn workers get no cleanup), the coordinator aborts with
+    the typed WorkerCrashed, and the build rolls back cleanly."""
+    from hyperspace_tpu.exceptions import WorkerCrashed
+
+    source, session, hs = _pooled_session(tmp_path)
+    faults.inject(point, crash=True, at_call=1)
+    try:
+        with pytest.raises(WorkerCrashed):
+            hs.create_index(
+                session.parquet(source), IndexConfig("idx1", ["key"], ["value"])
+            )
+    finally:
+        faults.reset()
+    _assert_no_build_scratch(tmp_path)
+    assert stats.get("build.worker.crashes") >= 1
+    _assert_crash_consistent(tmp_path, source, "create", point)
+    # A clean retry (next "process") succeeds end to end.
+    hs.create_index(session.parquet(source), IndexConfig("idx1", ["key"], ["value"]))
+    session.enable_hyperspace()
+    _query_matches(session, source)
+
+
+@pytest.mark.parametrize("point", ["build.worker.spawn", "build.manifest.merge"])
+def test_coordinator_crash_mid_pooled_build(tmp_path, point):
+    """Coordinator-side pooled points: a hard crash at worker spawn or
+    at the manifest merge dies like any writer death — exchange swept by
+    the builder's finally, recover() converges."""
+    source, session, hs = _pooled_session(tmp_path)
+    faults.inject(point, crash=True, at_call=1)
+    crashed = False
+    try:
+        hs.create_index(session.parquet(source), IndexConfig("idx1", ["key"], ["value"]))
+    except CrashPoint:
+        crashed = True
+    finally:
+        faults.reset()
+    assert crashed, f"crash at {point} never fired"
+    _assert_no_build_scratch(tmp_path)
+    _assert_crash_consistent(tmp_path, source, "create", point)
+
+
+def test_transient_worker_fault_aborts_typed_then_retries_clean(tmp_path):
+    """A transient FaultError inside a worker posts back through the
+    result queue, the coordinator aborts with the typed WorkerFailed
+    (Action.run rolls back), and a clean retry succeeds."""
+    from hyperspace_tpu.exceptions import WorkerFailed
+
+    source, session, hs = _pooled_session(tmp_path)
+    with faults.injected("build.exchange.write"):
+        with pytest.raises(WorkerFailed) as ei:
+            hs.create_index(
+                session.parquet(source), IndexConfig("idx1", ["key"], ["value"])
+            )
+        assert ei.value.error_type == "FaultError"
+    _assert_no_build_scratch(tmp_path)
+    hs.create_index(session.parquet(source), IndexConfig("idx1", ["key"], ["value"]))
+    session.enable_hyperspace()
+    _query_matches(session, source)

@@ -1,0 +1,145 @@
+"""parallel/procpool.py: the shared spawn-context worker lifecycle.
+
+The satellite contract pinned here standalone (no builder involved): a
+worker crash during a pooled run must abort with a typed
+`WorkerCrashed` via the bounded join's liveness check — including a
+REAL SIGKILLed worker — never hang the coordinator on a result queue
+that will never fill."""
+
+import os
+import signal
+import time
+
+import pytest
+
+from hyperspace_tpu import faults, stats
+from hyperspace_tpu.exceptions import WorkerCrashed, WorkerFailed
+from hyperspace_tpu.parallel.procpool import ProcessHost, TaskPool, spawn_context
+
+
+# Worker bodies must be module-level (spawn pickles them by qualified
+# name and re-imports this module in the child).
+
+def _double(x):
+    return x * 2
+
+
+def _sleep_forever(_seconds):
+    time.sleep(3600)
+
+
+def _value_error(msg):
+    raise ValueError(msg)
+
+
+def _hard_exit(code):
+    os._exit(code)
+
+
+def _hit_point():
+    faults.fault_point("build.exchange.write", "/tmp/probe")
+    return "ok"
+
+
+def _idle_until_stopped(stop_seconds):
+    time.sleep(stop_seconds)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def test_spawn_context_is_spawn():
+    assert spawn_context().get_start_method() == "spawn"
+
+
+def test_taskpool_collects_all_results():
+    with TaskPool("hs-test") as pool:
+        for i in range(3):
+            pool.submit(i, _double, i)
+        results = pool.join()
+    assert results == {0: 0, 1: 2, 2: 4}
+
+
+def test_posted_error_reraises_typed():
+    """A worker body that raises posts the error; join re-raises it as a
+    typed WorkerFailed carrying the worker-side traceback."""
+    with TaskPool("hs-test") as pool:
+        pool.submit("bad", _value_error, "boom-xyz")
+        with pytest.raises(WorkerFailed) as ei:
+            pool.join()
+    assert ei.value.error_type == "ValueError"
+    assert "boom-xyz" in str(ei.value)
+    assert "worker traceback" in str(ei.value)
+
+
+def test_sigkilled_worker_raises_typed_abort_bounded():
+    """The satellite: a real SIGKILL mid-task must surface as a typed
+    WorkerCrashed within a bounded wait (liveness check), not a hang."""
+    before = stats.get("build.worker.crashes")
+    with TaskPool("hs-test", poll_s=0.1, crash_grace_s=0.5) as pool:
+        pool.submit("victim", _sleep_forever, 0)
+        p = pool.host.get("victim")
+        # Wait for the process to actually be up before killing it.
+        deadline = time.monotonic() + 30
+        while not p.is_alive() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        os.kill(p.pid, signal.SIGKILL)
+        t0 = time.monotonic()
+        with pytest.raises(WorkerCrashed) as ei:
+            pool.join()
+        assert time.monotonic() - t0 < 30, "join did not bound the wait"
+    assert ei.value.task_id == "victim"
+    assert ei.value.exitcode == -signal.SIGKILL
+    assert stats.get("build.worker.crashes") == before + 1
+
+
+def test_hard_exit_worker_raises_typed_abort():
+    with TaskPool("hs-test", poll_s=0.1, crash_grace_s=0.5) as pool:
+        pool.submit("exiter", _hard_exit, 7)
+        with pytest.raises(WorkerCrashed) as ei:
+            pool.join()
+    assert ei.value.exitcode == 7
+
+
+def test_join_timeout_is_typed():
+    with TaskPool("hs-test", poll_s=0.05) as pool:
+        pool.submit("slow", _sleep_forever, 0)
+        with pytest.raises(WorkerCrashed, match="timed out"):
+            pool.join(timeout=0.5)
+        # __exit__ terminates the straggler.
+    assert not pool.host.get("slow").is_alive()
+
+
+def test_fault_rules_ship_into_workers_and_observed_merge_back():
+    """The coordinator's registered rules fire INSIDE the spawned worker
+    (fresh per-process schedules), and the worker's observed points merge
+    back on join — the cross-process leg of the deterministic harness."""
+    faults.inject("build.exchange.write", times=1)
+    with TaskPool("hs-test") as pool:
+        pool.submit("w", _hit_point)
+        with pytest.raises(WorkerFailed) as ei:
+            pool.join()
+    assert ei.value.error_type == "FaultError"
+    assert "build.exchange.write" in faults.observed_points()
+    faults.reset()
+    # recording() (armed, zero rules) also sees worker-side points.
+    with faults.recording() as seen:
+        with TaskPool("hs-test") as pool:
+            pool.submit("w", _hit_point)
+            assert pool.join() == {"w": "ok"}
+    assert "build.exchange.write" in seen
+
+
+def test_process_host_stop_terminates_stragglers():
+    host = ProcessHost("hs-test-host")
+    p = host.spawn("w", _idle_until_stopped, args=(3600,))
+    assert host.alive_count() == 1
+    t0 = time.monotonic()
+    host.stop(timeout=0.5, grace=5.0)
+    assert time.monotonic() - t0 < 30
+    assert not p.is_alive()
+    assert host.alive_count() == 0
