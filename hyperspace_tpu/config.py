@@ -61,6 +61,18 @@ SCAN_PREFETCH_ENABLED = "hyperspace.scan.prefetch.enabled"
 AGG_VENUE = "hyperspace.agg.venue"
 SORT_VENUE = "hyperspace.sort.venue"
 FILTER_VENUE = "hyperspace.filter.venue"
+# Device data path (docs/architecture.md "device data path").
+# staging.enabled gates the Arrow→device zero-copy staging layer
+# (execution/staging.py): eligible fixed-width columns stay read-only
+# views over the Arrow buffers on the cache-destined read path instead
+# of owned host copies (process-global, like the faults/obs switches —
+# the decode path has no session handle). fusedKernels gates the Pallas
+# fused kernels (segment reduce, join-agg run bounds): "auto" engages
+# them on the device venue when the shape is eligible AND exactness is
+# provable, with the jitted lax path as the always-available fallback;
+# "off" keeps the lax path everywhere.
+DEVICE_STAGING_ENABLED = "hyperspace.device.staging.enabled"
+DEVICE_FUSED_KERNELS = "hyperspace.device.fusedKernels"
 # Broadcast hash join: a non-aligned join whose smaller side has at most
 # this many rows (and is at least 4x smaller than the other) probes the
 # large side against the sorted small side instead of sorting both for a
@@ -325,6 +337,20 @@ KNOWN_KEYS: dict[str, ConfKey] = {
         "`auto`",
         "Where predicate masks evaluate: exact numpy on host vs the fused XLA "
         "computation (mesh-sharded rows on device)."),
+    DEVICE_STAGING_ENABLED: ConfKey(
+        "true",
+        "Arrow→device zero-copy staging (execution/staging.py): fixed-width "
+        "null-free columns on the cache-destined read path stay read-only "
+        "views over the Arrow buffers instead of owned host copies, counted "
+        "in `device.stage.bytes_zero_copy` vs `device.stage.bytes_copied`. "
+        "Process-global; `false` restores the always-copy decode."),
+    DEVICE_FUSED_KERNELS: ConfKey(
+        "`auto`",
+        "Fused Pallas kernels on the device venue (segment reduce, join-agg "
+        "run bounds): `auto` engages them when the shape is eligible and "
+        "byte-identical results are provable, falling back to the jitted lax "
+        "path otherwise (`device.kernel.fused`/`device.kernel.fallbacks` "
+        "count the split); `off` keeps the lax path everywhere."),
     JOIN_BROADCAST_MAX_ROWS: ConfKey(
         "4,000,000",
         "A non-aligned join whose smaller side is under this row count (and ≥4x "
@@ -597,6 +623,7 @@ class HyperspaceConf:
     agg_venue: str = DEFAULT_JOIN_VENUE
     sort_venue: str = DEFAULT_JOIN_VENUE
     filter_venue: str = DEFAULT_JOIN_VENUE
+    device_fused_kernels: str = "auto"
     join_broadcast_max_rows: int = DEFAULT_JOIN_BROADCAST_MAX_ROWS
     join_rebucketize: str = DEFAULT_JOIN_REBUCKETIZE
     validate_plans: bool = True
@@ -679,6 +706,14 @@ class HyperspaceConf:
             self.sort_venue = str(value)
         elif key == FILTER_VENUE:
             self.filter_venue = str(value)
+        elif key == DEVICE_FUSED_KERNELS:
+            self.device_fused_kernels = str(value)
+        elif key == DEVICE_STAGING_ENABLED:
+            # Process-global like the faults/obs switches: the decode
+            # path (ColumnTable.from_arrow) has no session handle.
+            from hyperspace_tpu.execution import staging
+
+            staging.set_enabled(_as_bool(value))
         elif key == JOIN_BROADCAST_MAX_ROWS:
             self.join_broadcast_max_rows = int(value)
         elif key == JOIN_REBUCKETIZE:
@@ -834,6 +869,12 @@ class HyperspaceConf:
             return self.sort_venue
         if key == FILTER_VENUE:
             return self.filter_venue
+        if key == DEVICE_FUSED_KERNELS:
+            return self.device_fused_kernels
+        if key == DEVICE_STAGING_ENABLED:
+            from hyperspace_tpu.execution import staging
+
+            return staging.enabled()
         if key == JOIN_BROADCAST_MAX_ROWS:
             return self.join_broadcast_max_rows
         if key == JOIN_REBUCKETIZE:

@@ -151,6 +151,25 @@ HOST_DERIVED = RefCache(
 )
 
 
+def table_footprint_bytes(table) -> int:
+    """Canonical ColumnTable byte accounting for every byte-budgeted
+    cache (io decoded-table cache, HOST_DERIVED side entries, the serve
+    result cache). Dictionary-coded string columns count at their
+    (codes + dictionary payload) footprint: the int32 code array plus
+    the summed character payload (+ pointer word) of the SMALL
+    dictionary — never the inflated per-row string size, and never a
+    ``<U``-dtype dictionary's UTF-32-padded ``.nbytes`` (which scales
+    with the LONGEST entry times the entry count). Over-counting here
+    evicted dict-coded columns far too eagerly: a 4M-row dict column is
+    ~16 MB of codes, not the hundreds of MB its decoded strings would
+    occupy."""
+    total = sum(int(v.nbytes) for v in table.columns.values())
+    total += sum(int(v.nbytes) for v in table.validity.values())
+    for d in table.dictionaries.values():
+        total += sum(len(str(s)) for s in d.tolist()) + 8 * len(d)
+    return int(total)
+
+
 def is_stable(arr: np.ndarray) -> bool:
     """True when the array's identity is a valid cache key: frozen arrays
     (decoded-table cache entries and HOST_DERIVED values) never mutate

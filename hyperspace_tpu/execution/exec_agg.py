@@ -86,6 +86,7 @@ class AggregateMixin:
             # Identity-cached factorization: repeat aggregations over a
             # stable index version skip re-factorizing the keys.
             groups=_group_ids_cached(table, plan.group_by),
+            fused=self._fused_kernels(),
         )
 
     def _try_partial_agg_pushdown(self, plan: "Aggregate") -> "ColumnTable | Aggregate | None":
@@ -198,7 +199,8 @@ class AggregateMixin:
         pschema = _Agg(_TableLeaf(lt), pkeys, partial_specs).schema
         venue = self._agg_venue()
         partial = aggregate_table(
-            lt, pkeys, partial_specs, pschema, venue=venue, groups=(gid, k, rep)
+            lt, pkeys, partial_specs, pschema, venue=venue, groups=(gid, k, rep),
+            fused=self._fused_kernels(),
         )
         self._phys(
             "PartialAggPushdown",
@@ -268,7 +270,7 @@ class AggregateMixin:
         reg_fields += [out_schema.field(a.alias) for a in regular]
         base = aggregate_table(
             ct, plan.group_by, regular, Schema(tuple(reg_fields)),
-            venue=venue, groups=(gid, k, rep),
+            venue=venue, groups=(gid, k, rep), fused=self._fused_kernels(),
         )
         cols = dict(base.columns)
         dicts = dict(base.dictionaries)
@@ -358,6 +360,7 @@ class AggregateMixin:
             sub = aggregate_table(
                 bt, list(s), specs2, Schema(tuple(fields)), venue=venue,
                 groups=None if prefix_groups is None else prefix_groups.get(len(s)),
+                fused=self._fused_kernels(),
             )
 
             def agg_col(f, spec, cols, dicts, validity, sub=sub):

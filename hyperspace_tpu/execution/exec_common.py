@@ -853,6 +853,40 @@ def _factorize_keys(ltables, rtables, lkeys, rkeys, null_safe=False):
     per_col_codes_r: list[list[np.ndarray]] = [[] for _ in rtables]
     cards: list[int] = []
     for lname, rname in zip(lkeys, rkeys):
+        dict_res = _dict_domain_codes(ltables, rtables, lname, rname)
+        if dict_res is not None:
+            # Dictionary-coded string keys factorize in the DICTIONARY
+            # domain: merge the small sorted dictionaries and remap each
+            # side's codes with one O(n) gather — the per-row string
+            # values never inflate on host (the O(n log n) string
+            # np.unique below was a top line of BENCH_SF100's
+            # key-factorization tax). Order and cross-side equality are
+            # preserved exactly (the merged domain is sorted and covers
+            # both sides); cardinality counts dictionary entries, a
+            # superset of used values — the mixed-radix combination only
+            # needs an injective order-preserving code space, so a
+            # larger radix is still correct.
+            lvals, rvals, card = dict_res
+            if null_safe and has_nulls:
+                masks = [t.valid_mask(lname) for t in ltables] + [
+                    t.valid_mask(rname) for t in rtables
+                ]
+                if any(m is not None for m in masks):
+                    lvals = [v.copy() for v in lvals]
+                    rvals = [v.copy() for v in rvals]
+                    any_null = False
+                    for v, m in zip(lvals + rvals, masks):
+                        if m is not None and (~m).any():
+                            v[~m] = card
+                            any_null = True
+                    if any_null:
+                        card += 1
+            cards.append(max(card, 1))
+            for i, v in enumerate(lvals):
+                per_col_codes_l[i].append(v)
+            for i, v in enumerate(rvals):
+                per_col_codes_r[i].append(v)
+            continue
         lvals = [_logical_key(t, lname) for t in ltables]
         rvals = [_logical_key(t, rname) for t in rtables]
         allv = np.concatenate(lvals + rvals) if (lvals or rvals) else np.array([])
@@ -932,6 +966,41 @@ def _factorize_keys(ltables, rtables, lkeys, rkeys, null_safe=False):
     if null_safe:
         return out_l, out_r
     return _apply_null_codes(out_l, out_r, lnulls, rnulls)
+
+
+def _dict_domain_codes(ltables, rtables, lname, rname):
+    """Dictionary-domain factorization of one string key column:
+    (per-left-table codes, per-right-table codes, cardinality) in the
+    merged sorted-dictionary domain, or None when the column pair is not
+    dictionary-coded on every table (the value-domain np.unique path
+    handles it). The merged domain is the sorted union of the SMALL
+    per-table dictionaries; each table's rows remap with one gather."""
+    lfs = [t.schema.field(lname) for t in ltables]
+    rfs = [t.schema.field(rname) for t in rtables]
+    if not all(f.is_string for f in lfs + rfs):
+        return None
+    pairs = [(t, t.schema.field(lname).name) for t in ltables] + [
+        (t, t.schema.field(rname).name) for t in rtables
+    ]
+    if any(nm not in t.dictionaries for t, nm in pairs):
+        return None
+    dicts = [np.asarray(t.dictionaries[nm]) for t, nm in pairs]
+    first = dicts[0]
+    if all(len(d) == len(first) and np.array_equal(d, first) for d in dicts[1:]):
+        # One shared sorted dictionary (the common single-index-version
+        # case): the codes already ARE the domain ranks — zero work.
+        codes = [t.columns[nm].astype(np.int64, copy=False) for t, nm in pairs]
+        card = len(first)
+    else:
+        merged = np.unique(np.concatenate([d.astype(str) for d in dicts]))
+        codes = []
+        for (t, nm), d in zip(pairs, dicts):
+            old_to_new = np.searchsorted(merged, d.astype(str)).astype(np.int64)
+            col = t.columns[nm]
+            codes.append(old_to_new[col] if len(d) else col.astype(np.int64, copy=False))
+        card = len(merged)
+    nl = len(ltables)
+    return codes[:nl], codes[nl:], card
 
 
 def _logical_key(table: ColumnTable, name: str) -> np.ndarray:

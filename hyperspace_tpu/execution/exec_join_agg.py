@@ -133,7 +133,7 @@ class FusedJoinAggMixin:
             self.stats["join_kernel"] = "device-run-prefix"
             out, spec_layout = self._device_fused_channels(
                 plan, data, codes, perms, primary, secondary, spec_sides,
-                gid_orig, k, spec_input,
+                gid_orig, k, spec_input, fused=self._fused_kernels(),
             )
         star = out[0]
 
@@ -172,17 +172,32 @@ class FusedJoinAggMixin:
         return ColumnTable(out_schema, cols, dicts, validity)
 
     def _device_fused_channels(
-        self, plan, data, codes, perms, primary, secondary, spec_sides, gid_orig, k, spec_input
+        self, plan, data, codes, perms, primary, secondary, spec_sides, gid_orig, k,
+        spec_input, fused: str = "off",
     ):
         """Device venue: the run-prefix kernel over bucket-major padded
         channels (ops/join_agg.py). Pads, the channel stacks, and the
         uploads all route through the identity caches, so repeat queries
-        over a stable index version serve from HBM."""
+        over a stable index version serve from HBM. With `fused` = auto
+        the pad widths round up to the 128-lane tile so the Pallas
+        run-bounds kernel can engage (extra pads are sentinels/dead
+        rows — results are unchanged by construction)."""
         from hyperspace_tpu.execution import device_cache as dcache
         from hyperspace_tpu.ops.join_agg import fused_join_aggregate
 
-        pk = _pad_bucket_major_cached(codes[primary], data[primary].offsets)
-        sk = _pad_bucket_major_cached(codes[secondary], data[secondary].offsets)
+        def width_of(offsets) -> int | None:
+            if fused != "auto":
+                return None  # natural Lmax width
+            counts = np.diff(offsets)
+            lm = max(int(counts.max()) if counts.size else 1, 1)
+            return ((lm + 127) // 128) * 128
+
+        pk = _pad_bucket_major_cached(
+            codes[primary], data[primary].offsets, width=width_of(data[primary].offsets)
+        )
+        sk = _pad_bucket_major_cached(
+            codes[secondary], data[secondary].offsets, width=width_of(data[secondary].offsets)
+        )
         b, lp = pk.shape
         ls = sk.shape[1]
 
@@ -264,7 +279,9 @@ class FusedJoinAggMixin:
 
         pvals = _stack_cached(p_arrays, (0, b, lp))
         svals = _stack_cached(s_arrays, (0, b, ls))
-        out = fused_join_aggregate(pk, sk, pvals, svals, gid_pad, k, tuple(channels))
+        out = fused_join_aggregate(
+            pk, sk, pvals, svals, gid_pad, k, tuple(channels), fused=fused
+        )
         return out, spec_layout
 
     def _host_fused_channels(

@@ -106,10 +106,33 @@ class ScanFilterMixin:
         serves an INDEX scan), an unreadable file — missing, truncated,
         or garbage parquet — surfaces as a typed IndexCorruptionError so
         the session can quarantine the index and re-plan against the
-        source instead of failing the query."""
+        source instead of failing the query.
+
+        Index scans decode PER FILE and concatenate through the cached
+        side-concat — the join-side pattern. Two wins over one
+        multi-file decode: each single-chunk per-file column stages as a
+        zero-copy Arrow buffer view (a 16-file concat is multi-chunk and
+        can never stage — this was the whole filter/group_agg staging
+        tax), and per-file cache entries are shared across queries with
+        DIFFERENT surviving file subsets (pruning no longer forces a
+        full re-decode). The frozen concat itself is identity-cached, so
+        repeat queries skip it entirely."""
         before = hio.table_cache_stats()
         try:
-            table = hio.read_parquet_cached(files, columns=columns, schema=schema)
+            if index_root is not None and len(files) > 1:
+                from concurrent.futures import ThreadPoolExecutor
+
+                from hyperspace_tpu.execution.exec_common import _concat_side_cached
+                from hyperspace_tpu.obs import trace as obs_trace
+
+                read = obs_trace.wrap(
+                    lambda f: hio.read_parquet_cached([f], columns=columns, schema=schema)
+                )
+                with ThreadPoolExecutor(max_workers=min(8, len(files))) as ex:
+                    tables = list(ex.map(read, files))
+                table = _concat_side_cached(tables)
+            else:
+                table = hio.read_parquet_cached(files, columns=columns, schema=schema)
         except IndexCorruptionError:
             raise
         except (OSError, pa.ArrowException) as e:

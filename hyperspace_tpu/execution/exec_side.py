@@ -581,8 +581,10 @@ class JoinSidesMixin:
                 return s, 0  # uncut: pass the (already stable) base through
             for arr in (*s.columns.values(), *s.validity.values()):
                 dc.freeze(arr)
-            size = int(sum(a.nbytes for a in s.columns.values()))
-            return s, size
+            # Canonical footprint (codes + dictionary payload for
+            # dict-coded columns) — the budget must see what the entry
+            # retains, not an inflated or partial estimate.
+            return s, dc.table_footprint_bytes(s)
 
         return dc.HOST_DERIVED.get_or_build(key, refs, build)
 

@@ -381,6 +381,15 @@ class Executor(
         emulated f64 on chips without native double support)."""
         return self._venue("agg_venue", "hyperspace.agg.venue", False, needs_native=False)
 
+    def _fused_kernels(self) -> str:
+        """Fused Pallas kernel gate for the device venue ("auto"/"off",
+        `hyperspace.device.fusedKernels`): auto engages the fused
+        segment-reduce / run-bounds kernels when the shape is eligible
+        and byte-identity is provable; the jitted lax path is the
+        always-available fallback (docs/architecture.md "device data
+        path")."""
+        return self.conf.device_fused_kernels if self.conf is not None else "auto"
+
     def _top_n(self, sort_plan: "Sort", n: int) -> ColumnTable:
         """ORDER BY ... LIMIT n as an O(rows) selection: np.partition on
         the first sort column finds the n-th threshold, only the (ties-

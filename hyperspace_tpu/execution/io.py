@@ -85,11 +85,9 @@ def _evict_locked() -> None:
 
 
 def _table_nbytes(t: ColumnTable) -> int:
-    total = sum(v.nbytes for v in t.columns.values())
-    total += sum(v.nbytes for v in t.validity.values())
-    for d in t.dictionaries.values():
-        total += sum(len(str(s)) for s in d) + 8 * len(d)
-    return total
+    from hyperspace_tpu.execution import device_cache
+
+    return device_cache.table_footprint_bytes(t)
 
 
 def _freeze_table(t: ColumnTable) -> None:
@@ -125,9 +123,17 @@ def read_parquet_cached(files: list[str], columns: list[str] | None = None, sche
     _MET_MISSES.inc()
     _MET_FILES.inc(len(files))
     _MET_BYTES.inc(disk_bytes)
-    table = read_parquet(files, columns=columns, schema=schema)
+    # Cache-destined decode: the one sanctioned caller of the zero-copy
+    # staging path (execution/staging.py) — eligible columns stay
+    # read-only views over the Arrow buffers, frozen into the cache
+    # below (or downgraded to owned copies when the table turns out too
+    # large to cache, restoring writable per-query semantics exactly).
+    table = read_table_files(
+        files, "parquet", columns=columns, schema=schema, zero_copy_ok=True
+    )
     nb = _table_nbytes(table)
     global _cache_bytes
+    cached = False
     with _cache_lock:
         if nb <= _CACHE_BUDGET // 4:
             # Freeze ONLY what actually enters the cache: frozen ⟺
@@ -140,6 +146,9 @@ def read_parquet_cached(files: list[str], columns: list[str] | None = None, sche
             _cache[key] = (mtimes, nb, table)
             _cache_bytes += nb
             _evict_locked()
+            cached = True
+    if not cached:
+        table.own_arrays()
     return table
 
 
@@ -186,11 +195,36 @@ def _read_one_file(path: str, fmt: str, columns: list[str] | None, schema: Schem
 def _read_one_file_once(path: str, fmt: str, columns: list[str] | None, schema: Schema | None):
     fault_point("bucket.read", path)
     if fmt == "parquet":
-        # partitioning=None: index files live under hive-looking `v__=N`
-        # version dirs; letting pyarrow infer a `v__` partition column
-        # would bake it into compacted files and then conflict with the
-        # inferred dictionary type on the next read.
-        return pq.read_table(path, columns=columns, partitioning=None)
+        # ParquetFile (never the dataset API): index files live under
+        # hive-looking `v__=N` version dirs, and inferring a `v__`
+        # partition column would bake it into compacted files. Decoding
+        # as ONE whole-file batch (instead of pq.read_table's ~128Ki-row
+        # internal batches) keeps every column SINGLE-CHUNK, which is
+        # what lets the zero-copy staging layer keep it as an Arrow
+        # buffer view — multi-chunk columns must copy to become
+        # contiguous. Measures at parity or faster than read_table.
+        pf = pq.ParquetFile(path)
+        n = pf.metadata.num_rows
+        if columns is not None:
+            # iter_batches silently IGNORES unknown columns where
+            # read_table raised — keep the strict contract (an index
+            # file missing a declared column is corruption, not a
+            # narrower read).
+            names = set(pf.schema_arrow.names)
+            missing = [c for c in columns if c not in names]
+            if missing:
+                raise pa.lib.ArrowInvalid(
+                    f"no match for column(s) {missing} in {path}"
+                )
+        batches = list(
+            pf.iter_batches(batch_size=max(n, 1), columns=columns, use_threads=True)
+        )
+        if not batches:
+            sch = pf.schema_arrow
+            if columns is not None:
+                sch = pa.schema([sch.field(c) for c in columns])
+            return sch.empty_table()
+        return pa.Table.from_batches(batches)
     if fmt == "orc":
         from pyarrow import orc
 
@@ -252,10 +286,14 @@ def read_table_files(
     fmt: str = "parquet",
     columns: list[str] | None = None,
     schema: Schema | None = None,
+    zero_copy_ok: bool = False,
 ) -> ColumnTable:
     """Format-aware multi-file read into a ColumnTable (decode released
     from the GIL and overlapped across files). `schema` is the registered
-    dataset schema; CSV/JSON decode is pinned to it."""
+    dataset schema; CSV/JSON decode is pinned to it. `zero_copy_ok`
+    opts the decode into the device-staging path — ONLY the
+    cache-destined read (read_parquet_cached) may pass it (see
+    ColumnTable.from_arrow)."""
     if not files:
         raise HyperspaceError("no files to read")
     import os
@@ -287,7 +325,8 @@ def read_table_files(
             table = pa.concat_tables(tables, promote_options="default") if len(tables) > 1 else tables[0]
     if schema is not None and columns is not None:
         schema = schema.select(columns)
-    return ColumnTable.from_arrow(table, schema)
+    with obs_trace.span("device.stage", files=len(files), zero_copy=zero_copy_ok):
+        return ColumnTable.from_arrow(table, schema, zero_copy_ok=zero_copy_ok)
 
 
 def _read_footer(path: str) -> "pq.FileMetaData":

@@ -1,0 +1,172 @@
+"""Arrow→device zero-copy staging — the host-marshalling tax collector.
+
+BENCH_SF100's round-5 accounting put the 600M-row join at ~60s of a
+401s wall: the other ~340s was host-side marshalling — bucket/source
+decode, key factorization, and channel staging — paid BETWEEN the Arrow
+bytes pyarrow decoded and the numpy arrays the device plane uploads.
+The biggest single line item is a deliberate memcpy: `ColumnTable
+.from_arrow` copied every zero-copy Arrow buffer into an owned numpy
+array so that "read-only" could mean exactly one thing in the engine
+(frozen by the cache layer, identity-stable).
+
+This module removes that copy WITHOUT weakening the invariant. A
+fixed-width, null-free, single-chunk Arrow column can be viewed as a
+read-only numpy array over the Arrow buffer itself (`np.frombuffer` —
+the view pins the buffer, so lifetime is safe). The view is only kept
+on the cache-destined read path (`io.read_parquet_cached` asks for it
+with ``zero_copy_ok=True`` and freezes the table moments later); a
+table that turns out too large to cache is downgraded to owned writable
+arrays (`ColumnTable.own_arrays`), restoring the old semantics exactly.
+So "writeable=False ⇒ identity-stable" still holds for every array the
+device/derived caches ever see.
+
+Accounting: every fixed-width column that crosses the staging boundary
+is counted in ``device.stage.bytes_zero_copy`` (kept as a buffer view)
+or ``device.stage.bytes_copied`` (host-materialized: nulls, casts,
+multi-chunk concat, unaligned views, or staging disabled). The venue
+bench gates the copied-byte reduction on these counters.
+
+Fault point ``device.stage`` fires before each zero-copy view attempt.
+An injected transient fault (or any real OSError from the buffer
+plumbing) degrades that column to the copied host path — the query
+still answers, bytes land in the copied counter. CrashPoint passes
+through untouched (BaseException — the query surface declares it).
+
+Gated by ``hyperspace.device.staging.enabled`` (process-global, like
+the faults/obs switches: the decode path has no session handle).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from hyperspace_tpu import stats
+from hyperspace_tpu.faults import fault_point
+
+# Process-global gate, flipped by config.set(DEVICE_STAGING_ENABLED).
+# Benign racy read by design (same contract as faults._armed): a stale
+# value steers one column down the other (equally correct) path.
+_lock = threading.Lock()
+_enabled = True
+
+
+def set_enabled(enabled: bool) -> None:
+    global _enabled
+    with _lock:
+        _enabled = bool(enabled)
+
+
+def enabled() -> bool:
+    return _enabled  # noqa: HSL013 — single-word read of a bool gate
+
+
+# Arrow fixed-width primitive types that view directly as the engine's
+# device dtypes. Bool is bit-packed in Arrow (no numpy view); date32 and
+# timestamp[us] are reinterpreted via Arrow's zero-copy .view() upstream.
+_VIEW_DTYPES = {
+    "int8": np.int8,
+    "int16": np.int16,
+    "int32": np.int32,
+    "int64": np.int64,
+    "uint8": np.uint8,
+    "uint16": np.uint16,
+    "uint32": np.uint32,
+    "uint64": np.uint64,
+    "float": np.float32,
+    "double": np.float64,
+    "date32[day]": np.int32,
+    "timestamp[us]": np.int64,
+}
+
+
+def count_copied(nbytes: int) -> None:
+    """Account host-materialized staging bytes (the copied path)."""
+    if nbytes > 0:
+        stats.increment("device.stage.bytes_copied", int(nbytes))
+
+
+def _buffer_view(arr, np_dtype) -> np.ndarray | None:
+    """Read-only numpy view over a primitive Arrow array's data buffer,
+    or None when the layout cannot be viewed (offset view misaligned to
+    the lane width — the `hs_take_rows` alignment-guard class)."""
+    bufs = arr.buffers()
+    if len(bufs) != 2 or bufs[1] is None:
+        return None
+    data = bufs[1]
+    dt = np.dtype(np_dtype)
+    byte_off = arr.offset * dt.itemsize
+    if (data.address + byte_off) % dt.itemsize:
+        return None  # unaligned offset view: the memcpy path owns it
+    if byte_off + len(arr) * dt.itemsize > data.size:
+        return None
+    out = np.frombuffer(data, dtype=dt, count=len(arr), offset=byte_off)
+    # np.frombuffer over an immutable Arrow buffer is already read-only;
+    # the view holds `data`, so the Arrow allocation outlives the array.
+    return out
+
+
+def stage_column(arr, field) -> np.ndarray | None:
+    """Zero-copy numpy view of one fixed-width Arrow column (chunked or
+    plain), or None when ineligible — nulls, bool, multi-chunk, dtype
+    mismatch with the schema, unaligned offset view, staging disabled,
+    or an injected/real staging fault (degrades to the copied path)."""
+    import pyarrow as pa
+
+    if not _enabled:
+        return None
+    if isinstance(arr, pa.ChunkedArray):
+        if arr.num_chunks != 1:
+            return None
+        arr = arr.chunk(0)
+    if arr.null_count:
+        return None
+    want = np.dtype(field.device_dtype)
+    np_dtype = _VIEW_DTYPES.get(str(arr.type))
+    if np_dtype is None or np.dtype(np_dtype) != want:
+        return None
+    try:
+        fault_point("device.stage", field.name)
+        if str(arr.type) in ("date32[day]", "timestamp[us]"):
+            # Arrow's .view() reinterprets the same buffer (zero-copy)
+            # into the engine's physical integer domain.
+            arr = arr.view(pa.int32() if want == np.int32 else pa.int64())
+        view = _buffer_view(arr, np_dtype)
+    except OSError:
+        # Transient staging failure (injected or real): this column
+        # degrades to the copied host path — the advisory contract.
+        return None
+    if view is None:
+        return None
+    stats.increment("device.stage.bytes_zero_copy", int(view.nbytes))
+    return view
+
+
+def validity_mask(arr) -> np.ndarray | None:
+    """Host bool validity mask (True = valid) of an Arrow column,
+    expanded from the PACKED validity bitmap with one vectorized
+    np.unpackbits pass per chunk — not through a pyarrow compute
+    round-trip that materializes an intermediate byte-per-row Arrow
+    array first. Returns None when the column is null-free."""
+    import pyarrow as pa
+
+    if not arr.null_count:
+        return None
+    chunks = arr.chunks if isinstance(arr, pa.ChunkedArray) else [arr]
+    parts: list[np.ndarray] = []
+    for c in chunks:
+        n = len(c)
+        bufs = c.buffers()
+        bitmap = bufs[0] if bufs else None
+        if c.null_count == 0 or bitmap is None:
+            parts.append(np.ones(n, dtype=bool))
+            continue
+        bits = np.frombuffer(bitmap, dtype=np.uint8)
+        mask = np.unpackbits(bits, bitorder="little")[c.offset : c.offset + n]
+        parts.append(mask.astype(bool))
+    out = parts[0] if len(parts) == 1 else np.concatenate(parts)
+    # Writable copy: the mask is a fresh host array either way (the
+    # engine zeroes null slots through it), and downstream freezing is
+    # the io cache's decision, not ours.
+    return np.ascontiguousarray(out)

@@ -77,11 +77,22 @@ class ColumnTable:
 
     # -- construction ----------------------------------------------------
     @staticmethod
-    def from_arrow(table, schema: Schema | None = None) -> "ColumnTable":
+    def from_arrow(table, schema: Schema | None = None, zero_copy_ok: bool = False) -> "ColumnTable":
         """Build from a pyarrow Table, dictionary-encoding string columns
-        and extracting validity masks for nullable data."""
+        and extracting validity masks for nullable data.
+
+        ``zero_copy_ok`` opts into the device-staging path
+        (execution/staging.py): fixed-width null-free single-chunk
+        columns are kept as READ-ONLY numpy views over the Arrow buffers
+        (no host materialization) instead of owned copies. Only the
+        cache-destined read path may pass it — read-only must keep
+        meaning identity-stable, so `io.read_parquet_cached` freezes the
+        table into the cache or downgrades it with :meth:`own_arrays`.
+        """
         import pyarrow as pa
         import pyarrow.compute as pc
+
+        from hyperspace_tpu.execution import staging
 
         if schema is None:
             schema = Schema.from_arrow(table.schema)
@@ -95,9 +106,10 @@ class ColumnTable:
             this engine: frozen by the cache layer (identity-stable).
             Without this, per-query scan arrays would masquerade as
             cacheable and pile dead entries into the device cache. The
-            copy only triggers for single-chunk null-free columns (the
-            zero-copy case) and is small next to the parquet decode that
-            produced them — a deliberate trade for an airtight stability
+            staging path (zero_copy_ok=True) is the one sanctioned
+            exception: its read-only views are frozen into the io cache
+            or downgraded back to owned copies before anyone else sees
+            them — a deliberate trade for an airtight stability
             invariant."""
             return arr if arr.flags.writeable else arr.copy()
         for f in schema.fields:
@@ -109,7 +121,10 @@ class ColumnTable:
                         f"vector column {f.name!r} contains {arr.null_count} null "
                         "rows; null embeddings are not supported"
                     )
-                valid = _owned(np.asarray(pc.is_valid(arr).combine_chunks()))
+                # Packed-bitmap expansion (one vectorized unpackbits per
+                # chunk) instead of a pyarrow compute round-trip that
+                # materializes an intermediate byte-per-row Arrow array.
+                valid = staging.validity_mask(arr)
                 validity[f.name] = valid
             if f.is_string:
                 # Arrow's C++ dictionary encode, then a SMALL sort of the
@@ -175,6 +190,11 @@ class ColumnTable:
                     np.ascontiguousarray(flat).astype(np.float32, copy=False).reshape(-1, f.dim)
                 )
             else:
+                if zero_copy_ok and valid is None:
+                    staged = staging.stage_column(arr, f)
+                    if staged is not None:
+                        columns[f.name] = staged
+                        continue
                 if f.dtype == "date":
                     arr = arr.cast(pa.int32())
                 elif f.dtype == "timestamp":
@@ -184,10 +204,30 @@ class ColumnTable:
                     # fill crashes on bool columns).
                     arr = pc.fill_null(arr, pa.scalar(False if f.dtype == "bool" else 0, arr.type))
                 np_arr = arr.to_numpy(zero_copy_only=False)
-                columns[f.name] = _owned(
+                out = _owned(
                     np.ascontiguousarray(np_arr).astype(f.device_dtype, copy=False)
                 )
+                staging.count_copied(out.nbytes)
+                columns[f.name] = out
         return ColumnTable(schema, columns, dictionaries, validity)
+
+    def own_arrays(self) -> "ColumnTable":
+        """Downgrade any read-only staged buffer views to owned WRITABLE
+        copies (in place; returns self). The un-cached exit of the
+        zero-copy read path: a table that will not be frozen into the io
+        cache must not carry read-only arrays, or every downstream
+        identity cache would mistake its per-query arrays for stable
+        ones. Copied bytes are accounted to the staging counters."""
+        from hyperspace_tpu.execution import staging
+
+        for name, arr in self.columns.items():
+            if not arr.flags.writeable:
+                self.columns[name] = arr.copy()
+                staging.count_copied(arr.nbytes)
+        for name, arr in self.validity.items():
+            if not arr.flags.writeable:
+                self.validity[name] = arr.copy()
+        return self
 
     @staticmethod
     def from_numpy(schema: Schema, columns: dict[str, np.ndarray], dictionaries=None, validity=None) -> "ColumnTable":
@@ -286,12 +326,24 @@ class ColumnTable:
 
         arrays = {}
         for f in self.schema.fields:
-            if f.is_string:
-                v = self.dictionaries[f.name][self.columns[f.name]]
-            else:
-                v = self.columns[f.name]
             valid = self.validity.get(f.name)
             mask = ~valid if valid is not None else None  # pa: True = null
+            if f.is_string:
+                # Emit the (codes, dictionary) pair AS a DictionaryArray:
+                # the column never inflates to a full per-row string
+                # array on host — parquet/IPC writers consume the codes
+                # and the small dictionary directly (write_bucket was an
+                # O(n)-string materialization per bucket before this).
+                d = self.dictionaries[f.name]
+                idx = pa.array(
+                    np.ascontiguousarray(self.columns[f.name], dtype=np.int32),
+                    mask=mask,
+                )
+                arrays[f.name] = pa.DictionaryArray.from_arrays(
+                    idx, pa.array(d.astype(object), type=pa.string())
+                )
+                continue
+            v = self.columns[f.name]
             if f.is_vector:
                 arrays[f.name] = pa.FixedSizeListArray.from_arrays(
                     pa.array(v.reshape(-1), type=pa.float32()), f.dim

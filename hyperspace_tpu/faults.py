@@ -58,6 +58,9 @@ Fault point names in use (see each call site):
 ``build.exchange.write`` build_exchange p1 shard, before a spill file finalizes
 ``build.exchange.read`` build_exchange p2 owner, before a bucket's spill read
 ``build.manifest.merge`` builder coordinator, before the per-owner stats merge
+``device.stage``      execution/staging.py, before each zero-copy column view
+                      (transient ⇒ that column degrades to the copied host
+                      path; crash ⇒ the query dies like any hard death)
 ====================  =====================================================
 
 Cross-process injection: the pooled build's workers are SPAWNED
@@ -107,6 +110,7 @@ KNOWN_POINTS = (
     "build.exchange.write",
     "build.exchange.read",
     "build.manifest.merge",
+    "device.stage",
 )
 
 

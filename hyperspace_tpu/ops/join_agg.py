@@ -16,6 +16,16 @@ count phase the join already runs) plus cumsum/gather/segment-sum, all
 on device, and downloads K per-group scalars instead of millions of
 pairs. Runs under scoped x64 (jax.enable_x64) for 53-bit accumulation;
 the global flag is never touched.
+
+Fused kernel ladder (docs/architecture.md "device data path"): with
+``hyperspace.device.fusedKernels`` = auto and an eligible shape, the run
+bounds come from the tiled Pallas searchsorted
+(ops/sortkeys.pallas_run_bounds — the secondary row resident in VMEM,
+one vectorized compare-and-count per tile) and feed the same lax
+epilogue; bounds are integers, so results are byte-identical to the
+all-lax path by construction. Ineligible shapes or failed lowerings
+fall back transparently (`device.kernel.fused`/`device.kernel.fallbacks`
+count the split).
 """
 
 from __future__ import annotations
@@ -27,7 +37,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from hyperspace_tpu import stats
 from hyperspace_tpu.compat import jit
+from hyperspace_tpu.obs import trace as obs_trace
 
 
 def _seg_scan_extremum(vals, new_seg, op):
@@ -42,6 +54,63 @@ def _seg_scan_extremum(vals, new_seg, op):
 
     _, out = jax.lax.associative_scan(comb, (new_seg, vals), axis=-1)
     return out
+
+
+def _one_bucket(pkb, skb, pvb, svb, gidb, stb, enb, num_segments: int, channels: tuple):
+    """Per-bucket channel reduction given the run bounds [stb, enb)."""
+    real = pkb < jnp.iinfo(pkb.dtype).max
+    matched = real & (enb > stb)
+    runlen = jnp.where(real, enb - stb, 0).astype(jnp.float64)
+    p_prefix = None
+    if svb.shape[0] and any(ch[0] == "s" for ch in channels):
+        p_prefix = jnp.concatenate(
+            [jnp.zeros((svb.shape[0], 1), svb.dtype), jnp.cumsum(svb, axis=-1)],
+            axis=-1,
+        )
+    new_key = None
+    if any(ch[0] in ("smin", "smax") for ch in channels):
+        new_key = jnp.concatenate(
+            [jnp.ones(1, bool), skb[1:] != skb[:-1]]
+        )
+    outs = []
+    for ch in channels:
+        kind = ch[0]
+        if kind == "star":
+            outs.append(jax.ops.segment_sum(runlen, gidb, num_segments))
+        elif kind == "p":
+            outs.append(jax.ops.segment_sum(pvb[ch[1]] * runlen, gidb, num_segments))
+        elif kind == "s":
+            pj = p_prefix[ch[1]]
+            w = jnp.where(real, pj[enb] - pj[stb], 0.0)
+            outs.append(jax.ops.segment_sum(w, gidb, num_segments))
+        else:
+            is_min = kind.endswith("min")
+            ident = jnp.inf if is_min else -jnp.inf
+            seg_red = jax.ops.segment_min if is_min else jax.ops.segment_max
+            if kind[0] == "p":
+                w = jnp.where(matched, pvb[ch[1]], ident)
+            else:
+                m = _seg_scan_extremum(
+                    svb[ch[1]], new_key, jnp.minimum if is_min else jnp.maximum
+                )
+                w = jnp.where(matched, m[jnp.maximum(enb - 1, 0)], ident)
+            outs.append(seg_red(w, gidb, num_segments))
+    return jnp.stack(outs)
+
+
+def _combine_buckets(per_bucket, channels: tuple):
+    """Fold the vmapped [B, C, K] per-bucket partials across buckets (a
+    group's rows can span buckets only via the primary side's bucketing;
+    sums add, extrema fold with their own op)."""
+    combined = []
+    for c, ch in enumerate(channels):
+        if ch[0] == "pmin" or ch[0] == "smin":
+            combined.append(jnp.min(per_bucket[:, c], axis=0))
+        elif ch[0] == "pmax" or ch[0] == "smax":
+            combined.append(jnp.max(per_bucket[:, c], axis=0))
+        else:
+            combined.append(jnp.sum(per_bucket[:, c], axis=0))
+    return jnp.stack(combined)  # [C, num_segments]
 
 
 @functools.partial(jit, static_argnames=("num_segments", "channels"))
@@ -59,58 +128,26 @@ def _fused_join_agg(pk, sk, pvals, svals, gid, num_segments: int, channels: tupl
     def one(pkb, skb, pvb, svb, gidb):
         st = jnp.searchsorted(skb, pkb, side="left").astype(jnp.int32)
         en = jnp.searchsorted(skb, pkb, side="right").astype(jnp.int32)
-        real = pkb < jnp.iinfo(pkb.dtype).max
-        matched = real & (en > st)
-        runlen = jnp.where(real, en - st, 0).astype(jnp.float64)
-        p_prefix = None
-        if svb.shape[0] and any(ch[0] == "s" for ch in channels):
-            p_prefix = jnp.concatenate(
-                [jnp.zeros((svb.shape[0], 1), svb.dtype), jnp.cumsum(svb, axis=-1)],
-                axis=-1,
-            )
-        new_key = None
-        if any(ch[0] in ("smin", "smax") for ch in channels):
-            new_key = jnp.concatenate(
-                [jnp.ones(1, bool), skb[1:] != skb[:-1]]
-            )
-        outs = []
-        for ch in channels:
-            kind = ch[0]
-            if kind == "star":
-                outs.append(jax.ops.segment_sum(runlen, gidb, num_segments))
-            elif kind == "p":
-                outs.append(jax.ops.segment_sum(pvb[ch[1]] * runlen, gidb, num_segments))
-            elif kind == "s":
-                pj = p_prefix[ch[1]]
-                w = jnp.where(real, pj[en] - pj[st], 0.0)
-                outs.append(jax.ops.segment_sum(w, gidb, num_segments))
-            else:
-                is_min = kind.endswith("min")
-                ident = jnp.inf if is_min else -jnp.inf
-                seg_red = jax.ops.segment_min if is_min else jax.ops.segment_max
-                if kind[0] == "p":
-                    w = jnp.where(matched, pvb[ch[1]], ident)
-                else:
-                    m = _seg_scan_extremum(
-                        svb[ch[1]], new_key, jnp.minimum if is_min else jnp.maximum
-                    )
-                    w = jnp.where(matched, m[jnp.maximum(en - 1, 0)], ident)
-                outs.append(seg_red(w, gidb, num_segments))
-        return jnp.stack(outs)
+        return _one_bucket(pkb, skb, pvb, svb, gidb, st, en, num_segments, channels)
 
     per_bucket = jax.vmap(one)(pk, sk, pvals.transpose(1, 0, 2), svals.transpose(1, 0, 2), gid)
-    # Combine across buckets per channel kind (a group's rows can span
-    # buckets only via the primary side's bucketing; sums add, extrema
-    # fold with their own op).
-    combined = []
-    for c, ch in enumerate(channels):
-        if ch[0] == "pmin" or ch[0] == "smin":
-            combined.append(jnp.min(per_bucket[:, c], axis=0))
-        elif ch[0] == "pmax" or ch[0] == "smax":
-            combined.append(jnp.max(per_bucket[:, c], axis=0))
-        else:
-            combined.append(jnp.sum(per_bucket[:, c], axis=0))
-    return jnp.stack(combined)  # [C, num_segments]
+    return _combine_buckets(per_bucket, channels)
+
+
+@functools.partial(jit, static_argnames=("num_segments", "channels"))
+def _fused_join_agg_bounds(
+    pk, sk, st, en, pvals, svals, gid, num_segments: int, channels: tuple
+):
+    """Same program as :func:`_fused_join_agg` with the run bounds
+    precomputed (the Pallas run-bounds kernel feeds this variant)."""
+
+    def one(pkb, skb, stb, enb, pvb, svb, gidb):
+        return _one_bucket(pkb, skb, pvb, svb, gidb, stb, enb, num_segments, channels)
+
+    per_bucket = jax.vmap(one)(
+        pk, sk, st, en, pvals.transpose(1, 0, 2), svals.transpose(1, 0, 2), gid
+    )
+    return _combine_buckets(per_bucket, channels)
 
 
 def fused_join_aggregate(
@@ -121,11 +158,15 @@ def fused_join_aggregate(
     gid: np.ndarray,
     num_groups: int,
     channels: tuple,
+    fused: str = "off",
 ) -> np.ndarray:
     """Host wrapper: pads the group dimension (+1 dead segment for pads)
     and runs the fused device program on the persistent x64 worker thread
-    (parallel/x64.py). Returns [C, num_groups] float64."""
+    (parallel/x64.py). Returns [C, num_groups] float64. `fused` = "auto"
+    tries the Pallas run-bounds kernel first (identical integer bounds,
+    so identical results), with the lax searchsorted as the fallback."""
     from hyperspace_tpu.execution.device_cache import device_put_cached
+    from hyperspace_tpu.ops.sortkeys import pallas_run_bounds
     from hyperspace_tpu.parallel.x64 import run_x64
 
     k_seg = 1 << max(int(num_groups).bit_length(), 1)  # >= num_groups+1
@@ -134,15 +175,38 @@ def fused_join_aggregate(
         # Stable (frozen, identity-cached) inputs serve from the HBM
         # cache on repeat queries; the upload keys carry the active x64
         # scope, so the float64 channels stay float64.
-        out = _fused_join_agg(
-            device_put_cached(pk),
-            device_put_cached(sk),
-            device_put_cached(pvals),
-            device_put_cached(svals),
-            device_put_cached(gid),
-            k_seg,
-            channels,
-        )
+        pk_dev = device_put_cached(pk)
+        sk_dev = device_put_cached(sk)
+        bounds = None
+        if fused == "auto":
+            with obs_trace.span(
+                "device.kernel", kernel="pallas-run-bounds",
+                buckets=pk.shape[0], secondary=sk.shape[1],
+            ):
+                bounds = pallas_run_bounds(pk_dev, sk_dev)
+            if bounds is not None:
+                stats.increment("device.kernel.fused")
+            else:
+                stats.increment("device.kernel.fallbacks")
+        if bounds is not None:
+            out = _fused_join_agg_bounds(
+                pk_dev, sk_dev, bounds[0], bounds[1],
+                device_put_cached(pvals),
+                device_put_cached(svals),
+                device_put_cached(gid),
+                k_seg,
+                channels,
+            )
+        else:
+            out = _fused_join_agg(
+                pk_dev,
+                sk_dev,
+                device_put_cached(pvals),
+                device_put_cached(svals),
+                device_put_cached(gid),
+                k_seg,
+                channels,
+            )
         return np.asarray(jax.device_get(out))
 
     return run_x64(call)[:, :num_groups]

@@ -184,6 +184,96 @@ def device_order_perm(table, by: list[tuple[str, bool]]) -> np.ndarray:
     return device_lanes_perm(order_lanes(table, by))
 
 
+# -- fused Pallas run bounds --------------------------------------------------
+# Batched searchsorted for the fused join-aggregate: every (bucket,
+# primary-row tile) program holds the bucket's WHOLE sorted secondary
+# key row in VMEM and counts `sk < pk` / `sk <= pk` with one vectorized
+# compare-and-sum — exactly searchsorted left/right on a sorted row,
+# integer-exact by construction (so results stay byte-identical to the
+# lax path), without the per-element binary-search while_loop XLA lowers
+# jnp.searchsorted to. Generalizes the ops/topk.py tiling (grid over
+# tiles, whole-reduction rows resident in VMEM).
+_RB_TILE = 128
+# The secondary row must fit VMEM beside the (tile, Ls) compare block.
+_RB_MAX_SECONDARY = 8192
+# Interpret mode (CPU tests) pays a python-level grid loop per program:
+# bound total compare work so the fused path never engages where the
+# brute-force O(Lp*Ls) sweep would dwarf the O(Lp log Ls) lax path.
+_RB_INTERPRET_WORK = 1 << 24
+
+import threading as _threading
+
+_pallas_rb_bad: set = set()
+_pallas_rb_bad_lock = _threading.Lock()
+
+
+@functools.lru_cache(maxsize=32)
+def _make_run_bounds_kernel(tile: int, ls_pad: int, interpret: bool):
+    import jax
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.compat import jit, resolve_pallas
+
+    pl = resolve_pallas()
+
+    def kernel(pk_ref, sk_ref, st_ref, en_ref):
+        pk = pk_ref[0, :]  # (tile,) int32, sorted or not — bounds are per-element
+        sk = sk_ref[0, :]  # (ls_pad,) int32, sorted (pads carry dtype max)
+        cmp = sk[None, :] < pk[:, None]
+        st_ref[0, :] = jnp.sum(cmp.astype(jnp.int32), axis=1)
+        en_ref[0, :] = jnp.sum((sk[None, :] <= pk[:, None]).astype(jnp.int32), axis=1)
+
+    def run(pk, sk):  # pk [B, lp_pad], sk [B, ls_pad]; lp_pad % tile == 0
+        b, lp = pk.shape
+        return pl.pallas_call(
+            kernel,
+            grid=(b, lp // tile),
+            in_specs=[
+                pl.BlockSpec((1, tile), lambda i, j: (i, j)),
+                pl.BlockSpec((1, ls_pad), lambda i, j: (i, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, tile), lambda i, j: (i, j)),
+                pl.BlockSpec((1, tile), lambda i, j: (i, j)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((b, lp), jnp.int32),
+                jax.ShapeDtypeStruct((b, lp), jnp.int32),
+            ],
+            interpret=interpret,
+        )(pk, sk)
+
+    return jit(run, key="ops.sortkeys.pallas_run_bounds")
+
+
+def pallas_run_bounds(pk, sk):
+    """(st, en) device arrays — per-row searchsorted left/right of the
+    bucket-batched primary codes `pk` [B, Lp] into the sorted secondary
+    codes `sk` [B, Ls] — via the fused Pallas kernel, or None when the
+    shape is ineligible or the lowering failed (caller keeps the lax
+    searchsorted path; results are identical either way). Lp must be a
+    multiple of the tile (the caller pads with sentinels)."""
+    import jax
+
+    b, lp = pk.shape
+    ls = sk.shape[1]
+    if ls > _RB_MAX_SECONDARY or lp % _RB_TILE or lp == 0 or ls == 0:
+        return None
+    interpret = jax.default_backend() == "cpu"
+    if interpret and b * lp * ls > _RB_INTERPRET_WORK:
+        return None
+    with _pallas_rb_bad_lock:
+        if (_RB_TILE, ls) in _pallas_rb_bad:
+            return None
+    try:
+        run = _make_run_bounds_kernel(_RB_TILE, ls, interpret)
+        return run(pk, sk)
+    except Exception:  # noqa: BLE001 — fall back to the lax searchsorted
+        with _pallas_rb_bad_lock:
+            _pallas_rb_bad.add((_RB_TILE, ls))
+        return None
+
+
 @functools.lru_cache(maxsize=32)
 def _make_batch_sort(num_operands: int, num_keys: int):
     import jax

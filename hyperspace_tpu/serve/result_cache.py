@@ -38,17 +38,15 @@ _EVT_EVICTION_STORM = obs_events.declare("serve.result_cache.eviction_storm")
 
 
 def table_nbytes(table) -> int:
-    """Resident byte estimate of a ColumnTable: physical column arrays,
-    validity masks, and dictionary payloads (object arrays report only
-    pointer bytes via .nbytes, so string payload is summed explicitly)."""
-    n = 0
-    for arr in table.columns.values():
-        n += int(arr.nbytes)
-    for arr in table.validity.values():
-        n += int(arr.nbytes)
-    for d in table.dictionaries.values():
-        n += int(d.nbytes) + sum(len(str(s)) for s in d.tolist())
-    return n
+    """Resident byte estimate of a ColumnTable — the canonical
+    (codes + dictionary payload) accounting from
+    execution/device_cache.py. The previous local estimate added a
+    ``<U``-dtype dictionary's UTF-32-padded ``.nbytes`` on top of its
+    character payload, over-counting dict-coded columns and evicting
+    them too eagerly."""
+    from hyperspace_tpu.execution.device_cache import table_footprint_bytes
+
+    return table_footprint_bytes(table)
 
 
 class ResultCache:

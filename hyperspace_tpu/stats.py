@@ -64,6 +64,19 @@ Counter names in use:
 - ``build.worker.crashes``  pooled-build workers found dead without a
   posted result — each one became a typed WorkerCrashed abort instead
   of a hung coordinator (parallel/procpool.py)
+- ``device.stage.bytes_zero_copy``  column bytes that crossed the
+  Arrow→device staging boundary as read-only buffer VIEWS — no host
+  materialization (execution/staging.py, docs/architecture.md "device
+  data path")
+- ``device.stage.bytes_copied``  column bytes host-materialized during
+  staging (nulls, casts, multi-chunk concat, unaligned offset views,
+  staging disabled, or the un-cached downgrade path)
+- ``device.kernel.fused``  fused Pallas kernel launches on the device
+  venue (segment reduce / join-agg run bounds) — each one replaced a
+  multi-dispatch lax composition
+- ``device.kernel.fallbacks``  device-venue reduces that took the
+  always-available jitted lax path while fused kernels were enabled
+  (ineligible shape, unprovable exactness, or a failed Pallas lowering)
 """
 
 from __future__ import annotations
@@ -103,6 +116,10 @@ KNOWN_COUNTERS = (
     "fleet.supervisor.restarts",
     "build.exchange.bytes",
     "build.worker.crashes",
+    "device.stage.bytes_zero_copy",
+    "device.stage.bytes_copied",
+    "device.kernel.fused",
+    "device.kernel.fallbacks",
 )
 
 _counters = {name: _metrics.counter(name) for name in KNOWN_COUNTERS}

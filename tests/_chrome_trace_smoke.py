@@ -92,11 +92,19 @@ def main() -> int:
         if a[2] != b[2] and a[0] < b[1] and b[0] < a[1]
     ]
     assert w_overlaps, f"no cross-process overlap among {len(workers)} worker spans"
+
+    # Device data path lanes: every Arrow→ColumnTable decode emits a
+    # `device.stage` span (the staging pass the zero-copy layer
+    # accounts), so the query timeline shows staging riding the pooled
+    # IO lanes rather than serializing on the critical path.
+    stage = [e for e in xs if e["name"] == "device.stage"]
+    assert stage, "no device.stage spans in the trace"
     print(
         f"OK: {len(xs)} spans -> {out_path}; {len(build)} build-stage slices, "
         f"{len(overlaps)} overlapping pairs (e.g. {overlaps[0][0]} ~ {overlaps[0][1]}); "
         f"{len(query)} query operator slices; {len(workers)} worker slices on "
-        f"{len(lanes)} pid lanes, {len(w_overlaps)} cross-process overlaps"
+        f"{len(lanes)} pid lanes, {len(w_overlaps)} cross-process overlaps; "
+        f"{len(stage)} device.stage slices"
     )
     return 0
 

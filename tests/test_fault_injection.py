@@ -694,3 +694,78 @@ def test_transient_worker_fault_aborts_typed_then_retries_clean(tmp_path):
     hs.create_index(session.parquet(source), IndexConfig("idx1", ["key"], ["value"]))
     session.enable_hyperspace()
     _query_matches(session, source)
+
+
+# ---------------------------------------------------------------------------
+# Device staging fault point (docs/architecture.md "device data path"):
+# `device.stage` fires before each zero-copy column view. A transient
+# fault degrades THAT COLUMN to the copied host path (the query still
+# answers, bytes land in device.stage.bytes_copied); a crash is a hard
+# death like any other — the read path holds no partial state, so
+# recover() is a convergent no-op and a clean retry serves correctly.
+# ---------------------------------------------------------------------------
+
+
+def _staged_query_session(tmp_path):
+    from hyperspace_tpu import stats
+
+    source = _write_source(tmp_path / "src")
+    session = HyperspaceSession(system_path=str(tmp_path / "sys"), num_buckets=2)
+    hs = Hyperspace(session)
+    hs.create_index(session.parquet(source), IndexConfig("idx1", ["key"], ["value"]))
+    session.enable_hyperspace()
+    stats.reset()
+    return source, session, hs
+
+
+def test_transient_stage_fault_degrades_to_copied_host_path(tmp_path):
+    from hyperspace_tpu import stats
+    from hyperspace_tpu.execution import io as hio
+
+    source, session, hs = _staged_query_session(tmp_path)
+    hio.clear_table_cache()
+    with faults.injected("device.stage"):
+        _query_matches(session, source)
+    # Every staging attempt faulted: nothing crossed zero-copy, the
+    # copied path carried the whole read, and the answer was correct.
+    assert stats.get("device.stage.bytes_zero_copy") == 0
+    assert stats.get("device.stage.bytes_copied") > 0
+    assert stats.get("faults.injected") >= 1
+    # With the harness disarmed the same read stages zero-copy again.
+    hio.clear_table_cache()
+    _query_matches(session, source)
+    assert stats.get("device.stage.bytes_zero_copy") > 0
+
+
+def test_stage_crash_is_hard_death_then_clean_retry(tmp_path):
+    from hyperspace_tpu.execution import io as hio
+    from hyperspace_tpu.faults import CrashPoint
+
+    source, session, hs = _staged_query_session(tmp_path)
+    hio.clear_table_cache()
+    with faults.injected("device.stage", crash=True):
+        with pytest.raises(CrashPoint):
+            _query_matches(session, source)
+    # The read path holds no partial on-disk state: recovery converges
+    # trivially and the next "process" serves the query correctly.
+    hs.recover()
+    hio.clear_table_cache()
+    _query_matches(session, source)
+
+
+def test_stage_fault_off_switch_disarms(tmp_path):
+    """hyperspace.faults.enabled=false must make device.stage inert even
+    with a rule registered (the production kill-switch contract)."""
+    from hyperspace_tpu import stats
+    from hyperspace_tpu.config import FAULTS_ENABLED
+    from hyperspace_tpu.execution import io as hio
+
+    source, session, hs = _staged_query_session(tmp_path)
+    hio.clear_table_cache()
+    session.conf.set(FAULTS_ENABLED, False)
+    try:
+        with faults.injected("device.stage"):
+            _query_matches(session, source)
+            assert stats.get("device.stage.bytes_zero_copy") > 0
+    finally:
+        session.conf.set(FAULTS_ENABLED, True)

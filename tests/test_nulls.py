@@ -55,8 +55,20 @@ def _nullable_parquet(tmp_path, n=800, seed=11):
 def frames_equal(a: pd.DataFrame, b: pd.DataFrame):
     cols = sorted(a.columns)
     assert sorted(b.columns) == cols
-    a2 = a[cols].sort_values(cols, na_position="last").reset_index(drop=True)
-    b2 = b[cols].sort_values(cols, na_position="last").reset_index(drop=True)
+
+    def decat(df: pd.DataFrame) -> pd.DataFrame:
+        # ColumnTable.to_arrow emits dictionary-coded string columns
+        # (codes + dictionary — strings never inflate on host), which
+        # pandas renders as Categorical; the VALUES are what this
+        # comparison is about.
+        out = df.copy()
+        for c in out.columns:
+            if isinstance(out[c].dtype, pd.CategoricalDtype):
+                out[c] = out[c].astype(object)
+        return out
+
+    a2 = decat(a[cols]).sort_values(cols, na_position="last").reset_index(drop=True)
+    b2 = decat(b[cols]).sort_values(cols, na_position="last").reset_index(drop=True)
     pd.testing.assert_frame_equal(a2, b2, check_dtype=False)
 
 
