@@ -30,7 +30,12 @@ Armbrust et al., SIGMOD 2015; the reference inherits it wholesale):
   executable-leak call sites), HSL016 error-contract drift against
   `exceptions.ERROR_CONTRACTS` (generated docs/errors.md), HSL017
   swallowed crash/fault handlers, HSL018 the static unwind-safety
-  proof over `faults.KNOWN_POINTS`. The
+  proof over `faults.KNOWN_POINTS`, and the process-domain layer
+  (`procdomain`): HSL019 spawn-import purity over the
+  `SPAWN_ENTRY_POINTS` registry's inferred worker domain, HSL020
+  exchange-surface typing at every process boundary, HSL021 the
+  shared-file protocol (atomic publish + TTL-reaped leases), HSL022
+  cross-boundary fault/telemetry continuity. The
   unified driver — lint + whole-program rules + validator corpus +
   findings baseline — is `python -m hyperspace_tpu.analysis.check`
   (docs/static_analysis.md).

@@ -45,6 +45,12 @@ _FALLBACK_BLOCKLIST = {
     # concurrent.futures / threading API names: `_pool.submit(...)` on a
     # ThreadPoolExecutor must not resolve to QueryServer.submit.
     "submit", "result", "shutdown", "wait", "notify", "start",
+    # pyarrow API names: `writer.write_table(...)` on a pq.ParquetWriter
+    # must not resolve to DeviceIndexBuilder.write_table — that edge
+    # would drag the whole device build plane into the spawn-worker
+    # domain (HSL019) through a receiver that is not even a program
+    # class.
+    "write_table",
 }
 
 
@@ -70,7 +76,7 @@ class CallGraph:
         # Ctor(...).m(...): type the receiver through the constructor.
         if "()." in raw:
             ctor_raw, _, rest = raw.partition("().")
-            cls_q = prog.class_of_ctor(fn.module, ctor_raw)
+            cls_q = prog.class_of_ctor(fn.module, ctor_raw, fn=fn)
             if cls_q is not None and rest:
                 return self._method_chain(cls_q, rest.split("."))
             return None
@@ -130,7 +136,7 @@ class CallGraph:
             src = fn.local_types[parts[0]]
             cls_q = None
             if src.endswith("()"):
-                cls_q = prog.class_of_ctor(fn.module, src[:-2])
+                cls_q = prog.class_of_ctor(fn.module, src[:-2], fn=fn)
             elif src.startswith("self.") and fn.cls is not None:
                 cls_q = f"{fn.module}.{fn.cls}"
                 for attr in src.split(".")[1:]:

@@ -40,6 +40,15 @@ engine (analysis/program.py → callgraph.py → locks.py):
   have a static propagation path to a recovery construct (witness
   chains land in the report's ``unwind_proof``), and ``+= 1``/``-= 1``
   pairs on shared state must be finally-balanced on raising paths.
+- **HSL019-022 process domains** (analysis/procdomain.py) — the
+  multi-process invariants over the inferred spawn domain
+  (``SPAWN_ENTRY_POINTS``): spawn-import purity (no module a worker
+  imports at start may import jax at module level), exchange-surface
+  typing (only picklable plain data crosses TaskPool/ProcessHost/fleet
+  boundaries), the shared-file protocol (atomic publish + TTL-reaped
+  O_EXCL leases on exchange/fleet paths), and cross-boundary
+  fault/telemetry continuity. The inferred domain graph lands in the
+  report's ``process_domains``.
 - **Validator corpus** — a small set of known-good / known-bad logical
   plans is pushed through the plan validator (analysis/validator.py) as
   a self-test; skipped (with a note) when numpy isn't installed, so the
@@ -82,6 +91,7 @@ from hyperspace_tpu.analysis.lint import (
     RULES,
 )
 from hyperspace_tpu.analysis.locks import LockGraph, resource_findings
+from hyperspace_tpu.analysis.procdomain import ProcessDomains
 from hyperspace_tpu.analysis.program import Program, _index_module, _module_name
 from hyperspace_tpu.analysis.races import (
     atomicity_findings,
@@ -601,10 +611,25 @@ def changed_files(root: pathlib.Path) -> tuple[str, set[str]] | None:
 
 
 def restrict_findings(findings: list[Finding], changed: set[str], root: pathlib.Path) -> list[Finding]:
-    """Findings whose (root-relative) path is in the changed set. The
-    engine still indexed the WHOLE program — resolution and cross-module
-    rules saw everything; only the reporting surface narrows."""
-    return [f for f in findings if _finding_key(f, root)[1] in changed]
+    """Findings whose (root-relative) path — or ANY file on the witness
+    chain — is in the changed set. The engine still indexed the WHOLE
+    program; only the reporting surface narrows. Witness files count
+    because a cross-module finding is often CAUSED by the edited callee
+    while its report line sits in an unchanged caller: dropping those
+    made --changed blind to exactly the regressions the whole-program
+    rules exist for."""
+
+    def _rel(path: str) -> str:
+        try:
+            return str(pathlib.Path(path).resolve().relative_to(root))
+        except ValueError:
+            return path
+
+    return [
+        f for f in findings
+        if _finding_key(f, root)[1] in changed
+        or any(_rel(w) in changed for w in f.witness_paths)
+    ]
 
 
 # -- baseline -----------------------------------------------------------------
@@ -659,6 +684,8 @@ def run_check(
     findings.extend(swallowed_findings(program, raises_obj))
     unwind, unwind_proof = unwind_findings(program, callgraph, raises_obj, contracts)
     findings.extend(unwind)
+    domains = ProcessDomains(program, callgraph, raises_obj)
+    findings.extend(domains.findings())
     allowed = []
     kept = []
     for f in findings:
@@ -704,12 +731,24 @@ def run_check(
                 1 for e in unwind_proof.values() if e["covered"]
             ),
             "dead_symbols": dead["count"],
+            # Process-domain accounting (HSL019-022): CI asserts the
+            # rules actually RAN — a zero entry-point count on the real
+            # repo would mean the registry extraction silently broke.
+            "spawn_entry_points": len(domains.entry_points),
+            "spawn_domain_functions": len(domains.task_fns),
+            "spawn_domain_modules": len(domains.domain_modules),
+            "spawn_boundary_sites": len(domains.boundary_sites),
+            "lease_acquire_sites": len(domains.lease_acquires),
         },
         "validator_corpus": corpus,
         "lock_graph": lockgraph.to_json(),
         # The HSL018 witness chains: per fault point, the recovery
         # construct that statically reaches each threading site.
         "unwind_proof": unwind_proof,
+        # The HSL019-022 substrate: the inferred process-domain graph
+        # (entries, task closure, domain modules, boundary sites, lease
+        # reap proofs) — procdemo pins its exact shape in a golden.
+        "process_domains": domains.to_json(),
         # Informational (never gated): private functions no public entry
         # point reaches through the resolved call graph.
         "dead_symbols": dead,
@@ -723,7 +762,7 @@ def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m hyperspace_tpu.analysis.check",
         description="Unified static analysis: per-file lint (HSL001-HSL008), "
-                    "whole-program rules (HSL009-HSL018), validator corpus, "
+                    "whole-program rules (HSL009-HSL022), validator corpus, "
                     "findings baseline.",
     )
     ap.add_argument("paths", nargs="*", help="files/directories (default: the "

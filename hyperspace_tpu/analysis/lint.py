@@ -72,9 +72,11 @@ whole-program rules — HSL009 lock-order inversion, HSL010 config-key
 drift, HSL011 resource/exception safety, HSL012 fault-point coverage,
 HSL013 lockset data races, HSL014 torn check-then-act, HSL015
 jit-cache hygiene, HSL016 error-contract drift, HSL017 swallowed
-crash/fault, HSL018 unwind safety — need the cross-module index
+crash/fault, HSL018 unwind safety, HSL019-022 the process-domain
+invariants (spawn-import purity, exchange-surface typing, shared-file
+protocol, cross-boundary continuity) — need the cross-module index
 (analysis/program.py, callgraph.py, locks.py, effects.py, races.py,
-raises.py) and run from the unified
+raises.py, procdomain.py) and run from the unified
 driver ``python -m hyperspace_tpu.analysis.check``, which parses each
 file ONCE and feeds the same tree to this linter and to the program
 index. All rules,
@@ -166,6 +168,18 @@ RULES: dict[str, RuleInfo] = {
                  scope="program"),
         RuleInfo("HSL018", "unwind-safety",
                  "fault point with no static path to a recovery construct; +=/-= pair unbalanced on unwind",
+                 scope="program"),
+        RuleInfo("HSL019", "spawn-import-purity",
+                 "module reachable at worker start from a spawn entry point imports jax/pallas at module level",
+                 scope="program"),
+        RuleInfo("HSL020", "exchange-surface-typing",
+                 "non-picklable/device value (ColumnTable, Span, lock, open handle, jax array) crosses a process boundary",
+                 scope="program"),
+        RuleInfo("HSL021", "shared-file-protocol",
+                 "bare write on an exchange/fleet/lease path outside the atomic publish protocol; O_EXCL acquire with no reachable TTL reap",
+                 scope="program"),
+        RuleInfo("HSL022", "cross-boundary-continuity",
+                 "spawn entry point missing fault/trace continuity plumbing; undeclared spawn target or worker telemetry name",
                  scope="program"),
     )
 }
@@ -263,6 +277,13 @@ class Finding:
     col: int
     rule: str
     message: str
+    # Files (other than `path`) on the finding's witness chain — the
+    # lock-order / escape / unwind / domain chains that PROVE the
+    # finding. `--changed` mode keeps a finding when ANY witness file
+    # changed, not just the primary location: editing a callee can
+    # create a finding whose report line sits in an unchanged caller.
+    # Not part of the baseline key (the message already pins the chain).
+    witness_paths: tuple = ()
 
     def __str__(self) -> str:
         return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"

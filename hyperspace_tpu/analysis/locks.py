@@ -208,7 +208,14 @@ class LockGraph:
                 f"threads interleaving these chains deadlock; impose one order "
                 f"or drop the outer lock before the call"
             )
-        return Finding(path, e1.with_line, 0, LOCK_ORDER, msg)
+        # Witness files: every module on either chain — `--changed` must
+        # keep the finding when the edit that created the cycle lives in
+        # a callee, not at the reported with-site.
+        witness = tuple(dict.fromkeys(
+            self._path_of(q) for q in (*e1.chain, *e2.chain)
+        ))
+        return Finding(path, e1.with_line, 0, LOCK_ORDER, msg,
+                       witness_paths=witness)
 
     def _multi_cycles(self, best, seen_pairs) -> list[Finding]:
         adj: dict[str, set[str]] = {}

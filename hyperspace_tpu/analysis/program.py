@@ -285,6 +285,16 @@ class _FunctionPass(ast.NodeVisitor):
             # still contain visitable sub-calls.
             if isinstance(item.context_expr, ast.Call):
                 self.visit(item.context_expr)
+                # `with Ctor(...) as x:` types the bound local exactly
+                # like `x = Ctor(...)` — the context-manager classes here
+                # (TaskPool, the servers) return self from __enter__, and
+                # this is how every pooled-build call site is spelled.
+                if isinstance(item.optional_vars, ast.Name):
+                    ctor = _dotted(item.context_expr.func)
+                    if ctor and ctor != "super":
+                        self.info.local_types.setdefault(
+                            item.optional_vars.id, ctor + "()"
+                        )
         for stmt in node.body:
             self.visit(stmt)
         for _ in refs:
@@ -959,12 +969,15 @@ class Program:
                     return mname
         return None
 
-    def class_of_ctor(self, module: str, ctor_raw: str) -> str | None:
+    def class_of_ctor(self, module: str, ctor_raw: str, fn: "FunctionInfo | None" = None) -> str | None:
         """The class qname `ctor_raw` (a dotted ctor/factory expression)
         constructs: a direct class reference, or a function whose return
-        annotation names a program class."""
+        annotation names a program class. With `fn`, the function's own
+        deferred imports are consulted first (resolve_symbol) — `from
+        ...procpool import TaskPool` inside a method types a
+        `with TaskPool(...) as pool:` local exactly like at runtime."""
         parts = ctor_raw.split(".")
-        target = self.resolve_symbol(module, parts[0])
+        target = self.resolve_symbol(module, parts[0], fn=fn)
         if target is None:
             return None
         for p in parts[1:]:

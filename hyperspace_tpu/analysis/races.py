@@ -109,7 +109,8 @@ def lockset_race_findings(
         bare, locked = pair
         findings.append(Finding(
             _path_of(program, bare.fn), bare.line, 0, LOCKSET_RACE,
-            f"lockset race on {state}: inferred guard {guard} (held at "
+            witness_paths=(_path_of(program, locked.fn),),
+            message=f"lockset race on {state}: inferred guard {guard} (held at "
             f"{len(guarded)}/{len(accesses)} accesses) — "
             f"path 1: {_describe(effects, locked)}; "
             f"path 2: {_describe(effects, bare)} — two threads interleaving "
@@ -291,9 +292,13 @@ def _torn_call(fn, mod, ri: _Region, name: str, state: str, guards, assigns,
                 if _suppressed(mod, call.line, ATOMICITY):
                     return None
                 chain = " -> ".join((fn.qname, *eff.chain))
+                witness = tuple(dict.fromkeys(
+                    _path_of(effects.program, q) for q in (fn.qname, *eff.chain)
+                ))
                 return Finding(
                     mod.path, call.line, 0, ATOMICITY,
-                    f"torn check-then-act on {state} across a call chain: "
+                    witness_paths=witness,
+                    message=f"torn check-then-act on {state} across a call chain: "
                     f"{name!r} read under {ri.lock} at {fn.qname}:{ri.start}, "
                     f"lock released, then {chain} re-acquires it and writes "
                     f"{state} behind a decision on the stale value — widen "

@@ -437,6 +437,12 @@ def error_contract_findings(
                 continue
             if suppressed:
                 continue
+            witness = tuple(dict.fromkeys(
+                program.modules[program.functions[q].module].path
+                for q in e.chain
+                if q in program.functions
+                and program.functions[q].module in program.modules
+            ))
             findings.append(Finding(
                 mod.path if mod is not None else fn.module, fn.line, 0,
                 CONTRACT_DRIFT,
@@ -445,6 +451,7 @@ def error_contract_findings(
                 f"declared contract only covers {list(types)} — declare {t} "
                 f"(or a superclass) in exceptions.ERROR_CONTRACTS, or handle "
                 f"it inside",
+                witness_paths=witness,
             ))
         # Dead declared types: a program-local exception the analysis can
         # see every raise site of, declared but covering no observed

@@ -39,6 +39,28 @@ import threading
 import time
 from typing import Any, Callable
 
+# Span names a spawned WORKER process may emit — the coordinator's lane
+# vocabulary for traces that ship back across the process boundary as
+# to_json() dicts and are adopted into the recent-root ring
+# (adopt_root; parallel/procpool.py ships them). Declared for the same
+# reason stats.KNOWN_COUNTERS is: an undeclared worker span name is a
+# typo'd (or unreviewed) lane the chrome exporter and /debug/trace
+# would silently grow. Statically enforced over the inferred spawn
+# domain by analysis rule HSL022 (docs/static_analysis.md); keep it a
+# plain literal of string constants — the analyzer reads it by AST.
+KNOWN_WORKER_SPANS = (
+    "build.p1.worker",
+    "build.p1.decode",
+    "build.p1.spill",
+    "build.p2.worker",
+    "build.p2.read",
+    "build.p2.sort",
+    "build.p2.write",
+    "io.read",
+    "io.footers",
+    "device.stage",
+)
+
 _current: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
     "hyperspace_obs_span", default=None
 )

@@ -41,8 +41,9 @@ import threading
 import time
 from pathlib import Path
 
-from hyperspace_tpu import stats
+from hyperspace_tpu import faults, stats
 from hyperspace_tpu.obs import events as obs_events
+from hyperspace_tpu.obs import trace as obs_trace
 from hyperspace_tpu.parallel.procpool import ProcessHost
 from hyperspace_tpu.utils import file_utils
 
@@ -91,8 +92,24 @@ def read_workers(fleet_dir: str | os.PathLike) -> dict[int, dict]:
     return out
 
 
-def _worker_entry(target, worker_id: int, fleet_dir: str, stop_event, args: tuple) -> None:
-    """Module-level shim (spawn needs a picklable top-level callable)."""
+def _worker_entry(target, worker_id: int, fleet_dir: str, stop_event, args: tuple,
+                  env: dict | None = None) -> None:
+    """Module-level shim (spawn needs a picklable top-level callable).
+
+    Cross-boundary continuity (HSL022, the TaskPool `_task_entry`
+    contract): the coordinator's registered fault rules and tracer
+    enablement ship in via `env` and are installed before the worker
+    main runs, so a deterministic fault schedule reaches long-lived
+    fleet members exactly like pooled build workers. Service workers
+    have no result envelope to merge observations back through — their
+    telemetry flows out via the per-worker health plane (/metrics,
+    /healthz) instead.
+    """
+    env = env or {}
+    fstate = env.get("faults")
+    if fstate is not None:
+        faults.install_state(fstate)
+    obs_trace.set_enabled(bool(env.get("obs_enabled", True)))
     target(WorkerContext(worker_id, fleet_dir, stop_event), *args)
 
 
@@ -170,10 +187,14 @@ class FleetSupervisor:
         return self
 
     def _spawn(self, worker_id: int):
+        env = {
+            "faults": faults.export_state(),
+            "obs_enabled": obs_trace.enabled(),
+        }
         return self._host.spawn(
             worker_id,
             _worker_entry,
-            args=(self._target, worker_id, self.fleet_dir, self._stop, self._args),
+            args=(self._target, worker_id, self.fleet_dir, self._stop, self._args, env),
             name=f"hs-fleet-{worker_id}",
         )
 

@@ -1,0 +1,11 @@
+"""Process-domain fixture package (HSL019-022).
+
+A miniature of the real multi-process installation: `pool` is the
+procpool analog (carriers + registry), `workers` the jax-free task
+bodies, `devkit` the device module a worker must never pay at load,
+`coord` the coordinator submitting across the boundary, and `service`
+the fleet-worker-main analog whose engine hides behind deferred
+imports. One planted violation per rule, each next to the clean
+counterpart of its pattern; the golden domain-graph JSON pins the
+inferred closure (tests/test_analysis_engine.py).
+"""

@@ -1,0 +1,18 @@
+"""Coordinator: submits task bodies into the pool. One submit ships
+paths (legal); one ships a ColumnTable instance (planted HSL020)."""
+
+from procdemo.pool import TaskPool
+from procdemo.workers import shard_body
+
+
+class ColumnTable:
+    def __init__(self):
+        self.columns = {}
+
+
+def run_build(files, exchange_dir):
+    with TaskPool() as pool:
+        pool.submit(0, shard_body, [str(f) for f in files], str(exchange_dir))
+        table = ColumnTable()
+        pool.submit(1, shard_body, table)  # planted HSL020
+        return pool.join()

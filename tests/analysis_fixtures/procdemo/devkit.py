@@ -1,0 +1,10 @@
+"""Device kit: the module a worker must never pay at load. Imported at
+module level by `workers` (a spawn-domain host), so the jax import
+below is the planted HSL019 violation — the finding lands HERE, with
+the workers → devkit chain and the seeding entry point as witness."""
+
+import jax  # planted HSL019
+
+
+def device_sum(xs):
+    return jax.numpy.sum(jax.numpy.asarray(xs))

@@ -1,0 +1,63 @@
+"""Mini spawn plumbing: carriers, registry, and the worker-span
+vocabulary (the procpool + obs.trace analog)."""
+
+SPAWN_ENTRY_POINTS = {
+    "procdemo.pool.task_entry": ("task", "carrier with full continuity"),
+    "procdemo.pool.bare_entry": ("task", "carrier missing the fault plumbing"),
+    "procdemo.workers.shard_body": ("task_body", "p1-shard analog"),
+    "procdemo.service.worker_main": ("service_body", "fleet worker-main analog"),
+}
+
+KNOWN_WORKER_SPANS = ("demo.shard",)
+
+
+def install_state(state):
+    pass
+
+
+def merge_observed(points):
+    pass
+
+
+def adopt_root(root):
+    pass
+
+
+class _Noop:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def span(name):
+    return _Noop()
+
+
+class TaskPool:
+    def __init__(self):
+        self._pending = {}
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def submit(self, task_id, fn, *args):
+        self._pending[task_id] = (fn, args)
+
+    def join(self):
+        merge_observed(())
+        adopt_root(None)
+        return {}
+
+
+def task_entry(q, fn, args, env):
+    install_state(env.get("faults"))
+    q.put((0, fn(*args)))
+
+
+def bare_entry(q, fn, args, env):  # planted HSL022: faults never ship in
+    q.put((0, fn(*args)))

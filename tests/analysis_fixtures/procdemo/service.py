@@ -1,0 +1,10 @@
+"""Fleet worker-main analog: a service body boots the heavy engine at
+RUN time behind deferred imports — the clean counterpart of the HSL019
+pattern (module-load purity holds; the runtime jax use is the worker's
+whole job)."""
+
+
+def worker_main(ctx):
+    from procdemo import devkit  # deferred: a runtime edge, legal
+
+    return devkit.device_sum([1, 2, 3])

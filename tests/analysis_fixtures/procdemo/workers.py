@@ -1,0 +1,46 @@
+"""Task bodies: jax-free at module load — except the planted leak
+through `devkit`, which imports jax at module level and is imported
+HERE at module level (the chain HSL019 reports)."""
+
+import numpy as np
+
+from procdemo import devkit
+from procdemo.pool import span
+
+
+def shard_body(files, exchange_dir):
+    with span("demo.shard"):
+        out = {}
+        for i, f in enumerate(files):
+            out[str(i)] = _spill(str(f), exchange_dir)
+        return {"spills": out, "n": int(np.int64(len(files)))}
+
+
+def _spill(name, exchange_dir):
+    path = exchange_dir + "/spill-" + name
+    _publish_atomic(path, "data")
+    return path
+
+
+def _publish_atomic(path, data):
+    # Clean counterpart (HSL021): tmp + fsync + os.replace.
+    import os
+    import tempfile
+
+    fd, tmp = tempfile.mkstemp()
+    with os.fdopen(fd, "w") as h:
+        h.write(data)
+        h.flush()
+        os.fsync(h.fileno())
+    os.replace(tmp, path)
+
+
+def bad_manifest(exchange_dir, doc):
+    with open(exchange_dir + "/manifest.json", "w") as h:  # planted HSL021
+        h.write(doc)
+
+
+def sum_on_device(xs):
+    # Coordinator-side helper; the devkit use keeps the module-level
+    # import live (the leak is the IMPORT, not this call).
+    return devkit.device_sum(xs)

@@ -1,0 +1,28 @@
+"""HSL019 spawn-import purity corpus.
+
+The file declares its own SPAWN_ENTRY_POINTS (the registry is
+AST-extracted per scanned module, like ERROR_CONTRACTS), making this
+module a spawn-domain host: its module-level imports run in every
+spawned worker before the task body does. `import jax` at module level
+flags; the deferred function-level import is a runtime edge and stays
+legal (the idiom the heavy modules use).
+"""
+
+SPAWN_ENTRY_POINTS = {
+    "hsl019.worker_body": ("task_body", "corpus task body"),
+}
+
+import jax  # expect: HSL019
+import numpy as np  # clean: numpy is part of the worker vocabulary
+
+
+def worker_body(path):
+    return {"path": str(path), "n": int(np.int64(3).item())}
+
+
+def coordinator_only(xs):
+    # Deferred import: executes at CALL time in whichever process runs
+    # this (the coordinator) — not at worker module load. Legal.
+    import jax.numpy as jnp
+
+    return jnp.asarray(xs)

@@ -1,0 +1,37 @@
+"""HSL020 exchange-surface typing corpus.
+
+A mini TaskPool (boundary methods are recognized by class+method name,
+same as the real parallel/procpool.py) plus a ColumnTable stand-in: a
+list of paths crosses the submit boundary legally; a ColumnTable
+instance — typed through the same local-binding inference the call
+graph uses for receivers — is a planted violation.
+"""
+
+SPAWN_ENTRY_POINTS = {
+    "hsl020.task_entry": ("task_body", "corpus task body"),
+}
+
+
+class ColumnTable:
+    def __init__(self):
+        self.columns = {}
+
+
+class TaskPool:
+    def submit(self, task_id, fn, *args):
+        pass
+
+    def join(self):
+        return {}
+
+
+def task_entry(paths):
+    return {"n": len(paths)}
+
+
+def coordinator(files):
+    pool = TaskPool()
+    pool.submit(0, task_entry, [str(f) for f in files])  # clean: paths cross
+    table = ColumnTable()
+    pool.submit(1, task_entry, table)  # expect: HSL020
+    return pool.join()

@@ -1,0 +1,62 @@
+"""HSL021 shared-file protocol corpus.
+
+The module hosts a spawn task body, so it is domain-gated: writes under
+shared exchange/lease paths must publish atomically, and every O_EXCL
+lease claim must reach a TTL reaper. One bare write and one reap-less
+claim are planted next to their clean counterparts.
+"""
+
+import os
+import tempfile
+
+SPAWN_ENTRY_POINTS = {
+    "hsl021.publish_entry": ("task_body", "corpus task body"),
+}
+
+
+def publish_entry(exchange_dir, doc):
+    path = exchange_dir + "/entry.json"
+    with open(path, "w") as f:  # expect: HSL021
+        f.write(doc)
+    return path
+
+
+def publish_atomic(exchange_dir, doc):
+    # Clean counterpart: tmp + fsync + os.replace — a reader in another
+    # process sees a whole entry or no entry.
+    fd, tmp = tempfile.mkstemp(dir=exchange_dir)
+    with os.fdopen(fd, "w") as f:
+        f.write(doc)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, exchange_dir + "/entry.json")
+
+
+def acquire_no_reap(lease_path):
+    fd = os.open(lease_path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)  # expect: HSL021
+    os.close(fd)
+    return True
+
+
+class Lease:
+    """Clean counterpart: the FileExistsError path reaps by TTL."""
+
+    def __init__(self, path, ttl_s):
+        self.path = path
+        self.ttl_s = ttl_s
+
+    def acquire(self, now_s):
+        try:
+            fd = os.open(self.path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            self._reap(now_s)
+            return None
+        os.close(fd)
+        return "token"
+
+    def _reap(self, now_s):
+        age_s = now_s - 0.0
+        if age_s <= self.ttl_s:
+            return False
+        os.unlink(self.path)
+        return True

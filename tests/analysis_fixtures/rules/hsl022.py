@@ -1,0 +1,54 @@
+"""HSL022 cross-boundary continuity corpus.
+
+Two task carriers: the good one installs the shipped fault state and
+the module merges observations back (join); the bad one spawns workers
+that silently lose injected faults. The module declares its own
+KNOWN_WORKER_SPANS / KNOWN_COUNTERS registries, so an undeclared worker
+span name flags too.
+"""
+
+SPAWN_ENTRY_POINTS = {
+    "hsl022.good_entry": ("task", "corpus carrier with full continuity"),
+    "hsl022.bad_entry": ("task", "corpus carrier missing the fault plumbing"),
+}
+
+KNOWN_WORKER_SPANS = ("work.step",)
+KNOWN_COUNTERS = ("work.items",)
+
+
+def install_state(state):
+    pass
+
+
+def merge_observed(points):
+    pass
+
+
+def adopt_root(root):
+    pass
+
+
+def span(name):
+    pass
+
+
+def increment(name):
+    pass
+
+
+def good_entry(fn, env):
+    install_state(env)
+    with span("work.step"):
+        increment("work.items")
+        return fn()
+
+
+def bad_entry(fn, env):  # expect: HSL022
+    with span("work.stepz"):  # expect: HSL022
+        return fn()
+
+
+def join_side(results):
+    merge_observed(())
+    adopt_root(None)
+    return results

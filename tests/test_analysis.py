@@ -109,11 +109,13 @@ class TestLintBehaviors:
     def test_rules_registry_covers_all_ids(self):
         from hyperspace_tpu.analysis.lint import RULES
 
-        assert sorted(RULES) == [f"HSL{i:03d}" for i in range(19)]
+        assert sorted(RULES) == [f"HSL{i:03d}" for i in range(23)]
         assert RULES["HSL009"].scope == "program"
         assert RULES["HSL013"].scope == "program"
         assert RULES["HSL016"].scope == "program"
         assert RULES["HSL018"].scope == "program"
+        assert RULES["HSL019"].scope == "program"
+        assert RULES["HSL022"].scope == "program"
         assert RULES["HSL001"].scope == "file"
 
 

@@ -34,6 +34,12 @@ from hyperspace_tpu.analysis.lint import (
 )
 from hyperspace_tpu.analysis.effects import Effects
 from hyperspace_tpu.analysis.locks import LockGraph, resource_findings
+from hyperspace_tpu.analysis.procdomain import (
+    SPAWN_ENTRY_POINTS,
+    ProcessDomains,
+    declared_entry_points,
+    module_level_imports,
+)
 from hyperspace_tpu.analysis.program import Program, _index_module, _module_name
 from hyperspace_tpu.analysis.races import (
     RACE_ALLOWLIST,
@@ -195,6 +201,7 @@ def _corpus_findings(path: pathlib.Path) -> set[tuple[int, str]]:
     findings += error_contract_findings(program, raises_obj, contracts)
     findings += swallowed_findings(program, raises_obj)
     findings += unwind_findings(program, callgraph, raises_obj, contracts)[0]
+    findings += ProcessDomains(program, callgraph, raises_obj).findings()
     return {(f.line, f.rule) for f in findings}
 
 
@@ -359,6 +366,93 @@ class TestRaisedemo:
         points, path = known_fault_points(program)
         assert points == {"demo.persist", "demo.orphan"}
         assert path.endswith("raisedemo/faults.py")
+
+
+# -- procdemo fixture package (process domains + HSL019-022) ------------------
+
+@pytest.fixture(scope="module")
+def procdemo():
+    program = Program.load([FIXTURES / "procdemo"])
+    callgraph = CallGraph(program)
+    raises_obj = Raises(program, callgraph)
+    return program, callgraph, ProcessDomains(program, callgraph, raises_obj)
+
+
+class TestProcdemo:
+    def test_domain_graph_matches_golden(self, procdemo):
+        _, _, domains = procdemo
+        golden = json.loads((FIXTURES / "goldens" / "procdemo_domains.json").read_text())
+        assert json.loads(json.dumps(domains.to_json())) == golden
+
+    def test_exactly_four_planted_findings(self, procdemo):
+        _, _, domains = procdemo
+        rules = sorted(f.rule for f in domains.findings())
+        assert rules == ["HSL019", "HSL020", "HSL021", "HSL022"]
+
+    def test_hsl019_witness_names_entry_and_import_chain(self, procdemo):
+        _, _, domains = procdemo
+        (f,) = domains.spawn_import_findings()
+        assert f.path.endswith("devkit.py")  # the module whose import is banned
+        assert "procdemo.workers.shard_body" in f.message  # the seeding entry
+        assert "procdemo.workers imports procdemo.devkit" in f.message
+        # the witness chain carries BOTH files — --changed keeps the
+        # finding when either side of the chain is what was edited
+        assert any(p.endswith("workers.py") for p in f.witness_paths)
+        assert any(p.endswith("devkit.py") for p in f.witness_paths)
+
+    def test_hsl020_names_the_banned_type_and_site(self, procdemo):
+        _, _, domains = procdemo
+        (f,) = domains.exchange_typing_findings()
+        assert "ColumnTable instance" in f.message
+        assert "submit site" in f.message
+        assert f.path.endswith("coord.py")
+
+    def test_hsl020_path_list_submit_stays_clean(self, procdemo):
+        # Same pool, same body, paths instead of a table: no finding at
+        # the first submit line (the proof is not vacuous).
+        _, _, domains = procdemo
+        (f,) = domains.exchange_typing_findings()
+        first_submit = min(
+            s.line for s in domains.boundary_sites if s.kind == "submit"
+        )
+        assert f.line > first_submit
+
+    def test_hsl021_flags_bare_write_not_atomic_publish(self, procdemo):
+        _, _, domains = procdemo
+        (f,) = domains.shared_file_findings()
+        assert f.path.endswith("workers.py")
+        assert "bad_manifest" in f.message
+        # _publish_atomic (mkstemp + fsync + os.replace) stayed clean
+
+    def test_hsl022_flags_carrier_without_install_state(self, procdemo):
+        _, _, domains = procdemo
+        (f,) = domains.continuity_findings()
+        assert "bare_entry" in f.message
+        assert "install_state" in f.message
+
+    def test_service_body_deferred_engine_is_legal(self, procdemo):
+        # worker_main boots devkit (jax) behind a deferred import: the
+        # service module is in the domain, devkit is NOT pulled in
+        # through it, and no finding lands on service.py.
+        _, _, domains = procdemo
+        assert "procdemo.service" in domains.domain_modules
+        assert not any(
+            f.path.endswith("service.py") for f in domains.findings()
+        )
+
+    def test_task_closure_and_boundary_inventory(self, procdemo):
+        _, _, domains = procdemo
+        assert "procdemo.workers._publish_atomic" in domains.task_fns
+        chain = domains.task_fns["procdemo.workers._publish_atomic"]
+        assert chain[0] == "procdemo.workers.shard_body"
+        kinds = sorted(s.kind for s in domains.boundary_sites)
+        assert kinds == ["put", "put", "return", "submit", "submit"]
+        # both submits resolved their task-body target (declared ⇒ no
+        # undeclared-target finding rode along)
+        assert all(
+            s.target == "procdemo.workers.shard_body"
+            for s in domains.boundary_sites if s.kind == "submit"
+        )
 
 
 # -- repo-wide guarantees (what the CI gate asserts) --------------------------
@@ -631,6 +725,141 @@ class TestRepoExceptionFlow:
         assert elapsed < 60.0, f"analysis.check took {elapsed:.1f}s"
 
 
+# -- process-domain guarantees (HSL019-022 on the real repo) ------------------
+
+@pytest.fixture(scope="module")
+def repo_domains(repo_program, repo_raises):
+    program, callgraph = repo_program
+    return ProcessDomains(program, callgraph, repo_raises)
+
+
+class TestRepoProcessDomains:
+    def test_spawn_domain_is_jax_pure_at_module_level(self, repo_domains):
+        """The acceptance proof: every module a spawned worker imports
+        at start — build_exchange, procpool, the fleet worker shim, the
+        bench fleet mains, and their whole module-level import closure
+        (package __init__s included) — is jax-free at module load. The
+        runtime mirror (tests/test_procpool.py) asserts the same fact
+        inside a real spawned interpreter."""
+        assert repo_domains.spawn_import_findings() == []
+        for m in (
+            "hyperspace_tpu.execution.build_exchange",
+            "hyperspace_tpu.parallel.procpool",
+            "hyperspace_tpu.parallel",  # the package __init__ that leaked jax
+            "hyperspace_tpu.serve.fleet.supervisor",
+            "hyperspace_tpu.execution.io",
+            "hyperspace_tpu.ops.sortkeys",
+            "benchmarks.bench_serve",
+        ):
+            assert m in repo_domains.domain_modules, m
+
+    def test_registry_entries_are_live_and_kinded(self, repo_domains):
+        for q, (kind, why) in SPAWN_ENTRY_POINTS.items():
+            assert kind in ("task", "task_body", "service", "service_body"), q
+            assert why, q
+        assert set(repo_domains.live_entries) == set(SPAWN_ENTRY_POINTS)
+
+    def test_task_closure_covers_the_worker_bodies(self, repo_domains):
+        # p2 reads spill through io.read_parquet and sorts through the
+        # deferred sortkeys import — the closure must see both.
+        fns = repo_domains.task_fns
+        assert "hyperspace_tpu.execution.build_exchange.p2_owner" in fns
+        assert "hyperspace_tpu.execution.io.read_parquet" in fns
+        assert "hyperspace_tpu.execution.build_exchange.host_sort_perm" in fns
+        # and it must NOT leak into the device build plane (the
+        # write_table fallback misresolution this PR blocklisted).
+        assert not any(q.startswith("hyperspace_tpu.ops.bucketize") for q in fns)
+        assert not any(q.startswith("hyperspace_tpu.parallel.mesh") for q in fns)
+
+    def test_every_spawn_target_is_declared(self, repo_domains):
+        # Both directions of the registry contract (the HSL012 shape):
+        # every statically detected spawn target resolves to a declared
+        # entry; zero continuity findings on the tree.
+        targets = {
+            s.target for s in repo_domains.boundary_sites
+            if s.kind in ("submit", "spawn", "fleet_target", "mp_process")
+            and s.target is not None
+        }
+        assert "hyperspace_tpu.execution.build_exchange.p1_shard" in targets
+        assert "hyperspace_tpu.execution.build_exchange.p2_owner" in targets
+        assert "hyperspace_tpu.parallel.procpool._task_entry" in targets
+        assert "hyperspace_tpu.serve.fleet.supervisor._worker_entry" in targets
+        assert targets <= set(SPAWN_ENTRY_POINTS)
+        assert repo_domains.continuity_findings() == []
+
+    def test_exchange_surface_is_clean_and_sites_found(self, repo_domains):
+        assert repo_domains.exchange_typing_findings() == []
+        kinds = {s.kind for s in repo_domains.boundary_sites}
+        # submit (builder), spawn (procpool/supervisor), fleet target
+        # (bench), worker put (procpool), task-body returns (p1/p2).
+        assert {"submit", "spawn", "fleet_target", "put", "return"} <= kinds
+
+    def test_every_lease_acquire_has_a_reap_proof(self, repo_domains):
+        assert repo_domains.shared_file_findings() == []
+        acquires = repo_domains.lease_acquires
+        assert acquires, "the lease O_EXCL sites must be inventoried"
+        for a in acquires:
+            assert a["reap_via"], a
+        fns = {a["fn"] for a in acquires}
+        assert "hyperspace_tpu.serve.fleet.lease.FileLease.try_acquire" in fns
+        assert "hyperspace_tpu.utils.file_utils._locked_rename" in fns
+
+    def test_worker_span_vocabulary_is_declared_and_fresh(self, repo_program, repo_domains):
+        """KNOWN_WORKER_SPANS covers exactly what the task domain can
+        emit — an undeclared name is a finding (checked above); a
+        declared name nothing emits is a stale registry entry."""
+        import ast as _ast
+
+        from hyperspace_tpu.obs.trace import KNOWN_WORKER_SPANS
+
+        program, _ = repo_program
+        emitted = set()
+        for q in repo_domains.task_fns:
+            fn = program.functions.get(q)
+            if fn is None:
+                continue
+            for node in _ast.walk(fn.node):
+                if (
+                    isinstance(node, _ast.Call) and node.args
+                    and isinstance(node.args[0], _ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                ):
+                    attr = getattr(node.func, "attr", getattr(node.func, "id", ""))
+                    if attr in ("span", "trace"):
+                        emitted.add(node.args[0].value)
+        assert emitted == set(KNOWN_WORKER_SPANS)
+
+    def test_module_level_imports_skip_deferred_and_type_checking(self):
+        src = (
+            "import os\n"
+            "try:\n"
+            "    import fast_json\n"
+            "except ImportError:\n"
+            "    import json as fast_json\n"
+            "from typing import TYPE_CHECKING\n"
+            "if TYPE_CHECKING:\n"
+            "    import jax\n"
+            "def f():\n"
+            "    import jax.numpy as jnp\n"
+            "    return jnp\n"
+        )
+        mod = _index_module("m", "m.py", src, ast.parse(src))
+        targets = {t for t, _ in module_level_imports(mod)}
+        assert "os" in targets and "fast_json" in targets and "json" in targets
+        assert not any(t.startswith("jax") for t in targets)
+
+    def test_declared_entry_points_extraction(self):
+        src = (
+            'SPAWN_ENTRY_POINTS = {\n'
+            '    "m.body": ("task_body", "why"),\n'
+            '    "m.shim": "service",\n'
+            '}\n'
+        )
+        program = Program({"m": _index_module("m", "m.py", src, ast.parse(src))})
+        got = declared_entry_points(program)
+        assert got == {"m.body": ("task_body", "why"), "m.shim": ("service", "")}
+
+
 # -- check CLI ----------------------------------------------------------------
 
 def _validate_sarif_required(sarif: dict) -> None:
@@ -759,8 +988,10 @@ class TestCheckCli:
         sarif = json.loads(out.read_text())
         _validate_sarif_required(sarif)
         fired = {r["ruleId"] for r in sarif["runs"][0]["results"]}
-        # old rules and the exception-flow rules both appear
-        assert {"HSL001", "HSL011", "HSL013", "HSL016", "HSL017", "HSL018"} <= fired
+        # old rules, the exception-flow rules, and the process-domain
+        # rules all appear
+        assert {"HSL001", "HSL011", "HSL013", "HSL016", "HSL017", "HSL018",
+                "HSL019", "HSL020", "HSL021", "HSL022"} <= fired
 
     def test_sarif_required_properties_on_clean_run(self, tmp_path):
         clean = tmp_path / "clean.py"
@@ -816,6 +1047,42 @@ class TestCheckCli:
         # nothing changed -> clean exit even with the bad file on disk
         monkeypatch.setattr(check_mod, "changed_files", lambda root: ("origin/main", set()))
         assert check_mod.main([str(bad), "--no-baseline", "--changed"]) == EXIT_CLEAN
+
+    def test_changed_mode_keeps_findings_whose_witness_changed(self, tmp_path, monkeypatch):
+        """The --changed blind-spot fix: a finding whose PRIMARY file is
+        unchanged but whose witness chain crosses a changed file must
+        still be reported — editing host.py (the spawn-domain module)
+        is what creates the HSL019 finding reported at impure.py."""
+        import hyperspace_tpu.analysis.check as check_mod
+
+        host = tmp_path / "host.py"
+        host.write_text(
+            'SPAWN_ENTRY_POINTS = {"host.body": ("task_body", "x")}\n'
+            "import impure\n"
+            "def body():\n"
+            "    return impure.K\n"
+        )
+        impure = tmp_path / "impure.py"
+        impure.write_text("import jax\nK = 1\n")
+        monkeypatch.setattr(check_mod, "_repo_root", lambda: tmp_path)
+        # only host.py "changed": the HSL019 finding (primary: impure.py)
+        # must survive through its witness chain
+        monkeypatch.setattr(
+            check_mod, "changed_files", lambda root: ("origin/main", {"host.py"})
+        )
+        out = tmp_path / "report.json"
+        rc = check_mod.main([str(host), str(impure), "--no-baseline", "--changed",
+                             "--format", "json", "--output", str(out)])
+        assert rc == EXIT_FINDINGS
+        report = json.loads(out.read_text())
+        assert [f["rule"] for f in report["findings"]] == ["HSL019"]
+        assert report["findings"][0]["path"].endswith("impure.py")
+        # an unrelated change set still drops it
+        monkeypatch.setattr(
+            check_mod, "changed_files", lambda root: ("origin/main", {"elsewhere.py"})
+        )
+        assert check_mod.main([str(host), str(impure), "--no-baseline",
+                               "--changed"]) == EXIT_CLEAN
 
     def test_changed_mode_falls_back_without_git(self, tmp_path, monkeypatch):
         import hyperspace_tpu.analysis.check as check_mod

@@ -578,6 +578,50 @@ def _crasher(ctx, marker_dir):
     raise SystemExit(3)
 
 
+def _fault_probe(ctx, marker_dir):
+    """Child: report whether the coordinator's registered fault rule
+    fired INSIDE this spawned fleet worker (fresh module state — the
+    rule can only be here if the supervisor shipped it)."""
+    from pathlib import Path
+
+    from hyperspace_tpu import faults
+
+    try:
+        faults.fault_point("fleet.lease.acquire", "probe")
+        out = "no-fault"
+    except faults.FaultError:
+        out = "fault-fired"
+    Path(marker_dir, f"{ctx.worker_id}.txt").write_text(out)
+
+
+class TestSupervisorFaultContinuity:
+    def test_fault_rules_ship_into_fleet_workers(self, tmp_path):
+        """The HSL022 contract at runtime (the fleet half of procpool's
+        cross-process injection test): a rule registered in the
+        coordinator fires inside a spawned fleet worker because
+        FleetSupervisor ships faults.export_state() through the worker
+        shim."""
+        from hyperspace_tpu import faults
+
+        marker = tmp_path / "probe"
+        marker.mkdir()
+        faults.inject("fleet.lease.acquire", times=1)
+        try:
+            sup = fleet.FleetSupervisor(
+                _fault_probe, fleet_dir=str(tmp_path / "fleet"), n=1,
+                args=(str(marker),), max_restarts=0,
+            )
+            with sup:
+                sup.start()
+                deadline = time.monotonic() + 60
+                out = marker / "0.txt"
+                while not out.exists() and time.monotonic() < deadline:
+                    time.sleep(0.05)
+        finally:
+            faults.reset()
+        assert out.read_text() == "fault-fired"
+
+
 # -- obs/http port=0 satellite ------------------------------------------------
 
 class TestEphemeralHealthPort:

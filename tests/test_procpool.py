@@ -45,6 +45,20 @@ def _idle_until_stopped(stop_seconds):
     time.sleep(stop_seconds)
 
 
+def _import_census():
+    """Runs INSIDE a spawned worker: report which heavyweight modules
+    the fresh interpreter paid for before the task body ran."""
+    import sys
+
+    return {
+        "jax": "jax" in sys.modules,
+        "jaxlib": "jaxlib" in sys.modules,
+        "hyperspace": sorted(
+            m for m in sys.modules if m.startswith("hyperspace_tpu")
+        ),
+    }
+
+
 @pytest.fixture(autouse=True)
 def _clean_faults():
     faults.reset()
@@ -132,6 +146,29 @@ def test_fault_rules_ship_into_workers_and_observed_merge_back():
             pool.submit("w", _hit_point)
             assert pool.join() == {"w": "ok"}
     assert "build.exchange.write" in seen
+
+
+def test_worker_never_imports_jax_at_start():
+    """The runtime mirror of static rule HSL019 (spawn-import purity):
+    a spawned TaskPool worker — which imports procpool and the task
+    body's module (this file) to unpickle its entry — must reach the
+    task body with jax NOT in sys.modules. The static proof says the
+    module-level import closure of every spawn-domain module is
+    jax-free; this asserts the same fact in a real spawned interpreter,
+    shipped back through the result envelope."""
+    with TaskPool("hs-test") as pool:
+        pool.submit("census", _import_census)
+        results = pool.join()
+    census = results["census"]
+    assert census["jax"] is False, (
+        "spawned worker paid the jax import before the task ran: "
+        f"{census['hyperspace']}"
+    )
+    assert census["jaxlib"] is False
+    # and the worker DID import the spawn plumbing (the census is not
+    # vacuous — procpool and its jax-free deps are present).
+    assert "hyperspace_tpu.parallel.procpool" in census["hyperspace"]
+    assert "hyperspace_tpu.faults" in census["hyperspace"]
 
 
 def test_process_host_stop_terminates_stragglers():
