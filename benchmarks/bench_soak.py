@@ -1,0 +1,474 @@
+"""Chaos soak harness: prove the ops controller heals the system.
+
+The gate of docs/fault_tolerance.md "self-driving operations": under a
+deterministic fault schedule plus an overload burst, **SLOs recover
+without a human** — no unbounded burn, no permanent quarantine, zero
+untyped errors, bounded time-to-recover per fault episode — and the
+identical schedule with `hyperspace.controller.enabled=false` shows the
+degraded counterfactual (the quarantine REMAINS), proving the
+controller, not luck, did the healing.
+
+Mixed query + refresh traffic flows through a real QueryServer over a
+real indexed store for the whole run while four fault episodes fire in
+sequence:
+
+1. **transient_io** — `faults.inject("bucket.read")` makes every data
+   read fail (after the retry layer gives up): availability burns, the
+   SLO pages, the controller sheds load + tightens quotas; the fault
+   clears and the burn must age back below the page threshold with the
+   overrides released.
+2. **corruption_quarantine** — a live index bucket file is corrupted on
+   disk: the next indexed query raises IndexCorruptionError, the index
+   is quarantined (queries keep answering via fallback), and the
+   controller must heal it — `recover()` + full rebuild through the
+   crash-safe Action protocol — leaving `session.index_health` empty.
+3. **overload_burst** — submit bursts far past capacity with tight
+   deadlines: queued queries expire (serve.timeouts), availability
+   burns, the controller tightens the shed threshold; every refusal
+   must be TYPED (AdmissionRejected/QuotaExceeded/QueryTimeout), the
+   p99 of completed queries stays bounded, and the burn recovers when
+   the burst ends.
+4. **worker_sigkill** — a real fleet member is SIGKILLed: the
+   supervisor must respawn it (WARN `fleet.worker.restarted`) within
+   the bound — the crash-loop backoff satellite keeps repeat crashes
+   from burning the restart budget in milliseconds.
+
+Determinism: the controller and the SLO tracker run on a VIRTUAL clock
+advanced a fixed 5 s per tick (burn windows are clamped spans over the
+sample ring, so compressed time keeps the multi-window math exact while
+a CI run finishes in ~a minute); fault injection counts calls, never
+wall time. Real wall time only enters through measured query latencies
+(the latency histogram) and the SIGKILL episode's respawn bound.
+
+Writes BENCH_SOAK.json. `--smoke` is the CI-scaled run (the `soak`
+job); gates are ALWAYS enforced — exit 1 on any failure.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+STEP_V = 5.0  # virtual seconds per tick (the controller/SLO clock)
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+class VirtualClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def advance(self, dt: float = STEP_V) -> float:
+        self.t += dt
+        return self.t
+
+
+def _gen_data(root: Path, rows: int, files: int) -> None:
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(11)
+    per = rows // files
+    root.mkdir(parents=True, exist_ok=True)
+    for f in range(files):
+        t = pa.table(
+            {
+                "id": pa.array(np.arange(f * per, (f + 1) * per, dtype=np.int64)),
+                "key": pa.array(rng.integers(0, 16, per, dtype=np.int64)),
+                "value": pa.array(rng.standard_normal(per)),
+            }
+        )
+        pq.write_table(t, root / f"part-{f}.parquet")
+
+
+class SoakBench:
+    """One soak run: fleet-of-one serving stack + controller + schedule."""
+
+    INDEX = "soak_idx"
+
+    def __init__(self, tmp: Path, smoke: bool):
+        self.tmp = tmp
+        self.smoke = smoke
+        self.rows = 8_000 if smoke else 32_000
+        self.clock = VirtualClock()
+        self.errors_typed: dict[str, int] = {}
+        self.errors_untyped: dict[str, int] = {}
+        self.completed_lat: list[float] = []
+        self.queries = 0
+        self._key = 0
+
+    # -- setup ------------------------------------------------------------
+    def build(self):
+        from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig
+        from hyperspace_tpu.serve.fleet.quota import TenantQuotas
+
+        self.data = self.tmp / "data"
+        _gen_data(self.data, self.rows, 2)
+        self.session = HyperspaceSession(system_path=str(self.tmp / "indexes"))
+        conf = self.session.conf
+        # Compressed-time control loop: cooldowns/windows are VIRTUAL.
+        conf.set("hyperspace.controller.enabled", "true")
+        conf.set("hyperspace.controller.cooldownSeconds", 20.0)
+        conf.set("hyperspace.obs.events.maxEvents", 4096)
+        self.hs = Hyperspace(self.session)
+        df = self.session.parquet(self.data)
+        self.hs.create_index(df, IndexConfig(self.INDEX, ["key"], ["value", "id"]))
+        self.session.enable_hyperspace()
+        self.df = df
+        self.server = self.session.serve(
+            workers=4,
+            max_queue_depth=64,
+            quotas=TenantQuotas(rate=10_000.0, burst=10_000.0),
+        )
+        self.ctrl = self.hs.controller(server=self.server, clock=lambda: self.clock.t)
+        # warm compile + plan caches so episode latencies are steady-state
+        self.run_batch(8)
+        self.tick(batch=8)
+
+    def shutdown(self):
+        self.server.shutdown()
+
+    # -- traffic ----------------------------------------------------------
+    def _plan(self):
+        from hyperspace_tpu import col
+
+        self._key = (self._key + 1) % 16
+        return self.df.filter(col("key") == self._key).select("id", "key", "value")
+
+    def run_batch(self, n: int, timeout: float | None = None, tenant: bool = True):
+        """Submit n point lookups and wait for each; every error must be
+        typed (the zero-untyped-errors gate folds from here)."""
+        from hyperspace_tpu.exceptions import HyperspaceError
+
+        handles = []
+        for i in range(n):
+            self.queries += 1
+            try:
+                handles.append(
+                    self.server.submit(
+                        self._plan(),
+                        tenant=f"t{i % 4}" if tenant else None,
+                        timeout=timeout,
+                    )
+                )
+            except BaseException as e:  # noqa: HSL017 — harness accounting:
+                # every refusal is recorded by type and judged by the
+                # zero-untyped gate below; nothing is swallowed silently.
+                self._record_error(e, HyperspaceError)
+        for h in handles:
+            t0 = time.perf_counter()
+            try:
+                h.result(timeout=60.0)
+                self.completed_lat.append(time.perf_counter() - t0)
+            except BaseException as e:  # noqa: HSL017 — same accounting
+                self._record_error(e, HyperspaceError)
+
+    def _record_error(self, e: BaseException, HyperspaceError) -> None:
+        name = type(e).__name__
+        if isinstance(e, (HyperspaceError, OSError)):
+            self.errors_typed[name] = self.errors_typed.get(name, 0) + 1
+        else:
+            self.errors_untyped[name] = self.errors_untyped.get(name, 0) + 1
+
+    def tick(self, batch: int = 12, timeout: float | None = None) -> dict:
+        """One soak tick: a traffic batch, one virtual-time step, one
+        controller reconciliation pass."""
+        self.run_batch(batch, timeout=timeout)
+        self.ctrl.step(now=self.clock.advance())
+        return self.ctrl.snapshot()
+
+    def refresh_traffic(self):
+        """The 'mixed refresh traffic' leg: append rows, full-refresh the
+        index through the normal crash-safe action."""
+        import numpy as np
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        n = 512
+        rng = np.random.default_rng(int(self.clock.t) + 1)
+        pq.write_table(
+            pa.table({
+                "id": pa.array(np.arange(self.rows, self.rows + n, dtype=np.int64)),
+                "key": pa.array(rng.integers(0, 16, n, dtype=np.int64)),
+                "value": pa.array(rng.standard_normal(n)),
+            }),
+            self.data / f"append-{int(self.clock.t)}.parquet",
+        )
+        self.rows += n
+        self.hs.refresh_index(self.INDEX, "full")
+
+    # -- verdict helpers --------------------------------------------------
+    def paging(self, snap: dict) -> bool:
+        return any(v == "page" for v in snap["verdicts"].values())
+
+    def drive_until(self, pred, max_ticks: int, batch: int = 12) -> tuple[bool, int]:
+        for i in range(max_ticks):
+            snap = self.tick(batch=batch)
+            if pred(snap):
+                return True, i + 1
+        return False, max_ticks
+
+    def quarantined(self) -> list[str]:
+        with self.session._state_lock:
+            return sorted(self.session.index_health)
+
+    # -- episodes ---------------------------------------------------------
+    def episode_transient_io(self) -> dict:
+        from hyperspace_tpu import faults
+        from hyperspace_tpu.execution import io as hio
+
+        t_start = self.clock.t
+        faults.inject("bucket.read")  # transient FaultError on every read
+        # The warm decoded-table/footer caches would serve every bucket
+        # without touching the disk — drop them so the injected IO fault
+        # reaches the read path (exactly what a real cache eviction or
+        # process restart does mid-incident).
+        hio.clear_table_cache()
+        hio.clear_footer_cache()
+        paged = False
+        try:
+            for _ in range(6):
+                snap = self.tick()
+                paged = paged or self.paging(snap)
+                if snap["engaged"]:
+                    break
+        finally:
+            faults.reset()
+        engaged = self.ctrl.snapshot()["engaged"]
+        recovered, ticks = self.drive_until(
+            lambda s: not self.paging(s) and not s["engaged"], max_ticks=40
+        )
+        return {
+            "name": "transient_io",
+            "paged": paged,
+            "controller_engaged": engaged,
+            "recovered": recovered,
+            "time_to_recover_vs": round(self.clock.t - t_start, 1),
+        }
+
+    def episode_corruption_quarantine(self, expect_heal: bool) -> dict:
+        t_start = self.clock.t
+        index_root = Path(
+            self.session.manager.path_resolver.get_index_path(self.INDEX)
+        )
+        versions = sorted(
+            (d for d in index_root.glob("v__=*") if d.is_dir()),
+            key=lambda d: int(d.name.split("=")[1]),
+        )
+        bucket = sorted(versions[-1].glob("*.parquet"))[0]
+        with open(bucket, "r+b") as f:
+            f.write(b"\x00GARBAGE\x00" * 4)
+            f.truncate(128)
+        # drive traffic until the corruption is hit and (controller on)
+        # healed — index_health must drain back to empty without a human
+        recovered, ticks = self.drive_until(
+            lambda s: not self.quarantined() and not self.paging(s),
+            max_ticks=20 if expect_heal else 8,
+        )
+        heals = [
+            e for e in self._controller_events("controller.actuation")
+            if e["fields"]["action"].startswith("heal.")
+            and e["fields"]["outcome"] == "executed"
+        ]
+        return {
+            "name": "corruption_quarantine",
+            "recovered": recovered if expect_heal else not recovered,
+            "quarantine_remains": bool(self.quarantined()),
+            "heal_actuations": len(heals),
+            "time_to_recover_vs": round(self.clock.t - t_start, 1),
+        }
+
+    def episode_overload_burst(self) -> dict:
+        t_start = self.clock.t
+        burst = 150 if self.smoke else 300
+        paged = False
+        shed_before = self.server.get_shed_depth()
+        min_shed = shed_before
+        for _ in range(4):
+            snap = self.tick(batch=burst, timeout=0.03)
+            paged = paged or self.paging(snap)
+            min_shed = min(min_shed, self.server.get_shed_depth())
+        recovered, ticks = self.drive_until(
+            lambda s: not self.paging(s) and not s["engaged"], max_ticks=40
+        )
+        import numpy as np
+
+        lat = np.sort(np.asarray(self.completed_lat))
+        p99 = float(np.percentile(lat, 99)) if len(lat) else 0.0
+        return {
+            "name": "overload_burst",
+            "paged": paged,
+            "shed_tightened_to": min_shed,
+            "shed_restored_to": self.server.get_shed_depth(),
+            "completed_p99_s": round(p99, 4),
+            "p99_bounded": p99 < 5.0,
+            "recovered": recovered,
+            "time_to_recover_vs": round(self.clock.t - t_start, 1),
+        }
+
+    def episode_worker_sigkill(self) -> dict:
+        import os
+        import signal
+
+        from hyperspace_tpu.serve.fleet.supervisor import FleetSupervisor
+
+        t0 = time.monotonic()
+        sup = FleetSupervisor(
+            _soak_fleet_worker, fleet_dir=str(self.tmp / "fleet"), n=2,
+            max_restarts=3,
+        )
+        sup.start()
+        try:
+            deadline = time.monotonic() + 120
+            while sup.alive_count() < 2 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            victim = sup.pids()[0]
+            os.kill(victim, signal.SIGKILL)
+            t_kill = time.monotonic()
+            recovered = False
+            while time.monotonic() < t_kill + 90:
+                if sup.alive_count() == 2 and sup.pids()[0] != victim:
+                    recovered = True
+                    break
+                time.sleep(0.05)
+            ttr = time.monotonic() - t_kill
+        finally:
+            sup.stop(timeout=30)
+        from hyperspace_tpu.obs import events
+
+        restarted = [
+            e for e in events.recent() if e["name"] == "fleet.worker.restarted"
+        ]
+        return {
+            "name": "worker_sigkill",
+            "recovered": recovered,
+            "restart_events": len(restarted),
+            "time_to_recover_s": round(ttr, 2),
+            "setup_s": round(time.monotonic() - t0, 2),
+        }
+
+    def _controller_events(self, name: str) -> list[dict]:
+        from hyperspace_tpu.obs import events
+
+        return [e for e in events.recent() if e["name"] == name]
+
+
+def _soak_fleet_worker(ctx):
+    """Dummy fleet member: hold the slot until told to stop (the SIGKILL
+    target — jax-free, so respawn cost is pure process spawn)."""
+    while not ctx.stop_event.is_set():
+        time.sleep(0.05)
+
+
+def main(argv) -> int:
+    smoke = "--smoke" in argv
+    out = Path("BENCH_SOAK.json")
+    for a in argv:
+        if a.startswith("--out="):
+            out = Path(a.split("=", 1)[1])
+    t0 = time.perf_counter()
+    tmp = Path(tempfile.mkdtemp(prefix="hs-soak-"))
+    doc: dict = {
+        "bench": "soak",
+        "smoke": smoke,
+        "step_virtual_s": STEP_V,
+        "episodes": [],
+    }
+    try:
+        log(f"[soak] setup (rows per phase: {8_000 if smoke else 32_000})")
+        bench = SoakBench(tmp, smoke)
+        bench.build()
+        try:
+            log("[soak] episode 1/4: transient_io")
+            doc["episodes"].append(bench.episode_transient_io())
+            bench.refresh_traffic()  # mixed refresh traffic between episodes
+            log("[soak] episode 2/4: corruption_quarantine")
+            doc["episodes"].append(bench.episode_corruption_quarantine(expect_heal=True))
+            log("[soak] episode 3/4: overload_burst")
+            doc["episodes"].append(bench.episode_overload_burst())
+            log("[soak] episode 4/4: worker_sigkill")
+            doc["episodes"].append(bench.episode_worker_sigkill())
+            actuations = bench._controller_events("controller.actuation")
+            doc["controlled"] = {
+                "queries": bench.queries,
+                "errors_typed": bench.errors_typed,
+                "errors_untyped": bench.errors_untyped,
+                "quarantined_at_end": bench.quarantined(),
+                "controller": bench.ctrl.snapshot(),
+                "audit_executed_actions": sorted(
+                    {
+                        e["fields"]["action"]
+                        for e in actuations
+                        if e["fields"]["outcome"] == "executed"
+                    }
+                ),
+            }
+        finally:
+            bench.shutdown()
+
+        # -- counterfactual: the IDENTICAL corruption with the controller
+        # disabled must leave the quarantine in place — the controller,
+        # not luck, does the healing.
+        log("[soak] counterfactual: corruption with controller disabled")
+        from hyperspace_tpu.obs import events, slo
+
+        slo.reset()
+        events.reset()
+        cf_tmp = tmp / "cf"
+        cf = SoakBench(cf_tmp, smoke=True)
+        cf.build()
+        try:
+            cf.session.conf.set("hyperspace.controller.enabled", "false")
+            cf_episode = cf.episode_corruption_quarantine(expect_heal=False)
+            doc["counterfactual"] = {
+                **cf_episode,
+                "errors_untyped": cf.errors_untyped,
+                "controller_mode": cf.ctrl.snapshot()["mode"],
+            }
+        finally:
+            cf.shutdown()
+
+        # -- hard gates (ALWAYS enforced) ---------------------------------
+        by_name = {e["name"]: e for e in doc["episodes"]}
+        gates = {
+            "every_episode_recovered": all(
+                e["recovered"] for e in doc["episodes"]
+            ),
+            "transient_io_paged_and_controller_engaged": (
+                by_name["transient_io"]["paged"]
+                and by_name["transient_io"]["controller_engaged"]
+            ),
+            "no_permanent_quarantine": not doc["controlled"]["quarantined_at_end"],
+            "heal_actuated": by_name["corruption_quarantine"]["heal_actuations"] >= 1,
+            "overload_p99_bounded": by_name["overload_burst"]["p99_bounded"],
+            "zero_untyped_errors": not doc["controlled"]["errors_untyped"],
+            "sigkill_respawned": by_name["worker_sigkill"]["recovered"],
+            "counterfactual_quarantine_remains": doc["counterfactual"][
+                "quarantine_remains"
+            ],
+            "counterfactual_zero_untyped": not doc["counterfactual"][
+                "errors_untyped"
+            ],
+        }
+        doc["gates"] = gates
+        doc["elapsed_s"] = round(time.perf_counter() - t0, 1)
+        out.write_text(json.dumps(doc, indent=2, default=str) + "\n")
+        log(f"[soak] wrote {out} in {doc['elapsed_s']}s")
+        for k, ok in gates.items():
+            log(f"[soak]   gate {k}: {'PASS' if ok else 'FAIL'}")
+        return 0 if all(gates.values()) else 1
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

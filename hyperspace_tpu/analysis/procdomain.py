@@ -132,6 +132,8 @@ SPAWN_ENTRY_POINTS = {
         "service_body", "bench fleet member: session + QueryServer behind deferred imports"),
     "benchmarks.bench_serve._bench_lease_holder": (
         "service_body", "bench single-flight holder killed mid-build by the takeover regime"),
+    "benchmarks.bench_soak._soak_fleet_worker": (
+        "service_body", "soak fleet member: jax-free slot holder SIGKILLed by the respawn episode"),
 }
 
 # Module-level imports that may never be reachable at worker start:

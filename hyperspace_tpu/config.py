@@ -162,6 +162,32 @@ FLEET_LEASE_SECONDS = "hyperspace.fleet.lease.seconds"
 FLEET_SINGLEFLIGHT_WAIT_SECONDS = "hyperspace.fleet.singleflight.waitSeconds"
 FLEET_WORKERS = "hyperspace.fleet.workers"
 FLEET_MAX_RESTARTS = "hyperspace.fleet.maxRestarts"
+FLEET_RESTART_BACKOFF_SECONDS = "hyperspace.fleet.restartBackoffSeconds"
+# Self-driving operations controller (serve/controller.py,
+# docs/fault_tolerance.md "self-driving operations"): a reconciliation
+# loop consuming SLO burn verdicts + the structured event ring and
+# actuating ONLY through the existing crash-safe protocols — shed
+# load / tighten tenant quotas while serve SLOs page, heal quarantined
+# indexes via recover() + rebuild, trigger an advisor sweep when
+# routing demotions cluster, and back off background work while SLOs
+# burn. Kill switch `hyperspace.controller.enabled` defaults OFF: the
+# controller observes nothing and touches nothing unless an operator
+# opts in. hysteresisTicks/recoveryTicks + cooldownSeconds prevent
+# actuation flapping across verdict flicker; actuationBudget bounds
+# total mutations per controller lifetime (exhaustion degrades to
+# observe-only + ERROR event, releases stay free so the system is
+# always left as found).
+CONTROLLER_ENABLED = "hyperspace.controller.enabled"
+CONTROLLER_INTERVAL_SECONDS = "hyperspace.controller.intervalSeconds"
+CONTROLLER_COOLDOWN_SECONDS = "hyperspace.controller.cooldownSeconds"
+CONTROLLER_HYSTERESIS_TICKS = "hyperspace.controller.hysteresisTicks"
+CONTROLLER_RECOVERY_TICKS = "hyperspace.controller.recoveryTicks"
+CONTROLLER_ACTUATION_BUDGET = "hyperspace.controller.actuationBudget"
+CONTROLLER_SHED_RATIO = "hyperspace.controller.shedRatio"
+CONTROLLER_QUOTA_FACTOR = "hyperspace.controller.quotaFactor"
+CONTROLLER_HEAL_REBUILD = "hyperspace.controller.heal.rebuild"
+CONTROLLER_DEMOTION_CLUSTER_SIZE = "hyperspace.controller.demotionClusterSize"
+CONTROLLER_DEMOTION_WINDOW_SECONDS = "hyperspace.controller.demotionWindowSeconds"
 RETRY_MAX_ATTEMPTS = "hyperspace.retry.maxAttempts"
 RETRY_BACKOFF_BASE = "hyperspace.retry.backoffBaseSeconds"
 RETRY_CAS_ATTEMPTS = "hyperspace.retry.casAttempts"
@@ -231,6 +257,16 @@ DEFAULT_FLEET_LEASE_SECONDS = 10.0
 DEFAULT_FLEET_SINGLEFLIGHT_WAIT_SECONDS = 15.0
 DEFAULT_FLEET_WORKERS = 2
 DEFAULT_FLEET_MAX_RESTARTS = 3
+DEFAULT_FLEET_RESTART_BACKOFF_SECONDS = 0.5
+DEFAULT_CONTROLLER_INTERVAL_SECONDS = 1.0
+DEFAULT_CONTROLLER_COOLDOWN_SECONDS = 30.0
+DEFAULT_CONTROLLER_HYSTERESIS_TICKS = 2
+DEFAULT_CONTROLLER_RECOVERY_TICKS = 2
+DEFAULT_CONTROLLER_ACTUATION_BUDGET = 32
+DEFAULT_CONTROLLER_SHED_RATIO = 0.5
+DEFAULT_CONTROLLER_QUOTA_FACTOR = 0.5
+DEFAULT_CONTROLLER_DEMOTION_CLUSTER_SIZE = 3
+DEFAULT_CONTROLLER_DEMOTION_WINDOW_SECONDS = 300.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -526,6 +562,75 @@ KNOWN_KEYS: dict[str, ConfKey] = {
         "3",
         "How many times the supervisor respawns a crashed worker before "
         "leaving its slot down (counted in `fleet.supervisor.restarts`)."),
+    FLEET_RESTART_BACKOFF_SECONDS: ConfKey(
+        "0.5",
+        "Base of the exponential backoff between restarts of the SAME fleet "
+        "member (delay = base x 2^(restarts-1), deterministic jitter, capped): "
+        "a crash-looping worker cannot burn its whole "
+        "`hyperspace.fleet.maxRestarts` budget in milliseconds. The first "
+        "respawn is immediate; when backoff engages a WARN "
+        "`fleet.worker.crash_loop` event names the member."),
+    CONTROLLER_ENABLED: ConfKey(
+        "false",
+        "Kill switch of the self-driving operations controller "
+        "([fault_tolerance.md](fault_tolerance.md) \"self-driving "
+        "operations\"): false (the default) means the reconciliation loop "
+        "observes nothing and actuates nothing; disarming a RUNNING "
+        "controller mid-loop releases any overrides it holds (shed depth, "
+        "quota throttle) and stands down."),
+    CONTROLLER_INTERVAL_SECONDS: ConfKey(
+        "1.0",
+        "Reconciliation-loop tick interval of `OpsController.start()`; each "
+        "tick samples the SLO tracker, drains new structured events, and "
+        "runs one `step()`."),
+    CONTROLLER_COOLDOWN_SECONDS: ConfKey(
+        "30",
+        "Minimum controller-clock seconds between two firings of the SAME "
+        "actuation (per healed index, per sweep, per shed engage) — the "
+        "anti-flap floor on top of the verdict hysteresis."),
+    CONTROLLER_HYSTERESIS_TICKS: ConfKey(
+        "2",
+        "Consecutive page-verdict ticks required before the overload "
+        "response engages: a single verdict flicker never actuates."),
+    CONTROLLER_RECOVERY_TICKS: ConfKey(
+        "2",
+        "Consecutive non-page ticks required before an engaged overload "
+        "response releases (restoring the original shed depth and quota "
+        "rates)."),
+    CONTROLLER_ACTUATION_BUDGET: ConfKey(
+        "32",
+        "Global mutation budget of one controller lifetime. Exhaustion "
+        "degrades the controller to observe-only — decisions are still "
+        "computed and audited, nothing mutates — announced once by an ERROR "
+        "`controller.observe_only` event. Releases of held overrides stay "
+        "free, so the system is always left as found."),
+    CONTROLLER_SHED_RATIO: ConfKey(
+        "0.5",
+        "Shed-depth tightening applied while serve SLOs page: the queue's "
+        "shed threshold drops to this fraction of `hyperspace.serve."
+        "maxQueueDepth` (non-priority submits refused earlier, typed), "
+        "restored on recovery."),
+    CONTROLLER_QUOTA_FACTOR: ConfKey(
+        "0.5",
+        "Tenant-quota tightening applied while serve SLOs page: every "
+        "tenant's token-bucket refill rate is scaled by this factor "
+        "(`TenantQuotas.set_throttle`), restored on recovery."),
+    CONTROLLER_HEAL_REBUILD: ConfKey(
+        "true",
+        "After healing a quarantined index via `recover()`, also rebuild it "
+        "(`refresh_index(mode=\"full\")` — the crash-safe Action protocol) "
+        "so on-disk corruption is actually repaired, not just re-served "
+        "until the next quarantine. false limits healing to log recovery."),
+    CONTROLLER_DEMOTION_CLUSTER_SIZE: ConfKey(
+        "3",
+        "How many `advisor.routing.demoted` events must cluster inside "
+        "`demotionWindowSeconds` before the controller triggers an advisor "
+        "lifecycle sweep (the sweep itself stays gated by the "
+        "`hyperspace.advisor.lifecycle.*` opt-ins)."),
+    CONTROLLER_DEMOTION_WINDOW_SECONDS: ConfKey(
+        "300",
+        "Trailing controller-clock window over which routing-demotion "
+        "events are counted toward the sweep-trigger cluster."),
     ADVISOR_ROUTING_ENABLED: ConfKey(
         "false",
         "Adaptive query routing ([advisor.md](advisor.md)): a per-plan-"
@@ -647,6 +752,18 @@ class HyperspaceConf:
     fleet_singleflight_wait_seconds: float = DEFAULT_FLEET_SINGLEFLIGHT_WAIT_SECONDS
     fleet_workers: int = DEFAULT_FLEET_WORKERS
     fleet_max_restarts: int = DEFAULT_FLEET_MAX_RESTARTS
+    fleet_restart_backoff_seconds: float = DEFAULT_FLEET_RESTART_BACKOFF_SECONDS
+    controller_enabled: bool = False  # opt-in: the controller mutates serving state
+    controller_interval_seconds: float = DEFAULT_CONTROLLER_INTERVAL_SECONDS
+    controller_cooldown_seconds: float = DEFAULT_CONTROLLER_COOLDOWN_SECONDS
+    controller_hysteresis_ticks: int = DEFAULT_CONTROLLER_HYSTERESIS_TICKS
+    controller_recovery_ticks: int = DEFAULT_CONTROLLER_RECOVERY_TICKS
+    controller_actuation_budget: int = DEFAULT_CONTROLLER_ACTUATION_BUDGET
+    controller_shed_ratio: float = DEFAULT_CONTROLLER_SHED_RATIO
+    controller_quota_factor: float = DEFAULT_CONTROLLER_QUOTA_FACTOR
+    controller_heal_rebuild: bool = True
+    controller_demotion_cluster_size: int = DEFAULT_CONTROLLER_DEMOTION_CLUSTER_SIZE
+    controller_demotion_window_seconds: float = DEFAULT_CONTROLLER_DEMOTION_WINDOW_SECONDS
     advisor_routing_enabled: bool = False  # opt-in: routing changes plan choice
     advisor_routing_demote_ratio: float = DEFAULT_ADVISOR_ROUTING_DEMOTE_RATIO
     advisor_routing_alpha: float = DEFAULT_ADVISOR_ROUTING_ALPHA
@@ -760,6 +877,30 @@ class HyperspaceConf:
             self.fleet_workers = int(value)
         elif key == FLEET_MAX_RESTARTS:
             self.fleet_max_restarts = int(value)
+        elif key == FLEET_RESTART_BACKOFF_SECONDS:
+            self.fleet_restart_backoff_seconds = float(value)
+        elif key == CONTROLLER_ENABLED:
+            self.controller_enabled = _as_bool(value)
+        elif key == CONTROLLER_INTERVAL_SECONDS:
+            self.controller_interval_seconds = float(value)
+        elif key == CONTROLLER_COOLDOWN_SECONDS:
+            self.controller_cooldown_seconds = float(value)
+        elif key == CONTROLLER_HYSTERESIS_TICKS:
+            self.controller_hysteresis_ticks = int(value)
+        elif key == CONTROLLER_RECOVERY_TICKS:
+            self.controller_recovery_ticks = int(value)
+        elif key == CONTROLLER_ACTUATION_BUDGET:
+            self.controller_actuation_budget = int(value)
+        elif key == CONTROLLER_SHED_RATIO:
+            self.controller_shed_ratio = float(value)
+        elif key == CONTROLLER_QUOTA_FACTOR:
+            self.controller_quota_factor = float(value)
+        elif key == CONTROLLER_HEAL_REBUILD:
+            self.controller_heal_rebuild = _as_bool(value)
+        elif key == CONTROLLER_DEMOTION_CLUSTER_SIZE:
+            self.controller_demotion_cluster_size = int(value)
+        elif key == CONTROLLER_DEMOTION_WINDOW_SECONDS:
+            self.controller_demotion_window_seconds = float(value)
         elif key == ADVISOR_ROUTING_ENABLED:
             self.advisor_routing_enabled = _as_bool(value)
         elif key == ADVISOR_ROUTING_DEMOTE_RATIO:
@@ -921,6 +1062,30 @@ class HyperspaceConf:
             return self.fleet_workers
         if key == FLEET_MAX_RESTARTS:
             return self.fleet_max_restarts
+        if key == FLEET_RESTART_BACKOFF_SECONDS:
+            return self.fleet_restart_backoff_seconds
+        if key == CONTROLLER_ENABLED:
+            return self.controller_enabled
+        if key == CONTROLLER_INTERVAL_SECONDS:
+            return self.controller_interval_seconds
+        if key == CONTROLLER_COOLDOWN_SECONDS:
+            return self.controller_cooldown_seconds
+        if key == CONTROLLER_HYSTERESIS_TICKS:
+            return self.controller_hysteresis_ticks
+        if key == CONTROLLER_RECOVERY_TICKS:
+            return self.controller_recovery_ticks
+        if key == CONTROLLER_ACTUATION_BUDGET:
+            return self.controller_actuation_budget
+        if key == CONTROLLER_SHED_RATIO:
+            return self.controller_shed_ratio
+        if key == CONTROLLER_QUOTA_FACTOR:
+            return self.controller_quota_factor
+        if key == CONTROLLER_HEAL_REBUILD:
+            return self.controller_heal_rebuild
+        if key == CONTROLLER_DEMOTION_CLUSTER_SIZE:
+            return self.controller_demotion_cluster_size
+        if key == CONTROLLER_DEMOTION_WINDOW_SECONDS:
+            return self.controller_demotion_window_seconds
         if key == ADVISOR_ROUTING_ENABLED:
             return self.advisor_routing_enabled
         if key == ADVISOR_ROUTING_DEMOTE_RATIO:

@@ -202,6 +202,16 @@ ERROR_CONTRACTS: dict[str, tuple[str, ...]] = {
     "hyperspace_tpu.advisor.lifecycle.LifecyclePolicy.sweep": (
         "OSError", "CrashPoint", "ValueError", "KeyError", "NotImplementedError",
     ),
+    # Self-driving operations controller (serve/controller.py). One
+    # reconciliation step actuates through the SAME facade methods an
+    # operator would call (recover/refresh/lifecycle), so it shares the
+    # full query surface: at runtime `_actuate` absorbs per-mutation
+    # Exceptions (recorded as controller.actuation_failed, the step
+    # continues), but the declared surface stays the honest upper bound
+    # on what the actuator lambdas can raise — plus the injected
+    # IO-fault surface at the controller.actuate fault point and
+    # CrashPoint (a dying process does not keep reconciling).
+    "hyperspace_tpu.serve.controller.OpsController.step": _QUERY_SURFACE,
     # Fleet plane (docs/serving.md "fleet topology"). The shared caches
     # are advisory by contract — IO failures are counted and answered
     # with a miss — so what escapes is the injected hard-death surface

@@ -61,6 +61,10 @@ Fault point names in use (see each call site):
 ``device.stage``      execution/staging.py, before each zero-copy column view
                       (transient ⇒ that column degrades to the copied host
                       path; crash ⇒ the query dies like any hard death)
+``controller.actuate`` serve/controller.py, immediately BEFORE each ops-
+                      controller mutation (shed engage/release, heal,
+                      sweep): a crash there proves the reconciliation
+                      step leaves no partial actuation behind
 ====================  =====================================================
 
 Cross-process injection: the pooled build's workers are SPAWNED
@@ -111,6 +115,7 @@ KNOWN_POINTS = (
     "build.exchange.read",
     "build.manifest.merge",
     "device.stage",
+    "controller.actuate",
 )
 
 

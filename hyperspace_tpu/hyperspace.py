@@ -557,6 +557,18 @@ class Hyperspace:
 
         return LifecyclePolicy(self)
 
+    def controller(self, server=None, **kwargs):
+        """The self-driving operations controller over this API
+        (serve/controller.py, docs/fault_tolerance.md "self-driving
+        operations"): a reconciliation loop consuming SLO burn verdicts
+        and the structured event ring, actuating only through the
+        crash-safe protocols this facade exposes. Gated by
+        `hyperspace.controller.enabled` (default off) — construct it,
+        opt in, and call `.start()` (or drive `.step()` yourself)."""
+        from hyperspace_tpu.serve.controller import OpsController
+
+        return OpsController(self, server=server, **kwargs)
+
     def explain(
         self,
         plan: LogicalPlan,

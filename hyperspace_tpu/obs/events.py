@@ -61,6 +61,24 @@ KNOWN_EVENTS: dict[str, str] = {
     "serve.shed": "warn",
     "fleet.singleflight.takeover": "warn",
     "fleet.worker.restarted": "warn",
+    # A fleet member is crash-looping: the supervisor engaged exponential
+    # backoff before its next respawn, so the restart budget cannot be
+    # burned in milliseconds (serve/fleet/supervisor.py).
+    "fleet.worker.crash_loop": "warn",
+    # Self-driving operations controller (serve/controller.py,
+    # docs/fault_tolerance.md "self-driving operations"): every decision
+    # is an auditable record. `controller.actuation` carries
+    # action/trigger/outcome for each decision (executed, deferred, or
+    # observed); `controller.actuation_failed` records a mutation that
+    # raised (its own Action already rolled back); `controller.backoff`
+    # records background work (heal rebuild / advisor sweep) held while
+    # serve SLOs burn; `controller.observe_only` fires ONCE when the
+    # global actuation budget is exhausted and the controller degrades
+    # to computing-but-not-acting.
+    "controller.actuation": "info",
+    "controller.actuation_failed": "error",
+    "controller.backoff": "info",
+    "controller.observe_only": "error",
     # JIT plane (docs/observability.md): a call-site key is compiling on
     # most calls (the runtime mirror of lint rule HSL015), or the
     # map-count guard dropped jax's caches to stay under

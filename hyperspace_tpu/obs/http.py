@@ -75,6 +75,7 @@ class HealthServer:
         # Weak provider sets: a dead session/server drops out of healthz.
         self._sessions: weakref.WeakSet = weakref.WeakSet()
         self._servers: weakref.WeakSet = weakref.WeakSet()
+        self._controllers: weakref.WeakSet = weakref.WeakSet()
 
     # -- providers --------------------------------------------------------
     def attach_session(self, session) -> None:
@@ -88,6 +89,12 @@ class HealthServer:
     def detach_server(self, query_server) -> None:
         with self._lock:
             self._servers.discard(query_server)
+
+    def attach_controller(self, controller) -> None:
+        """Surface an ops controller's live verdict in /healthz
+        (serve/controller.py registers itself on start())."""
+        with self._lock:
+            self._controllers.add(controller)
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "HealthServer":
@@ -143,6 +150,7 @@ class HealthServer:
         with self._lock:
             sessions = list(self._sessions)
             servers = list(self._servers)
+            controllers = list(self._controllers)
         indexes: dict[str, dict] = {}
         for s in sessions:
             with s._state_lock:
@@ -161,6 +169,10 @@ class HealthServer:
             "endpoint": {"host": self.host, "port": self.port},
             "indexes": indexes,
             "scheduler": scheduler,
+            # Self-driving operations (serve/controller.py): each
+            # attached controller's live verdict — mode, engaged
+            # overrides, remaining actuation budget, recent decisions.
+            "controller": [c.snapshot() for c in controllers],
             "slo": slo_verdicts,
             "jit": {**proc, "sites": _runtime.jit_report()},
             "events": _events.counts_by_severity(),

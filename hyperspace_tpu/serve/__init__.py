@@ -8,6 +8,7 @@ construct it explicitly (`session.serve()`); plain `session.run()` is
 unchanged.
 """
 
+from hyperspace_tpu.serve.controller import OpsController
 from hyperspace_tpu.serve.plan_cache import (
     PlanCache,
     collection_log_versions,
@@ -19,6 +20,7 @@ from hyperspace_tpu.serve.scheduler import QueryHandle, QueryServer
 __all__ = [
     "QueryServer",
     "QueryHandle",
+    "OpsController",
     "PlanCache",
     "ResultCache",
     "collection_log_versions",

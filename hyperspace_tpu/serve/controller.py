@@ -1,0 +1,435 @@
+"""Self-driving operations: the SLO→advisor reconciliation control loop.
+
+The paper's Hyperspace is "an index you manage": a human watches query
+regressions and calls refresh/optimize/recover by hand. Every control
+signal and actuator that a self-managing installation needs already
+exists in this repo in isolation — `obs/slo.py` computes multi-window
+burn verdicts, `obs/events.py` records quarantines and routing
+demotions, `faults.py` injects failures deterministically, and the
+advisor/Action protocols make every mutation crash-safe. This module
+composes them into one closed loop (docs/fault_tolerance.md
+"self-driving operations"):
+
+========================  ==========================================
+signal                    actuation (existing crash-safe protocol)
+========================  ==========================================
+`serve.availability` or   **shed load + tighten quotas**:
+`serve.latency_p99`       `QueryServer.set_shed_depth` drops the
+pages (multi-window       graceful-saturation threshold to
+burn verdict)             `controller.shedRatio` x maxQueueDepth and
+                          `TenantQuotas.set_throttle` scales every
+                          tenant's refill rate by
+                          `controller.quotaFactor`; both restored
+                          when the burn recovers.
+index quarantined         **heal**: `Hyperspace.recover(name)` (log
+(`session.index_health`)  repair + quarantine lift) then — gated by
+                          `controller.heal.rebuild` — a full
+                          `refresh_index` rebuild through the normal
+                          two-phase Action, so the corrupt bytes are
+                          actually replaced.
+`advisor.routing.demoted` **advisor sweep**:
+events cluster            `LifecyclePolicy.sweep()` — still gated by
+                          the `hyperspace.advisor.lifecycle.*`
+                          opt-ins; the controller only decides WHEN.
+serve SLOs burning        **back off background work**: heals and
+                          sweeps (rebuild/optimize-class work) are
+                          deferred with a `controller.backoff` event
+                          until the burn clears.
+========================  ==========================================
+
+Control discipline — the loop must never become its own incident:
+
+- **Kill switch.** `hyperspace.controller.enabled` defaults OFF. A
+  running controller that sees it flip releases whatever overrides it
+  holds and stands down mid-loop.
+- **Hysteresis.** The overload response needs `hysteresisTicks`
+  consecutive page verdicts to engage and `recoveryTicks` consecutive
+  non-page verdicts to release — a verdict flicker never flaps the
+  actuators.
+- **Cooldown.** Each actuation (per healed index, per sweep, per
+  engage) is rate-limited by `cooldownSeconds` on the controller's own
+  injectable clock.
+- **Actuation budget.** `actuationBudget` bounds total mutations per
+  controller lifetime. Exhaustion degrades to observe-only — decisions
+  are still computed and audited, nothing mutates — announced once by
+  an ERROR `controller.observe_only` event. Releases stay free, so the
+  system is always left as found.
+- **Audit.** Every decision is a structured `controller.*` event
+  carrying action/trigger/outcome; `/healthz` surfaces the live
+  controller snapshot next to the SLO verdicts.
+- **Crash safety.** The `controller.actuate` fault point fires
+  immediately BEFORE each mutation: an injected CrashPoint there
+  proves a dying controller leaves no partial actuation behind
+  (nothing has mutated yet), and every mutation it does make goes
+  through APIs that are individually crash-safe (Action two-phase
+  protocol / locked scheduler state). An actuation that fails with an
+  ordinary Exception is recorded (`controller.actuation_failed`) and
+  reconciliation continues — one broken actuator must not stop the
+  loop — while CrashPoint propagates like the process death it
+  simulates.
+
+Proven end to end by the chaos soak harness (`benchmarks/bench_soak.py`
+→ BENCH_SOAK.json): under a deterministic fault schedule the SLOs
+recover without a human, and the identical run with the controller
+disabled shows the degraded counterfactual.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from pathlib import Path
+
+from hyperspace_tpu import faults, stats
+from hyperspace_tpu.obs import events as obs_events
+from hyperspace_tpu.obs import metrics as obs_metrics
+from hyperspace_tpu.obs import slo as obs_slo
+from hyperspace_tpu.obs import trace as obs_trace
+
+# Declared at import (obs/events.py): emit never raises, so audit
+# records cannot widen the controller's narrow typed surface.
+_EVT_ACTUATION = obs_events.declare("controller.actuation")
+_EVT_FAILED = obs_events.declare("controller.actuation_failed")
+_EVT_BACKOFF = obs_events.declare("controller.backoff")
+_EVT_OBSERVE_ONLY = obs_events.declare("controller.observe_only")
+
+_ENGAGED = obs_metrics.gauge(
+    "controller.engaged", "1 while the controller's overload response holds overrides"
+)
+_BUDGET_REMAINING = obs_metrics.gauge(
+    "controller.budget_remaining", "actuations left before observe-only degradation"
+)
+
+# The serve objectives whose page verdicts drive the overload response.
+SERVE_OBJECTIVES = ("serve.availability", "serve.latency_p99")
+
+
+class OpsController:
+    """The reconciliation loop over one session (+ optional QueryServer).
+
+    Construct via ``Hyperspace.controller(server=...)``; `step()` is one
+    reconciliation pass (the unit tests drive it with an injectable
+    clock), `start()`/`stop()` run it as a daemon loop at
+    `hyperspace.controller.intervalSeconds`.
+    """
+
+    def __init__(self, hyperspace, server=None, clock=time.monotonic):
+        # `hyperspace` is the user-facing API facade: like the advisor's
+        # LifecyclePolicy, the controller has exactly the powers an
+        # operator has — recover/refresh/lifecycle — no private side
+        # doors into the log.
+        self.hyperspace = hyperspace
+        self.session = hyperspace.session
+        self.server = server
+        self._clock = clock
+        self._lock = threading.RLock()
+        self._budget = int(self.session.conf.controller_actuation_budget)
+        self._observe_only_announced = False
+        self._page_ticks = 0
+        self._ok_ticks = 0
+        self._engaged = False
+        self._saved: dict = {}
+        self._cooldowns: dict[str, float] = {}
+        self._last_seq = 0
+        self._demotions: collections.deque = collections.deque()
+        self._last_verdicts: dict[str, str] = {}
+        self._recent_actions: collections.deque = collections.deque(maxlen=16)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        _BUDGET_REMAINING.set(self._budget)
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "OpsController":
+        """Run the loop as a daemon thread; idempotent. Also registers
+        this controller with the process-shared health endpoint (if one
+        is live) so /healthz carries the controller verdict."""
+        with self._lock:
+            if self._thread is None:
+                self._stop.clear()
+                self._thread = threading.Thread(
+                    target=self._run, name="hs-ops-controller", daemon=True
+                )
+                self._thread.start()
+        from hyperspace_tpu.obs import http as obs_http
+
+        shared = obs_http.shared()
+        if shared is not None:
+            shared.attach_controller(self)
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        with self._lock:
+            t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+        with self._lock:
+            self._thread = None
+
+    def __enter__(self) -> "OpsController":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.stop()
+        return False
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.step()
+            except Exception as e:
+                # One failed reconciliation pass must not kill the loop:
+                # record it and keep reconciling. CrashPoint is a
+                # BaseException and propagates — a dying process does
+                # not keep actuating.
+                stats.increment("controller.actuation_failures")
+                _EVT_FAILED.emit(action="step", error=f"{type(e).__name__}: {e}")
+            self._stop.wait(self.session.conf.controller_interval_seconds)
+
+    # -- one reconciliation pass ------------------------------------------
+    def step(self, now: float | None = None) -> dict:
+        """One reconciliation pass: sample SLOs, drain new events,
+        decide, actuate. Returns the post-step snapshot (the /healthz
+        document's `controller` section). `now` overrides the injected
+        clock for deterministic tests."""
+        conf = self.session.conf
+        if now is None:
+            now = self._clock()
+        now = float(now)
+        with self._lock:
+            if not conf.controller_enabled:
+                # Kill switch mid-loop: release anything we hold, then
+                # stand down without observing or deciding anything.
+                if self._engaged:
+                    self._release_overload(now, trigger="kill_switch")
+                return self.snapshot()
+            stats.increment("controller.ticks")
+            obs_slo.sample(now)
+            verdicts = obs_slo.evaluate(now)
+            self._last_verdicts = {k: v["verdict"] for k, v in verdicts.items()}
+            burning = any(
+                self._last_verdicts.get(o) == "page" for o in SERVE_OBJECTIVES
+            )
+            if burning:
+                self._page_ticks += 1
+                self._ok_ticks = 0
+            else:
+                self._ok_ticks += 1
+                self._page_ticks = 0
+            demotion_cluster = self._drain_events(conf, now)
+
+            # 1. Overload response: shed + tighten quotas while pages
+            # persist (hysteresis), restore once the burn clears.
+            if (
+                burning
+                and not self._engaged
+                and self._page_ticks >= int(conf.controller_hysteresis_ticks)
+            ):
+                self._actuate(
+                    "shed.engage", trigger="slo.page", now=now,
+                    fn=lambda: self._engage_overload(conf),
+                    verdicts=dict(self._last_verdicts),
+                )
+            elif (
+                not burning
+                and self._engaged
+                and self._ok_ticks >= int(conf.controller_recovery_ticks)
+            ):
+                self._release_overload(now, trigger="slo.recovered")
+
+            # 2. Heal quarantined indexes — rebuild-class work, deferred
+            # while serve SLOs burn (backing off background work is
+            # itself the actuation that protects the serve plane).
+            with self.session._state_lock:
+                quarantined = sorted(self.session.index_health)
+            for root in quarantined:
+                name = Path(root).name
+                if burning:
+                    self._defer_background(
+                        conf, "heal", now, index=name, reason="slo.burning"
+                    )
+                    continue
+                self._actuate(
+                    f"heal.{name}", trigger="index.quarantined", now=now,
+                    fn=lambda n=name: self._heal(conf, n), index=name,
+                )
+
+            # 3. Routing demotions clustering means the index layout no
+            # longer fits the workload: hand the evidence to the advisor.
+            if demotion_cluster:
+                if burning:
+                    self._defer_background(
+                        conf, "advisor.sweep", now, reason="slo.burning"
+                    )
+                elif self._actuate(
+                    "advisor.sweep", trigger="routing.demotion_cluster", now=now,
+                    fn=self._sweep, demotions=demotion_cluster,
+                ):
+                    self._demotions.clear()  # evidence consumed; re-arm
+            return self.snapshot()
+
+    # -- signal plumbing --------------------------------------------------
+    def _drain_events(self, conf, now: float) -> int:
+        """Fold new ring events into the controller's trailing state;
+        returns the demotion count when it constitutes a cluster."""
+        fresh = [e for e in obs_events.recent() if e["seq"] > self._last_seq]
+        if fresh:
+            self._last_seq = max(e["seq"] for e in fresh)
+        n = sum(1 for e in fresh if e["name"] == "advisor.routing.demoted")
+        if n:
+            self._demotions.append((now, n))
+        cutoff = now - float(conf.controller_demotion_window_seconds)
+        while self._demotions and self._demotions[0][0] < cutoff:
+            self._demotions.popleft()
+        total = sum(c for _, c in self._demotions)
+        return total if total >= int(conf.controller_demotion_cluster_size) else 0
+
+    # -- actuators --------------------------------------------------------
+    def _actuate(self, action: str, trigger: str, now: float, fn, **details) -> bool:
+        """Run one mutation under the full control discipline: cooldown,
+        budget, fault point, audit. Returns True when it executed."""
+        conf = self.session.conf
+        if self._cooldowns.get(action, float("-inf")) > now:
+            stats.increment("controller.deferred")
+            return False
+        if self._budget <= 0:
+            # Observe-only: the decision is still computed and audited,
+            # nothing mutates.
+            self._announce_observe_only()
+            stats.increment("controller.deferred")
+            _EVT_ACTUATION.emit(
+                action=action, trigger=trigger, outcome="observe_only", **details
+            )
+            return False
+        # The fault point fires BEFORE any mutation: a CrashPoint here
+        # unwinds out of step() with zero partial state (tested), and a
+        # transient FaultError surfaces through the declared contract.
+        faults.fault_point("controller.actuate")
+        try:
+            with obs_trace.span("controller.actuate", action=action, trigger=trigger):
+                fn()
+        except Exception as e:
+            # The failed subsystem's own Action already rolled back;
+            # record, cool down, keep reconciling. CrashPoint propagates.
+            stats.increment("controller.actuation_failures")
+            _EVT_FAILED.emit(
+                action=action, trigger=trigger, error=f"{type(e).__name__}: {e}"
+            )
+            self._cooldowns[action] = now + float(conf.controller_cooldown_seconds)
+            return False
+        self._budget -= 1
+        _BUDGET_REMAINING.set(self._budget)
+        stats.increment("controller.actuations")
+        self._cooldowns[action] = now + float(conf.controller_cooldown_seconds)
+        record = _EVT_ACTUATION.emit(
+            action=action, trigger=trigger, outcome="executed",
+            budget_remaining=self._budget, **details,
+        )
+        self._recent_actions.append(
+            {"action": action, "trigger": trigger, "at": now, "seq": record["seq"]}
+        )
+        return True
+
+    def _engage_overload(self, conf) -> None:
+        # Re-entered under the step() RLock; restated here because this
+        # runs through the _actuate(fn=...) indirection, which hides the
+        # entry-lock guarantee from direct call-site analysis.
+        with self._lock:
+            saved: dict = {}
+            if self.server is not None:
+                saved["shed_depth"] = self.server.get_shed_depth()
+                self.server.set_shed_depth(
+                    int(self.server.max_queue_depth * float(conf.controller_shed_ratio))
+                )
+                quotas = getattr(self.server, "quotas", None)
+                if quotas is not None:
+                    saved["throttle"] = quotas.throttle()
+                    quotas.set_throttle(float(conf.controller_quota_factor))
+            self._saved = saved
+            self._engaged = True
+            _ENGAGED.set(1)
+
+    def _release_overload(self, now: float, trigger: str) -> None:
+        """Restore the pre-engage shed depth and quota throttle. Free of
+        budget by design — the controller must always be able to leave
+        the system as it found it (kill switch, budget exhaustion)."""
+        faults.fault_point("controller.actuate")
+        try:
+            if self.server is not None:
+                if "shed_depth" in self._saved:
+                    self.server.set_shed_depth(self._saved["shed_depth"])
+                quotas = getattr(self.server, "quotas", None)
+                if quotas is not None and "throttle" in self._saved:
+                    quotas.set_throttle(self._saved["throttle"])
+        except Exception as e:
+            stats.increment("controller.actuation_failures")
+            _EVT_FAILED.emit(
+                action="shed.release", trigger=trigger,
+                error=f"{type(e).__name__}: {e}",
+            )
+            return
+        self._engaged = False
+        self._saved = {}
+        _ENGAGED.set(0)
+        record = _EVT_ACTUATION.emit(
+            action="shed.release", trigger=trigger, outcome="executed",
+            budget_remaining=self._budget,
+        )
+        self._recent_actions.append(
+            {"action": "shed.release", "trigger": trigger, "at": now,
+             "seq": record["seq"]}
+        )
+
+    def _heal(self, conf, name: str) -> None:
+        """recover() repairs the log and lifts the quarantine; the gated
+        full refresh rebuilds the data files through the crash-safe
+        Action protocol so the corruption is actually gone (not merely
+        re-served until the next quarantine)."""
+        self.hyperspace.recover(name)
+        if conf.controller_heal_rebuild:
+            self.hyperspace.refresh_index(name, "full")
+        stats.increment("controller.heals")
+
+    def _sweep(self) -> None:
+        # The lifecycle policy's own gates (autoCreate/autoVacuum/
+        # autoOptimize, confidence and benefit floors) still decide WHAT
+        # may mutate; the controller only decided WHEN to look.
+        self.hyperspace.lifecycle().sweep()
+
+    def _defer_background(self, conf, action: str, now: float, **details) -> None:
+        stats.increment("controller.deferred")
+        key = f"backoff.{action}"
+        if self._cooldowns.get(key, float("-inf")) <= now:
+            # Rate-limit the audit record, not the deferral itself.
+            self._cooldowns[key] = now + float(conf.controller_cooldown_seconds)
+            _EVT_BACKOFF.emit(action=action, **details)
+
+    def _announce_observe_only(self) -> None:
+        if not self._observe_only_announced:
+            self._observe_only_announced = True
+            _EVT_OBSERVE_ONLY.emit(budget_remaining=0)
+
+    # -- views ------------------------------------------------------------
+    def snapshot(self) -> dict:
+        """Point-in-time controller state — the /healthz `controller`
+        section (docs/observability.md)."""
+        with self._lock:
+            enabled = bool(self.session.conf.controller_enabled)
+            if not enabled:
+                mode = "disabled"
+            elif self._budget <= 0:
+                mode = "observe_only"
+            else:
+                mode = "actuate"
+            return {
+                "enabled": enabled,
+                "mode": mode,
+                "engaged": self._engaged,
+                "budget_remaining": self._budget,
+                "verdicts": dict(self._last_verdicts),
+                "page_ticks": self._page_ticks,
+                "ok_ticks": self._ok_ticks,
+                "pending_demotions": sum(c for _, c in self._demotions),
+                "recent_actions": list(self._recent_actions),
+            }

@@ -46,17 +46,20 @@ class TokenBucket:
         self.tokens = float(burst)
         self._t_last = now
 
-    def try_take(self, now: float) -> float:
+    def try_take(self, now: float, scale: float = 1.0) -> float:
         """Take one token. Returns 0.0 on success, else the seconds
-        until one will be available (the retry-after hint)."""
-        self.tokens = min(self.burst, self.tokens + (now - self._t_last) * self.rate)
+        until one will be available (the retry-after hint). `scale`
+        multiplies the refill rate for this refill window — the ops
+        controller's fleet-wide throttle (serve/controller.py)."""
+        rate = self.rate * scale
+        self.tokens = min(self.burst, self.tokens + (now - self._t_last) * rate)
         self._t_last = now
         if self.tokens >= 1.0:
             self.tokens -= 1.0
             return 0.0
-        if self.rate <= 0:
+        if rate <= 0:
             return float("inf")
-        return (1.0 - self.tokens) / self.rate
+        return (1.0 - self.tokens) / rate
 
 
 class TenantQuotas:
@@ -76,6 +79,21 @@ class TenantQuotas:
         self._lock = threading.Lock()
         self._buckets: dict[str, TokenBucket] = {}
         self._limits: dict[str, tuple[float, float]] = {}
+        # Multiplier on every bucket's refill rate (1.0 = rated). The
+        # ops controller tightens it while serve SLOs page and restores
+        # it on recovery (docs/fault_tolerance.md "self-driving
+        # operations"); per-tenant limits and burst stay untouched.
+        self._throttle = 1.0
+
+    def set_throttle(self, factor: float) -> None:
+        """Scale every tenant's refill rate by `factor` (0 < factor;
+        1.0 restores the rated quotas)."""
+        with self._lock:
+            self._throttle = max(0.0, float(factor))
+
+    def throttle(self) -> float:
+        with self._lock:
+            return self._throttle
 
     def set_limit(self, tenant: str, rate: float, burst: float | None = None) -> None:
         """Override one tenant's rate/burst; takes effect on its next
@@ -101,7 +119,7 @@ class TenantQuotas:
                     self._buckets.pop(next(iter(self._buckets)))
             else:
                 self._buckets[tenant] = self._buckets.pop(tenant)  # LRU touch
-            wait_s = bucket.try_take(self._clock())
+            wait_s = bucket.try_take(self._clock(), scale=self._throttle)
         if wait_s > 0.0:
             _QUOTA_REJECTED.inc()
             _EVT_QUOTA.emit(tenant=tenant, retry_after_s=wait_s)

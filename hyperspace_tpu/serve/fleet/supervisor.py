@@ -22,7 +22,12 @@ The supervisor's contract:
   is respawned until its restart budget (``hyperspace.fleet.maxRestarts``)
   is spent — each respawn counted in `fleet.supervisor.restarts` and
   announced as a WARN ``fleet.worker.restarted`` event. Workers that
-  exit 0 are considered done and stay down.
+  exit 0 are considered done and stay down. The FIRST respawn of a
+  member is immediate; repeat crashes of the SAME member back off
+  exponentially (``hyperspace.fleet.restartBackoffSeconds`` base,
+  deterministic per-member jitter, capped) so a crash-looping worker
+  cannot burn its whole budget in milliseconds — the moment backoff
+  engages, a WARN ``fleet.worker.crash_loop`` event names the member.
 - **drain/stop**: `stop()` sets the shared stop event (workers exit
   their serve loops, QueryServers drain) and joins with a timeout;
   stragglers are terminated. The supervisor is a context manager.
@@ -48,9 +53,18 @@ from hyperspace_tpu.parallel.procpool import ProcessHost
 from hyperspace_tpu.utils import file_utils
 
 _EVT_RESTARTED = obs_events.declare("fleet.worker.restarted")
+_EVT_CRASH_LOOP = obs_events.declare("fleet.worker.crash_loop")
 
 _MONITOR_POLL_S = 0.1
 _HEALTH_TIMEOUT_S = 5.0
+_BACKOFF_CAP_S = 30.0
+
+
+def _restart_jitter(worker_id: int, attempt: int) -> float:
+    """Deterministic jitter fraction in [0, 0.25): spreads simultaneous
+    crash-loop respawns without RNG (the faults-harness determinism
+    contract extends to the supervisor's timing decisions)."""
+    return ((worker_id * 2654435761 + attempt * 40503) % 1000) / 4000.0
 
 WORKERS_DIRNAME = "workers"
 
@@ -153,6 +167,7 @@ class FleetSupervisor:
         n: int | None = None,
         args: tuple = (),
         max_restarts: int | None = None,
+        restart_backoff: float | None = None,
         conf=None,
     ):
         n = int(n if n is not None else getattr(conf, "fleet_workers", 2))
@@ -163,6 +178,10 @@ class FleetSupervisor:
         self.max_restarts = int(
             max_restarts if max_restarts is not None else getattr(conf, "fleet_max_restarts", 3)
         )
+        self.restart_backoff = float(
+            restart_backoff if restart_backoff is not None
+            else getattr(conf, "fleet_restart_backoff_seconds", 0.5)
+        )
         # The shared spawn-context worker lifecycle (parallel/procpool.py):
         # the host owns the spawn context, the stop event, and the keyed
         # process registry; the supervisor layers fleet policy (restart
@@ -171,6 +190,10 @@ class FleetSupervisor:
         self._stop = self._host.stop_event
         self._lock = threading.Lock()
         self._restarts: dict[int, int] = {}
+        # Per-member earliest-next-respawn deadlines (monotonic clock):
+        # the crash-loop backoff state, entries live only while a
+        # delayed respawn is pending.
+        self._restart_at: dict[int, float] = {}
         self._monitor_thread: threading.Thread | None = None
         self._stopping = False
 
@@ -201,11 +224,15 @@ class FleetSupervisor:
     def _monitor(self) -> None:
         """Respawn crashed members until their restart budget is spent.
         exit 0 = completed (left down); any other exit, including a
-        SIGKILL's negative code, = crash."""
+        SIGKILL's negative code, = crash. A member crashing AGAIN backs
+        off exponentially before its next respawn (first respawn is
+        immediate), so a crash-looping worker spends its budget over
+        seconds — observable, WARN-announced — not milliseconds."""
         while True:
             with self._lock:
                 if self._stopping:
                     return
+                now = time.monotonic()
                 dead = [
                     (wid, p) for wid, p in self._host.processes().items()
                     if not p.is_alive() and p.exitcode not in (0, None)
@@ -214,6 +241,22 @@ class FleetSupervisor:
                     used = self._restarts.get(wid, 0)
                     if used >= self.max_restarts:
                         continue
+                    if used > 0 and self.restart_backoff > 0:
+                        deadline = self._restart_at.get(wid)
+                        if deadline is None:
+                            delay = min(
+                                self.restart_backoff * (2 ** (used - 1)),
+                                _BACKOFF_CAP_S,
+                            ) * (1.0 + _restart_jitter(wid, used))
+                            self._restart_at[wid] = now + delay
+                            _EVT_CRASH_LOOP.emit(
+                                worker_id=wid, exitcode=p.exitcode,
+                                restarts_used=used, delay_s=round(delay, 3),
+                            )
+                            continue
+                        if now < deadline:
+                            continue
+                    self._restart_at.pop(wid, None)
                     self._restarts[wid] = used + 1
                     self._spawn(wid)
                     stats.increment("fleet.supervisor.restarts")

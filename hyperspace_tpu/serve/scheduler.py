@@ -299,6 +299,21 @@ class QueryServer:
         with self._cv:
             return len(self._prio) + len(self._fifo)
 
+    def set_shed_depth(self, depth: int) -> int:
+        """Move the graceful-saturation shed threshold at runtime —
+        the ops controller's load-shedding actuator (serve/controller.py):
+        while serve SLOs page, non-priority traffic is refused earlier
+        (typed, with the observed depth) so the queue drains instead of
+        feeding the burn. Clamped to [1, maxQueueDepth]; returns the
+        applied value."""
+        with self._cv:
+            self.shed_depth = max(1, min(int(depth), self.max_queue_depth))
+            return self.shed_depth
+
+    def get_shed_depth(self) -> int:
+        with self._cv:
+            return self.shed_depth
+
     def saturation(self) -> dict:
         """Point-in-time scheduler load — the /healthz overload signal
         (docs/serving.md): how full the admission queue is and how many

@@ -77,6 +77,21 @@ Counter names in use:
 - ``device.kernel.fallbacks``  device-venue reduces that took the
   always-available jitted lax path while fused kernels were enabled
   (ineligible shape, unprovable exactness, or a failed Pallas lowering)
+- ``controller.ticks``  reconciliation steps the self-driving operations
+  controller ran while armed (serve/controller.py,
+  docs/fault_tolerance.md "self-driving operations")
+- ``controller.actuations``  mutations the controller executed through
+  the crash-safe protocols (shed engage, quota tighten, heal, sweep)
+- ``controller.actuation_failures``  actuations that raised an ordinary
+  Exception — recorded (ERROR ``controller.actuation_failed`` event) and
+  the reconciliation continued; the failed subsystem's own Action
+  rollback already ran
+- ``controller.deferred``  actuations the controller decided on but
+  held back — per-actuation cooldown still running, background work
+  backed off while serve SLOs burn, or observe-only after budget
+  exhaustion
+- ``controller.heals``  quarantined indexes the controller healed
+  (recover() + gated rebuild) without a human in the loop
 """
 
 from __future__ import annotations
@@ -120,6 +135,11 @@ KNOWN_COUNTERS = (
     "device.stage.bytes_copied",
     "device.kernel.fused",
     "device.kernel.fallbacks",
+    "controller.ticks",
+    "controller.actuations",
+    "controller.actuation_failures",
+    "controller.deferred",
+    "controller.heals",
 )
 
 _counters = {name: _metrics.counter(name) for name in KNOWN_COUNTERS}

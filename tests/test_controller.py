@@ -1,0 +1,461 @@
+"""Self-driving operations controller (serve/controller.py,
+docs/fault_tolerance.md "self-driving operations"): every trigger→action
+mapping, hysteresis across verdict flicker, per-actuation cooldown,
+actuation-budget exhaustion degrading to observe-only, the kill switch
+disarming mid-loop, and CrashPoint at the `controller.actuate` fault
+point unwinding with zero partial state — all driven by an injectable
+clock (no sleeps on the decision paths)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from hyperspace_tpu import faults, stats
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.faults import CrashPoint
+from hyperspace_tpu.obs import events, metrics, slo
+from hyperspace_tpu.obs import http as obs_http
+from hyperspace_tpu.serve.controller import OpsController
+from hyperspace_tpu.serve.fleet.quota import TenantQuotas
+from hyperspace_tpu.serve.scheduler import QueryServer
+
+
+class FakeSession:
+    """The session surface the controller reads: conf + the lock-guarded
+    index_health map (the test_health_plane.FakeSession shape)."""
+
+    def __init__(self, **conf_overrides):
+        self.conf = HyperspaceConf()
+        self.conf.set("hyperspace.controller.enabled", "true")
+        for k, v in conf_overrides.items():
+            self.conf.set(k, v)
+        self._state_lock = threading.RLock()
+        self.index_health = {}
+
+
+class FakeLifecycle:
+    def __init__(self, log):
+        self._log = log
+
+    def sweep(self):
+        self._log.append(("sweep",))
+        return {"applied": [], "skipped": [], "failed": []}
+
+
+class FakeHyperspace:
+    """The facade surface the controller actuates through; records every
+    call so tests pin the trigger→protocol mapping."""
+
+    def __init__(self, session):
+        self.session = session
+        self.calls = []
+        self.fail_next = None  # exception type to raise on the next call
+
+    def _maybe_fail(self):
+        if self.fail_next is not None:
+            exc, self.fail_next = self.fail_next, None
+            raise exc("injected facade failure")
+
+    def recover(self, name=None):
+        self._maybe_fail()
+        self.calls.append(("recover", name))
+        with self.session._state_lock:
+            for root in [r for r in self.session.index_health
+                         if name is None or r.endswith(name)]:
+                self.session.index_health.pop(root)
+        return {}
+
+    def refresh_index(self, name, mode="full"):
+        self._maybe_fail()
+        self.calls.append(("refresh", name, mode))
+
+    def lifecycle(self):
+        return FakeLifecycle(self.calls)
+
+
+def _serve_counters():
+    return (
+        metrics.counter("serve.completed"),
+        metrics.counter("serve.failed"),
+        metrics.counter("serve.timeouts"),
+        metrics.counter("serve.cancelled"),
+        metrics.histogram("serve.latency.seconds"),
+    )
+
+
+def _controller(server=None, **conf_overrides):
+    session = FakeSession(**conf_overrides)
+    hs = FakeHyperspace(session)
+    return hs, OpsController(hs, server=server, clock=lambda: 0.0)
+
+
+def _drive_page(completed, failed, ctrl, t0=0.0):
+    """Walk the controller's own sampling into a sustained availability
+    page: baseline traffic, then a hard failure burst. Returns the time
+    of the last (second consecutive page) step."""
+    completed.inc(10_000)
+    ctrl.step(now=t0)
+    ctrl.step(now=t0 + 4000.0)
+    failed.inc(3_000)
+    ctrl.step(now=t0 + 4030.0)  # page tick 1: hysteresis holds
+    ctrl.step(now=t0 + 4031.0)  # page tick 2: actuate
+    return t0 + 4031.0
+
+
+def _actuation_events(action=None):
+    out = [e for e in events.recent() if e["name"] == "controller.actuation"]
+    if action is not None:
+        out = [e for e in out if e["fields"]["action"] == action]
+    return out
+
+
+@pytest.fixture
+def shed_server():
+    """A real QueryServer (DI run_fn) + real TenantQuotas — the overload
+    actuation surface."""
+    session = FakeSession()
+    quotas = TenantQuotas(rate=10.0, burst=10.0)
+    server = QueryServer(
+        session, workers=1, max_queue_depth=32, run_fn=lambda p: p, quotas=quotas
+    )
+    try:
+        yield server
+    finally:
+        server.shutdown()
+
+
+# -- trigger -> action mappings --------------------------------------------
+
+
+def test_slo_page_engages_shed_and_quota_tighten(shed_server):
+    completed, failed, *_ = _serve_counters()
+    hs, ctrl = _controller(server=shed_server)
+    assert shed_server.get_shed_depth() == 32
+    _drive_page(completed, failed, ctrl)
+    assert shed_server.get_shed_depth() == 16  # 0.5 x maxQueueDepth
+    assert shed_server.quotas.throttle() == pytest.approx(0.5)
+    snap = ctrl.snapshot()
+    assert snap["engaged"] is True
+    assert snap["verdicts"]["serve.availability"] == "page"
+    assert stats.get("controller.actuations") == 1
+    (evt,) = _actuation_events("shed.engage")
+    assert evt["fields"]["trigger"] == "slo.page"
+    assert evt["fields"]["outcome"] == "executed"
+    assert metrics.REGISTRY.get("controller.engaged").value == 1
+
+
+def test_recovery_releases_overrides_after_recovery_ticks(shed_server):
+    completed, failed, *_ = _serve_counters()
+    hs, ctrl = _controller(server=shed_server)
+    t = _drive_page(completed, failed, ctrl)
+    # clean traffic pushes the burst out of the page windows
+    completed.inc(80_000)
+    ctrl.step(now=t + 70.0)  # non-page tick 1: still engaged
+    assert ctrl.snapshot()["engaged"] is True
+    ctrl.step(now=t + 71.0)  # non-page tick 2: release
+    assert ctrl.snapshot()["engaged"] is False
+    assert shed_server.get_shed_depth() == 32
+    assert shed_server.quotas.throttle() == pytest.approx(1.0)
+    (evt,) = _actuation_events("shed.release")
+    assert evt["fields"]["trigger"] == "slo.recovered"
+    assert metrics.REGISTRY.get("controller.engaged").value == 0
+
+
+def test_quarantine_triggers_recover_then_gated_rebuild():
+    _serve_counters()
+    hs, ctrl = _controller()
+    with hs.session._state_lock:
+        hs.session.index_health["/idx/myidx"] = {"reason": "torn bucket"}
+    ctrl.step(now=0.0)
+    ctrl.step(now=1.0)
+    assert hs.calls == [("recover", "myidx"), ("refresh", "myidx", "full")]
+    assert hs.session.index_health == {}
+    assert stats.get("controller.heals") == 1
+    (evt,) = _actuation_events("heal.myidx")
+    assert evt["fields"]["trigger"] == "index.quarantined"
+
+
+def test_heal_rebuild_gate_off_limits_heal_to_recover():
+    _serve_counters()
+    hs, ctrl = _controller(**{"hyperspace.controller.heal.rebuild": "false"})
+    with hs.session._state_lock:
+        hs.session.index_health["/idx/a"] = {"reason": "x"}
+    ctrl.step(now=0.0)
+    ctrl.step(now=1.0)
+    assert hs.calls == [("recover", "a")]
+
+
+def test_demotion_cluster_triggers_advisor_sweep():
+    _serve_counters()
+    hs, ctrl = _controller()
+    demoted = events.declare("advisor.routing.demoted")
+    for i in range(3):
+        demoted.emit(signature=f"s{i}")
+    ctrl.step(now=0.0)
+    ctrl.step(now=1.0)
+    assert ("sweep",) in hs.calls
+    (evt,) = _actuation_events("advisor.sweep")
+    assert evt["fields"]["trigger"] == "routing.demotion_cluster"
+    assert evt["fields"]["demotions"] == 3
+    # evidence consumed: no second sweep without fresh demotions
+    ctrl.step(now=100.0)
+    assert hs.calls.count(("sweep",)) == 1
+
+
+def test_demotions_below_cluster_size_or_outside_window_never_sweep():
+    _serve_counters()
+    hs, ctrl = _controller()
+    demoted = events.declare("advisor.routing.demoted")
+    demoted.emit(signature="a")
+    demoted.emit(signature="b")
+    ctrl.step(now=0.0)  # 2 < clusterSize 3
+    assert ("sweep",) not in hs.calls
+    # the third arrives after the first two aged out of the window
+    demoted.emit(signature="c")
+    ctrl.step(now=1000.0)  # window 300s: earlier pair expired
+    assert ("sweep",) not in hs.calls
+
+
+# -- back off background work while SLOs burn -------------------------------
+
+
+def test_heal_and_sweep_defer_while_burning(shed_server):
+    completed, failed, *_ = _serve_counters()
+    hs, ctrl = _controller(server=shed_server)
+    t = _drive_page(completed, failed, ctrl)
+    assert ctrl.snapshot()["engaged"] is True
+    # the quarantine lands MID-burn: rebuild-class work must wait
+    with hs.session._state_lock:
+        hs.session.index_health["/idx/hot"] = {"reason": "x"}
+    ctrl.step(now=t + 1.0)  # still paging
+    assert not any(c[0] in ("recover", "refresh") for c in hs.calls)
+    assert not any(c[0] in ("recover", "refresh") for c in hs.calls)
+    backoffs = [e for e in events.recent() if e["name"] == "controller.backoff"]
+    assert {e["fields"]["action"] for e in backoffs} == {"heal"}
+    assert stats.get("controller.deferred") >= 1
+    # burn clears -> the held-back heal executes
+    completed.inc(80_000)
+    ctrl.step(now=t + 70.0)
+    ctrl.step(now=t + 71.0)
+    ctrl.step(now=t + 72.0)
+    assert ("recover", "hot") in hs.calls
+
+
+# -- hysteresis / cooldown (no flapping) ------------------------------------
+
+
+def test_single_verdict_flicker_never_actuates(shed_server):
+    completed, failed, *_ = _serve_counters()
+    hs, ctrl = _controller(server=shed_server)
+    completed.inc(10_000)
+    ctrl.step(now=0.0)
+    ctrl.step(now=4000.0)
+    failed.inc(3_000)
+    ctrl.step(now=4030.0)  # page tick 1 of hysteresis 2
+    assert ctrl.snapshot()["engaged"] is False
+    assert shed_server.get_shed_depth() == 32
+    # flicker back to ok: the page streak resets
+    completed.inc(80_000)
+    ctrl.step(now=4100.0)
+    assert ctrl.snapshot()["page_ticks"] == 0
+    assert ctrl.snapshot()["engaged"] is False
+    assert _actuation_events() == []
+
+
+def test_heal_failure_cools_down_before_retry():
+    _serve_counters()
+    hs, ctrl = _controller()
+    with hs.session._state_lock:
+        hs.session.index_health["/idx/bad"] = {"reason": "x"}
+    hs.fail_next = RuntimeError
+    ctrl.step(now=0.0)
+    assert stats.get("controller.actuation_failures") == 1
+    failed_events = [e for e in events.recent()
+                     if e["name"] == "controller.actuation_failed"]
+    assert failed_events and failed_events[0]["fields"]["action"] == "heal.bad"
+    # still quarantined; inside the 30s cooldown nothing retries
+    ctrl.step(now=5.0)
+    assert hs.calls == []
+    assert stats.get("controller.deferred") >= 1
+    # past the cooldown the heal retries and succeeds
+    ctrl.step(now=31.0)
+    assert ("recover", "bad") in hs.calls
+
+
+# -- actuation budget --------------------------------------------------------
+
+
+def test_budget_exhaustion_degrades_to_observe_only(shed_server):
+    completed, failed, *_ = _serve_counters()
+    hs, ctrl = _controller(
+        server=shed_server, **{"hyperspace.controller.actuationBudget": 1}
+    )
+    t = _drive_page(completed, failed, ctrl)  # spends the whole budget
+    assert ctrl.snapshot()["budget_remaining"] == 0
+    # release stays free: the system is always left as found
+    completed.inc(80_000)
+    ctrl.step(now=t + 70.0)
+    ctrl.step(now=t + 71.0)
+    assert shed_server.get_shed_depth() == 32
+    # a new trigger is observed, audited, and NOT executed
+    with hs.session._state_lock:
+        hs.session.index_health["/idx/q"] = {"reason": "x"}
+    ctrl.step(now=t + 72.0)
+    assert not any(c[0] == "recover" for c in hs.calls)
+    assert ctrl.snapshot()["mode"] == "observe_only"
+    observe = [e for e in events.recent() if e["name"] == "controller.observe_only"]
+    assert len(observe) == 1 and observe[0]["severity"] == "error"
+    suppressed = _actuation_events("heal.q")
+    assert suppressed and suppressed[0]["fields"]["outcome"] == "observe_only"
+    # announced once, not per tick
+    ctrl.step(now=t + 103.0)
+    assert len([e for e in events.recent()
+                if e["name"] == "controller.observe_only"]) == 1
+
+
+# -- kill switch -------------------------------------------------------------
+
+
+def test_kill_switch_disarms_mid_loop_and_releases(shed_server):
+    completed, failed, *_ = _serve_counters()
+    hs, ctrl = _controller(server=shed_server)
+    _drive_page(completed, failed, ctrl)
+    assert shed_server.get_shed_depth() == 16
+    ticks_before = stats.get("controller.ticks")
+    hs.session.conf.set("hyperspace.controller.enabled", "false")
+    with hs.session._state_lock:
+        hs.session.index_health["/idx/x"] = {"reason": "x"}
+    snap = ctrl.step(now=5000.0)
+    # overrides released, nothing else observed or actuated
+    assert shed_server.get_shed_depth() == 32
+    assert shed_server.quotas.throttle() == pytest.approx(1.0)
+    assert snap["mode"] == "disabled" and snap["engaged"] is False
+    assert stats.get("controller.ticks") == ticks_before
+    assert not any(c[0] == "recover" for c in hs.calls)
+    (evt,) = _actuation_events("shed.release")
+    assert evt["fields"]["trigger"] == "kill_switch"
+
+
+def test_disabled_by_default_controller_never_acts():
+    session = FakeSession()
+    session.conf.set("hyperspace.controller.enabled", "false")
+    hs = FakeHyperspace(session)
+    ctrl = OpsController(hs, clock=lambda: 0.0)
+    with session._state_lock:
+        session.index_health["/idx/x"] = {"reason": "x"}
+    snap = ctrl.step(now=0.0)
+    assert snap["mode"] == "disabled"
+    assert hs.calls == [] and stats.get("controller.ticks") == 0
+
+
+# -- crash safety (controller.actuate fault point) ---------------------------
+
+
+def test_crashpoint_at_actuate_unwinds_with_zero_partial_state(shed_server):
+    completed, failed, *_ = _serve_counters()
+    hs, ctrl = _controller(server=shed_server)
+    completed.inc(10_000)
+    ctrl.step(now=0.0)
+    ctrl.step(now=4000.0)
+    failed.inc(3_000)
+    ctrl.step(now=4030.0)
+    with faults.injected("controller.actuate", crash=True):
+        with pytest.raises(CrashPoint):
+            ctrl.step(now=4031.0)  # the engage tick dies BEFORE mutating
+    assert shed_server.get_shed_depth() == 32  # no partial actuation
+    assert shed_server.quotas.throttle() == pytest.approx(1.0)
+    assert ctrl.snapshot()["engaged"] is False
+    assert stats.get("controller.actuations") == 0
+    # the "next process": a clean retry actuates normally
+    ctrl.step(now=4032.0)
+    assert shed_server.get_shed_depth() == 16
+
+
+def test_transient_fault_at_actuate_surfaces_typed():
+    _serve_counters()
+    hs, ctrl = _controller()
+    with hs.session._state_lock:
+        hs.session.index_health["/idx/t"] = {"reason": "x"}
+    with faults.injected("controller.actuate", times=1):
+        with pytest.raises(OSError):
+            ctrl.step(now=0.0)
+    assert hs.calls == []  # the fault fired before any mutation
+    ctrl.step(now=1.0)
+    assert ("recover", "t") in hs.calls
+
+
+# -- loop + healthz surface --------------------------------------------------
+
+
+def test_start_stop_loop_ticks_and_stops():
+    _serve_counters()
+    hs, ctrl = _controller(**{"hyperspace.controller.intervalSeconds": 0.01})
+    ctrl._clock = time.monotonic
+    with ctrl.start():
+        deadline = time.monotonic() + 5.0
+        while stats.get("controller.ticks") < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+    assert stats.get("controller.ticks") >= 3
+    ticks = stats.get("controller.ticks")
+    time.sleep(0.05)
+    assert stats.get("controller.ticks") == ticks  # stopped means stopped
+
+
+def test_loop_survives_a_failing_step():
+    _serve_counters()
+    hs, ctrl = _controller(**{"hyperspace.controller.intervalSeconds": 0.01})
+    ctrl._clock = time.monotonic
+    boom = {"n": 0}
+
+    real_step = ctrl.step
+
+    def flaky_step(now=None):
+        boom["n"] += 1
+        if boom["n"] == 1:
+            raise RuntimeError("transient controller bug")
+        return real_step(now)
+
+    ctrl.step = flaky_step
+    with ctrl.start():
+        deadline = time.monotonic() + 5.0
+        while boom["n"] < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+    assert boom["n"] >= 3  # the loop kept reconciling past the failure
+    failed_events = [e for e in events.recent()
+                     if e["name"] == "controller.actuation_failed"]
+    assert any(e["fields"]["action"] == "step" for e in failed_events)
+
+
+def test_healthz_surfaces_controller_verdict():
+    _serve_counters()
+    hs, ctrl = _controller()
+    endpoint = obs_http.HealthServer().start()
+    try:
+        endpoint.attach_controller(ctrl)
+        ctrl.step(now=0.0)
+        with urllib.request.urlopen(endpoint.url("/healthz"), timeout=10) as r:
+            doc = json.loads(r.read().decode())
+        (view,) = doc["controller"]
+        assert view["enabled"] is True
+        assert view["mode"] == "actuate"
+        assert view["budget_remaining"] == 32
+        assert "verdicts" in view
+    finally:
+        endpoint.stop()
+
+
+def test_start_registers_with_shared_health_endpoint():
+    _serve_counters()
+    hs, ctrl = _controller(**{"hyperspace.controller.intervalSeconds": 0.05})
+    endpoint = obs_http.acquire()
+    try:
+        ctrl._clock = time.monotonic
+        with ctrl.start():
+            with urllib.request.urlopen(endpoint.url("/healthz"), timeout=10) as r:
+                doc = json.loads(r.read().decode())
+            assert len(doc["controller"]) == 1
+    finally:
+        obs_http.release()

@@ -555,6 +555,48 @@ class TestMultiProcessFleet:
         finally:
             sup.stop(timeout=30)
 
+    def test_crash_loop_backs_off_instead_of_burning_budget(self, tmp_path):
+        """A crash-looping member must not spend its whole maxRestarts
+        budget in milliseconds: the first respawn is immediate, repeat
+        respawns of the SAME member wait out an exponential backoff
+        (announced by a WARN fleet.worker.crash_loop event naming the
+        member and its delay)."""
+        from hyperspace_tpu.obs import events
+
+        marker = tmp_path / "attempts"
+        marker.mkdir()
+        sup = fleet.FleetSupervisor(
+            _crasher, fleet_dir=str(tmp_path / "fleet"), n=1,
+            args=(str(marker),), max_restarts=3, restart_backoff=0.4,
+        )
+        sup.start()
+        try:
+            deadline = time.monotonic() + 180
+            while time.monotonic() < deadline:
+                if sup.restarts().get(0, 0) >= 3 and sup.alive_count() == 0:
+                    break
+                time.sleep(0.2)
+            assert sup.restarts().get(0, 0) == 3
+        finally:
+            sup.stop(timeout=30)
+        loops = [e for e in events.recent() if e["name"] == "fleet.worker.crash_loop"]
+        restarted = [e for e in events.recent() if e["name"] == "fleet.worker.restarted"]
+        # respawns 2 and 3 each engaged a backoff window first
+        assert len(loops) == 2 and len(restarted) == 3
+        assert all(e["severity"] == "warn" for e in loops)
+        assert all(e["fields"]["worker_id"] == 0 for e in loops)
+        delays = [e["fields"]["delay_s"] for e in loops]
+        assert 0.4 <= delays[0] <= 0.5  # base x (1 + jitter<0.25)
+        assert 0.8 <= delays[1] <= 1.0  # base x 2 x (1 + jitter)
+        # the scheduled delay was actually waited out: the respawn event
+        # lands no earlier than crash_loop + delay
+        for loop in loops:
+            after = min(
+                (e for e in restarted if e["seq"] > loop["seq"]),
+                key=lambda e: e["seq"],
+            )
+            assert after["ts"] - loop["ts"] >= loop["fields"]["delay_s"] - 0.05
+
 
 def _lease_holder(sf_dir, name, ready_q):
     """Child: take the single-flight lease for `name` and hang until

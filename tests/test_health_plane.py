@@ -482,6 +482,58 @@ def test_healthz_standalone_server_without_session():
         doc = json.loads(body)
         assert doc["status"] == "ok"
         assert doc["indexes"] == {} and doc["scheduler"] == []
+        assert doc["controller"] == []  # none attached; the key is always there
+    finally:
+        hs.stop()
+
+
+def test_slo_page_recover_repage_reemits_through_healthz():
+    """Regression pin for the slo.burn re-arm contract driven end to end
+    through the health plane: a page that recovers and then re-fires
+    must emit a SECOND slo.burn event (the re-arm logic in
+    SLOTracker.evaluate), and /healthz must surface the current SLO and
+    controller verdicts while it happens."""
+    from hyperspace_tpu.serve.controller import OpsController
+
+    completed, failed, *_ = _serve_counters()
+    session = FakeSession()
+    session.conf.set("hyperspace.controller.enabled", "true")
+
+    class _Facade:
+        def __init__(self, s):
+            self.session = s
+
+    ctrl = OpsController(_Facade(session), clock=lambda: 0.0)
+    # page: a hard failure burst inside every window
+    completed.inc(10_000)
+    slo.sample(now=0.0)
+    slo.sample(now=4000.0)
+    failed.inc(3_000)
+    slo.sample(now=4030.0)
+    assert slo.evaluate(now=4030.0)["serve.availability"]["verdict"] == "page"
+    assert len([e for e in events.recent() if e["name"] == "slo.burn"]) == 1
+    # recover: clean traffic pushes the burst out of the page windows
+    completed.inc(80_000)
+    slo.sample(now=4100.0)
+    assert slo.evaluate(now=4100.0)["serve.availability"]["verdict"] != "page"
+    # re-page: a second burst must RE-EMIT (the re-arm contract)
+    failed.inc(9_000)
+    slo.sample(now=4130.0)
+    assert slo.evaluate(now=4130.0)["serve.availability"]["verdict"] == "page"
+    assert len([e for e in events.recent() if e["name"] == "slo.burn"]) == 2
+    # the controller sees the same verdict on its own clock, and
+    # /healthz surfaces its snapshot next to the SLO section (the scrape
+    # re-samples on the real clock, so only the controller view — which
+    # carries the verdict the controller last acted on — is pinned here)
+    ctrl.step(now=4131.0)
+    hs = obs_http.HealthServer().start()
+    try:
+        hs.attach_controller(ctrl)
+        code, body = _get(hs.url("/healthz"))
+        doc = json.loads(body)
+        assert doc["controller"][0]["mode"] == "actuate"
+        assert doc["controller"][0]["verdicts"]["serve.availability"] == "page"
+        assert "slo" in doc
     finally:
         hs.stop()
 
