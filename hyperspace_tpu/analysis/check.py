@@ -49,6 +49,20 @@ engine (analysis/program.py → callgraph.py → locks.py):
   O_EXCL leases on exchange/fleet paths), and cross-boundary
   fault/telemetry continuity. The inferred domain graph lands in the
   report's ``process_domains``.
+- **HSL023-026 trace domains** (analysis/tracedomain.py) — the
+  device-plane invariants over the inferred trace domain (the closure
+  of every function object handed to ``compat.jit``, ``shard_map``, or
+  a Pallas ``pallas_call``): traced-effect purity (no host effect
+  anywhere in a traced closure), signature-space boundedness (jit keys,
+  static arguments and pad widths derive from declared bounded domains
+  — ``compat.KNOWN_STATIC_DOMAINS``), donation/aliasing safety
+  (zero-copy staged views are never mutated or donated; callers go
+  through ``ColumnTable.own_arrays``), and kernel fallback-ladder
+  completeness (``ops.KNOWN_KERNELS``: every Pallas engagement proves
+  an exactness gate, a permanent per-shape fallback and its
+  ``device.kernel.*`` counters). The inferred trace graph, donation
+  proof and per-kernel ladder proofs land in the report's
+  ``trace_domains``.
 - **Validator corpus** — a small set of known-good / known-bad logical
   plans is pushed through the plan validator (analysis/validator.py) as
   a self-test; skipped (with a note) when numpy isn't installed, so the
@@ -92,6 +106,7 @@ from hyperspace_tpu.analysis.lint import (
 )
 from hyperspace_tpu.analysis.locks import LockGraph, resource_findings
 from hyperspace_tpu.analysis.procdomain import ProcessDomains
+from hyperspace_tpu.analysis.tracedomain import TraceDomains
 from hyperspace_tpu.analysis.program import Program, _index_module, _module_name
 from hyperspace_tpu.analysis.races import (
     atomicity_findings,
@@ -686,6 +701,8 @@ def run_check(
     findings.extend(unwind)
     domains = ProcessDomains(program, callgraph, raises_obj)
     findings.extend(domains.findings())
+    tdomains = TraceDomains(program, callgraph, raises_obj)
+    findings.extend(tdomains.findings())
     allowed = []
     kept = []
     for f in findings:
@@ -739,6 +756,19 @@ def run_check(
             "spawn_domain_modules": len(domains.domain_modules),
             "spawn_boundary_sites": len(domains.boundary_sites),
             "lease_acquire_sites": len(domains.lease_acquires),
+            # Trace-domain accounting (HSL023-026): same CI contract —
+            # a zero trace-entry count on the real repo would mean jit
+            # site detection silently broke.
+            "trace_entry_points": len({e.traced for e in tdomains.entries}),
+            "trace_domain_functions": len(tdomains.trace_fns),
+            "trace_kernels_proven": sum(
+                1 for lad in tdomains._kernel_ladders if lad["proven"]
+            ),
+            # The trace closure's own blind-spot accounting: traced
+            # bodies call mostly jnp/lax (external, unresolvable by
+            # design), so this ratio runs high — the bound pins it from
+            # drifting higher, like calls_unresolved_ratio above.
+            "trace_domain_unresolved_ratio": tdomains.unresolved_ratio(),
         },
         "validator_corpus": corpus,
         "lock_graph": lockgraph.to_json(),
@@ -749,6 +779,10 @@ def run_check(
         # (entries, task closure, domain modules, boundary sites, lease
         # reap proofs) — procdemo pins its exact shape in a golden.
         "process_domains": domains.to_json(),
+        # The HSL023-026 substrate: the inferred trace-domain graph
+        # (entries, traced closure, donation proof, per-kernel fallback
+        # ladders) — jitdemo pins its exact shape in a golden.
+        "trace_domains": tdomains.to_json(),
         # Informational (never gated): private functions no public entry
         # point reaches through the resolved call graph.
         "dead_symbols": dead,
@@ -762,7 +796,7 @@ def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m hyperspace_tpu.analysis.check",
         description="Unified static analysis: per-file lint (HSL001-HSL008), "
-                    "whole-program rules (HSL009-HSL022), validator corpus, "
+                    "whole-program rules (HSL009-HSL026), validator corpus, "
                     "findings baseline.",
     )
     ap.add_argument("paths", nargs="*", help="files/directories (default: the "

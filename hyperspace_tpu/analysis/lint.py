@@ -181,6 +181,18 @@ RULES: dict[str, RuleInfo] = {
         RuleInfo("HSL022", "cross-boundary-continuity",
                  "spawn entry point missing fault/trace continuity plumbing; undeclared spawn target or worker telemetry name",
                  scope="program"),
+        RuleInfo("HSL023", "traced-effect-purity",
+                 "host effect (config/stats/event/lock/file/clock/materialization) reachable inside the jit trace-domain closure",
+                 scope="program"),
+        RuleInfo("HSL024", "signature-space-boundedness",
+                 "jit key/static argument/pad width not derived from a declared bounded domain — recompile-storm risk",
+                 scope="program"),
+        RuleInfo("HSL025", "donation-aliasing-safety",
+                 "zero-copy staged view mutated or donated without own_arrays; donated buffer referenced after the jitted call",
+                 scope="program"),
+        RuleInfo("HSL026", "kernel-fallback-ladder",
+                 "Pallas engagement undeclared in ops.KNOWN_KERNELS or missing its exactness gate, permanent fallback, or device.kernel.* counters",
+                 scope="program"),
     )
 }
 

@@ -502,9 +502,23 @@ def _scan_handlers(fn: FunctionInfo, mod, raises: Raises) -> list[Finding]:
         )
     ]
 
-    def _report(line: int, msg: str) -> None:
-        if not _suppressed(mod, line, SWALLOWED):
-            findings.append(Finding(mod.path, line, 0, SWALLOWED, msg))
+    def _report(line: int, msg: str, types: set[str] = frozenset()) -> None:
+        if _suppressed(mod, line, SWALLOWED):
+            return
+        # Witness: the module defining each swallowed program-local
+        # exception type — `--changed` mode keeps the finding when the
+        # type's definition moves, not just when the handler does.
+        prog = raises.program
+        witness = tuple(dict.fromkeys(
+            [mod.path] + [
+                prog.modules[c.module].path
+                for c in prog.classes.values()
+                if c.name in types and c.module in prog.modules
+            ]
+        ))
+        findings.append(
+            Finding(mod.path, line, 0, SWALLOWED, msg, witness_paths=witness)
+        )
 
     for sub in ast.walk(fn.node):
         if not isinstance(sub, ast.Try):
@@ -538,6 +552,7 @@ def _scan_handlers(fn: FunctionInfo, mod, raises: Raises) -> list[Finding]:
                     f"writers get no cleanup (faults.py); handling it here "
                     f"lets a 'dead' process keep running; re-raise it, or "
                     f"`# noqa: HSL017` with the isolation argument",
+                    canon,
                 )
             elif "FaultError" in canon and not has_raise:
                 _report(
@@ -546,6 +561,7 @@ def _scan_handlers(fn: FunctionInfo, mod, raises: Raises) -> list[Finding]:
                     f"injected fault silently absorbed never reaches the "
                     f"retry layer or the crash sweep; let it propagate (or "
                     f"classify via is_retryable and re-raise the rest)",
+                    canon,
                 )
             elif body_is_pass and "Exception" in canon:
                 _report(
@@ -554,6 +570,7 @@ def _scan_handlers(fn: FunctionInfo, mod, raises: Raises) -> list[Finding]:
                     f"swallows every software failure — record it (counter / "
                     f"trace event / log) or narrow the type; a best-effort "
                     f"path still owes the operator a signal",
+                    canon,
                 )
             elif (
                 "OSError" in canon
@@ -573,6 +590,7 @@ def _scan_handlers(fn: FunctionInfo, mod, raises: Raises) -> list[Finding]:
                     f"too (corruption, missing files) — classify with "
                     f"exceptions.is_retryable and re-raise the non-retryable "
                     f"remainder (utils/retry.py does this for you)",
+                    canon,
                 )
     return findings
 
@@ -695,6 +713,10 @@ def unwind_findings(
                             f"error contract can reach it, so an injected "
                             f"crash here unwinds into nothing that repairs "
                             f"or surfaces it",
+                            witness_paths=tuple(dict.fromkeys(
+                                p for p in (mod.path, faults_path)
+                                if p is not None
+                            )),
                         ))
                 else:
                     site["via"] = f"{root} ({roots.get(root, '?')})"
@@ -783,6 +805,7 @@ def _balance_findings(program: Program) -> list[Finding]:
                 f"in between skews the count forever (a stuck in-flight "
                 f"gauge / leaked refcount); move the decrement into a "
                 f"try/finally around the raising region",
+                witness_paths=(mod.path,),
             ))
     return findings
 

@@ -32,6 +32,29 @@ from __future__ import annotations
 import functools
 import inspect
 
+#: The bounded signature-space registry (static-analysis rule HSL024,
+#: analysis/tracedomain.py). Every value that reaches a jit static
+#: argument must range over a declared bounded domain, or each new value
+#: mints a fresh compile — the static dual of the runtime
+#: ``jit.recompile_storm`` detector in obs/runtime.py. Keys are static
+#: argument / enum parameter names; values describe the domain (a tuple
+#: enumerates it exactly). AST-extracted by the analyzer like
+#: ``faults.KNOWN_POINTS`` — keep it a plain literal of constants.
+KNOWN_STATIC_DOMAINS = {
+    # jit static argument names (bounded by construction at their sites)
+    "cap": "pow2-rounded expansion capacity (join_expand)",
+    "m_pad": "pow2-rounded pair-buffer length (join _compact_pairs)",
+    "shift": "bit width from pack_shift — at most 64",
+    "num_segments": "tile-rounded group count (aggregate/join_agg)",
+    "channels": "per-spec channel count — bounded by the plan",
+    "fns": "reduction-kind tuple drawn from the AggSpec vocabulary",
+    "iters": "Lloyd iteration count — a config-bounded small int",
+    # enum parameters that select a compiled variant
+    "venue": ("auto", "device", "host"),
+    "fused": ("auto", "off"),
+    "impl": ("auto", "pallas", "lax"),
+}
+
 
 def _resolve_shard_map():
     import jax

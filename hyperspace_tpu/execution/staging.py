@@ -102,8 +102,13 @@ def _buffer_view(arr, np_dtype) -> np.ndarray | None:
     if byte_off + len(arr) * dt.itemsize > data.size:
         return None
     out = np.frombuffer(data, dtype=dt, count=len(arr), offset=byte_off)
-    # np.frombuffer over an immutable Arrow buffer is already read-only;
-    # the view holds `data`, so the Arrow allocation outlives the array.
+    # Freeze unconditionally: frombuffer over an IMMUTABLE Arrow buffer
+    # (the parquet path) is already read-only, but a buffer wrapping a
+    # caller's live numpy array (in-memory pa.table) stays writable —
+    # and a writable staged view would let query code corrupt the shared
+    # Arrow allocation. The view holds `data`, so the Arrow allocation
+    # outlives the array either way.
+    out.flags.writeable = False
     return out
 
 

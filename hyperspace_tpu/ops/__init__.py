@@ -1,3 +1,22 @@
 from hyperspace_tpu.ops.hashing import bucket_ids, combine_hashes, hash_int_column, string_dict_hashes
 
-__all__ = ["bucket_ids", "combine_hashes", "hash_int_column", "string_dict_hashes"]
+#: Every Pallas kernel the package ships, by its jit call-site key
+#: (static-analysis rule HSL026, analysis/tracedomain.py — the mirror
+#: of ``faults.KNOWN_POINTS``). Each declared kernel's engagement chain
+#: must statically carry the full fallback ladder: an exactness gate, a
+#: permanent per-shape bad-set fallback, and both ``device.kernel.*``
+#: counters. Undeclared engagements and stale entries are findings, so
+#: this tuple is provably the complete kernel inventory.
+KNOWN_KERNELS = (
+    "ops.aggregate.pallas_segment_reduce",
+    "ops.sortkeys.pallas_run_bounds",
+    "ops.topk.pallas_tile",
+)
+
+__all__ = [
+    "KNOWN_KERNELS",
+    "bucket_ids",
+    "combine_hashes",
+    "hash_int_column",
+    "string_dict_hashes",
+]

@@ -35,6 +35,7 @@ import functools
 
 import numpy as np
 
+from hyperspace_tpu import stats
 from hyperspace_tpu.exceptions import HyperspaceError
 
 
@@ -267,10 +268,13 @@ def pallas_run_bounds(pk, sk):
             return None
     try:
         run = _make_run_bounds_kernel(_RB_TILE, ls, interpret)
-        return run(pk, sk)
+        out = run(pk, sk)
+        stats.increment("device.kernel.fused")
+        return out
     except Exception:  # noqa: BLE001 — fall back to the lax searchsorted
         with _pallas_rb_bad_lock:
             _pallas_rb_bad.add((_RB_TILE, ls))
+        stats.increment("device.kernel.fallbacks")
         return None
 
 

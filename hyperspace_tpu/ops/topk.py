@@ -21,6 +21,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from hyperspace_tpu import stats
+
 _TILE = 2048
 _MAX_PALLAS_K = 64
 
@@ -128,11 +130,13 @@ def topk(scores, k: int, impl: str = "auto") -> tuple[np.ndarray, np.ndarray]:
     if use_pallas:
         try:
             v, i = _pallas_topk(scores, k)
+            stats.increment("device.kernel.fused")
             return np.asarray(v), np.asarray(i)
         except Exception:  # noqa: BLE001 — fall back to the XLA path
             if impl == "pallas":
                 raise
             with _pallas_bad_lock:
                 _pallas_bad.add((k, tile))
+            stats.increment("device.kernel.fallbacks")
     v, i = jax.lax.top_k(scores, k)
     return np.asarray(v), np.asarray(i)

@@ -1,0 +1,88 @@
+"""Two Pallas engagements with fallback ladders: ``tile_reduce`` is
+complete (gate + permanent per-shape fallback + both counters — the
+proven ladder), ``rowmax`` has no *bad* set, so a retryable lowering
+failure re-engages Pallas forever (planted HSL026)."""
+
+import functools
+import threading
+
+import jax.numpy as jnp
+
+from jitdemo.shims import jit, resolve_pallas, stats
+
+# Both engagements declared, both counters declared — the registries
+# the HSL026 checks read (AST-extracted, like the real ops/stats ones).
+KNOWN_KERNELS = (
+    "jitdemo.tile_reduce",
+    "jitdemo.rowmax",
+)
+KNOWN_COUNTERS = (
+    "device.kernel.fused",
+    "device.kernel.fallbacks",
+)
+
+_TILE = 128
+_MAX_TILE = 4096
+
+# (n,) shapes whose lowering failed: permanent fallback, lock-guarded.
+_bad_shapes: set = set()
+_bad_lock = threading.Lock()
+
+
+def _next_mult(n, m):
+    return ((n + m - 1) // m) * m
+
+
+@functools.lru_cache(maxsize=8)
+def _make_tile_reduce(n):
+    pl = resolve_pallas()
+
+    def kernel(x_ref, o_ref):
+        o_ref[...] = jnp.sum(x_ref[...], axis=1)
+
+    def run(x):
+        return pl.pallas_call(kernel, grid=(n // _TILE,))(x)
+
+    return jit(run, key="jitdemo.tile_reduce")
+
+
+def tile_reduce(x):
+    n = x.shape[1]
+    m = _next_mult(n, _TILE)
+    if n <= _MAX_TILE and (n,) not in _bad_shapes:
+        try:
+            run = _make_tile_reduce(m)
+            out = run(jnp.pad(x, ((0, 0), (0, m - n))))
+            stats.increment("device.kernel.fused")
+            return out
+        except Exception:
+            with _bad_lock:
+                _bad_shapes.add((n,))
+            stats.increment("device.kernel.fallbacks")
+    return jnp.sum(x, axis=1)
+
+
+@functools.lru_cache(maxsize=8)
+def _make_rowmax(n):
+    pl = resolve_pallas()
+
+    def kernel(x_ref, o_ref):
+        o_ref[...] = jnp.max(x_ref[...], axis=1)
+
+    def run(x):
+        return pl.pallas_call(kernel, grid=(n // _TILE,))(x)
+
+    return jit(run, key="jitdemo.rowmax")
+
+
+def rowmax(x):
+    n = x.shape[1]
+    if n <= _MAX_TILE:
+        try:
+            run = _make_rowmax(n)
+            out = run(x)
+            stats.increment("device.kernel.fused")
+            return out
+        except Exception:
+            stats.increment("device.kernel.fallbacks")
+    return jnp.max(x, axis=1)

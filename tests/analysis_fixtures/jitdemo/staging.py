@@ -1,0 +1,42 @@
+"""Zero-copy staging mini-plane: writeable=False staged views, the
+``own_arrays`` ownership gateway, and one planted in-place mutation of
+an aliased view (HSL025)."""
+
+import numpy as np
+
+
+def stage_column(buf):
+    arr = np.frombuffer(buf, dtype=np.int64)
+    arr.flags.writeable = False
+    return arr
+
+
+class ColumnTable:
+    def __init__(self, columns):
+        self.columns = columns
+
+    @classmethod
+    def from_arrow(cls, table, zero_copy_ok=False):
+        cols = {}
+        for name, buf in table.items():
+            arr = stage_column(buf)
+            cols[name] = arr
+        return cls(cols)
+
+    def own_arrays(self):
+        self.columns = {n: np.array(a) for n, a in self.columns.items()}
+        return self
+
+
+def read_owned(table):
+    t = ColumnTable.from_arrow(table, zero_copy_ok=True)
+    t.own_arrays()
+    t.columns["a"][0] = -1
+    return t
+
+
+def read_aliased(table):
+    t = ColumnTable.from_arrow(table, zero_copy_ok=True)
+    # Planted HSL025: the staged view still aliases the Arrow buffer.
+    t.columns["a"][0] = -1
+    return t

@@ -1,0 +1,64 @@
+"""Traced entry points: decorator-form jit (bare and partial), a
+shard_map body inside a bounded factory, and the two signature/purity
+plants — ``leaky_norm`` reaches a counter bump through its closure
+(HSL023) and ``poly`` declares an undeclared static domain (HSL024)."""
+
+import functools
+
+import jax.numpy as jnp
+
+from jitdemo.shims import Mesh, jit, shard_map, stats
+
+
+@functools.partial(jit, static_argnames=("reps",))
+def scale(x, reps):
+    # "reps" is a declared bounded domain (shims.KNOWN_STATIC_DOMAINS).
+    for _ in range(reps):
+        x = x * 1.1
+    return x
+
+
+@functools.partial(jit, static_argnames=("order",))
+def poly(x, order):
+    # Planted HSL024: "order" is not a declared static domain — every
+    # new order value mints a fresh compile signature.
+    out = x
+    for _ in range(order):
+        out = out * x
+    return out
+
+
+def _total(x):
+    # Planted HSL023: a host effect two hops inside the trace domain
+    # (leaky_norm -> _total). The fix is `engage` below.
+    stats.increment("device.kernel.fused")
+    return jnp.sum(x)
+
+
+@jit
+def leaky_norm(x):
+    return _total(x) / x.size
+
+
+@jit
+def norm(x):
+    return x / jnp.sum(x)
+
+
+def engage(x):
+    # Clean counterpart: the effect lives at the engagement site, on
+    # the host side of the trace boundary.
+    out = norm(x)
+    stats.increment("device.kernel.fused")
+    return out
+
+
+@functools.lru_cache(maxsize=4)
+def make_exchange(axis):
+    mesh = Mesh(("x",))
+
+    @functools.partial(shard_map, mesh=mesh)
+    def fn(block):
+        return block - jnp.mean(block)
+
+    return jit(fn, key="jitdemo.exchange")

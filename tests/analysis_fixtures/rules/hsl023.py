@@ -1,0 +1,41 @@
+"""HSL023 traced-effect purity: host effects reachable through the
+trace-domain closure. The effects live in helpers the jitted entry
+points call — lexically outside any jit, so the per-file HSL002 check
+cannot see them; only the whole-program closure walk does."""
+
+import time
+
+import jax.numpy as jnp
+
+from hyperspace_tpu import stats
+from hyperspace_tpu.compat import jit
+
+
+def _tally(x):
+    stats.increment("device.kernel.fused")  # expect: HSL023
+    return jnp.sum(x)
+
+
+def _stamp(x):
+    t = time.time()  # expect: HSL023
+    return x * t
+
+
+def _scale(x):
+    # Clean traced helper: pure array math only.
+    return x * 2.0
+
+
+@jit
+def bad_norm(x):
+    return _tally(x) / x.size
+
+
+@jit
+def bad_stamped(x):
+    return _stamp(x)
+
+
+@jit
+def good_norm(x):
+    return _scale(x) / x.size

@@ -1,0 +1,69 @@
+"""HSL024 signature-space boundedness: every leg of the rule — a
+non-literal jit key, an unbounded jit factory, an undeclared static
+argument, a stale registry entry, and an unrounded pad width — next to
+its clean counterpart."""
+
+import functools
+
+import jax.numpy as jnp
+
+from hyperspace_tpu.compat import jit
+
+KNOWN_STATIC_DOMAINS = {  # expect: HSL024
+    "m_pad": "tile-rounded pad target",
+    "knob": "stale: no jit site uses it and no function takes it",
+}
+
+
+def _next_mult(n, m):
+    return ((n + m - 1) // m) * m
+
+
+@functools.partial(jit, static_argnames=("m_pad",))
+def pad_to(x, m_pad):
+    # Clean: "m_pad" is a declared bounded domain.
+    return jnp.pad(x, (0, m_pad - x.shape[0]))
+
+
+@functools.partial(jit, static_argnames=("order",))
+def poly(x, order):  # expect: HSL024
+    return x ** order
+
+
+@functools.lru_cache(maxsize=8)
+def make_scaler(c):
+    def run(x):
+        return x * c
+
+    # Non-literal key: every c mints a key the storm detector cannot
+    # group.
+    return jit(run, key=f"scale.{c}")  # expect: HSL024
+
+
+@functools.lru_cache(maxsize=None)
+def make_shifter(s):
+    def run(x):
+        return x + s
+
+    # The factory cache itself is unbounded, so the set of live jit
+    # callables is too.
+    return jit(run, key="corpus.shift")  # expect: HSL024
+
+
+@functools.lru_cache(maxsize=16)
+def make_clean(c):
+    def run(x):
+        return x - c
+
+    return jit(run, key="corpus.clean")
+
+
+def pad_raw(x):
+    n = x.shape[0]
+    return jnp.pad(x, (0, 2 * n))  # expect: HSL024
+
+
+def pad_rounded(x):
+    n = x.shape[0]
+    m = _next_mult(n, 8)
+    return jnp.pad(x, (0, m - n))

@@ -1,0 +1,66 @@
+"""HSL025 donation/aliasing safety: mutating a zero-copy staged view
+without the own_arrays gateway, donating a staged view, and touching a
+buffer after donating it — each next to its clean counterpart."""
+
+import functools
+
+import numpy as np
+
+from hyperspace_tpu.compat import jit
+
+
+def stage_column(buf):
+    arr = np.frombuffer(buf, dtype=np.int64)
+    arr.flags.writeable = False
+    return arr
+
+
+class ColumnTable:
+    def __init__(self, columns):
+        self.columns = columns
+
+    @classmethod
+    def from_arrow(cls, table, zero_copy_ok=False):
+        cols = {}
+        for name, buf in table.items():
+            arr = stage_column(buf)
+            cols[name] = arr
+        return cls(cols)
+
+    def own_arrays(self):
+        self.columns = {n: np.array(a) for n, a in self.columns.items()}
+        return self
+
+
+@functools.partial(jit, donate_argnums=(0,))
+def scrub(x):
+    return x * 0
+
+
+def mutate_aliased(table):
+    t = ColumnTable.from_arrow(table, zero_copy_ok=True)
+    t.columns["a"][0] = -1  # expect: HSL025
+    return t
+
+
+def mutate_owned(table):
+    t = ColumnTable.from_arrow(table, zero_copy_ok=True)
+    t.own_arrays()
+    t.columns["a"][0] = -1
+    return t
+
+
+def donate_staged(buf):
+    col = stage_column(buf)
+    return scrub(col)  # expect: HSL025
+
+
+def reuse_after_donate(buf):
+    x = np.ascontiguousarray(buf)
+    y = scrub(x)  # expect: HSL025
+    return y, x
+
+
+def donate_fresh(buf):
+    x = np.ascontiguousarray(buf)
+    return scrub(x)

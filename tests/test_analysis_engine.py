@@ -41,6 +41,10 @@ from hyperspace_tpu.analysis.procdomain import (
     module_level_imports,
 )
 from hyperspace_tpu.analysis.program import Program, _index_module, _module_name
+from hyperspace_tpu.analysis.tracedomain import (
+    TraceDomains,
+    declared_static_domains,
+)
 from hyperspace_tpu.analysis.races import (
     RACE_ALLOWLIST,
     atomicity_findings,
@@ -202,6 +206,7 @@ def _corpus_findings(path: pathlib.Path) -> set[tuple[int, str]]:
     findings += swallowed_findings(program, raises_obj)
     findings += unwind_findings(program, callgraph, raises_obj, contracts)[0]
     findings += ProcessDomains(program, callgraph, raises_obj).findings()
+    findings += TraceDomains(program, callgraph, raises_obj).findings()
     return {(f.line, f.rule) for f in findings}
 
 
@@ -453,6 +458,109 @@ class TestProcdemo:
             s.target == "procdemo.workers.shard_body"
             for s in domains.boundary_sites if s.kind == "submit"
         )
+
+
+# -- jitdemo fixture package (trace domains + HSL023-026) ---------------------
+
+@pytest.fixture(scope="module")
+def jitdemo():
+    program = Program.load([FIXTURES / "jitdemo"])
+    callgraph = CallGraph(program)
+    raises_obj = Raises(program, callgraph)
+    return program, callgraph, TraceDomains(program, callgraph, raises_obj)
+
+
+class TestJitdemo:
+    def test_trace_graph_matches_golden(self, jitdemo):
+        _, _, tdomains = jitdemo
+        golden = json.loads((FIXTURES / "goldens" / "jitdemo_trace.json").read_text())
+        assert json.loads(json.dumps(tdomains.to_json())) == golden
+
+    def test_exactly_four_planted_findings(self, jitdemo):
+        _, _, tdomains = jitdemo
+        rules = sorted(f.rule for f in tdomains.findings())
+        assert rules == ["HSL023", "HSL024", "HSL025", "HSL026"]
+
+    def test_hsl023_witness_follows_the_closure(self, jitdemo):
+        # The effect is two hops from the entry: leaky_norm -> _total.
+        # HSL002 (lexical) cannot see it; the closure walk must, and
+        # the finding must carry the chain.
+        _, _, tdomains = jitdemo
+        (f,) = [f for f in tdomains.findings() if f.rule == "HSL023"]
+        assert f.path.endswith("traced.py")
+        assert "stats counter increment" in f.message
+        assert "jitdemo.traced.leaky_norm -> jitdemo.traced._total" in f.message
+        assert any(p.endswith("traced.py") for p in f.witness_paths)
+
+    def test_hsl023_engage_counterpart_stays_clean(self, jitdemo):
+        # norm/engage hoists the same counter bump to the engagement
+        # site — the proof is not vacuous.
+        _, _, tdomains = jitdemo
+        assert "jitdemo.traced.norm" in tdomains.trace_fns
+        hits = [f for f in tdomains.findings() if f.rule == "HSL023"]
+        assert len(hits) == 1
+        assert all("jitdemo.traced.engage" not in f.message for f in hits)
+
+    def test_hsl024_names_the_undeclared_static(self, jitdemo):
+        # "order" is undeclared; "reps" (declared) stays clean.
+        _, _, tdomains = jitdemo
+        (f,) = [f for f in tdomains.findings() if f.rule == "HSL024"]
+        assert "'order'" in f.message and "jitdemo.traced.poly" in f.message
+        assert "reps" not in f.message
+
+    def test_hsl025_mutation_names_the_gateway(self, jitdemo):
+        # read_aliased mutates the staged view; read_owned (through
+        # own_arrays) stays clean.
+        _, _, tdomains = jitdemo
+        (f,) = [f for f in tdomains.findings() if f.rule == "HSL025"]
+        assert f.path.endswith("staging.py")
+        assert "read_aliased" in f.message and "own_arrays" in f.message
+        assert "read_owned" not in f.message
+
+    def test_hsl026_flags_only_the_ladder_hole(self, jitdemo):
+        # rowmax is missing exactly the permanent fallback; everything
+        # else on its ladder (gate, both counters) is present, and
+        # tile_reduce's complete ladder is proven.
+        _, _, tdomains = jitdemo
+        (f,) = [f for f in tdomains.findings() if f.rule == "HSL026"]
+        assert "'jitdemo.rowmax'" in f.message
+        assert "permanent per-shape fallback" in f.message
+        assert "gate" not in f.message.split("missing", 1)[1]
+        by_kernel = {lad["kernel"]: lad for lad in tdomains._kernel_ladders}
+        assert by_kernel["jitdemo.tile_reduce"]["proven"] is True
+        assert by_kernel["jitdemo.rowmax"]["proven"] is False
+        assert by_kernel["jitdemo.tile_reduce"]["witness"] == [
+            "jitdemo.device.tile_reduce", "jitdemo.device._make_tile_reduce",
+        ]
+
+    def test_entry_forms_and_kind_merge(self, jitdemo):
+        # All entry shapes detected: bare @jit, partial(jit, ...),
+        # call-form jit in factories, the shard_map body (which is also
+        # the jit call-form target: kinds merge), and Pallas kernels.
+        _, _, tdomains = jitdemo
+        entries = json.loads(json.dumps(tdomains.to_json()))["entries"]
+        assert entries["jitdemo.traced.make_exchange.<locals>.fn"]["kinds"] == [
+            "jit", "shard_map",
+        ]
+        assert entries["jitdemo.traced.make_exchange.<locals>.fn"]["key"] == (
+            "jitdemo.exchange"
+        )
+        kinds = {k for e in entries.values() for k in e["kinds"]}
+        assert kinds == {"jit", "shard_map", "pallas_kernel"}
+
+    def test_donation_proof_records_the_gateway_witness(self, jitdemo):
+        _, _, tdomains = jitdemo
+        proof = json.loads(json.dumps(tdomains.to_json()))["donation_proof"]
+        assert proof["donation_sites"] == []
+        # the planted mutation flips the proof off for the fixture
+        assert proof["proven"] is False
+        owned = [p for p in proof["staged_view_producers"]
+                 if p["fn"].endswith("read_owned")]
+        assert owned[0]["ownership_witness"] == ["jitdemo.staging.read_owned"]
+
+    def test_static_domain_registry_extracted(self, jitdemo):
+        program, _, _ = jitdemo
+        assert declared_static_domains(program) == {"reps", "n"}
 
 
 # -- repo-wide guarantees (what the CI gate asserts) --------------------------
@@ -733,6 +841,12 @@ def repo_domains(repo_program, repo_raises):
     return ProcessDomains(program, callgraph, repo_raises)
 
 
+@pytest.fixture(scope="module")
+def repo_tdomains(repo_program, repo_raises):
+    program, callgraph = repo_program
+    return TraceDomains(program, callgraph, repo_raises)
+
+
 class TestRepoProcessDomains:
     def test_spawn_domain_is_jax_pure_at_module_level(self, repo_domains):
         """The acceptance proof: every module a spawned worker imports
@@ -828,6 +942,111 @@ class TestRepoProcessDomains:
                     if attr in ("span", "trace"):
                         emitted.add(node.args[0].value)
         assert emitted == set(KNOWN_WORKER_SPANS)
+
+    def test_trace_domain_is_pure(self, repo_tdomains):
+        """The acceptance proof for the device plane: the dispatch-
+        augmented closure of every jit/shard_map/Pallas entry in the
+        repo is host-effect-free, signature-bounded, donation-safe, and
+        ladder-complete — zero HSL023-026 findings."""
+        assert repo_tdomains.findings() == []
+
+    def test_traced_helper_closure_found(self, repo_tdomains):
+        # The fused device paths are in the domain with entry-rooted
+        # witness chains — the closure is not vacuous.
+        fns = repo_tdomains.trace_fns
+        for q in (
+            "hyperspace_tpu.ops.aggregate._segment_reduce_many",
+            "hyperspace_tpu.ops.join._fused_join",
+            "hyperspace_tpu.ops.join_agg._fused_join_agg_bounds",
+            "hyperspace_tpu.ops.kmeans._lloyd",
+            "hyperspace_tpu.plan.expr.evaluate",
+        ):
+            assert q in fns, q
+        # expression evaluation enters through the filter kernels
+        chain = fns["hyperspace_tpu.plan.expr.evaluate"]
+        assert chain[0].startswith("hyperspace_tpu.ops.filter.")
+
+    def test_every_pallas_ladder_is_proven(self, repo_tdomains):
+        """All three Pallas kernels carry the complete fallback ladder:
+        eligibility gate, permanent per-shape *bad* set, and both
+        device.kernel.* counters, with the engagement chain from the
+        public op down to the factory."""
+        ladders = {lad["kernel"]: lad for lad in repo_tdomains._kernel_ladders}
+        assert set(ladders) == {
+            "ops.aggregate.pallas_segment_reduce",
+            "ops.sortkeys.pallas_run_bounds",
+            "ops.topk.pallas_tile",
+        }
+        for name, lad in ladders.items():
+            assert lad["proven"], name
+            assert lad["gate"] and lad["bad_set"], name
+            assert set(lad["counters"]) == {
+                "device.kernel.fused", "device.kernel.fallbacks",
+            }, name
+        assert ladders["ops.topk.pallas_tile"]["witness"] == [
+            "hyperspace_tpu.ops.topk.topk",
+            "hyperspace_tpu.ops.topk._pallas_topk",
+            "hyperspace_tpu.ops.topk._make_tile_kernel",
+        ]
+        assert ladders["ops.aggregate.pallas_segment_reduce"]["witness"][0] == (
+            "hyperspace_tpu.ops.aggregate.aggregate_table"
+        )
+
+    def test_known_kernels_registry_is_fresh(self, repo_tdomains):
+        # Same both-directions contract as faults.KNOWN_POINTS: every
+        # engagement declared, every declared entry live.
+        assert repo_tdomains.known_kernels == {
+            lad["kernel"] for lad in repo_tdomains._kernel_ladders
+        }
+
+    def test_donation_proof_is_gated_not_vacuous(self, repo_tdomains):
+        """No donation anywhere today (that IS the HSL025 proof the
+        ROADMAP's donated-buffer plans will build on), while the staging
+        producer and the own_arrays gateway are both found."""
+        proof = json.loads(json.dumps(repo_tdomains.to_json()))["donation_proof"]
+        assert proof["donation_sites"] == []
+        assert proof["proven"] is True
+        (producer,) = proof["staged_view_producers"]
+        assert producer["fn"] == (
+            "hyperspace_tpu.execution.table.ColumnTable.from_arrow"
+        )
+        assert any(
+            g["fn"] == "hyperspace_tpu.execution.io.read_parquet_cached"
+            for g in proof["own_arrays_gateways"]
+        )
+
+    def test_trace_unresolved_accounting_and_bound(self, repo_tdomains):
+        """trace_domain.unresolved_ratio is recorded in the summary and
+        bounded: traced bodies call mostly jax APIs the grounded
+        resolver deliberately rejects (~0.85 today), but a jump past
+        the bound means closure edges are silently vanishing."""
+        report = run_check(default_paths(REPO_ROOT), REPO_ROOT, [TESTS_DIR])
+        s = report["summary"]
+        assert s["trace_entry_points"] >= 25
+        assert s["trace_domain_functions"] >= 15
+        assert s["trace_kernels_proven"] == 3
+        assert 0.0 < s["trace_domain_unresolved_ratio"] < 0.9
+        assert s["trace_domain_unresolved_ratio"] == repo_tdomains.unresolved_ratio()
+        assert repo_tdomains.unresolved_ratio() == round(
+            repo_tdomains.trace_calls_unresolved / repo_tdomains.trace_calls_total, 4
+        )
+
+    def test_static_domains_cover_the_device_plane(self, repo_program, repo_tdomains):
+        from hyperspace_tpu.analysis.tracedomain import _lru_bound
+
+        program, _ = repo_program
+        declared = declared_static_domains(program)
+        assert declared is not None and {"fns", "num_segments"} <= declared
+        # every static argument outside a bounded lru factory (whose
+        # memo key already bounds it) comes from the declared registry
+        for e in repo_tdomains.entries:
+            if e.kind == "pallas_kernel" or not e.static_names:
+                continue
+            host = program.functions[e.host]
+            if _lru_bound(host.node) == "bounded":
+                continue
+            for n in e.static_names:
+                assert n in declared, (e.traced, n)
 
     def test_module_level_imports_skip_deferred_and_type_checking(self):
         src = (
@@ -988,10 +1207,11 @@ class TestCheckCli:
         sarif = json.loads(out.read_text())
         _validate_sarif_required(sarif)
         fired = {r["ruleId"] for r in sarif["runs"][0]["results"]}
-        # old rules, the exception-flow rules, and the process-domain
-        # rules all appear
+        # old rules, the exception-flow rules, the process-domain rules,
+        # and the trace-domain rules all appear
         assert {"HSL001", "HSL011", "HSL013", "HSL016", "HSL017", "HSL018",
-                "HSL019", "HSL020", "HSL021", "HSL022"} <= fired
+                "HSL019", "HSL020", "HSL021", "HSL022",
+                "HSL023", "HSL024", "HSL025", "HSL026"} <= fired
 
     def test_sarif_required_properties_on_clean_run(self, tmp_path):
         clean = tmp_path / "clean.py"

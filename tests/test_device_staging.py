@@ -277,6 +277,88 @@ def test_bool_and_multichunk_columns_take_copy_path():
     np.testing.assert_array_equal(ct2.columns["a"], np.arange(10))
 
 
+# -- staged-view immutability (the HSL025 runtime mirror) ---------------------
+#
+# The static rule (analysis/tracedomain.py HSL025) proves no code path
+# mutates or donates a writeable=False staged view; these tests pin the
+# runtime half of the same contract: the views really are read-only (a
+# mutation attempt raises rather than corrupting the Arrow buffer), and
+# own_arrays() is the one sanctioned way to writable arrays.
+
+def test_mutating_zero_copy_staged_view_raises():
+    t = pa.table({"a": np.arange(1000, dtype=np.int64)})
+    ct = ColumnTable.from_arrow(t, zero_copy_ok=True)
+    assert not ct.columns["a"].flags.writeable
+    with pytest.raises(ValueError):
+        ct.columns["a"][0] = -1
+    # the Arrow buffer is untouched
+    assert t.column("a")[0].as_py() == 0
+
+
+def test_date32_and_timestamp_views_are_read_only():
+    # These stage through Arrow's zero-copy .view() reinterpretation
+    # (date32→int32 days, timestamp[us]→int64 micros) — the re-viewed
+    # arrays must carry the same read-only contract as direct views.
+    t = pa.table(
+        {
+            "d": pa.array([0, 1, 20000], type=pa.date32()),
+            "ts": pa.array([0, 1_000_000, 2_000_000], type=pa.timestamp("us")),
+        }
+    )
+    ct = ColumnTable.from_arrow(t, zero_copy_ok=True)
+    assert ct.columns["d"].dtype == np.int32
+    assert ct.columns["ts"].dtype == np.int64
+    np.testing.assert_array_equal(ct.columns["d"], [0, 1, 20000])
+    np.testing.assert_array_equal(ct.columns["ts"], [0, 1_000_000, 2_000_000])
+    for name in ("d", "ts"):
+        assert not ct.columns[name].flags.writeable, name
+        with pytest.raises(ValueError):
+            ct.columns[name][0] = 7
+
+
+def test_every_zero_copy_column_is_read_only():
+    """Whatever the staging layer kept as a view (counted in
+    bytes_zero_copy) must be non-writeable — a writable view would let
+    query code corrupt the shared Arrow buffer silently."""
+    t = pa.table(
+        {
+            "i64": np.arange(500, dtype=np.int64),
+            "f32": np.arange(500, dtype=np.float32),
+            "i32": np.arange(500, dtype=np.int32),
+            "d": pa.array(list(range(500)), type=pa.date32()),
+            "ts": pa.array([i * 1000 for i in range(500)], type=pa.timestamp("us")),
+            "nullable": pa.array(
+                [None if i % 5 == 0 else i for i in range(500)], type=pa.int64()
+            ),
+        }
+    )
+    before = stats.get("device.stage.bytes_zero_copy")
+    ct = ColumnTable.from_arrow(t, zero_copy_ok=True)
+    staged = stats.get("device.stage.bytes_zero_copy") - before
+    assert staged == 500 * (8 + 4 + 4 + 4 + 8)  # every eligible column viewed
+    for name in ("i64", "f32", "i32", "d", "ts"):
+        assert not ct.columns[name].flags.writeable, name
+    # the nullable column took the copy path and stays writable
+    assert ct.columns["nullable"].flags.writeable
+
+
+def test_own_arrays_is_the_writable_gateway():
+    t = pa.table({"a": np.arange(1000, dtype=np.int64)})
+    ct = ColumnTable.from_arrow(t, zero_copy_ok=True)
+    view = ct.columns["a"]
+    assert not view.flags.writeable
+    before_cp = stats.get("device.stage.bytes_copied")
+    ct.own_arrays()
+    # downgraded to an owned writable copy, accounted to the counters
+    assert ct.columns["a"].flags.writeable
+    assert ct.columns["a"] is not view
+    assert stats.get("device.stage.bytes_copied") - before_cp == view.nbytes
+    ct.columns["a"][0] = -1  # now legal
+    assert ct.columns["a"][0] == -1
+    # the original staged view and its Arrow buffer are untouched
+    assert view[0] == 0 and t.column("a")[0].as_py() == 0
+
+
 # -- dict-coded footprint accounting (RefCache satellite) --------------------
 
 def test_dict_footprint_counts_codes_plus_dictionary():
