@@ -33,6 +33,26 @@ sequence:
    the bound — the crash-loop backoff satellite keeps repeat crashes
    from burning the restart budget in milliseconds.
 
+`--fleet N` (N >= 2) adds the fleet-coordination regime on top — a
+real FleetSupervisor handle on the controller plus a SECOND live
+controller over the SAME store — and three more episodes:
+
+5. **brownout** — `faults.inject("bucket.read", delay_s=...)` makes
+   every read SLOW instead of failed: the tightened latency objective
+   pages, the controller sheds AND grows the member count through
+   `FleetSupervisor.set_target_workers` (sustained queue saturation);
+   the delay clears, overrides release, and the fleet scales back to
+   its pre-episode baseline — with bounded completed-query p99 and
+   zero untyped errors throughout.
+6. **fleet_heal_two_controllers** — the corruption episode under TWO
+   live controllers: the per-index single-flight lease must yield
+   exactly ONE executed heal fleet-wide while the other member audits
+   `outcome="observed"` and lifts its local quarantine via the
+   idempotent recover().
+7. **sigkill_mid_heal_takeover** — a phantom healer dies (SIGKILL)
+   holding the heal lease: the surviving controller reaps it after the
+   TTL (`fleet.singleflight.takeovers`) and completes the heal.
+
 Determinism: the controller and the SLO tracker run on a VIRTUAL clock
 advanced a fixed 5 s per tick (burn windows are clamped spans over the
 sample ring, so compressed time keeps the multi-window math exact while
@@ -47,6 +67,7 @@ job); gates are ALWAYS enforced — exit 1 on any failure.
 from __future__ import annotations
 
 import json
+import os
 import shutil
 import sys
 import tempfile
@@ -95,9 +116,10 @@ class SoakBench:
 
     INDEX = "soak_idx"
 
-    def __init__(self, tmp: Path, smoke: bool):
+    def __init__(self, tmp: Path, smoke: bool, fleet_n: int = 0):
         self.tmp = tmp
         self.smoke = smoke
+        self.fleet_n = fleet_n  # >= 2 switches on the fleet regime
         self.rows = 8_000 if smoke else 32_000
         self.clock = VirtualClock()
         self.errors_typed: dict[str, int] = {}
@@ -105,6 +127,7 @@ class SoakBench:
         self.completed_lat: list[float] = []
         self.queries = 0
         self._key = 0
+        self.sup = None
 
     # -- setup ------------------------------------------------------------
     def build(self):
@@ -129,13 +152,28 @@ class SoakBench:
             max_queue_depth=64,
             quotas=TenantQuotas(rate=10_000.0, burst=10_000.0),
         )
-        self.ctrl = self.hs.controller(server=self.server, clock=lambda: self.clock.t)
+        if self.fleet_n >= 2:
+            # The scale actuator's real fleet handle (separate dir from
+            # the SIGKILL episode's throwaway supervisor).
+            from hyperspace_tpu.serve.fleet.supervisor import FleetSupervisor
+
+            self.sup = FleetSupervisor(
+                _soak_fleet_worker, fleet_dir=str(self.tmp / "fleet-scale"),
+                n=self.fleet_n, max_restarts=6,
+            )
+            self.sup.start()
+        self.ctrl = self.hs.controller(
+            server=self.server, clock=lambda: self.clock.t,
+            member_id="member-0", supervisor=self.sup,
+        )
         # warm compile + plan caches so episode latencies are steady-state
         self.run_batch(8)
         self.tick(batch=8)
 
     def shutdown(self):
         self.server.shutdown()
+        if self.sup is not None:
+            self.sup.stop(timeout=30)
 
     # -- traffic ----------------------------------------------------------
     def _plan(self):
@@ -147,6 +185,12 @@ class SoakBench:
     def run_batch(self, n: int, timeout: float | None = None, tenant: bool = True):
         """Submit n point lookups and wait for each; every error must be
         typed (the zero-untyped-errors gate folds from here)."""
+        self._await(self._submit(n, timeout=timeout, tenant=tenant))
+
+    def _submit(self, n: int, timeout: float | None = None, tenant: bool = True):
+        """Submit n point lookups WITHOUT waiting — the brownout episode
+        steps the controller while the queue is still loaded, so the
+        saturation signal is sampled live rather than post-drain."""
         from hyperspace_tpu.exceptions import HyperspaceError
 
         handles = []
@@ -164,6 +208,11 @@ class SoakBench:
                 # every refusal is recorded by type and judged by the
                 # zero-untyped gate below; nothing is swallowed silently.
                 self._record_error(e, HyperspaceError)
+        return handles
+
+    def _await(self, handles) -> None:
+        from hyperspace_tpu.exceptions import HyperspaceError
+
         for h in handles:
             t0 = time.perf_counter()
             try:
@@ -255,8 +304,7 @@ class SoakBench:
             "time_to_recover_vs": round(self.clock.t - t_start, 1),
         }
 
-    def episode_corruption_quarantine(self, expect_heal: bool) -> dict:
-        t_start = self.clock.t
+    def _corrupt_latest_bucket(self) -> None:
         index_root = Path(
             self.session.manager.path_resolver.get_index_path(self.INDEX)
         )
@@ -268,6 +316,10 @@ class SoakBench:
         with open(bucket, "r+b") as f:
             f.write(b"\x00GARBAGE\x00" * 4)
             f.truncate(128)
+
+    def episode_corruption_quarantine(self, expect_heal: bool) -> dict:
+        t_start = self.clock.t
+        self._corrupt_latest_bucket()
         # drive traffic until the corruption is hit and (controller on)
         # healed — index_health must drain back to empty without a human
         recovered, ticks = self.drive_until(
@@ -356,6 +408,192 @@ class SoakBench:
             "setup_s": round(time.monotonic() - t0, 2),
         }
 
+    # -- fleet episodes (--fleet N) ---------------------------------------
+    def episode_brownout(self) -> dict:
+        """Slow-path fault injection: every bucket read dawdles instead
+        of failing. The tightened latency objective pages, the
+        controller sheds AND scales the fleet up on sustained queue
+        saturation; the delay clears, and both the overrides and the
+        member count must come back to baseline."""
+        from hyperspace_tpu import faults, stats
+        from hyperspace_tpu.execution import io as hio
+        from hyperspace_tpu.obs import events
+
+        t_start = self.clock.t
+        conf = self.session.conf
+        base_workers = int(self.sup.n)
+        delays0 = stats.get("faults.delays_injected")
+        # A 20 ms latency objective against ~60-80 ms injected reads:
+        # the SLOW path (not a failed one) is what pages. The saturation
+        # bar drops so the 4-worker queue saturates within the episode.
+        conf.set("hyperspace.obs.slo.latencyP99Seconds", 0.02)
+        conf.set("hyperspace.controller.scale.saturation", 0.3)
+        faults.inject("bucket.read", delay_s=0.06, jitter_s=0.02)
+        paged = False
+        try:
+            for _ in range(8):
+                # Cold caches every tick so the delay reaches the reads.
+                hio.clear_table_cache()
+                hio.clear_footer_cache()
+                handles = self._submit(48, timeout=2.0)
+                snap = self.ctrl.step(now=self.clock.advance())
+                self._await(handles)
+                paged = paged or self.paging(snap)
+                if paged and snap["engaged"] and int(self.sup.n) > base_workers:
+                    break
+        finally:
+            faults.reset()
+        engaged = self.ctrl.snapshot()["engaged"]
+        peak_workers = int(self.sup.n)
+        # Incident over: restore the default latency objective (the
+        # 20 ms bar exists so compressed-time delays page at all) — the
+        # page must now AGE OUT through the burn windows, not flip off.
+        conf.set("hyperspace.obs.slo.latencyP99Seconds", 1.0)
+        recovered, ticks = self.drive_until(
+            lambda s: not self.paging(s) and not s["engaged"], max_ticks=40
+        )
+        # Calm ticks release the scale episode (budget-free) — allow a
+        # few more ticks for the hysteresis to drain.
+        scaled_back = int(self.sup.n) == base_workers
+        for _ in range(10):
+            if scaled_back:
+                break
+            self.tick()
+            scaled_back = int(self.sup.n) == base_workers
+        conf.set("hyperspace.controller.scale.saturation", 0.75)
+        scale_events = [
+            e for e in events.recent()
+            if e["name"] == "controller.actuation"
+            and e["fields"]["action"] == "fleet.scale.up"
+            and e["fields"]["outcome"] == "executed"
+        ]
+        import numpy as np
+
+        lat = np.asarray(self.completed_lat)
+        p99 = float(np.percentile(lat, 99)) if len(lat) else 0.0
+        return {
+            "name": "brownout",
+            "paged": paged,
+            "controller_engaged": engaged,
+            "recovered": recovered,
+            "delays_injected": stats.get("faults.delays_injected") - delays0,
+            "scale_up_actuated": bool(scale_events),
+            "peak_workers": peak_workers,
+            "scaled_back": scaled_back,
+            "workers_at_end": int(self.sup.n),
+            "completed_p99_s": round(p99, 4),
+            "p99_bounded": p99 < 5.0,
+            "time_to_recover_vs": round(self.clock.t - t_start, 1),
+        }
+
+    def episode_fleet_heal(self) -> dict:
+        """The corruption episode under TWO live controllers over the
+        SAME store: the per-index single-flight lease must yield exactly
+        ONE executed heal fleet-wide; the other member audits
+        outcome="observed" and lifts its local quarantine."""
+        from hyperspace_tpu import Hyperspace, HyperspaceSession, col
+        from hyperspace_tpu.exceptions import HyperspaceError
+        from hyperspace_tpu.obs import events
+
+        t_start = self.clock.t
+        sess_b = HyperspaceSession(system_path=str(self.tmp / "indexes"))
+        sess_b.conf.set("hyperspace.controller.enabled", "true")
+        sess_b.conf.set("hyperspace.controller.cooldownSeconds", 20.0)
+        hs_b = Hyperspace(sess_b)
+        df_b = sess_b.parquet(self.data)
+        sess_b.enable_hyperspace()
+        ctrl_b = hs_b.controller(clock=lambda: self.clock.t, member_id="member-1")
+
+        def traffic_b():
+            # A full key sweep so member B hits the corrupt bucket in
+            # the same tick member A does.
+            for k in range(16):
+                try:
+                    sess_b.run(df_b.filter(col("key") == k).select("id", "value"))
+                except BaseException as e:  # noqa: HSL017 — harness accounting
+                    self._record_error(e, HyperspaceError)
+
+        def b_quarantined():
+            with sess_b._state_lock:
+                return sorted(sess_b.index_health)
+
+        seq0 = max((e["seq"] for e in events.recent()), default=0)
+        self._corrupt_latest_bucket()
+        both_saw = False
+        for _ in range(20):
+            self.run_batch(12)
+            traffic_b()
+            both_saw = both_saw or (
+                bool(self.quarantined()) and bool(b_quarantined())
+            )
+            now = self.clock.advance()
+            self.ctrl.step(now=now)
+            ctrl_b.step(now=now)
+            if both_saw and not self.quarantined() and not b_quarantined():
+                break
+        heals = [
+            e for e in events.recent()
+            if e["seq"] > seq0 and e["name"] == "controller.actuation"
+            and e["fields"]["action"].startswith("heal.")
+            and e["fields"]["outcome"] in ("executed", "observed")
+        ]
+        executed = [e for e in heals if e["fields"]["outcome"] == "executed"]
+        observed = [e for e in heals if e["fields"]["outcome"] == "observed"]
+        return {
+            "name": "fleet_heal_two_controllers",
+            "both_members_quarantined": both_saw,
+            "executed_heals": len(executed),
+            "executed_by": sorted(
+                {e["fields"].get("member", "?") for e in executed}
+            ),
+            "observed_heals": len(observed),
+            "observed_by": sorted(
+                {e["fields"].get("member", "?") for e in observed}
+            ),
+            "recovered": bool(
+                both_saw and not self.quarantined() and not b_quarantined()
+            ),
+            "time_to_recover_vs": round(self.clock.t - t_start, 1),
+        }
+
+    def episode_sigkill_mid_heal(self) -> dict:
+        """A healer SIGKILLed mid-heal leaves its heal lease live; the
+        surviving controller must wait out the TTL, reap it
+        (`fleet.singleflight.takeovers`), and complete the heal."""
+        from hyperspace_tpu import stats
+        from hyperspace_tpu.serve.fleet.singleflight import key_name
+
+        t_start = self.clock.t
+        conf = self.session.conf
+        conf.set("hyperspace.fleet.lease.seconds", 1.0)
+        self._corrupt_latest_bucket()
+        heal_dir = Path(conf.system_path) / "_fleet" / "heal"
+        heal_dir.mkdir(parents=True, exist_ok=True)
+        lease = heal_dir / f"{key_name(f'heal.{self.INDEX}')}.lease"
+        # The phantom dead healer: a freshly-stamped lease whose holder
+        # (pid 999999) will never release it — exactly what a SIGKILL
+        # mid-heal leaves behind. Claimed with O_EXCL like a real holder
+        # would, so the survivor must outwait the 1 s TTL.
+        fd = os.open(str(lease), os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        try:
+            os.write(fd, f"{time.time():.6f}:999999:deadbeef".encode())
+        finally:
+            os.close(fd)
+        takeovers0 = stats.get("fleet.singleflight.takeovers")
+        t_wall = time.monotonic()
+        recovered, ticks = self.drive_until(
+            lambda s: not self.quarantined() and not self.paging(s),
+            max_ticks=24,
+        )
+        return {
+            "name": "sigkill_mid_heal_takeover",
+            "recovered": recovered,
+            "lease_takeovers": stats.get("fleet.singleflight.takeovers")
+            - takeovers0,
+            "takeover_wall_s": round(time.monotonic() - t_wall, 2),
+            "time_to_recover_vs": round(self.clock.t - t_start, 1),
+        }
+
     def _controller_events(self, name: str) -> list[dict]:
         from hyperspace_tpu.obs import events
 
@@ -372,31 +610,47 @@ def _soak_fleet_worker(ctx):
 def main(argv) -> int:
     smoke = "--smoke" in argv
     out = Path("BENCH_SOAK.json")
-    for a in argv:
+    fleet_n = 0
+    for i, a in enumerate(argv):
         if a.startswith("--out="):
             out = Path(a.split("=", 1)[1])
+        elif a.startswith("--fleet="):
+            fleet_n = int(a.split("=", 1)[1])
+        elif a == "--fleet" and i + 1 < len(argv):
+            fleet_n = int(argv[i + 1])
     t0 = time.perf_counter()
     tmp = Path(tempfile.mkdtemp(prefix="hs-soak-"))
+    total = 7 if fleet_n >= 2 else 4
     doc: dict = {
         "bench": "soak",
         "smoke": smoke,
+        "fleet": fleet_n,
         "step_virtual_s": STEP_V,
         "episodes": [],
     }
     try:
         log(f"[soak] setup (rows per phase: {8_000 if smoke else 32_000})")
-        bench = SoakBench(tmp, smoke)
+        bench = SoakBench(tmp, smoke, fleet_n=fleet_n)
         bench.build()
         try:
-            log("[soak] episode 1/4: transient_io")
+            log(f"[soak] episode 1/{total}: transient_io")
             doc["episodes"].append(bench.episode_transient_io())
             bench.refresh_traffic()  # mixed refresh traffic between episodes
-            log("[soak] episode 2/4: corruption_quarantine")
+            log(f"[soak] episode 2/{total}: corruption_quarantine")
             doc["episodes"].append(bench.episode_corruption_quarantine(expect_heal=True))
-            log("[soak] episode 3/4: overload_burst")
+            log(f"[soak] episode 3/{total}: overload_burst")
             doc["episodes"].append(bench.episode_overload_burst())
-            log("[soak] episode 4/4: worker_sigkill")
+            log(f"[soak] episode 4/{total}: worker_sigkill")
             doc["episodes"].append(bench.episode_worker_sigkill())
+            if fleet_n >= 2:
+                log(f"[soak] episode 5/{total}: brownout")
+                doc["episodes"].append(bench.episode_brownout())
+                bench.refresh_traffic()  # cold caches before corrupting
+                log(f"[soak] episode 6/{total}: fleet_heal_two_controllers")
+                doc["episodes"].append(bench.episode_fleet_heal())
+                bench.refresh_traffic()
+                log(f"[soak] episode 7/{total}: sigkill_mid_heal_takeover")
+                doc["episodes"].append(bench.episode_sigkill_mid_heal())
             actuations = bench._controller_events("controller.actuation")
             doc["controlled"] = {
                 "queries": bench.queries,
@@ -459,6 +713,29 @@ def main(argv) -> int:
                 "errors_untyped"
             ],
         }
+        if fleet_n >= 2:
+            gates.update({
+                "brownout_paged_and_recovered": (
+                    by_name["brownout"]["paged"]
+                    and by_name["brownout"]["recovered"]
+                ),
+                "brownout_delays_injected": (
+                    by_name["brownout"]["delays_injected"] >= 1
+                ),
+                "brownout_p99_bounded": by_name["brownout"]["p99_bounded"],
+                "scale_up_actuated": by_name["brownout"]["scale_up_actuated"],
+                "scaled_back_to_baseline": by_name["brownout"]["scaled_back"],
+                "fleet_heal_exactly_one": (
+                    by_name["fleet_heal_two_controllers"]["executed_heals"] == 1
+                ),
+                "fleet_heal_follower_observed": (
+                    by_name["fleet_heal_two_controllers"]["observed_heals"] >= 1
+                ),
+                "sigkill_heal_takeover": (
+                    by_name["sigkill_mid_heal_takeover"]["lease_takeovers"] >= 1
+                    and by_name["sigkill_mid_heal_takeover"]["recovered"]
+                ),
+            })
         doc["gates"] = gates
         doc["elapsed_s"] = round(time.perf_counter() - t0, 1)
         out.write_text(json.dumps(doc, indent=2, default=str) + "\n")

@@ -119,17 +119,41 @@ class RoutingLedger:
         """Route `signature`: ``"indexed"`` (default — the rewrite keeps
         the benefit of the doubt) or ``"raw"`` once BOTH paths have
         enough samples and the indexed EMA measured slower than
-        demoteRatio x the raw EMA."""
+        demoteRatio x the raw EMA. An operator/controller `pin`
+        overrides the measured verdict outright."""
         conf = self._session.conf
         stamp = collection_stamp(self._session) if stamp is None else stamp
         with self._lock:
             self._load_locked()
             self._sync_stamp_locked(stamp)
             entry = self._entries.get(signature)
+            if entry is not None and entry.get("pinned") in ("indexed", "raw"):
+                if entry["pinned"] == "raw":
+                    _DEMOTIONS.inc()
+                return entry["pinned"]
             if entry is not None and self._demoted_locked(entry, conf):
                 _DEMOTIONS.inc()
                 return "raw"
             return "indexed"
+
+    def pin(self, signature: str, mode: str = "raw",
+            stamp: str | None = None) -> None:
+        """Pin `signature` to a route unconditionally (the OpsController's
+        recompile-storm response pins to ``"raw"`` so the signature stops
+        feeding the jit cache). Pins ride the same versioned stamp as the
+        measured evidence: any committed index mutation wipes them —
+        structural re-promotion, exactly like demotions. Persisted
+        immediately (a pin must survive the process)."""
+        if mode not in ("indexed", "raw"):
+            raise ValueError(f"unknown routing mode {mode!r} (indexed|raw)")
+        stamp = collection_stamp(self._session) if stamp is None else stamp
+        with self._lock:
+            self._load_locked()
+            self._sync_stamp_locked(stamp)
+            self._entries.setdefault(signature, {})["pinned"] = mode
+            self._unpersisted = 0
+            doc = self._doc_locked()
+        self._persist(doc)
 
     def record(self, signature: str, mode: str, wall_s: float,
                stamp: str | None = None) -> None:
@@ -170,7 +194,7 @@ class RoutingLedger:
         return {
             "stamp": self._stamp,
             "entries": {
-                k: {m: list(c) for m, c in v.items()}
+                k: {m: (list(c) if isinstance(c, list) else c) for m, c in v.items()}
                 for k, v in self._entries.items()
             },
         }
@@ -214,13 +238,17 @@ class RoutingLedger:
             }
 
     def demoted_signatures(self) -> list[str]:
-        """Signatures decide() would currently route raw (report/bench
-        evidence; does not bump the demotion counter)."""
+        """Signatures decide() would currently route raw — measured
+        demotions plus raw pins (report/bench evidence; does not bump
+        the demotion counter)."""
         conf = self._session.conf
         out = []
         with self._lock:
             self._load_locked()
             for sig, entry in self._entries.items():
-                if self._demoted_locked(entry, conf):
+                if entry.get("pinned") == "raw" or (
+                    entry.get("pinned") != "indexed"
+                    and self._demoted_locked(entry, conf)
+                ):
                     out.append(sig)
         return sorted(out)

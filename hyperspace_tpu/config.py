@@ -99,6 +99,7 @@ ANALYSIS_VALIDATE = "hyperspace.analysis.validate"
 # instead of failing. recover.onAccess makes index listing lazily repair
 # a crashed writer's transient log (after graceSeconds of staleness).
 FAULTS_ENABLED = "hyperspace.faults.enabled"
+FAULTS_MAX_DELAY_SECONDS = "hyperspace.faults.maxDelaySeconds"
 # Observability plane (docs/observability.md). obs.enabled gates the
 # tracer: False makes span()/trace() return shared no-op singletons (no
 # allocation on the query hot path); per-query profiles remain available
@@ -161,6 +162,7 @@ FLEET_CACHE_MAX_BYTES = "hyperspace.fleet.cache.maxBytes"
 FLEET_LEASE_SECONDS = "hyperspace.fleet.lease.seconds"
 FLEET_SINGLEFLIGHT_WAIT_SECONDS = "hyperspace.fleet.singleflight.waitSeconds"
 FLEET_WORKERS = "hyperspace.fleet.workers"
+FLEET_MIN_WORKERS = "hyperspace.fleet.minWorkers"
 FLEET_MAX_RESTARTS = "hyperspace.fleet.maxRestarts"
 FLEET_RESTART_BACKOFF_SECONDS = "hyperspace.fleet.restartBackoffSeconds"
 # Self-driving operations controller (serve/controller.py,
@@ -188,6 +190,19 @@ CONTROLLER_QUOTA_FACTOR = "hyperspace.controller.quotaFactor"
 CONTROLLER_HEAL_REBUILD = "hyperspace.controller.heal.rebuild"
 CONTROLLER_DEMOTION_CLUSTER_SIZE = "hyperspace.controller.demotionClusterSize"
 CONTROLLER_DEMOTION_WINDOW_SECONDS = "hyperspace.controller.demotionWindowSeconds"
+# Fleet-coordinated operations (docs/fault_tolerance.md "fleet
+# coordination"): heal.coordinate routes heal actuations through the
+# fleet single-flight lease so exactly one member rebuilds a quarantined
+# index fleet-wide; scale.* drive the supervisor's member count up on
+# sustained fleet-health saturation (and back to the pre-episode
+# baseline on recovery); stormResponse turns jit.recompile_storm events
+# into an actuated response (raw-route pin + one audited cache drop)
+# instead of observed-only telemetry.
+CONTROLLER_HEAL_COORDINATE = "hyperspace.controller.heal.coordinate"
+CONTROLLER_SCALE_SATURATION = "hyperspace.controller.scale.saturation"
+CONTROLLER_SCALE_MAX_WORKERS = "hyperspace.controller.scale.maxWorkers"
+CONTROLLER_SCALE_STEP = "hyperspace.controller.scale.step"
+CONTROLLER_STORM_RESPONSE = "hyperspace.controller.stormResponse"
 RETRY_MAX_ATTEMPTS = "hyperspace.retry.maxAttempts"
 RETRY_BACKOFF_BASE = "hyperspace.retry.backoffBaseSeconds"
 RETRY_CAS_ATTEMPTS = "hyperspace.retry.casAttempts"
@@ -256,8 +271,10 @@ DEFAULT_FLEET_CACHE_MAX_BYTES = 1 << 30
 DEFAULT_FLEET_LEASE_SECONDS = 10.0
 DEFAULT_FLEET_SINGLEFLIGHT_WAIT_SECONDS = 15.0
 DEFAULT_FLEET_WORKERS = 2
+DEFAULT_FLEET_MIN_WORKERS = 1
 DEFAULT_FLEET_MAX_RESTARTS = 3
 DEFAULT_FLEET_RESTART_BACKOFF_SECONDS = 0.5
+DEFAULT_FAULTS_MAX_DELAY_SECONDS = 30.0
 DEFAULT_CONTROLLER_INTERVAL_SECONDS = 1.0
 DEFAULT_CONTROLLER_COOLDOWN_SECONDS = 30.0
 DEFAULT_CONTROLLER_HYSTERESIS_TICKS = 2
@@ -267,6 +284,9 @@ DEFAULT_CONTROLLER_SHED_RATIO = 0.5
 DEFAULT_CONTROLLER_QUOTA_FACTOR = 0.5
 DEFAULT_CONTROLLER_DEMOTION_CLUSTER_SIZE = 3
 DEFAULT_CONTROLLER_DEMOTION_WINDOW_SECONDS = 300.0
+DEFAULT_CONTROLLER_SCALE_SATURATION = 0.75
+DEFAULT_CONTROLLER_SCALE_MAX_WORKERS = 8
+DEFAULT_CONTROLLER_SCALE_STEP = 1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -420,6 +440,12 @@ KNOWN_KEYS: dict[str, ConfKey] = {
         "Kill switch for the fault-injection harness (`faults.py`): false makes "
         "every `fault_point` inert even with rules registered. See "
         "[fault_tolerance.md](fault_tolerance.md)."),
+    FAULTS_MAX_DELAY_SECONDS: ConfKey(
+        "30",
+        "Clamp on any single injected brownout delay (base + jitter of a "
+        "`delay_s` fault rule): a typo'd rule slows a call by at most this "
+        "long, so deadline-carrying paths surface their typed timeouts "
+        "instead of wedging."),
     RETRY_MAX_ATTEMPTS: ConfKey(
         "3",
         "Attempts per transient-IO call site (log/pointer/manifest writes, "
@@ -558,6 +584,10 @@ KNOWN_KEYS: dict[str, ConfKey] = {
         "2",
         "Default worker-process count of a `FleetSupervisor` "
         "(serve/fleet/supervisor.py)."),
+    FLEET_MIN_WORKERS: ConfKey(
+        "1",
+        "Floor of `FleetSupervisor.set_target_workers`: no scale-down (manual "
+        "or controller-actuated) drops the fleet below this many members."),
     FLEET_MAX_RESTARTS: ConfKey(
         "3",
         "How many times the supervisor respawns a crashed worker before "
@@ -631,6 +661,34 @@ KNOWN_KEYS: dict[str, ConfKey] = {
         "300",
         "Trailing controller-clock window over which routing-demotion "
         "events are counted toward the sweep-trigger cluster."),
+    CONTROLLER_HEAL_COORDINATE: ConfKey(
+        "true",
+        "Route heal actuations through the fleet single-flight lease "
+        "(serve/fleet/singleflight.py) so exactly ONE member rebuilds a "
+        "quarantined index fleet-wide; followers observe the published "
+        "heal marker and only lift their local quarantine. Engages only "
+        "when a fleet directory is discoverable; false keeps every heal "
+        "process-local."),
+    CONTROLLER_SCALE_SATURATION: ConfKey(
+        "0.75",
+        "Queue-fullness ratio (worst of the fleet-health aggregate and the "
+        "local server) at or above which a controller tick counts toward "
+        "the scale-up hysteresis."),
+    CONTROLLER_SCALE_MAX_WORKERS: ConfKey(
+        "8",
+        "Ceiling of controller-actuated fleet scale-up "
+        "(`FleetSupervisor.set_target_workers`); recovery restores the "
+        "pre-episode member count."),
+    CONTROLLER_SCALE_STEP: ConfKey(
+        "1",
+        "How many members each scale-up actuation adds (each addition is a "
+        "separate audited, budgeted, cooled-down actuation)."),
+    CONTROLLER_STORM_RESPONSE: ConfKey(
+        "true",
+        "Actuate on `jit.recompile_storm` events: pin the storming key's "
+        "signature to the raw-scan route (`RoutingLedger.pin`) and drop the "
+        "jit caches once (`jit_memory.drop_caches`). false keeps storms "
+        "observe-only telemetry."),
     ADVISOR_ROUTING_ENABLED: ConfKey(
         "false",
         "Adaptive query routing ([advisor.md](advisor.md)): a per-plan-"
@@ -751,6 +809,7 @@ class HyperspaceConf:
     fleet_lease_seconds: float = DEFAULT_FLEET_LEASE_SECONDS
     fleet_singleflight_wait_seconds: float = DEFAULT_FLEET_SINGLEFLIGHT_WAIT_SECONDS
     fleet_workers: int = DEFAULT_FLEET_WORKERS
+    fleet_min_workers: int = DEFAULT_FLEET_MIN_WORKERS
     fleet_max_restarts: int = DEFAULT_FLEET_MAX_RESTARTS
     fleet_restart_backoff_seconds: float = DEFAULT_FLEET_RESTART_BACKOFF_SECONDS
     controller_enabled: bool = False  # opt-in: the controller mutates serving state
@@ -764,6 +823,11 @@ class HyperspaceConf:
     controller_heal_rebuild: bool = True
     controller_demotion_cluster_size: int = DEFAULT_CONTROLLER_DEMOTION_CLUSTER_SIZE
     controller_demotion_window_seconds: float = DEFAULT_CONTROLLER_DEMOTION_WINDOW_SECONDS
+    controller_heal_coordinate: bool = True
+    controller_scale_saturation: float = DEFAULT_CONTROLLER_SCALE_SATURATION
+    controller_scale_max_workers: int = DEFAULT_CONTROLLER_SCALE_MAX_WORKERS
+    controller_scale_step: int = DEFAULT_CONTROLLER_SCALE_STEP
+    controller_storm_response: bool = True
     advisor_routing_enabled: bool = False  # opt-in: routing changes plan choice
     advisor_routing_demote_ratio: float = DEFAULT_ADVISOR_ROUTING_DEMOTE_RATIO
     advisor_routing_alpha: float = DEFAULT_ADVISOR_ROUTING_ALPHA
@@ -875,6 +939,8 @@ class HyperspaceConf:
             self.fleet_singleflight_wait_seconds = float(value)
         elif key == FLEET_WORKERS:
             self.fleet_workers = int(value)
+        elif key == FLEET_MIN_WORKERS:
+            self.fleet_min_workers = int(value)
         elif key == FLEET_MAX_RESTARTS:
             self.fleet_max_restarts = int(value)
         elif key == FLEET_RESTART_BACKOFF_SECONDS:
@@ -901,6 +967,16 @@ class HyperspaceConf:
             self.controller_demotion_cluster_size = int(value)
         elif key == CONTROLLER_DEMOTION_WINDOW_SECONDS:
             self.controller_demotion_window_seconds = float(value)
+        elif key == CONTROLLER_HEAL_COORDINATE:
+            self.controller_heal_coordinate = _as_bool(value)
+        elif key == CONTROLLER_SCALE_SATURATION:
+            self.controller_scale_saturation = float(value)
+        elif key == CONTROLLER_SCALE_MAX_WORKERS:
+            self.controller_scale_max_workers = int(value)
+        elif key == CONTROLLER_SCALE_STEP:
+            self.controller_scale_step = int(value)
+        elif key == CONTROLLER_STORM_RESPONSE:
+            self.controller_storm_response = _as_bool(value)
         elif key == ADVISOR_ROUTING_ENABLED:
             self.advisor_routing_enabled = _as_bool(value)
         elif key == ADVISOR_ROUTING_DEMOTE_RATIO:
@@ -929,6 +1005,11 @@ class HyperspaceConf:
             from hyperspace_tpu import faults
 
             faults.set_enabled(_as_bool(value))
+        elif key == FAULTS_MAX_DELAY_SECONDS:
+            # Process-global like the harness it clamps.
+            from hyperspace_tpu import faults
+
+            faults.set_max_delay(float(value))
         elif key == OBS_ENABLED:
             # Process-global like the metrics/sink it feeds (obs/trace.py).
             from hyperspace_tpu.obs import trace as _obs_trace
@@ -1060,6 +1141,8 @@ class HyperspaceConf:
             return self.fleet_singleflight_wait_seconds
         if key == FLEET_WORKERS:
             return self.fleet_workers
+        if key == FLEET_MIN_WORKERS:
+            return self.fleet_min_workers
         if key == FLEET_MAX_RESTARTS:
             return self.fleet_max_restarts
         if key == FLEET_RESTART_BACKOFF_SECONDS:
@@ -1086,6 +1169,16 @@ class HyperspaceConf:
             return self.controller_demotion_cluster_size
         if key == CONTROLLER_DEMOTION_WINDOW_SECONDS:
             return self.controller_demotion_window_seconds
+        if key == CONTROLLER_HEAL_COORDINATE:
+            return self.controller_heal_coordinate
+        if key == CONTROLLER_SCALE_SATURATION:
+            return self.controller_scale_saturation
+        if key == CONTROLLER_SCALE_MAX_WORKERS:
+            return self.controller_scale_max_workers
+        if key == CONTROLLER_SCALE_STEP:
+            return self.controller_scale_step
+        if key == CONTROLLER_STORM_RESPONSE:
+            return self.controller_storm_response
         if key == ADVISOR_ROUTING_ENABLED:
             return self.advisor_routing_enabled
         if key == ADVISOR_ROUTING_DEMOTE_RATIO:

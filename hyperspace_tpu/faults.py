@@ -24,6 +24,20 @@ Design constraints:
   with errno EIO, so `exceptions.is_retryable` classifies it and the
   retry layer (utils/retry.py) handles it like any flaky disk.
 - **Deterministic.** Schedules count calls, never wall time or RNG.
+  Brownout jitter derives from the rule's own call counter through a
+  Knuth multiplicative hash — the same schedule every run.
+
+Brownout (slow-path) injection: a rule with ``delay_s`` makes the point
+*slow* instead of failed — the arriving call sleeps ``delay_s`` plus a
+deterministic per-call jitter in ``[0, jitter_s)`` before continuing (or
+before the rule's error/crash action, so "slow then fail" composes).
+The sleep happens OUTSIDE the harness lock (HSL013), in small slices
+that re-check the armed gate, so the ``hyperspace.faults.enabled`` kill
+switch disarms a delay already in flight. Tests that must not spend
+wall time install a virtual sleeper via :func:`set_sleeper` (the same
+virtual-clock idiom as the soak harness); delays are clamped to
+``hyperspace.faults.maxDelaySeconds`` so a typo'd rule cannot wedge a
+deadline-carrying path past its typed timeout budget.
 
 Kill switch: ``hyperspace.faults.enabled`` (config.py) — when set False,
 `fault_point` is inert even with rules registered, so a production
@@ -151,15 +165,20 @@ class FaultRule:
     `at_call` fires on exactly the K-th arrival at the point (1-based);
     `times` caps how many times the rule fires (fail-N-then-succeed);
     both unset ⇒ fires on every arrival. Actions compose in order:
-    truncate/corrupt mutate the file first, then `error`/`crash` raise —
-    so a single rule can model "the disk wrote garbage AND the process
-    died"."""
+    `delay_s` sleeps first (brownout), then truncate/corrupt mutate the
+    file, then `error`/`crash` raise — so a single rule can model "the
+    disk went slow, wrote garbage AND the process died". A pure-delay
+    rule (delay_s set, no other action) slows the call and lets it
+    proceed; `jitter_s` adds a deterministic per-call extra in
+    ``[0, jitter_s)`` derived from the rule's call counter (no RNG)."""
 
     point: str
     error: BaseException | type | None = None
     crash: bool = False
     truncate: int | None = None  # keep only the first N bytes of `path`
     corrupt: bytes | None = None  # overwrite the head of `path` with these bytes
+    delay_s: float = 0.0  # brownout: sleep this long before any other action
+    jitter_s: float = 0.0  # deterministic per-call extra delay in [0, jitter_s)
     at_call: int | None = None  # 1-based call index this rule fires at
     times: int | None = None  # max number of firings (None = unlimited)
     calls: int = 0
@@ -172,6 +191,16 @@ _observed: set[str] = set()
 _armed = False  # fast-path gate: False ⇒ fault_point returns immediately
 _enabled = True  # hyperspace.faults.enabled kill switch
 
+# Brownout machinery. The sleeper is a hook (default: real time.sleep)
+# so virtual-clock harnesses account delay without spending wall time;
+# sleeps run in _DELAY_SLICE_S slices re-checking the armed gate, so
+# the kill switch disarms a delay already in flight. _max_delay_s caps
+# any single injected delay (hyperspace.faults.maxDelaySeconds).
+_DELAY_SLICE_S = 0.05
+_KNUTH = 2654435761  # multiplicative-hash constant (deterministic jitter)
+_sleeper = None  # None ⇒ time.sleep; swapped by set_sleeper()
+_max_delay_s = 30.0
+
 
 def set_enabled(enabled: bool) -> None:
     """Config kill switch (`hyperspace.faults.enabled`). False disarms
@@ -182,6 +211,24 @@ def set_enabled(enabled: bool) -> None:
         _armed = _enabled and bool(_rules)
 
 
+def set_sleeper(sleeper) -> None:
+    """Install the brownout sleep hook: ``sleeper(seconds)`` is called
+    (possibly in slices) for every injected delay. Pass a virtual-clock
+    advance to keep delay accounting wall-clock-free (the soak harness
+    does), or None to restore real ``time.sleep``."""
+    global _sleeper
+    with _lock:
+        _sleeper = sleeper
+
+
+def set_max_delay(seconds: float) -> None:
+    """Config clamp (`hyperspace.faults.maxDelaySeconds`) on any single
+    injected delay (base + jitter)."""
+    global _max_delay_s
+    with _lock:
+        _max_delay_s = max(0.0, float(seconds))
+
+
 def inject(
     point: str,
     *,
@@ -189,18 +236,24 @@ def inject(
     crash: bool = False,
     truncate: int | None = None,
     corrupt: bytes | None = None,
+    delay_s: float = 0.0,
+    jitter_s: float = 0.0,
     at_call: int | None = None,
     times: int | None = None,
 ) -> FaultRule:
     """Register a fault at `point`. With no explicit action, the rule
-    raises a transient :class:`FaultError` (the common retry-test case)."""
+    raises a transient :class:`FaultError` (the common retry-test case);
+    a bare ``delay_s`` makes a brownout rule — the call slows down and
+    then proceeds normally."""
     if point not in KNOWN_POINTS:
         raise ValueError(f"unknown fault point {point!r} (see faults.KNOWN_POINTS)")
-    if error is None and not crash and truncate is None and corrupt is None:
+    if (error is None and not crash and truncate is None and corrupt is None
+            and not delay_s):
         error = FaultError
     rule = FaultRule(
         point=point, error=error, crash=crash, truncate=truncate,
-        corrupt=corrupt, at_call=at_call, times=times,
+        corrupt=corrupt, delay_s=delay_s, jitter_s=jitter_s,
+        at_call=at_call, times=times,
     )
     global _armed
     with _lock:
@@ -210,12 +263,15 @@ def inject(
 
 
 def reset() -> None:
-    """Clear every rule and observation; disarm the fast path."""
-    global _armed
+    """Clear every rule and observation; disarm the fast path. The
+    brownout sleeper hook is restored to real ``time.sleep`` so a
+    virtual clock can never leak across tests."""
+    global _armed, _sleeper
     with _lock:
         _rules.clear()
         _observed.clear()
         _armed = False
+        _sleeper = None
 
 
 @contextmanager
@@ -263,6 +319,7 @@ def export_state() -> dict:
         return {
             "enabled": _enabled,
             "armed": _armed,
+            "max_delay_s": _max_delay_s,
             "rules": [dataclasses.replace(r, calls=0, fired=0) for r in _rules],
         }
 
@@ -272,11 +329,12 @@ def install_state(state: dict) -> None:
     (worker) process. `armed` is honored even with zero rules so a
     coordinator-side `recording()` pass observes worker-side points
     too."""
-    global _armed, _enabled
+    global _armed, _enabled, _max_delay_s
     with _lock:
         _rules.clear()
         _rules.extend(state.get("rules") or ())
         _enabled = bool(state.get("enabled", True))
+        _max_delay_s = float(state.get("max_delay_s", _max_delay_s))
         _armed = _enabled and (bool(_rules) or bool(state.get("armed")))
 
 
@@ -302,7 +360,7 @@ def fault_point(name: str, path: str | os.PathLike | None = None) -> None:
 
 
 def _hit(name: str, path: str | os.PathLike | None) -> None:
-    to_fire: list[FaultRule] = []
+    to_fire: list[tuple[FaultRule, int]] = []
     with _lock:
         _observed.add(name)
         for rule in _rules:
@@ -314,9 +372,13 @@ def _hit(name: str, path: str | os.PathLike | None) -> None:
             if rule.times is not None and rule.fired >= rule.times:
                 continue
             rule.fired += 1
-            to_fire.append(rule)
-    for rule in to_fire:
+            to_fire.append((rule, rule.calls))
+    for rule, call_no in to_fire:
         stats.increment("faults.injected")
+        # Brownout first — "went slow, THEN failed" is the composition a
+        # real degraded disk exhibits. Runs outside _lock (HSL013).
+        if rule.delay_s > 0.0 or rule.jitter_s > 0.0:
+            _apply_delay(rule, call_no)
         if path is not None and (rule.truncate is not None or rule.corrupt is not None):
             _mangle_file(path, rule)
         if rule.crash:
@@ -325,6 +387,34 @@ def _hit(name: str, path: str | os.PathLike | None) -> None:
             if isinstance(rule.error, type):
                 raise rule.error(f"injected fault at {name!r}" + (f" ({path})" if path else ""))
             raise rule.error
+
+
+def _apply_delay(rule: FaultRule, call_no: int) -> None:
+    """Sleep the rule's brownout schedule for its `call_no`-th arrival:
+    base delay plus a deterministic jitter in ``[0, jitter_s)`` hashed
+    from the call counter (same schedule every run, no RNG), clamped to
+    the configured max. Sliced so the kill switch (or reset) disarms a
+    delay already in flight."""
+    jitter = rule.jitter_s * ((call_no * _KNUTH) % 1000) / 1000.0
+    with _lock:
+        total = min(rule.delay_s + jitter, _max_delay_s)
+        sleeper = _sleeper
+    if total <= 0.0:
+        return
+    stats.increment("faults.delays_injected")
+    import time
+
+    if sleeper is None:
+        sleeper = time.sleep
+    remaining = total
+    while remaining > 0.0:
+        with _lock:  # kill switch flipped mid-delay ⇒ stop browning out
+            armed = _armed
+        if not armed:
+            return
+        step = min(remaining, _DELAY_SLICE_S)
+        sleeper(step)
+        remaining -= step
 
 
 def _mangle_file(path: str | os.PathLike, rule: FaultRule) -> None:

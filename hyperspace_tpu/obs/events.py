@@ -65,6 +65,9 @@ KNOWN_EVENTS: dict[str, str] = {
     # backoff before its next respawn, so the restart budget cannot be
     # burned in milliseconds (serve/fleet/supervisor.py).
     "fleet.worker.crash_loop": "warn",
+    # The supervisor's member count moved (set_target_workers — manual
+    # or controller-actuated scale up/down); carries from/to counts.
+    "fleet.worker.scaled": "info",
     # Self-driving operations controller (serve/controller.py,
     # docs/fault_tolerance.md "self-driving operations"): every decision
     # is an auditable record. `controller.actuation` carries
@@ -79,6 +82,10 @@ KNOWN_EVENTS: dict[str, str] = {
     "controller.actuation_failed": "error",
     "controller.backoff": "info",
     "controller.observe_only": "error",
+    # The controller answered a jit.recompile_storm: the storming key's
+    # signature was pinned to the raw-scan route and the jit caches
+    # dropped once (serve/controller.py "storm response").
+    "controller.storm_response": "warn",
     # JIT plane (docs/observability.md): a call-site key is compiling on
     # most calls (the runtime mirror of lint rule HSL015), or the
     # map-count guard dropped jax's caches to stay under

@@ -117,6 +117,21 @@ class ProcessHost:
                 p.terminate()
                 p.join(timeout=grace)
 
+    def terminate(self, key, grace: float = 5.0) -> bool:
+        """Stop and deregister ONE worker (the supervisor's scale-down
+        path): drop it from the registry first — so a concurrent monitor
+        pass cannot respawn it — then terminate if still alive and join
+        with `grace`. Returns True when a process was registered under
+        `key`."""
+        with self._lock:
+            p = self._procs.pop(key, None)
+        if p is None:
+            return False
+        if p.is_alive():
+            p.terminate()
+        p.join(timeout=grace)
+        return True
+
 
 def _task_entry(result_q, task_id, fn, args, env) -> None:
     """Module-level worker entry (spawn needs a picklable top-level

@@ -31,11 +31,45 @@ index quarantined         **heal**: `Hyperspace.recover(name)` (log
 events cluster            `LifecyclePolicy.sweep()` — still gated by
                           the `hyperspace.advisor.lifecycle.*`
                           opt-ins; the controller only decides WHEN.
+sustained fleet/serve     **scale the fleet**:
+saturation                `FleetSupervisor.set_target_workers` grows
+(`fleet_health` queue     the member count by `controller.scale.step`
+ratio over               (up to `controller.scale.maxWorkers`) after
+`controller.scale.`       `hysteresisTicks` saturated ticks, and
+`saturation`)             restores the pre-episode count after
+                          `recoveryTicks` calm ticks (the scale-down,
+                          like every release, is budget-free).
+`jit.recompile_storm`     **storm response**: pin the storming key's
+event in the window       signature to the raw-scan route
+                          (`RoutingLedger.pin`) and drop the jit
+                          caches once (`jit_memory.drop_caches`) —
+                          the signature stops feeding the cache it is
+                          churning. Gated by
+                          `controller.stormResponse`.
 serve SLOs burning        **back off background work**: heals and
                           sweeps (rebuild/optimize-class work) are
                           deferred with a `controller.backoff` event
                           until the burn clears.
 ========================  ==========================================
+
+Fleet coordination (docs/fault_tolerance.md "fleet coordination"): N
+controllers over ONE store must not race their heals — a quarantined
+index would be rebuilt N times (N full refreshes of the same bytes).
+Heal actuations therefore route through the fleet's O_EXCL single-
+flight lease (serve/fleet/singleflight.py) keyed per index, with a
+generation-stamped marker file as the published artifact: exactly one
+member (the lease leader) runs recover+rebuild and bumps the marker
+generation; every other member observes the fresh marker, lifts its
+LOCAL quarantine via the idempotent `recover()`, and spends neither
+budget nor a `controller.heals` count (audited as outcome="observed").
+A SIGKILLed healer's lease goes stale after the TTL and the next
+member reaps it and takes over (`fleet.singleflight.takeovers`). Every
+audit event carries this controller's `member` id so the fleet-wide
+decision log is reconstructible from any member's event ring.
+Coordination is gated by `hyperspace.controller.heal.coordinate` and
+engages only when a fleet directory is discoverable (explicit
+`hyperspace.fleet.cache.dir`, or an existing store to derive
+`<system.path>/_fleet` under); otherwise heals stay process-local.
 
 Control discipline — the loop must never become its own incident:
 
@@ -77,6 +111,8 @@ disabled shows the degraded counterfactual.
 from __future__ import annotations
 
 import collections
+import json
+import os
 import threading
 import time
 from pathlib import Path
@@ -93,6 +129,7 @@ _EVT_ACTUATION = obs_events.declare("controller.actuation")
 _EVT_FAILED = obs_events.declare("controller.actuation_failed")
 _EVT_BACKOFF = obs_events.declare("controller.backoff")
 _EVT_OBSERVE_ONLY = obs_events.declare("controller.observe_only")
+_EVT_STORM = obs_events.declare("controller.storm_response")
 
 _ENGAGED = obs_metrics.gauge(
     "controller.engaged", "1 while the controller's overload response holds overrides"
@@ -114,7 +151,8 @@ class OpsController:
     `hyperspace.controller.intervalSeconds`.
     """
 
-    def __init__(self, hyperspace, server=None, clock=time.monotonic):
+    def __init__(self, hyperspace, server=None, clock=time.monotonic,
+                 member_id: str | None = None, supervisor=None):
         # `hyperspace` is the user-facing API facade: like the advisor's
         # LifecyclePolicy, the controller has exactly the powers an
         # operator has — recover/refresh/lifecycle — no private side
@@ -122,6 +160,11 @@ class OpsController:
         self.hyperspace = hyperspace
         self.session = hyperspace.session
         self.server = server
+        # Fleet identity on every audit event (defaults to the pid —
+        # unique per fleet member since members are processes) and the
+        # optional supervisor handle the scale actuator drives.
+        self.member_id = str(member_id) if member_id else f"pid-{os.getpid()}"
+        self.supervisor = supervisor
         self._clock = clock
         self._lock = threading.RLock()
         self._budget = int(self.session.conf.controller_actuation_budget)
@@ -135,6 +178,18 @@ class OpsController:
         self._demotions: collections.deque = collections.deque()
         self._last_verdicts: dict[str, str] = {}
         self._recent_actions: collections.deque = collections.deque(maxlen=16)
+        # Fleet-heal bookkeeping: marker generation last observed per
+        # index (fresh generation = another member healed since we
+        # looked), and the single-flight lease an in-flight heal holds
+        # (its own small lock: stop() must reach it while step() is
+        # blocked inside an actuation holding the main lock).
+        self._seen_heal_gen: dict[str, int] = {}
+        self._lease_lock = threading.Lock()
+        self._held_lease: tuple | None = None
+        # Scale hysteresis state (mirrors page/ok ticks for saturation).
+        self._sat_ticks = 0
+        self._calm_ticks = 0
+        self._scale_baseline: int | None = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         _BUDGET_REMAINING.set(self._budget)
@@ -159,7 +214,22 @@ class OpsController:
         return self
 
     def stop(self, timeout: float = 5.0) -> None:
+        """Stand the loop down. A heal actuation in flight may hold the
+        fleet single-flight lease — release it BEFORE joining, so a
+        controller stopped mid-heal (disarm, shutdown) never leaves a
+        live lease blocking the fleet for TTL seconds. FileLease.release
+        is token-checked and idempotent, so the actuation's own
+        `finally` re-release is harmless."""
         self._stop.set()
+        with self._lease_lock:
+            held = self._held_lease
+            self._held_lease = None
+        if held is not None:
+            lease, token = held
+            try:
+                lease.release(token)
+            except OSError:
+                pass  # reaped/expired already — nothing left to free
         with self._lock:
             t = self._thread
         if t is not None:
@@ -217,7 +287,7 @@ class OpsController:
             else:
                 self._ok_ticks += 1
                 self._page_ticks = 0
-            demotion_cluster = self._drain_events(conf, now)
+            demotion_cluster, storm_keys = self._drain_events(conf, now)
 
             # 1. Overload response: shed + tighten quotas while pages
             # persist (hysteresis), restore once the burn clears.
@@ -237,6 +307,13 @@ class OpsController:
                 and self._ok_ticks >= int(conf.controller_recovery_ticks)
             ):
                 self._release_overload(now, trigger="slo.recovered")
+
+            # 1b. Fleet scaling: sustained saturation grows the member
+            # count (same hysteresis discipline as the overload
+            # response); sustained calm restores the pre-episode count
+            # (budget-free, like every release).
+            if self.supervisor is not None:
+                self._reconcile_scale(conf, now)
 
             # 2. Heal quarantined indexes — rebuild-class work, deferred
             # while serve SLOs burn (backing off background work is
@@ -267,15 +344,34 @@ class OpsController:
                     fn=self._sweep, demotions=demotion_cluster,
                 ):
                     self._demotions.clear()  # evidence consumed; re-arm
+
+            # 4. Recompile-storm response: pin the storming signature to
+            # the raw-scan route and drop the jit caches once. NOT
+            # deferred while burning — a storm is itself a serve-plane
+            # pressure source, and the response is cheap.
+            if getattr(conf, "controller_storm_response", True):
+                for key in storm_keys:
+                    self._actuate(
+                        f"storm.response.{key}", trigger="jit.recompile_storm",
+                        now=now, fn=lambda k=key: self._storm_response(k),
+                        key=key,
+                    )
             return self.snapshot()
 
     # -- signal plumbing --------------------------------------------------
-    def _drain_events(self, conf, now: float) -> int:
+    def _drain_events(self, conf, now: float) -> tuple[int, list[str]]:
         """Fold new ring events into the controller's trailing state;
-        returns the demotion count when it constitutes a cluster."""
+        returns (demotion count when it constitutes a cluster, the keys
+        of fresh `jit.recompile_storm` events, deduplicated in order)."""
         fresh = [e for e in obs_events.recent() if e["seq"] > self._last_seq]
         if fresh:
             self._last_seq = max(e["seq"] for e in fresh)
+        storms: list[str] = []
+        for e in fresh:
+            if e["name"] == "jit.recompile_storm":
+                key = str(e.get("fields", {}).get("key", ""))
+                if key and key not in storms:
+                    storms.append(key)
         n = sum(1 for e in fresh if e["name"] == "advisor.routing.demoted")
         if n:
             self._demotions.append((now, n))
@@ -283,7 +379,8 @@ class OpsController:
         while self._demotions and self._demotions[0][0] < cutoff:
             self._demotions.popleft()
         total = sum(c for _, c in self._demotions)
-        return total if total >= int(conf.controller_demotion_cluster_size) else 0
+        cluster = total if total >= int(conf.controller_demotion_cluster_size) else 0
+        return cluster, storms
 
     # -- actuators --------------------------------------------------------
     def _actuate(self, action: str, trigger: str, now: float, fn, **details) -> bool:
@@ -299,7 +396,8 @@ class OpsController:
             self._announce_observe_only()
             stats.increment("controller.deferred")
             _EVT_ACTUATION.emit(
-                action=action, trigger=trigger, outcome="observe_only", **details
+                action=action, trigger=trigger, outcome="observe_only",
+                member=self.member_id, **details,
             )
             return False
         # The fault point fires BEFORE any mutation: a CrashPoint here
@@ -308,23 +406,40 @@ class OpsController:
         faults.fault_point("controller.actuate")
         try:
             with obs_trace.span("controller.actuate", action=action, trigger=trigger):
-                fn()
+                result = fn()
         except Exception as e:
             # The failed subsystem's own Action already rolled back;
             # record, cool down, keep reconciling. CrashPoint propagates.
             stats.increment("controller.actuation_failures")
             _EVT_FAILED.emit(
-                action=action, trigger=trigger, error=f"{type(e).__name__}: {e}"
+                action=action, trigger=trigger, member=self.member_id,
+                error=f"{type(e).__name__}: {e}",
             )
             self._cooldowns[action] = now + float(conf.controller_cooldown_seconds)
             return False
+        if result == "observed":
+            # Fleet-coordinated decision resolved by ANOTHER member (a
+            # heal follower): nothing mutated here, so no budget spent
+            # and no actuation counted — "exactly one fleet-wide" stays
+            # exact — but the decision is audited and cooled down like
+            # any other.
+            self._cooldowns[action] = now + float(conf.controller_cooldown_seconds)
+            record = _EVT_ACTUATION.emit(
+                action=action, trigger=trigger, outcome="observed",
+                member=self.member_id, budget_remaining=self._budget, **details,
+            )
+            self._recent_actions.append(
+                {"action": action, "trigger": trigger, "at": now,
+                 "seq": record["seq"]}
+            )
+            return True
         self._budget -= 1
         _BUDGET_REMAINING.set(self._budget)
         stats.increment("controller.actuations")
         self._cooldowns[action] = now + float(conf.controller_cooldown_seconds)
         record = _EVT_ACTUATION.emit(
             action=action, trigger=trigger, outcome="executed",
-            budget_remaining=self._budget, **details,
+            member=self.member_id, budget_remaining=self._budget, **details,
         )
         self._recent_actions.append(
             {"action": action, "trigger": trigger, "at": now, "seq": record["seq"]}
@@ -374,14 +489,73 @@ class OpsController:
         _ENGAGED.set(0)
         record = _EVT_ACTUATION.emit(
             action="shed.release", trigger=trigger, outcome="executed",
-            budget_remaining=self._budget,
+            member=self.member_id, budget_remaining=self._budget,
         )
         self._recent_actions.append(
             {"action": "shed.release", "trigger": trigger, "at": now,
              "seq": record["seq"]}
         )
 
-    def _heal(self, conf, name: str) -> None:
+    def _heal(self, conf, name: str):
+        """Heal one quarantined index — fleet-coordinated when a fleet
+        directory is discoverable, process-local otherwise.
+
+        Coordinated path: the heal routes through the single-flight
+        lease keyed per index. The lease LEADER runs the local heal
+        (recover + gated rebuild) and publishes a generation-stamped
+        marker; every FOLLOWER observes the fresh marker, lifts its own
+        quarantine with the idempotent `recover()` (the leader already
+        repaired the shared bytes), and returns ``"observed"`` so
+        `_actuate` spends no budget and counts no heal — exactly one
+        `controller.heals` fleet-wide. Generations (not wall-clock
+        timestamps) mark freshness: a member that restarts observes one
+        stale marker at most, then heals normally next tick."""
+        root = self._fleet_root(conf)
+        if root is None:
+            self._heal_local(conf, name)
+            return None
+        from hyperspace_tpu.serve.fleet.singleflight import SingleFlight
+
+        heal_dir = root / "heal"
+        heal_dir.mkdir(parents=True, exist_ok=True)
+        marker = heal_dir / f"{name}.json"
+        sf = SingleFlight(
+            heal_dir,
+            lease_ttl_s=float(conf.fleet_lease_seconds),
+            wait_s=float(conf.fleet_singleflight_wait_seconds),
+        )
+
+        def check():
+            doc = self._read_marker(marker)
+            if doc is None:
+                return None
+            gen = int(doc.get("generation", 0))
+            if gen <= self._seen_heal_gen.get(name, 0):
+                return None  # our own past observation, not a fresh heal
+            return doc
+
+        def build():
+            self._heal_local(conf, name)
+            prior = self._read_marker(marker) or {}
+            gen = int(prior.get("generation", 0)) + 1
+            self._write_marker(marker, {
+                "index": name, "member": self.member_id, "generation": gen,
+            })
+            self._seen_heal_gen[name] = gen
+            return {"led": True, "generation": gen}
+
+        doc = sf.run(f"heal.{name}", build, check=check,
+                     on_lease=self._note_lease)
+        if isinstance(doc, dict) and not doc.get("led"):
+            # Follower: another member rebuilt the shared bytes; lift
+            # the LOCAL quarantine (recover is idempotent) and record
+            # the generation we acted on.
+            self._seen_heal_gen[name] = int(doc.get("generation", 0))
+            self.hyperspace.recover(name)
+            return "observed"
+        return None
+
+    def _heal_local(self, conf, name: str) -> None:
         """recover() repairs the log and lifts the quarantine; the gated
         full refresh rebuilds the data files through the crash-safe
         Action protocol so the corruption is actually gone (not merely
@@ -390,6 +564,149 @@ class OpsController:
         if conf.controller_heal_rebuild:
             self.hyperspace.refresh_index(name, "full")
         stats.increment("controller.heals")
+
+    def _fleet_root(self, conf) -> Path | None:
+        """The shared fleet directory heals coordinate under, or None
+        when coordination is off / no fleet root is discoverable (then
+        heals stay process-local — the pre-fleet behavior)."""
+        if not getattr(conf, "controller_heal_coordinate", True):
+            return None
+        if getattr(conf, "fleet_cache_dir", ""):
+            return Path(conf.fleet_cache_dir)
+        sp = Path(conf.system_path)
+        if sp.is_dir():
+            return sp / "_fleet"
+        return None
+
+    def _note_lease(self, lease, token) -> None:
+        """SingleFlight's on_lease hook: remember the lease an in-flight
+        heal holds so stop() can release it before joining."""
+        with self._lease_lock:
+            self._held_lease = (lease, token) if lease is not None else None
+
+    @staticmethod
+    def _read_marker(path: Path) -> dict | None:
+        try:
+            return json.loads(path.read_text())
+        except (OSError, ValueError):
+            return None  # absent or torn: treated as no published heal
+
+    @staticmethod
+    def _write_marker(path: Path, doc: dict) -> None:
+        # Tmp + rename so a follower's read never sees a torn document;
+        # writer races are excluded by the single-flight lease.
+        tmp = path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(doc))
+        os.replace(tmp, path)
+
+    def _reconcile_scale(self, conf, now: float) -> None:
+        """Fleet-scale hysteresis: count saturated vs calm ticks from
+        the worst of the fleet-aggregate and local queue ratios, grow
+        the member count after `hysteresisTicks` saturated ticks, and
+        restore the pre-episode baseline after `recoveryTicks` calm
+        ticks (budget-free — the controller always leaves the fleet as
+        found)."""
+        sat = self._saturation_ratio()
+        if sat >= float(getattr(conf, "controller_scale_saturation", 0.75)):
+            self._sat_ticks += 1
+            self._calm_ticks = 0
+        else:
+            self._calm_ticks += 1
+            self._sat_ticks = 0
+        current = int(self.supervisor.n)
+        max_workers = int(getattr(conf, "controller_scale_max_workers", 8))
+        if (
+            self._sat_ticks >= int(conf.controller_hysteresis_ticks)
+            and current < max_workers
+        ):
+            step = max(1, int(getattr(conf, "controller_scale_step", 1)))
+            target = min(current + step, max_workers)
+            baseline = self._scale_baseline if self._scale_baseline is not None else current
+            if self._actuate(
+                "fleet.scale.up", trigger="fleet.saturation", now=now,
+                fn=lambda t=target: self._scale_to(t, conf),
+                workers=target, saturation=round(sat, 3),
+            ):
+                self._scale_baseline = baseline
+                self._sat_ticks = 0
+        elif (
+            self._calm_ticks >= int(conf.controller_recovery_ticks)
+            and self._scale_baseline is not None
+            and current > self._scale_baseline
+        ):
+            self._scale_release(conf, now)
+
+    def _saturation_ratio(self) -> float:
+        """Worst queue-fullness ratio across the fleet aggregate and the
+        local server (either one saturating is a real capacity signal)."""
+        ratios = [0.0]
+        try:
+            agg = self.supervisor.fleet_health().get("saturation", {})
+            ratios.append(
+                float(agg.get("queue_depth", 0)) / max(1.0, float(agg.get("max_queue_depth", 0)))
+            )
+        except Exception:
+            # Unreachable members count as zero load for this tick, but
+            # the failed probe itself is still a signal.
+            stats.increment("controller.health_probe_errors")
+        if self.server is not None:
+            try:
+                local = self.server.saturation()
+                ratios.append(
+                    float(local.get("queue_depth", 0))
+                    / max(1.0, float(local.get("max_queue_depth", 0)))
+                )
+            except Exception:
+                stats.increment("controller.health_probe_errors")
+        return max(ratios)
+
+    def _scale_to(self, target: int, conf) -> None:
+        min_workers = max(1, int(getattr(conf, "fleet_min_workers", 1)))
+        self.supervisor.set_target_workers(target, min_workers=min_workers)
+        stats.increment("controller.scale")
+
+    def _scale_release(self, conf, now: float) -> None:
+        """Restore the pre-episode member count. Budget-free like
+        `_release_overload`: the scale-down is the controller leaving
+        the fleet as it found it."""
+        baseline = self._scale_baseline
+        if baseline is None:
+            return
+        faults.fault_point("controller.actuate")
+        try:
+            min_workers = max(1, int(getattr(conf, "fleet_min_workers", 1)))
+            self.supervisor.set_target_workers(baseline, min_workers=min_workers)
+        except Exception as e:
+            stats.increment("controller.actuation_failures")
+            _EVT_FAILED.emit(
+                action="fleet.scale.down", trigger="fleet.recovered",
+                member=self.member_id, error=f"{type(e).__name__}: {e}",
+            )
+            return
+        self._scale_baseline = None
+        self._calm_ticks = 0
+        stats.increment("controller.scale")
+        record = _EVT_ACTUATION.emit(
+            action="fleet.scale.down", trigger="fleet.recovered",
+            outcome="executed", member=self.member_id,
+            budget_remaining=self._budget, workers=baseline,
+        )
+        self._recent_actions.append(
+            {"action": "fleet.scale.down", "trigger": "fleet.recovered",
+             "at": now, "seq": record["seq"]}
+        )
+
+    def _storm_response(self, key: str) -> None:
+        """One recompile storm, one response: pin the storming key's
+        signature to the raw-scan route (so it stops feeding the jit
+        cache — versioned like every routing entry, any index mutation
+        re-promotes it) and drop the jit caches once to evict the
+        churned executables."""
+        from hyperspace_tpu.utils import jit_memory
+
+        self.session.routing_ledger().pin(key, "raw")
+        jit_memory.drop_caches(reason="controller.storm_response")
+        _EVT_STORM.emit(key=key, route="raw", member=self.member_id)
 
     def _sweep(self) -> None:
         # The lifecycle policy's own gates (autoCreate/autoVacuum/
@@ -425,11 +742,14 @@ class OpsController:
             return {
                 "enabled": enabled,
                 "mode": mode,
+                "member": self.member_id,
                 "engaged": self._engaged,
                 "budget_remaining": self._budget,
                 "verdicts": dict(self._last_verdicts),
                 "page_ticks": self._page_ticks,
                 "ok_ticks": self._ok_ticks,
+                "sat_ticks": self._sat_ticks,
+                "scale_baseline": self._scale_baseline,
                 "pending_demotions": sum(c for _, c in self._demotions),
                 "recent_actions": list(self._recent_actions),
             }

@@ -61,14 +61,22 @@ class SingleFlight:
         self.lease_ttl_s = float(lease_ttl_s)
         self.wait_s = float(wait_s)
 
-    def run(self, name: str, build: Callable, check: Callable | None = None):
+    def run(self, name: str, build: Callable, check: Callable | None = None,
+            on_lease: Callable | None = None):
         """Run `build()` at most once across the fleet for `name`,
         returning its value. `check() -> value | None` observes the
         leader's published artifact (e.g. a shared-cache read); without
         it every claimant that loses the lease waits for the lease to
         clear and then builds (pure serialization, no artifact reuse).
         Exceptions from `build` propagate to the caller that ran it;
-        the lease is always released."""
+        the lease is always released.
+
+        `on_lease(lease, token)` (optional) is told when this process
+        WINS the lease, and `on_lease(None, None)` when it is released —
+        a shutdown path (OpsController.stop) uses it to release a lease
+        its in-flight actuation still holds instead of leaving it live
+        for TTL seconds. FileLease.release is token-checked and
+        idempotent, so the `finally` re-release is harmless."""
         lease = FileLease(self.root / f"{key_name(name)}.lease", self.lease_ttl_s)
         deadline = time.monotonic() + self.wait_s
         while True:
@@ -84,6 +92,8 @@ class SingleFlight:
             claim = lease.try_acquire()
             if claim is not None:
                 token, reaped = claim
+                if on_lease is not None:
+                    on_lease(lease, token)
                 try:
                     if check is not None:
                         # Double-check after winning: the previous
@@ -100,6 +110,8 @@ class SingleFlight:
                     return build()
                 finally:
                     lease.release(token)
+                    if on_lease is not None:
+                        on_lease(None, None)
             if time.monotonic() >= deadline:
                 # The leader is slow (or its artifact is uncacheable):
                 # build locally. Same cost as a world without dedup.

@@ -28,6 +28,13 @@ The supervisor's contract:
   deterministic per-member jitter, capped) so a crash-looping worker
   cannot burn its whole budget in milliseconds — the moment backoff
   engages, a WARN ``fleet.worker.crash_loop`` event names the member.
+- **scale**: `set_target_workers(n)` grows or shrinks the member count
+  live — the OpsController's fleet actuator (scale up on sustained
+  fleet-health saturation, back down on recovery). New members spawn
+  through the same env-shipping path as start(); drained members are
+  terminated, deregistered, and never respawned. Every change counts
+  (`fleet.worker.scaled`), emits an INFO ``fleet.worker.scaled`` event,
+  and moves the ``fleet.target_workers`` gauge.
 - **drain/stop**: `stop()` sets the shared stop event (workers exit
   their serve loops, QueryServers drain) and joins with a timeout;
   stragglers are terminated. The supervisor is a context manager.
@@ -48,12 +55,18 @@ from pathlib import Path
 
 from hyperspace_tpu import faults, stats
 from hyperspace_tpu.obs import events as obs_events
+from hyperspace_tpu.obs import metrics as obs_metrics
 from hyperspace_tpu.obs import trace as obs_trace
 from hyperspace_tpu.parallel.procpool import ProcessHost
 from hyperspace_tpu.utils import file_utils
 
 _EVT_RESTARTED = obs_events.declare("fleet.worker.restarted")
 _EVT_CRASH_LOOP = obs_events.declare("fleet.worker.crash_loop")
+_EVT_SCALED = obs_events.declare("fleet.worker.scaled")
+
+_TARGET_WORKERS = obs_metrics.gauge(
+    "fleet.target_workers", "the supervisor's current target member count"
+)
 
 _MONITOR_POLL_S = 0.1
 _HEALTH_TIMEOUT_S = 5.0
@@ -203,11 +216,53 @@ class FleetSupervisor:
         with self._lock:
             for wid in range(self.n):
                 self._spawn(wid)
+            _TARGET_WORKERS.set(self.n)
             self._monitor_thread = threading.Thread(
                 target=self._monitor, name="hs-fleet-monitor", daemon=True
             )
             self._monitor_thread.start()
         return self
+
+    def set_target_workers(self, n: int, min_workers: int = 1) -> int:
+        """Scale the fleet to `n` members (the OpsController's fleet
+        actuator). Up: fresh ids spawn through the same env-shipping
+        path as start(), so the coordinator's fault rules and tracer
+        state reach the new members. Down: the highest ids are
+        terminated, their registration JSON and restart state dropped,
+        so `fleet_health` stops counting them. Clamped to at least
+        `min_workers`; returns the applied target. Idempotent — a no-op
+        change emits nothing."""
+        n = max(int(min_workers), int(n))
+        with self._lock:
+            if self._stopping:
+                return self.n
+            old = self.n
+            if n == old:
+                return old
+            to_drain = list(range(n, old))
+            for wid in range(old, n):
+                # A re-grown slot starts with a fresh restart budget —
+                # its crash history belonged to the drained member.
+                self._restarts.pop(wid, None)
+                self._restart_at.pop(wid, None)
+                self._spawn(wid)
+            # Publish the new target BEFORE draining: the monitor skips
+            # wid >= self.n, so a drained member that exits non-zero in
+            # the termination window cannot be respawned.
+            self.n = n
+        for wid in to_drain:
+            self._host.terminate(wid, grace=5.0)
+            with self._lock:
+                self._restarts.pop(wid, None)
+                self._restart_at.pop(wid, None)
+            try:
+                (Path(self.fleet_dir) / WORKERS_DIRNAME / f"{wid}.json").unlink()
+            except OSError:
+                pass
+        stats.increment("fleet.worker.scaled", abs(n - old))
+        _EVT_SCALED.emit(from_workers=old, to_workers=n)
+        _TARGET_WORKERS.set(n)
+        return n
 
     def _spawn(self, worker_id: int):
         env = {
@@ -238,6 +293,8 @@ class FleetSupervisor:
                     if not p.is_alive() and p.exitcode not in (0, None)
                 ]
                 for wid, p in dead:
+                    if isinstance(wid, int) and wid >= self.n:
+                        continue  # scaled-down slot: stays down by design
                     used = self._restarts.get(wid, 0)
                     if used >= self.max_restarts:
                         continue
@@ -317,8 +374,10 @@ class FleetSupervisor:
             for sched in (doc or {}).get("scheduler", []):
                 for k in agg:
                     agg[k] += int(sched.get(k, 0))
+        with self._lock:
+            spawned = self.n
         return {"status": worst, "saturation": agg, "members": members,
-                "alive": self.alive_count(), "spawned": self.n}
+                "alive": self.alive_count(), "spawned": spawned}
 
     def aggregate_metrics(self) -> dict[int, str]:
         """Raw Prometheus text per registered live member (a scrape

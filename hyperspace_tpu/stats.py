@@ -92,6 +92,18 @@ Counter names in use:
   exhaustion
 - ``controller.heals``  quarantined indexes the controller healed
   (recover() + gated rebuild) without a human in the loop
+- ``controller.scale``  fleet scale actuations the controller executed
+  (set_target_workers up on sustained saturation, back down on
+  recovery)
+- ``controller.health_probe_errors``  saturation probes (fleet-health
+  aggregate or local server) that raised — the member counts as zero
+  load for that tick, but the operator still gets the signal
+- ``fleet.worker.scaled``  fleet members added or drained by
+  ``FleetSupervisor.set_target_workers`` (counted per member moved,
+  paired with an INFO ``fleet.worker.scaled`` event)
+- ``faults.delays_injected``  brownout delays the injection harness
+  applied (a `delay_s` fault rule firing — the slow-path counterpart
+  of ``faults.injected``)
 """
 
 from __future__ import annotations
@@ -140,6 +152,10 @@ KNOWN_COUNTERS = (
     "controller.actuation_failures",
     "controller.deferred",
     "controller.heals",
+    "controller.scale",
+    "controller.health_probe_errors",
+    "fleet.worker.scaled",
+    "faults.delays_injected",
 )
 
 _counters = {name: _metrics.counter(name) for name in KNOWN_COUNTERS}

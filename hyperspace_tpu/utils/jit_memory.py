@@ -59,6 +59,22 @@ def map_count() -> int:
         return 0
 
 
+def drop_caches(reason: str) -> None:
+    """Unconditionally clear jax's compilation caches — the audited
+    actuator the OpsController's recompile-storm response uses (the
+    sampled guard above stays the autonomous pressure-relief path).
+    Counted and WARN-announced like every other drop, with the `reason`
+    on the event so the decision log says WHY the executables vanished."""
+    import jax
+
+    jax.clear_caches()
+    stats.increment("jit_memory.cache_drops")
+    _EVT_CACHE_DROP.emit(reason=reason, map_count=map_count(), limit=_map_limit())
+    from hyperspace_tpu.obs import runtime as obs_runtime
+
+    obs_runtime.refresh_process_gauges()
+
+
 def maybe_relieve_jit_pressure() -> bool:
     """Sampled check; clears jax's compilation caches when the process
     nears the kernel mapping limit. Returns True when a clear ran."""

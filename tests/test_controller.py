@@ -459,3 +459,287 @@ def test_start_registers_with_shared_health_endpoint():
             assert len(doc["controller"]) == 1
     finally:
         obs_http.release()
+
+
+# -- fleet coordination: lease-elected healing -------------------------------
+
+
+def _fleet_controller(tmp_path, member_id, **conf_overrides):
+    """A controller whose FakeSession points at a real (tmp) store dir,
+    so `_fleet_root` discovers `<system.path>/_fleet` and heals go
+    through the single-flight lease."""
+    session = FakeSession(**conf_overrides)
+    session.conf.set("hyperspace.system.path", str(tmp_path))
+    hs = FakeHyperspace(session)
+    return hs, OpsController(hs, clock=lambda: 0.0, member_id=member_id)
+
+
+def _heal_lease_path(tmp_path, name="shared"):
+    from hyperspace_tpu.serve.fleet.singleflight import key_name
+
+    return tmp_path / "_fleet" / "heal" / f"{key_name(f'heal.{name}')}.lease"
+
+
+def test_two_controllers_one_store_exactly_one_heal(tmp_path):
+    _serve_counters()
+    hs_a, ctrl_a = _fleet_controller(tmp_path, "member-a")
+    hs_b, ctrl_b = _fleet_controller(tmp_path, "member-b")
+    for hs in (hs_a, hs_b):
+        with hs.session._state_lock:
+            hs.session.index_health["/idx/shared"] = {"reason": "torn"}
+    ctrl_a.step(now=0.0)
+    ctrl_b.step(now=0.0)
+    # exactly ONE member (the lease leader) ran recover + rebuild …
+    assert hs_a.calls == [("recover", "shared"), ("refresh", "shared", "full")]
+    # … the follower lifted its LOCAL quarantine via recover only
+    assert hs_b.calls == [("recover", "shared")]
+    assert hs_a.session.index_health == {} and hs_b.session.index_health == {}
+    assert stats.get("controller.heals") == 1
+    (led,) = [e for e in _actuation_events("heal.shared")
+              if e["fields"]["outcome"] == "executed"]
+    (obs,) = [e for e in _actuation_events("heal.shared")
+              if e["fields"]["outcome"] == "observed"]
+    assert led["fields"]["member"] == "member-a"
+    assert obs["fields"]["member"] == "member-b"
+    # the follower's observation spent no budget and no heal count
+    assert ctrl_b.snapshot()["budget_remaining"] == 32
+    assert ctrl_a.snapshot()["budget_remaining"] == 31
+    # the published marker carries the leader + generation
+    marker = json.loads((tmp_path / "_fleet" / "heal" / "shared.json").read_text())
+    assert marker == {"index": "shared", "member": "member-a", "generation": 1}
+
+
+def test_sigkilled_healer_lease_is_reaped_and_taken_over(tmp_path):
+    _serve_counters()
+    hs, ctrl = _fleet_controller(
+        tmp_path, "survivor", **{"hyperspace.fleet.lease.seconds": 5.0}
+    )
+    with hs.session._state_lock:
+        hs.session.index_health["/idx/shared"] = {"reason": "torn"}
+    # a healer died (SIGKILL) holding the heal lease: its epoch is
+    # beyond the TTL, so the surviving member must reap it and take over
+    lease = _heal_lease_path(tmp_path)
+    lease.parent.mkdir(parents=True, exist_ok=True)
+    lease.write_text(f"{time.time() - 120.0:.6f}:999999:dead")
+    takeovers0 = stats.get("fleet.singleflight.takeovers")
+    ctrl.step(now=0.0)
+    assert ("recover", "shared") in hs.calls
+    assert stats.get("fleet.singleflight.takeovers") == takeovers0 + 1
+    assert stats.get("controller.heals") == 1
+    assert not lease.exists()
+    takeover = [e for e in events.recent()
+                if e["name"] == "fleet.singleflight.takeover"]
+    assert takeover and takeover[0]["fields"]["key"] == "heal.shared"
+
+
+def test_restarted_member_observes_stale_marker_once_then_heals(tmp_path):
+    """A fresh controller (restart: empty generation memory) observes a
+    pre-existing marker at most ONCE; when the quarantine persists past
+    the cooldown it leads a real heal and bumps the generation."""
+    _serve_counters()
+    hs, ctrl = _fleet_controller(tmp_path, "restarted")
+    marker = tmp_path / "_fleet" / "heal" / "shared.json"
+    marker.parent.mkdir(parents=True, exist_ok=True)
+    marker.write_text(json.dumps(
+        {"index": "shared", "member": "old-member", "generation": 3}
+    ))
+    with hs.session._state_lock:
+        hs.session.index_health["/idx/shared"] = {"reason": "torn"}
+    ctrl.step(now=0.0)
+    assert hs.calls == [("recover", "shared")]  # observed, recover only
+    # the corruption was NOT actually healed: it comes back
+    with hs.session._state_lock:
+        hs.session.index_health["/idx/shared"] = {"reason": "torn again"}
+    ctrl.step(now=10.0)  # inside the heal cooldown: deferred
+    assert hs.calls == [("recover", "shared")]
+    ctrl.step(now=31.0)  # past cooldown: marker gen 3 already seen -> LEAD
+    assert hs.calls == [
+        ("recover", "shared"),
+        ("recover", "shared"), ("refresh", "shared", "full"),
+    ]
+    assert json.loads(marker.read_text())["generation"] == 4
+    assert json.loads(marker.read_text())["member"] == "restarted"
+
+
+def test_stop_mid_heal_releases_the_held_lease(tmp_path):
+    """stop() while an in-flight heal holds the single-flight lease must
+    release it BEFORE joining — a controller stopped mid-heal never
+    leaves a live lease wedging the fleet for TTL seconds."""
+    _serve_counters()
+    hs, ctrl = _fleet_controller(tmp_path, "stopping")
+    entered = threading.Event()
+    unblock = threading.Event()
+
+    def slow_refresh(name, mode="full"):
+        hs.calls.append(("refresh", name, mode))
+        entered.set()
+        assert unblock.wait(timeout=30.0)
+
+    hs.refresh_index = slow_refresh
+    with hs.session._state_lock:
+        hs.session.index_health["/idx/shared"] = {"reason": "torn"}
+    t = threading.Thread(target=lambda: ctrl.step(now=0.0))
+    t.start()
+    try:
+        assert entered.wait(timeout=30.0)  # the heal is mid-build, lease held
+        lease = _heal_lease_path(tmp_path)
+        assert lease.exists()
+        ctrl.stop(timeout=0.5)
+        assert not lease.exists()  # released BEFORE the join, not after TTL
+    finally:
+        unblock.set()
+        t.join(timeout=30.0)
+    assert not t.is_alive()
+
+
+def test_heal_coordination_gate_off_keeps_heals_local(tmp_path):
+    _serve_counters()
+    hs, ctrl = _fleet_controller(
+        tmp_path, "solo", **{"hyperspace.controller.heal.coordinate": "false"}
+    )
+    with hs.session._state_lock:
+        hs.session.index_health["/idx/shared"] = {"reason": "torn"}
+    ctrl.step(now=0.0)
+    assert hs.calls == [("recover", "shared"), ("refresh", "shared", "full")]
+    assert not (tmp_path / "_fleet").exists()  # no marker, no lease
+
+
+# -- fleet scaling: supervisor actuation -------------------------------------
+
+
+class FakeSupervisor:
+    """The FleetSupervisor surface the scale actuator drives."""
+
+    def __init__(self, n=2):
+        self.n = n
+        self.calls = []
+        self.saturation = {"queue_depth": 0, "max_queue_depth": 64}
+
+    def set_target_workers(self, n, min_workers=1):
+        self.calls.append(("scale", n, min_workers))
+        self.n = max(min_workers, n)
+        return self.n
+
+    def fleet_health(self):
+        return {"saturation": dict(self.saturation)}
+
+
+def _scale_controller(sup, **conf_overrides):
+    session = FakeSession(**conf_overrides)
+    hs = FakeHyperspace(session)
+    ctrl = OpsController(hs, clock=lambda: 0.0, member_id="scaler",
+                         supervisor=sup)
+    return hs, ctrl
+
+
+def test_sustained_saturation_scales_up_and_recovery_scales_back():
+    _serve_counters()
+    sup = FakeSupervisor(n=2)
+    hs, ctrl = _scale_controller(sup)
+    sup.saturation["queue_depth"] = 60  # ratio 0.94 >= 0.75
+    ctrl.step(now=0.0)  # saturated tick 1 of hysteresis 2
+    assert sup.calls == []
+    ctrl.step(now=1.0)  # saturated tick 2: scale up
+    assert sup.calls == [("scale", 3, 1)]
+    assert sup.n == 3
+    assert stats.get("controller.scale") == 1
+    (up,) = _actuation_events("fleet.scale.up")
+    assert up["fields"]["trigger"] == "fleet.saturation"
+    assert up["fields"]["workers"] == 3
+    budget_after_up = ctrl.snapshot()["budget_remaining"]
+    # calm ticks: the fleet drains, the episode releases to baseline
+    sup.saturation["queue_depth"] = 0
+    ctrl.step(now=2.0)  # calm tick 1 of recovery 2
+    assert sup.n == 3
+    ctrl.step(now=3.0)  # calm tick 2: scale back down
+    assert sup.calls[-1] == ("scale", 2, 1)
+    assert sup.n == 2
+    (down,) = _actuation_events("fleet.scale.down")
+    assert down["fields"]["trigger"] == "fleet.recovered"
+    # the release is budget-free, like every release
+    assert ctrl.snapshot()["budget_remaining"] == budget_after_up
+    assert ctrl.snapshot()["scale_baseline"] is None
+    assert stats.get("controller.scale") == 2
+
+
+def test_scale_up_respects_max_workers_cap():
+    _serve_counters()
+    sup = FakeSupervisor(n=2)
+    hs, ctrl = _scale_controller(
+        sup, **{"hyperspace.controller.scale.maxWorkers": 3,
+                "hyperspace.controller.cooldownSeconds": 1.0}
+    )
+    sup.saturation["queue_depth"] = 64
+    for i in range(8):
+        ctrl.step(now=float(i * 5))
+    assert sup.n == 3  # grew one step, then pinned at the cap
+    assert len(_actuation_events("fleet.scale.up")) == 1
+
+
+def test_local_server_saturation_alone_drives_scale_up():
+    _serve_counters()
+    sup = FakeSupervisor(n=1)
+    session = FakeSession()
+    hs = FakeHyperspace(session)
+    gate = threading.Event()
+    server = QueryServer(
+        session, workers=1, max_queue_depth=32,
+        run_fn=lambda p: gate.wait(timeout=30.0),
+    )
+    try:
+        ctrl = OpsController(hs, server=server, clock=lambda: 0.0,
+                             supervisor=sup)
+        # fleet aggregate is idle; the LOCAL queue ratio must still count
+        for _ in range(30):
+            server.submit(object())
+        ctrl.step(now=0.0)
+        ctrl.step(now=1.0)
+        assert sup.calls and sup.calls[0][1] == 2
+    finally:
+        gate.set()
+        server.shutdown()
+
+
+# -- recompile-storm response ------------------------------------------------
+
+
+class FakeLedger:
+    def __init__(self):
+        self.pins = []
+
+    def pin(self, signature, mode="raw"):
+        self.pins.append((signature, mode))
+
+
+def test_recompile_storm_pins_raw_and_drops_jit_caches():
+    _serve_counters()
+    hs, ctrl = _controller()
+    ledger = FakeLedger()
+    hs.session.routing_ledger = lambda: ledger
+    drops0 = stats.get("jit_memory.cache_drops")
+    events.declare("jit.recompile_storm").emit(key="sig-hot", recompiles=9)
+    ctrl.step(now=0.0)
+    assert ledger.pins == [("sig-hot", "raw")]
+    assert stats.get("jit_memory.cache_drops") == drops0 + 1
+    (act,) = _actuation_events("storm.response.sig-hot")
+    assert act["fields"]["trigger"] == "jit.recompile_storm"
+    assert act["fields"]["outcome"] == "executed"
+    storm = [e for e in events.recent()
+             if e["name"] == "controller.storm_response"]
+    assert storm and storm[0]["fields"]["key"] == "sig-hot"
+    assert storm[0]["fields"]["route"] == "raw"
+    # same key storming again inside the cooldown: deferred, one pin
+    events.declare("jit.recompile_storm").emit(key="sig-hot", recompiles=9)
+    ctrl.step(now=1.0)
+    assert ledger.pins == [("sig-hot", "raw")]
+
+
+def test_storm_response_gate_off_never_pins():
+    _serve_counters()
+    hs, ctrl = _controller(**{"hyperspace.controller.stormResponse": "false"})
+    ledger = FakeLedger()
+    hs.session.routing_ledger = lambda: ledger
+    events.declare("jit.recompile_storm").emit(key="sig-x", recompiles=9)
+    ctrl.step(now=0.0)
+    assert ledger.pins == []
+    assert _actuation_events("storm.response.sig-x") == []

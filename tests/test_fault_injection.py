@@ -142,6 +142,112 @@ class TestFaultHarness:
 
 
 # ---------------------------------------------------------------------------
+# Brownout (slow-path) injection
+# ---------------------------------------------------------------------------
+
+
+class TestBrownoutDelay:
+    """`delay_s` rules: the point goes SLOW instead of failed — with a
+    virtual sleeper the accounting is wall-clock-free, jitter is a
+    deterministic function of the call counter, the kill switch disarms
+    a delay already in flight, and delay composes before error."""
+
+    def _virtual(self):
+        slept = []
+        faults.set_sleeper(slept.append)
+        return slept
+
+    def test_pure_delay_slows_then_proceeds(self):
+        slept = self._virtual()
+        delays0 = stats.get("faults.delays_injected")
+        with faults.injected("bucket.read", delay_s=0.2):
+            faults.fault_point("bucket.read")  # must NOT raise
+        assert sum(slept) == pytest.approx(0.2)
+        assert stats.get("faults.delays_injected") == delays0 + 1
+
+    def test_jitter_is_deterministic_per_call(self):
+        def schedule():
+            slept = self._virtual()
+            totals = []
+            with faults.injected("bucket.read", delay_s=0.1, jitter_s=0.05):
+                for _ in range(3):
+                    slept.clear()
+                    faults.fault_point("bucket.read")
+                    totals.append(round(sum(slept), 6))
+            return totals
+
+        first, second = schedule(), schedule()
+        assert first == second  # same schedule every run, no RNG
+        assert len(set(first)) > 1  # the jitter actually varies per call
+        for n, total in enumerate(first, start=1):
+            expect = 0.1 + 0.05 * ((n * 2654435761) % 1000) / 1000.0
+            assert total == pytest.approx(expect)
+
+    def test_delay_composes_before_error(self):
+        slept = self._virtual()
+        with faults.injected("bucket.read", delay_s=0.3, error=FaultError):
+            with pytest.raises(FaultError):
+                faults.fault_point("bucket.read")
+        assert sum(slept) == pytest.approx(0.3)  # slow FIRST, then failed
+
+    def test_kill_switch_disarms_a_delay_in_flight(self):
+        slept = []
+
+        def sleeper(s):
+            slept.append(s)
+            faults.set_enabled(False)  # flipped mid-delay
+
+        faults.set_sleeper(sleeper)
+        faults.inject("bucket.read", delay_s=10.0)
+        try:
+            faults.fault_point("bucket.read")
+        finally:
+            faults.set_enabled(True)
+            faults.reset()
+        # one slice at most ran; the remaining ~10s were abandoned
+        assert sum(slept) <= 0.1
+
+    def test_delay_clamped_by_max_delay(self):
+        slept = self._virtual()
+        faults.set_max_delay(0.1)
+        try:
+            with faults.injected("bucket.read", delay_s=60.0, jitter_s=60.0):
+                faults.fault_point("bucket.read")
+        finally:
+            faults.set_max_delay(30.0)
+        assert sum(slept) == pytest.approx(0.1)
+
+    def test_deadline_carrying_path_times_out_typed_under_delay(self):
+        """A brownout under a deadline-carrying path surfaces a TYPED
+        QueryTimeout — delayed queries must never hang their callers."""
+        import threading
+
+        from hyperspace_tpu.config import HyperspaceConf
+        from hyperspace_tpu.exceptions import QueryTimeout
+        from hyperspace_tpu.serve.scheduler import QueryServer
+
+        class _Session:
+            conf = HyperspaceConf()
+            _state_lock = threading.RLock()
+            index_health = {}
+
+        faults.inject("bucket.read", delay_s=0.5)  # real sleeper: real slowness
+        server = QueryServer(
+            _Session(), workers=1, max_queue_depth=8,
+            run_fn=lambda p: faults.fault_point("bucket.read"),
+        )
+        try:
+            slow = server.submit(object())  # occupies the only worker
+            queued = server.submit(object(), timeout=0.05)  # expires queued
+            with pytest.raises(QueryTimeout):
+                queued.result(timeout=10.0)
+            slow.result(timeout=10.0)  # the delayed query itself completes
+        finally:
+            faults.reset()
+            server.shutdown()
+
+
+# ---------------------------------------------------------------------------
 # Retry / backoff
 # ---------------------------------------------------------------------------
 

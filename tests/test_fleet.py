@@ -663,6 +663,34 @@ class TestSupervisorFaultContinuity:
             faults.reset()
         assert out.read_text() == "fault-fired"
 
+    def test_export_state_carries_brownout_schedule(self):
+        """The spawn-shipping contract covers the slow path too: a
+        delay rule's full brownout schedule (delay, jitter, the
+        configured clamp) survives export_state -> install_state, with
+        fresh per-process call counters."""
+        from hyperspace_tpu import faults
+
+        faults.inject("bucket.read", delay_s=0.25, jitter_s=0.05, times=3)
+        faults.set_max_delay(12.0)
+        try:
+            state = faults.export_state()
+            (rule,) = state["rules"]
+            assert rule.delay_s == 0.25 and rule.jitter_s == 0.05
+            assert rule.calls == 0 and rule.fired == 0  # fresh schedule
+            assert state["max_delay_s"] == 12.0
+            # a "worker": install and verify the delay actually applies
+            faults.reset()
+            faults.install_state(state)
+            slept = []
+            faults.set_sleeper(slept.append)
+            faults.fault_point("bucket.read")
+            assert sum(slept) == pytest.approx(
+                0.25 + 0.05 * ((1 * 2654435761) % 1000) / 1000.0
+            )
+        finally:
+            faults.set_max_delay(30.0)
+            faults.reset()
+
 
 # -- obs/http port=0 satellite ------------------------------------------------
 
