@@ -60,6 +60,16 @@ a CI run finishes in ~a minute); fault injection counts calls, never
 wall time. Real wall time only enters through measured query latencies
 (the latency histogram) and the SIGKILL episode's respawn bound.
 
+The incident flight recorder runs throughout: the durable telemetry
+journal is ON (its overhead rides every query, so the completed-p99
+gate doubles as the journal-overhead gate) and every paging or
+quarantine episode must leave exactly ONE finalized incident bundle
+behind — open.json carrying the paging burn verdict, manifest.json
+carrying the actuation audit trail and the recovery resolution, plus
+the snapshotted journal segments — while the controller-disabled
+counterfactual leaves ZERO. `--incidents-out=DIR` copies the bundles
+out of the scratch tree before teardown (the CI soak job's artifact).
+
 Writes BENCH_SOAK.json. `--smoke` is the CI-scaled run (the `soak`
 job); gates are ALWAYS enforced — exit 1 on any failure.
 """
@@ -142,6 +152,11 @@ class SoakBench:
         conf.set("hyperspace.controller.enabled", "true")
         conf.set("hyperspace.controller.cooldownSeconds", 20.0)
         conf.set("hyperspace.obs.events.maxEvents", 4096)
+        # Durable telemetry journal ON for the whole soak: the overhead
+        # rides every query/actuation, so the existing completed-p99
+        # gate doubles as the journal-overhead gate; the incident
+        # bundles snapshot its segments at episode close.
+        conf.set("hyperspace.obs.journal.enabled", "true")
         self.hs = Hyperspace(self.session)
         df = self.session.parquet(self.data)
         self.hs.create_index(df, IndexConfig(self.INDEX, ["key"], ["value", "id"]))
@@ -269,6 +284,37 @@ class SoakBench:
     def quarantined(self) -> list[str]:
         with self.session._state_lock:
             return sorted(self.session.index_health)
+
+    # -- incident-bundle accounting ---------------------------------------
+    def run_episode(self, fn, *args, **kw) -> dict:
+        """Run one episode with flight-recorder accounting: which
+        incident bundles are NEW afterwards, and whether each closed
+        with the paging burn verdict, the actuation audit trail, and a
+        recovery resolution — the bundle gates fold from here."""
+        before = {b["name"] for b in self.ctrl.list_incidents()}
+        ep = fn(*args, **kw)
+        new = [b for b in self.ctrl.list_incidents() if b["name"] not in before]
+        bundles = []
+        for b in new:
+            detail = self.ctrl.read_incident(b["name"]) or {}
+            man = detail.get("manifest") or {}
+            opened = detail.get("open") or {}
+            bundles.append({
+                "name": b["name"],
+                "trigger": b.get("trigger"),
+                "closed": "manifest" in detail,
+                "resolution": man.get("resolution"),
+                "paged_objectives": sorted(
+                    k for k, v in (opened.get("verdicts") or {}).items()
+                    if v == "page"
+                ),
+                "audited_actions": sorted(
+                    {a["action"] for a in man.get("actions", [])}
+                ),
+                "journal_segments": int(man.get("journal_segments") or 0),
+            })
+        ep["incident_bundles"] = bundles
+        return ep
 
     # -- episodes ---------------------------------------------------------
     def episode_transient_io(self) -> dict:
@@ -610,10 +656,13 @@ def _soak_fleet_worker(ctx):
 def main(argv) -> int:
     smoke = "--smoke" in argv
     out = Path("BENCH_SOAK.json")
+    incidents_out: Path | None = None
     fleet_n = 0
     for i, a in enumerate(argv):
         if a.startswith("--out="):
             out = Path(a.split("=", 1)[1])
+        elif a.startswith("--incidents-out="):
+            incidents_out = Path(a.split("=", 1)[1])
         elif a.startswith("--fleet="):
             fleet_n = int(a.split("=", 1)[1])
         elif a == "--fleet" and i + 1 < len(argv):
@@ -634,14 +683,18 @@ def main(argv) -> int:
         bench.build()
         try:
             log(f"[soak] episode 1/{total}: transient_io")
-            doc["episodes"].append(bench.episode_transient_io())
+            doc["episodes"].append(bench.run_episode(bench.episode_transient_io))
             bench.refresh_traffic()  # mixed refresh traffic between episodes
             log(f"[soak] episode 2/{total}: corruption_quarantine")
-            doc["episodes"].append(bench.episode_corruption_quarantine(expect_heal=True))
+            doc["episodes"].append(
+                bench.run_episode(
+                    bench.episode_corruption_quarantine, expect_heal=True
+                )
+            )
             log(f"[soak] episode 3/{total}: overload_burst")
-            doc["episodes"].append(bench.episode_overload_burst())
+            doc["episodes"].append(bench.run_episode(bench.episode_overload_burst))
             log(f"[soak] episode 4/{total}: worker_sigkill")
-            doc["episodes"].append(bench.episode_worker_sigkill())
+            doc["episodes"].append(bench.run_episode(bench.episode_worker_sigkill))
             if fleet_n >= 2:
                 log(f"[soak] episode 5/{total}: brownout")
                 doc["episodes"].append(bench.episode_brownout())
@@ -651,8 +704,13 @@ def main(argv) -> int:
                 bench.refresh_traffic()
                 log(f"[soak] episode 7/{total}: sigkill_mid_heal_takeover")
                 doc["episodes"].append(bench.episode_sigkill_mid_heal())
+            # Flight-recorder inventory, captured while the controlled
+            # run's bundles are still on disk (tmp dies in the finally).
+            incident_index = bench.ctrl.list_incidents()
+            inc_root = bench.ctrl._incident_root(bench.session.conf)
             actuations = bench._controller_events("controller.actuation")
             doc["controlled"] = {
+                "incident_bundles": incident_index,
                 "queries": bench.queries,
                 "errors_typed": bench.errors_typed,
                 "errors_untyped": bench.errors_untyped,
@@ -687,12 +745,31 @@ def main(argv) -> int:
                 **cf_episode,
                 "errors_untyped": cf.errors_untyped,
                 "controller_mode": cf.ctrl.snapshot()["mode"],
+                # A disabled controller must record NOTHING: the flight
+                # recorder is a controller behavior, not ambient.
+                "incident_bundles_total": len(cf.ctrl.list_incidents()),
             }
         finally:
             cf.shutdown()
 
         # -- hard gates (ALWAYS enforced) ---------------------------------
         by_name = {e["name"]: e for e in doc["episodes"]}
+
+        def _sole_bundle(ep_name: str):
+            bs = by_name[ep_name]["incident_bundles"]
+            return bs[0] if len(bs) == 1 else None
+
+        # The flight-recorder contract: each injected episode leaves
+        # exactly ONE finalized bundle with snapshotted journal segments
+        # and a recovery resolution; the paging episodes' bundles carry
+        # the paging burn verdict plus the shed engage/release audit
+        # (transient_io ALSO quarantines — injected reads fail — so its
+        # bundle opens on the quarantine trigger and closes "healed");
+        # the corruption bundle carries the heal audit; the SIGKILL
+        # episode (no SLO interplay) records nothing.
+        b_io = _sole_bundle("transient_io")
+        b_corrupt = _sole_bundle("corruption_quarantine")
+        b_burst = _sole_bundle("overload_burst")
         gates = {
             "every_episode_recovered": all(
                 e["recovered"] for e in doc["episodes"]
@@ -712,6 +789,34 @@ def main(argv) -> int:
             "counterfactual_zero_untyped": not doc["counterfactual"][
                 "errors_untyped"
             ],
+            "incident_bundle_per_episode": (
+                None not in (b_io, b_corrupt, b_burst)
+                and not by_name["worker_sigkill"]["incident_bundles"]
+            ),
+            "incident_bundles_paged_audited_recovered": (
+                all(
+                    b is not None
+                    and b["closed"]
+                    and b["resolution"] in ("healed", "slo.recovered")
+                    and b["journal_segments"] >= 1  # journal rode along
+                    for b in (b_io, b_corrupt, b_burst)
+                )
+                and all(
+                    b is not None
+                    and b["paged_objectives"]  # the paging burn verdict
+                    and "shed.engage" in b["audited_actions"]
+                    and "shed.release" in b["audited_actions"]
+                    for b in (b_io, b_burst)
+                )
+                and b_corrupt is not None
+                and any(
+                    a.startswith("heal.")
+                    for a in b_corrupt["audited_actions"]
+                )
+            ),
+            "counterfactual_zero_bundles": (
+                doc["counterfactual"]["incident_bundles_total"] == 0
+            ),
         }
         if fleet_n >= 2:
             gates.update({
@@ -737,6 +842,16 @@ def main(argv) -> int:
                 ),
             })
         doc["gates"] = gates
+        # Export the bundles OUT of tmp (the finally below removes it)
+        # so CI can upload them as the incident-bundle artifact.
+        if incidents_out is not None and inc_root is not None and inc_root.is_dir():
+            if incidents_out.exists():
+                shutil.rmtree(incidents_out)
+            shutil.copytree(inc_root, incidents_out)
+            log(
+                f"[soak] exported {len(incident_index)} incident "
+                f"bundle(s) -> {incidents_out}"
+            )
         doc["elapsed_s"] = round(time.perf_counter() - t0, 1)
         out.write_text(json.dumps(doc, indent=2, default=str) + "\n")
         log(f"[soak] wrote {out} in {doc['elapsed_s']}s")

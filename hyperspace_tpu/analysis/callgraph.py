@@ -51,6 +51,11 @@ _FALLBACK_BLOCKLIST = {
     # domain (HSL019) through a receiver that is not even a program
     # class.
     "write_table",
+    # file-object API: `fh.flush()` on an open file must not resolve to
+    # RoutingLedger.flush — that edge would pull the ledger's persist
+    # path (and its fault point) into every buffered-write caller's
+    # error contract (HSL016).
+    "flush",
 }
 
 

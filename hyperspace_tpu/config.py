@@ -124,6 +124,17 @@ OBS_EVENTS_MAX = "hyperspace.obs.events.maxEvents"
 # queries, and the latency threshold the p99 objective holds serves to.
 OBS_SLO_AVAILABILITY_TARGET = "hyperspace.obs.slo.availabilityTarget"
 OBS_SLO_LATENCY_P99_SECONDS = "hyperspace.obs.slo.latencyP99Seconds"
+# Durable telemetry journal (obs/journal.py, docs/observability.md
+# "telemetry journal"): a bounded, segment-rotated JSONL journal per
+# process under `<dir>/<pid>/` (dir defaults to `<system.path>/_obs`),
+# fed by the event ring, completed root spans, periodic metric
+# snapshots and SLO verdict transitions. Advisory and off by default —
+# one boolean read per tap when disabled.
+OBS_JOURNAL_ENABLED = "hyperspace.obs.journal.enabled"
+OBS_JOURNAL_DIR = "hyperspace.obs.journal.dir"
+OBS_JOURNAL_SEGMENT_BYTES = "hyperspace.obs.journal.segmentBytes"
+OBS_JOURNAL_MAX_BYTES = "hyperspace.obs.journal.maxBytes"
+OBS_JOURNAL_SNAPSHOT_SECONDS = "hyperspace.obs.journal.snapshotSeconds"
 # Concurrent query-serving plane (docs/serving.md). The subsystem is OFF
 # by default: nothing changes for direct `session.run()` callers; a
 # QueryServer is constructed explicitly (or via `session.serve()`) and
@@ -203,6 +214,19 @@ CONTROLLER_SCALE_SATURATION = "hyperspace.controller.scale.saturation"
 CONTROLLER_SCALE_MAX_WORKERS = "hyperspace.controller.scale.maxWorkers"
 CONTROLLER_SCALE_STEP = "hyperspace.controller.scale.step"
 CONTROLLER_STORM_RESPONSE = "hyperspace.controller.stormResponse"
+# Incident bundles (docs/fault_tolerance.md "incident bundles"): on an
+# SLO page engage, a fresh quarantine, or observe-only entry the
+# controller snapshots a content-complete forensic bundle under
+# `<dir>/<ts>-<trigger>/` (dir defaults to `<fleet root>/incidents`) —
+# journal segments from every reachable member, event ring dump, jit
+# report, config snapshot, routing ledger, and the actuation audit
+# trail. Advisory (capture failures never compound the incident),
+# rate-limited by the controller cooldown, retained newest-first up to
+# maxBundles.
+CONTROLLER_INCIDENT_ENABLED = "hyperspace.controller.incident.enabled"
+CONTROLLER_INCIDENT_DIR = "hyperspace.controller.incident.dir"
+CONTROLLER_INCIDENT_MAX_BUNDLES = "hyperspace.controller.incident.maxBundles"
+CONTROLLER_INCIDENT_SEGMENTS = "hyperspace.controller.incident.segments"
 RETRY_MAX_ATTEMPTS = "hyperspace.retry.maxAttempts"
 RETRY_BACKOFF_BASE = "hyperspace.retry.backoffBaseSeconds"
 RETRY_CAS_ATTEMPTS = "hyperspace.retry.casAttempts"
@@ -287,6 +311,11 @@ DEFAULT_CONTROLLER_DEMOTION_WINDOW_SECONDS = 300.0
 DEFAULT_CONTROLLER_SCALE_SATURATION = 0.75
 DEFAULT_CONTROLLER_SCALE_MAX_WORKERS = 8
 DEFAULT_CONTROLLER_SCALE_STEP = 1
+DEFAULT_OBS_JOURNAL_SEGMENT_BYTES = 64 << 10
+DEFAULT_OBS_JOURNAL_MAX_BYTES = 4 << 20
+DEFAULT_OBS_JOURNAL_SNAPSHOT_SECONDS = 5.0
+DEFAULT_CONTROLLER_INCIDENT_MAX_BUNDLES = 16
+DEFAULT_CONTROLLER_INCIDENT_SEGMENTS = 4
 
 
 @dataclasses.dataclass(frozen=True)
@@ -502,6 +531,35 @@ KNOWN_KEYS: dict[str, ConfKey] = {
         "Latency threshold of the `serve.latency_p99` objective: 99% of served "
         "queries must finish under it (measured from the latency histogram's "
         "bucket bounds)."),
+    OBS_JOURNAL_ENABLED: ConfKey(
+        "false",
+        "Durable telemetry journal (process-global, [observability.md]"
+        "(observability.md) \"telemetry journal\"): append events, completed "
+        "root spans, periodic metric snapshots, and SLO verdict transitions "
+        "to a segment-rotated JSONL journal under `<dir>/<pid>/`. Advisory — "
+        "IO failures are counted (`obs.journal.errors`), never raised. "
+        "Pooled/fleet workers inherit it and journal under their own pid."),
+    OBS_JOURNAL_DIR: ConfKey(
+        "unset (`<system.path>/_obs`)",
+        "Root of the telemetry journal; one `<pid>/` subdirectory per "
+        "journaling process. The fleet merge reads this root "
+        "(`python -m hyperspace_tpu.obs.export --format chrome --fleet "
+        "<dir>`)."),
+    OBS_JOURNAL_SEGMENT_BYTES: ConfKey(
+        "65536",
+        "Active-segment size at which the journal seals: flush + fsync + "
+        "atomic rename to `segment-<n>.jsonl` (readers only ever see whole "
+        "segments; a crash tears at most the unsealed tail)."),
+    OBS_JOURNAL_MAX_BYTES: ConfKey(
+        "4194304",
+        "Per-process byte budget over sealed segments; exceeded ⇒ "
+        "oldest-first eviction (`obs.journal.evictions`). The journal is a "
+        "flight recorder, not an archive."),
+    OBS_JOURNAL_SNAPSHOT_SECONDS: ConfKey(
+        "5.0",
+        "Minimum spacing of periodic counter/gauge snapshot records — taken "
+        "opportunistically on the journal write path, no background "
+        "thread."),
     RECOVER_ON_ACCESS: ConfKey(
         "true",
         "Index listing lazily repairs a crashed writer's log (torn entries "
@@ -689,6 +747,29 @@ KNOWN_KEYS: dict[str, ConfKey] = {
         "signature to the raw-scan route (`RoutingLedger.pin`) and drop the "
         "jit caches once (`jit_memory.drop_caches`). false keeps storms "
         "observe-only telemetry."),
+    CONTROLLER_INCIDENT_ENABLED: ConfKey(
+        "true",
+        "Incident bundles ([fault_tolerance.md](fault_tolerance.md) "
+        "\"incident bundles\"): on an SLO page engage, a fresh quarantine, "
+        "or observe-only entry the controller opens a forensic bundle under "
+        "`<dir>/<ts>-<trigger>/` (event ring dump, jit report, config "
+        "snapshot, routing ledger, actuation audit trail) and closes it on "
+        "recovery with every reachable member's journal segments. Advisory: "
+        "capture failures count `controller.incident_errors`, never raise."),
+    CONTROLLER_INCIDENT_DIR: ConfKey(
+        "unset (`<fleet root>/incidents`)",
+        "Where incident bundles land; defaults next to the fleet "
+        "coordination root (`hyperspace.fleet.cacheDir` or "
+        "`<system.path>/_fleet`). Served read-only at `/debug/incidents`."),
+    CONTROLLER_INCIDENT_MAX_BUNDLES: ConfKey(
+        "16",
+        "On-disk bundle retention: opening a bundle beyond this count "
+        "evicts the oldest bundle directory first."),
+    CONTROLLER_INCIDENT_SEGMENTS: ConfKey(
+        "4",
+        "How many of each reachable member's newest sealed journal "
+        "segments the closing bundle copies in — the cross-process evidence "
+        "window."),
     ADVISOR_ROUTING_ENABLED: ConfKey(
         "false",
         "Adaptive query routing ([advisor.md](advisor.md)): a per-plan-"
@@ -828,6 +909,10 @@ class HyperspaceConf:
     controller_scale_max_workers: int = DEFAULT_CONTROLLER_SCALE_MAX_WORKERS
     controller_scale_step: int = DEFAULT_CONTROLLER_SCALE_STEP
     controller_storm_response: bool = True
+    controller_incident_enabled: bool = True
+    controller_incident_dir: str = ""  # "" = <fleet root>/incidents
+    controller_incident_max_bundles: int = DEFAULT_CONTROLLER_INCIDENT_MAX_BUNDLES
+    controller_incident_segments: int = DEFAULT_CONTROLLER_INCIDENT_SEGMENTS
     advisor_routing_enabled: bool = False  # opt-in: routing changes plan choice
     advisor_routing_demote_ratio: float = DEFAULT_ADVISOR_ROUTING_DEMOTE_RATIO
     advisor_routing_alpha: float = DEFAULT_ADVISOR_ROUTING_ALPHA
@@ -977,6 +1062,14 @@ class HyperspaceConf:
             self.controller_scale_step = int(value)
         elif key == CONTROLLER_STORM_RESPONSE:
             self.controller_storm_response = _as_bool(value)
+        elif key == CONTROLLER_INCIDENT_ENABLED:
+            self.controller_incident_enabled = _as_bool(value)
+        elif key == CONTROLLER_INCIDENT_DIR:
+            self.controller_incident_dir = str(value)
+        elif key == CONTROLLER_INCIDENT_MAX_BUNDLES:
+            self.controller_incident_max_bundles = int(value)
+        elif key == CONTROLLER_INCIDENT_SEGMENTS:
+            self.controller_incident_segments = int(value)
         elif key == ADVISOR_ROUTING_ENABLED:
             self.advisor_routing_enabled = _as_bool(value)
         elif key == ADVISOR_ROUTING_DEMOTE_RATIO:
@@ -1038,6 +1131,31 @@ class HyperspaceConf:
             from hyperspace_tpu.obs import slo as _obs_slo
 
             _obs_slo.configure(latency_threshold_s=float(value))
+        elif key == OBS_JOURNAL_ENABLED:
+            # Process-global like the rings it taps (obs/journal.py);
+            # enabling without an explicit dir derives the default root
+            # from this conf's system path.
+            from hyperspace_tpu.obs import journal as _obs_journal
+
+            _obs_journal.configure(enabled=_as_bool(value))
+            if _as_bool(value):
+                _obs_journal.ensure_root(os.path.join(self.system_path, "_obs"))
+        elif key == OBS_JOURNAL_DIR:
+            from hyperspace_tpu.obs import journal as _obs_journal
+
+            _obs_journal.configure(root=str(value) if value else "")
+        elif key == OBS_JOURNAL_SEGMENT_BYTES:
+            from hyperspace_tpu.obs import journal as _obs_journal
+
+            _obs_journal.configure(segment_bytes=int(value))
+        elif key == OBS_JOURNAL_MAX_BYTES:
+            from hyperspace_tpu.obs import journal as _obs_journal
+
+            _obs_journal.configure(max_bytes=int(value))
+        elif key == OBS_JOURNAL_SNAPSHOT_SECONDS:
+            from hyperspace_tpu.obs import journal as _obs_journal
+
+            _obs_journal.configure(snapshot_s=float(value))
         elif key == RETRY_MAX_ATTEMPTS:
             from hyperspace_tpu.utils import retry
 
@@ -1179,6 +1297,14 @@ class HyperspaceConf:
             return self.controller_scale_step
         if key == CONTROLLER_STORM_RESPONSE:
             return self.controller_storm_response
+        if key == CONTROLLER_INCIDENT_ENABLED:
+            return self.controller_incident_enabled
+        if key == CONTROLLER_INCIDENT_DIR:
+            return self.controller_incident_dir
+        if key == CONTROLLER_INCIDENT_MAX_BUNDLES:
+            return self.controller_incident_max_bundles
+        if key == CONTROLLER_INCIDENT_SEGMENTS:
+            return self.controller_incident_segments
         if key == ADVISOR_ROUTING_ENABLED:
             return self.advisor_routing_enabled
         if key == ADVISOR_ROUTING_DEMOTE_RATIO:
@@ -1227,4 +1353,24 @@ class HyperspaceConf:
             from hyperspace_tpu.obs import slo as _obs_slo
 
             return _obs_slo.TRACKER.latency_threshold_s
+        if key == OBS_JOURNAL_ENABLED:
+            from hyperspace_tpu.obs import journal as _obs_journal
+
+            return _obs_journal.configured_enabled()
+        if key == OBS_JOURNAL_DIR:
+            from hyperspace_tpu.obs import journal as _obs_journal
+
+            return _obs_journal.root()
+        if key == OBS_JOURNAL_SEGMENT_BYTES:
+            from hyperspace_tpu.obs import journal as _obs_journal
+
+            return _obs_journal.segment_bytes()
+        if key == OBS_JOURNAL_MAX_BYTES:
+            from hyperspace_tpu.obs import journal as _obs_journal
+
+            return _obs_journal.max_bytes()
+        if key == OBS_JOURNAL_SNAPSHOT_SECONDS:
+            from hyperspace_tpu.obs import journal as _obs_journal
+
+            return _obs_journal.snapshot_seconds()
         return default

@@ -43,12 +43,17 @@ The **runtime health plane** layers live visibility on top
   XLA:CPU map-count segfault guard;
 - **slo** — declared objectives (availability, p99 latency) with
   multi-window error-budget burn rates;
+- **journal** — a durable, bounded, crash-safe JSONL journal of
+  events, root spans, SLO transitions, and metrics snapshots, one
+  ``<root>/<pid>/`` dir per process; the fleet merge and the
+  controller's incident bundles read it (docs/observability.md
+  "telemetry journal");
 - **http** — ``/metrics``, ``/healthz``, ``/debug/events``, and
   ``/debug/trace`` over a zero-dependency stdlib server riding the
   QueryServer lifecycle (``hyperspace.obs.http.*``).
 """
 
-from hyperspace_tpu.obs import events, metrics, runtime, slo, trace
+from hyperspace_tpu.obs import events, journal, metrics, runtime, slo, trace
 from hyperspace_tpu.obs.trace import annotate, current_span, event, set_enabled, span
 
 __all__ = [
@@ -56,6 +61,7 @@ __all__ = [
     "current_span",
     "event",
     "events",
+    "journal",
     "metrics",
     "runtime",
     "set_enabled",

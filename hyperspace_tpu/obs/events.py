@@ -29,6 +29,7 @@ import itertools
 import threading
 import time
 
+from hyperspace_tpu.obs import journal as _journal
 from hyperspace_tpu.obs import metrics as _metrics
 from hyperspace_tpu.obs import trace as _trace
 
@@ -86,6 +87,11 @@ KNOWN_EVENTS: dict[str, str] = {
     # signature was pinned to the raw-scan route and the jit caches
     # dropped once (serve/controller.py "storm response").
     "controller.storm_response": "warn",
+    # The controller opened or closed an incident bundle — a durable
+    # forensic snapshot under <fleet>/incidents/<ts>-<trigger>/
+    # (docs/fault_tolerance.md "incident bundles"); carries
+    # trigger/phase/dir.
+    "controller.incident": "warn",
     # JIT plane (docs/observability.md): a call-site key is compiling on
     # most calls (the runtime mirror of lint rule HSL015), or the
     # map-count guard dropped jax's caches to stay under
@@ -101,6 +107,10 @@ DEFAULT_MAX_EVENTS = 256
 
 _EMITTED = _metrics.counter("obs.events.emitted", "structured events recorded")
 _DROPPED = _metrics.counter("obs.events.dropped", "events aged out of the bounded ring")
+_UTILIZATION = _metrics.gauge(
+    "obs.events.ring_utilization",
+    "resident events / ring capacity — saturation visible before drops start",
+)
 
 _seq = itertools.count(1)  # itertools.count is GIL-atomic
 
@@ -144,6 +154,11 @@ class _Ring:
         with self._lock:
             return int(self._events.maxlen or 0)
 
+    def utilization(self) -> float:
+        with self._lock:
+            cap = self._events.maxlen or 0
+            return len(self._events) / cap if cap else 0.0
+
     def clear(self) -> None:
         with self._lock:
             self._events.clear()
@@ -173,6 +188,7 @@ class Event:
             "fields": fields,
         }
         RING.append(record)
+        _journal.record_event(record)  # durable tap; advisory, never raises
         return record
 
 
@@ -203,6 +219,15 @@ def counts_by_severity() -> dict[str, int]:
 def max_events() -> int:
     """The ring's current bound (config get path)."""
     return RING.max_events()
+
+
+def refresh_gauges() -> float:
+    """Refresh `obs.events.ring_utilization` from the live ring (called
+    per /metrics scrape and /healthz read — drops only say saturation
+    happened; this gauge shows it coming). Returns the utilization."""
+    u = RING.utilization()
+    _UTILIZATION.set(u)
+    return u
 
 
 def configure(max_events: int | None = None) -> None:

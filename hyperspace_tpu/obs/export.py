@@ -6,6 +6,8 @@ Modes::
     python -m hyperspace_tpu.obs.export --sink q.jsonl       # aggregate a sink
     python -m hyperspace_tpu.obs.export --format chrome \
         --sink q.jsonl --output trace.json                   # span timelines
+    python -m hyperspace_tpu.obs.export --format chrome \
+        --fleet /path/_obs --output fleet.json   # merged fleet journals
 
 Prometheus: renders whatever the registry holds (the /metrics endpoint
 in obs/http.py serves exactly this), or replays a JSON-lines trace sink
@@ -142,6 +144,26 @@ def live_roots() -> list[dict]:
     return [r.to_json() for r in _trace.recent_roots()]
 
 
+def roots_from_fleet(journal_root: str) -> list[dict]:
+    """Root spans merged from every fleet member's durable journal under
+    `journal_root` (the `_obs` dir: one `<pid>/` per member —
+    obs/journal.py). Each root is stamped with its member's pid so
+    `chrome_trace` lanes one track group per member even for spans whose
+    trace ids predate adoption. Dead members' sealed segments read fine;
+    torn tails are skipped by the journal reader."""
+    from hyperspace_tpu.obs import journal as _journal
+
+    roots = []
+    for rec in _journal.merge_dir(journal_root):
+        if rec.get("kind") != "span" or not isinstance(rec.get("trace"), dict):
+            continue
+        root = rec["trace"]
+        if isinstance(rec.get("pid"), int):
+            root = dict(root, pid=rec["pid"])
+        roots.append(root)
+    return roots
+
+
 def chrome_trace(roots: "list[dict]") -> dict:
     """Span trees as a Chrome Trace Event document (Perfetto/
     chrome://tracing). Each span becomes one complete ("X") event laned
@@ -154,12 +176,19 @@ def chrome_trace(roots: "list[dict]") -> dict:
         s["t0_s"] for r in roots for s in _walk_span(r) if s.get("t0_s") is not None
     ]
     base = min(starts) if starts else 0.0
+    # Lanes are qualified by (pid, os-thread): two fleet members whose
+    # OS thread ids collide (they usually do — every member's main
+    # thread) must not interleave on one track. Alias numbering restarts
+    # per pid so each member's track group reads thread-1..N.
     tid_alias: dict = {}
+    lanes_per_pid: dict = {}
 
-    def lane(raw_tid) -> int:
-        if raw_tid not in tid_alias:
-            tid_alias[raw_tid] = len(tid_alias) + 1
-        return tid_alias[raw_tid]
+    def lane(pid: int, raw_tid) -> int:
+        key = (pid, raw_tid)
+        if key not in tid_alias:
+            lanes_per_pid[pid] = lanes_per_pid.get(pid, 0) + 1
+            tid_alias[key] = lanes_per_pid[pid]
+        return tid_alias[key]
 
     def emit(span: dict, pid: int, trace_id: "str | None", parent_ts: float) -> None:
         ts = (
@@ -178,7 +207,7 @@ def chrome_trace(roots: "list[dict]") -> dict:
                 "ts": round(ts, 3),
                 "dur": round((span.get("wall_s") or 0.0) * 1e6, 3),
                 "pid": pid,
-                "tid": lane(span.get("tid", 0)),
+                "tid": lane(pid, span.get("tid", 0)),
                 "args": args,
             }
         )
@@ -188,23 +217,37 @@ def chrome_trace(roots: "list[dict]") -> dict:
     for root in roots:
         trace_id = root.get("trace_id")
         # Root ids are "<pid>-<seq>" (obs/trace.py): keep sink lines from
-        # several processes on separate pid tracks.
+        # several processes on separate pid tracks. Journal-merged roots
+        # may also carry an explicit "pid" (obs/journal.py), preferred
+        # over parsing.
         pid = 1
-        if trace_id and "-" in str(trace_id):
+        if isinstance(root.get("pid"), int):
+            pid = root["pid"]
+        elif trace_id and "-" in str(trace_id):
             head = str(trace_id).split("-", 1)[0]
             if head.isdigit():
                 pid = int(head)
         emit(root, pid, trace_id, 0.0)
-    alias_of = {alias: raw for raw, alias in tid_alias.items()}
+    alias_of = {(pid, alias): raw for (pid, raw), alias in tid_alias.items()}
     meta = [
         {
             "ph": "M",
             "name": "thread_name",
             "pid": pid,
             "tid": alias,
-            "args": {"name": f"thread-{alias} (os:{alias_of[alias]})"},
+            "args": {"name": f"thread-{alias} (os:{alias_of[(pid, alias)]})"},
         }
         for pid, alias in sorted({(e["pid"], e["tid"]) for e in events})
+    ]
+    meta += [
+        {
+            "ph": "M",
+            "name": "process_name",
+            "pid": pid,
+            "tid": 0,
+            "args": {"name": f"member pid {pid}"},
+        }
+        for pid in sorted({e["pid"] for e in events})
     ]
     return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
 
@@ -218,6 +261,12 @@ def main(argv: "list[str] | None" = None) -> int:
         "--sink", help="read a JSON-lines trace sink file instead of live process state"
     )
     ap.add_argument(
+        "--fleet",
+        help="merge every fleet member's durable journal under this _obs "
+        "root (obs/journal.py) — one chrome track group per member pid; "
+        "reads sealed segments only, so it works on a dead fleet",
+    )
+    ap.add_argument(
         "--format",
         choices=("prom", "chrome"),
         default="prom",
@@ -227,7 +276,12 @@ def main(argv: "list[str] | None" = None) -> int:
     ap.add_argument("--output", help="write here instead of stdout")
     args = ap.parse_args(argv)
     if args.format == "chrome":
-        roots = roots_from_sink(args.sink) if args.sink else live_roots()
+        if args.fleet:
+            roots = roots_from_fleet(args.fleet)
+            if args.sink:
+                roots += roots_from_sink(args.sink)
+        else:
+            roots = roots_from_sink(args.sink) if args.sink else live_roots()
         text = json.dumps(chrome_trace(roots))
     elif args.sink:
         text = render_prometheus(registry_from_sink(args.sink))

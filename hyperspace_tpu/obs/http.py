@@ -15,7 +15,11 @@ file or `explain(analyze)`:
 - ``GET /debug/events[?level=warn&limit=100]`` — the structured event
   ring (obs/events.py);
 - ``GET /debug/trace[?limit=8]`` — recent root span trees
-  (obs/trace.py), the live counterpart of the JSON-lines sink.
+  (obs/trace.py), the live counterpart of the JSON-lines sink;
+- ``GET /debug/incidents[?name=<bundle>]`` — read-only index of the
+  controller's incident bundles (serve/controller.py,
+  docs/fault_tolerance.md "incident bundles"): the list, or one
+  bundle's manifest + file inventory.
 
 Lifecycle: a :class:`HealthServer` can be constructed standalone, but
 the normal path is ``hyperspace.obs.http.enabled=true`` + a
@@ -76,6 +80,7 @@ class HealthServer:
         self._sessions: weakref.WeakSet = weakref.WeakSet()
         self._servers: weakref.WeakSet = weakref.WeakSet()
         self._controllers: weakref.WeakSet = weakref.WeakSet()
+        self._supervisors: weakref.WeakSet = weakref.WeakSet()
 
     # -- providers --------------------------------------------------------
     def attach_session(self, session) -> None:
@@ -95,6 +100,14 @@ class HealthServer:
         (serve/controller.py registers itself on start())."""
         with self._lock:
             self._controllers.add(controller)
+
+    def attach_supervisor(self, supervisor) -> None:
+        """Surface a fleet supervisor's member list in /healthz: pids,
+        ports, per-member last-heartbeat age — WITHOUT scraping members
+        (FleetSupervisor.fleet_summary), so a silently dead member is
+        visible between supervisor poll ticks."""
+        with self._lock:
+            self._supervisors.add(supervisor)
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "HealthServer":
@@ -151,6 +164,7 @@ class HealthServer:
             sessions = list(self._sessions)
             servers = list(self._servers)
             controllers = list(self._controllers)
+            supervisors = list(self._supervisors)
         indexes: dict[str, dict] = {}
         for s in sessions:
             with s._state_lock:
@@ -159,6 +173,7 @@ class HealthServer:
         _slo.sample()
         slo_verdicts = _slo.evaluate()
         proc = _runtime.refresh_process_gauges()
+        _events.refresh_gauges()
         status = "ok"
         if indexes or any(v["verdict"] == "warn" for v in slo_verdicts.values()):
             status = "degraded"
@@ -176,6 +191,10 @@ class HealthServer:
             "slo": slo_verdicts,
             "jit": {**proc, "sites": _runtime.jit_report()},
             "events": _events.counts_by_severity(),
+            # Fleet topology (serve/fleet/supervisor.py): member
+            # pids/ports and per-member last-heartbeat ages, read from
+            # registrations — no member scrape on the /healthz path.
+            "fleet": [s.fleet_summary() for s in supervisors],
         }
 
     def metrics_text(self) -> str:
@@ -184,6 +203,7 @@ class HealthServer:
         _runtime.refresh_process_gauges()
         _slo.sample()
         _slo.evaluate()
+        _events.refresh_gauges()
         return render_prometheus()
 
 
@@ -215,6 +235,28 @@ class _Handler(BaseHTTPRequestHandler):
                 limit = int((q.get("limit") or [8])[0])
                 roots = _trace.recent_roots(limit=limit)
                 self._send_json(200, {"traces": [r.to_json() for r in roots]})
+            elif url.path == "/debug/incidents":
+                # Read-only: list every attached controller's incident
+                # bundles, or one bundle's manifest + file inventory via
+                # ?name=<bundle dir name> (serve/controller.py).
+                name = (q.get("name") or [None])[0]
+                with self.plane._lock:
+                    controllers = list(self.plane._controllers)
+                if name is None:
+                    bundles = []
+                    for c in controllers:
+                        bundles.extend(c.list_incidents())
+                    self._send_json(200, {"incidents": bundles})
+                else:
+                    doc = None
+                    for c in controllers:
+                        doc = c.read_incident(name)
+                        if doc is not None:
+                            break
+                    if doc is None:
+                        self._send_json(404, {"error": f"unknown incident {name!r}"})
+                    else:
+                        self._send_json(200, doc)
             else:
                 self._send_json(404, {"error": f"unknown path {url.path!r}"})
         except (ValueError, KeyError) as e:

@@ -37,6 +37,7 @@ import threading
 import time
 
 from hyperspace_tpu.obs import events as _events
+from hyperspace_tpu.obs import journal as _journal
 from hyperspace_tpu.obs import metrics as _metrics
 
 # Default objective targets (`hyperspace.obs.slo.*` keys override).
@@ -150,6 +151,9 @@ class SLOTracker:
             for name, doc in KNOWN_OBJECTIVES.items()
         }
         self._paged: set[str] = set()
+        # Last verdict per objective — the journal records verdict
+        # TRANSITIONS only (ok→page, page→ok, …), not every evaluate.
+        self._last_verdict: dict[str, str] = {}
 
     def objective(self, name: str) -> BurnRate:
         """The tracker for a DECLARED objective; undeclared names raise
@@ -225,8 +229,16 @@ class SLOTracker:
                     self._paged.add(name)
                 else:
                     self._paged.discard(name)
+                previous = self._last_verdict.get(name, "ok")
+                self._last_verdict[name] = v["verdict"]
             if fresh_page:
                 _EVT_BURN.emit(objective=name, **{k: w for k, w in v["windows"].items()})
+            if previous != v["verdict"]:
+                # Durable tap: the page AND the recovery land in the
+                # telemetry journal (obs/journal.py) — the incident
+                # bundle's evidence that the burn happened and ended.
+                _journal.record_slo(name, v["verdict"], previous,
+                                    detail={"windows": v["windows"]})
             out[name] = v
         return out
 
@@ -235,6 +247,7 @@ class SLOTracker:
             self.availability_target = DEFAULT_AVAILABILITY_TARGET
             self.latency_threshold_s = DEFAULT_LATENCY_P99_SECONDS
             self._paged.clear()
+            self._last_verdict.clear()
         for name, rate in self._rates.items():
             rate.reset()
             rate.target = (

@@ -226,6 +226,7 @@ class _TraceHandle:
             with _recent_lock:
                 _recent_roots.append(self._span)
             _emit(self._span)
+            _journal_root(self._span)
         return False
 
 
@@ -360,6 +361,17 @@ def adopt_root(root: "dict | None") -> None:
     with _recent_lock:
         _recent_roots.append(span)
     _emit(span)
+    _journal_root(span)
+
+
+def _journal_root(span: "Span") -> None:
+    """Durable tap: completed root spans (local and adopted) also land
+    in the telemetry journal (obs/journal.py). The journal is advisory
+    and off by default; `to_json` is only paid when it is on."""
+    from hyperspace_tpu.obs import journal as _journal
+
+    if _journal.enabled():
+        _journal.record_span(span.to_json())
 
 
 def last_trace() -> "Span | None":

@@ -144,6 +144,11 @@ def _task_entry(result_q, task_id, fn, args, env) -> None:
     if fstate is not None:
         faults.install_state(fstate)
     obs_trace.set_enabled(bool(env.get("obs_enabled", True)))
+    jstate = env.get("journal")
+    if jstate is not None:
+        from hyperspace_tpu.obs import journal as obs_journal
+
+        obs_journal.install_state(jstate)
     try:
         result = fn(*args)
         root = obs_trace.last_trace()
@@ -186,9 +191,12 @@ class TaskPool:
         """Spawn one worker running ``fn(*args)``; its return value comes
         back from :meth:`join`. The coordinator's fault-injection state
         and tracer enablement ship along."""
+        from hyperspace_tpu.obs import journal as obs_journal
+
         env = {
             "faults": faults.export_state(),
             "obs_enabled": obs_trace.enabled(),
+            "journal": obs_journal.export_state(),
         }
         p = self._host.spawn(task_id, _task_entry, (self._q, task_id, fn, args, env))
         self._pending[task_id] = p

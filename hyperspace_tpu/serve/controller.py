@@ -102,10 +102,28 @@ Control discipline — the loop must never become its own incident:
   loop — while CrashPoint propagates like the process death it
   simulates.
 
+Incident flight recorder (docs/observability.md "incident bundles"):
+the controller is the one component that already knows WHEN something
+went wrong — so it snapshots a content-complete bundle under
+`<fleet>/incidents/<ts>-<trigger>/` the moment an episode opens (SLO
+page engage, a fresh quarantine, observe-only degradation) and
+finalizes it when the episode resolves: event-ring dump, config
+snapshot, jit report, routing ledger, the actuation audit trail, and
+the last N durable journal segments (obs/journal.py) from every
+reachable fleet member. At most ONE bundle is open at a time — later
+triggers annotate it — and the recorder runs under the same cooldown
+discipline as every actuator, with retention capped at
+`controller.incident.maxBundles`. The whole path is advisory: any IO
+failure is counted (`controller.incident_errors`), never raised — the
+flight recorder must never become the incident. Bundles are served
+read-only at `/debug/incidents` (obs/http.py).
+
 Proven end to end by the chaos soak harness (`benchmarks/bench_soak.py`
 → BENCH_SOAK.json): under a deterministic fault schedule the SLOs
 recover without a human, and the identical run with the controller
-disabled shows the degraded counterfactual.
+disabled shows the degraded counterfactual — and every injected
+episode leaves exactly one incident bundle behind (zero with the
+controller disabled), which the soak gates enforce.
 """
 
 from __future__ import annotations
@@ -113,6 +131,7 @@ from __future__ import annotations
 import collections
 import json
 import os
+import shutil
 import threading
 import time
 from pathlib import Path
@@ -130,6 +149,7 @@ _EVT_FAILED = obs_events.declare("controller.actuation_failed")
 _EVT_BACKOFF = obs_events.declare("controller.backoff")
 _EVT_OBSERVE_ONLY = obs_events.declare("controller.observe_only")
 _EVT_STORM = obs_events.declare("controller.storm_response")
+_EVT_INCIDENT = obs_events.declare("controller.incident")
 
 _ENGAGED = obs_metrics.gauge(
     "controller.engaged", "1 while the controller's overload response holds overrides"
@@ -186,6 +206,15 @@ class OpsController:
         self._seen_heal_gen: dict[str, int] = {}
         self._lease_lock = threading.Lock()
         self._held_lease: tuple | None = None
+        # Incident flight recorder: at most ONE open bundle at a time
+        # (later triggers annotate it rather than opening a second);
+        # `_seen_quarantine` makes "fresh quarantine" detectable across
+        # ticks so re-quarantine after a heal opens a NEW incident.
+        self._incident_dir: Path | None = None
+        self._incident_trigger: str | None = None
+        self._incident_opened_at: float | None = None
+        self._incident_notes: list[dict] = []
+        self._seen_quarantine: set[str] = set()
         # Scale hysteresis state (mirrors page/ok ticks for saturation).
         self._sat_ticks = 0
         self._calm_ticks = 0
@@ -211,6 +240,10 @@ class OpsController:
         shared = obs_http.shared()
         if shared is not None:
             shared.attach_controller(self)
+            if self.supervisor is not None:
+                # /healthz "fleet" section: member pids/ports and
+                # per-member heartbeat ages without a member scrape.
+                shared.attach_supervisor(self.supervisor)
         return self
 
     def stop(self, timeout: float = 5.0) -> None:
@@ -273,6 +306,7 @@ class OpsController:
                 # stand down without observing or deciding anything.
                 if self._engaged:
                     self._release_overload(now, trigger="kill_switch")
+                self._close_incident(now, resolution="kill_switch")
                 return self.snapshot()
             stats.increment("controller.ticks")
             obs_slo.sample(now)
@@ -296,11 +330,17 @@ class OpsController:
                 and not self._engaged
                 and self._page_ticks >= int(conf.controller_hysteresis_ticks)
             ):
-                self._actuate(
+                if self._actuate(
                     "shed.engage", trigger="slo.page", now=now,
                     fn=lambda: self._engage_overload(conf),
                     verdicts=dict(self._last_verdicts),
-                )
+                ):
+                    # The overload response engaging IS the incident
+                    # opening: snapshot the system state at the moment
+                    # the controller started mutating it.
+                    self._open_incident(
+                        "slo.page", now, verdicts=dict(self._last_verdicts)
+                    )
             elif (
                 not burning
                 and self._engaged
@@ -320,6 +360,13 @@ class OpsController:
             # itself the actuation that protects the serve plane).
             with self.session._state_lock:
                 quarantined = sorted(self.session.index_health)
+            # A FRESH quarantine (not seen last tick) opens an incident
+            # bundle — re-quarantine after a successful heal is a new
+            # episode and records as one.
+            current_q = {Path(r).name for r in quarantined}
+            for q_name in sorted(current_q - self._seen_quarantine):
+                self._open_incident(f"quarantine.{q_name}", now, index=q_name)
+            self._seen_quarantine = current_q
             for root in quarantined:
                 name = Path(root).name
                 if burning:
@@ -355,6 +402,21 @@ class OpsController:
                         f"storm.response.{key}", trigger="jit.recompile_storm",
                         now=now, fn=lambda k=key: self._storm_response(k),
                         key=key,
+                    )
+
+            # 5. Incident close: the episode is over once nothing is
+            # burning, no override is engaged, and no index remains
+            # quarantined — finalize the open bundle (journal segments
+            # from every member, manifest with the audit trail). The
+            # quarantine state is re-read: a heal that just executed
+            # above empties it THIS tick, and recovery should close the
+            # bundle in the same reconciliation pass it happened in.
+            if self._incident_dir is not None and not burning and not self._engaged:
+                with self.session._state_lock:
+                    still_quarantined = bool(self.session.index_health)
+                if not still_quarantined:
+                    self._close_incident(
+                        now, resolution=self._incident_resolution()
                     )
             return self.snapshot()
 
@@ -393,7 +455,7 @@ class OpsController:
         if self._budget <= 0:
             # Observe-only: the decision is still computed and audited,
             # nothing mutates.
-            self._announce_observe_only()
+            self._announce_observe_only(now)
             stats.increment("controller.deferred")
             _EVT_ACTUATION.emit(
                 action=action, trigger=trigger, outcome="observe_only",
@@ -722,10 +784,263 @@ class OpsController:
             self._cooldowns[key] = now + float(conf.controller_cooldown_seconds)
             _EVT_BACKOFF.emit(action=action, **details)
 
-    def _announce_observe_only(self) -> None:
+    def _announce_observe_only(self, now: float) -> None:
         if not self._observe_only_announced:
             self._observe_only_announced = True
             _EVT_OBSERVE_ONLY.emit(budget_remaining=0)
+            # Budget exhaustion is itself an incident: snapshot the
+            # moment the controller degraded (open + close in one
+            # motion — there is no "recovery" to wait for). An already-
+            # open episode is annotated instead, not closed early.
+            if self._incident_dir is None:
+                self._open_incident("observe_only", now, budget_remaining=0)
+                self._close_incident(now, resolution="observe_only")
+            else:
+                self._incident_notes.append(
+                    {"trigger": "observe_only", "at": now, "budget_remaining": 0}
+                )
+
+    # -- incident flight recorder -----------------------------------------
+    def _incident_root(self, conf) -> Path | None:
+        """Where bundles land, or None when the recorder is disabled /
+        no root is derivable. NOT gated by `heal.coordinate` — a
+        single-process controller still records its incidents."""
+        if not getattr(conf, "controller_incident_enabled", True):
+            return None
+        explicit = getattr(conf, "controller_incident_dir", "")
+        if explicit:
+            return Path(explicit)
+        if getattr(conf, "fleet_cache_dir", ""):
+            return Path(conf.fleet_cache_dir) / "incidents"
+        sp = Path(conf.system_path)
+        if sp.is_dir():
+            return sp / "_fleet" / "incidents"
+        return None
+
+    def _open_incident(self, trigger: str, now: float, **annotations) -> None:
+        """Open ONE incident bundle: `<root>/<ts>-<trigger>/` with the
+        state an operator needs at page time — event-ring dump, config
+        snapshot, jit report, routing ledger. Rate-limited per trigger
+        by the controller cooldown; retention pruned to
+        `controller.incident.maxBundles`. Advisory end to end: IO
+        failures are counted, never raised."""
+        if self._incident_dir is not None:
+            # One open bundle at a time: later triggers annotate it.
+            self._incident_notes.append(
+                {"trigger": trigger, "at": now, **annotations}
+            )
+            return
+        conf = self.session.conf
+        root = self._incident_root(conf)
+        if root is None:
+            return
+        key = f"incident.{trigger}"
+        if self._cooldowns.get(key, float("-inf")) > now:
+            stats.increment("controller.deferred")
+            return
+        self._cooldowns[key] = now + float(conf.controller_cooldown_seconds)
+        try:
+            wall = time.time()  # noqa: HSL007 — bundle names + manifest
+            # timestamps are operator-facing artifacts, not control flow.
+            stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime(wall))
+            base = f"{stamp}-{trigger}"
+            bundle = root / base
+            n = 2
+            while bundle.exists():
+                bundle = root / f"{base}-{n}"
+                n += 1
+            bundle.mkdir(parents=True)
+            self._write_bundle_state(bundle, trigger, now, wall, annotations)
+            self._incident_dir = bundle
+            self._incident_trigger = trigger
+            self._incident_opened_at = now
+            self._incident_notes = []
+            stats.increment("controller.incidents")
+            _EVT_INCIDENT.emit(
+                phase="open", trigger=trigger, bundle=bundle.name,
+                member=self.member_id,
+            )
+            self._prune_incidents(root, int(conf.controller_incident_max_bundles))
+        except (OSError, ValueError):
+            # Advisory: the flight recorder must never become the
+            # incident — the failed write is the count, reconciliation
+            # continues untouched.
+            stats.increment("controller.incident_errors")
+
+    def _write_bundle_state(
+        self, bundle: Path, trigger: str, now: float, wall: float, annotations: dict
+    ) -> None:
+        from hyperspace_tpu import config as _config
+        from hyperspace_tpu.obs import runtime as obs_runtime
+        from hyperspace_tpu.utils import file_utils
+
+        conf = self.session.conf
+        file_utils.write_json(bundle / "open.json", {
+            "trigger": trigger, "member": self.member_id,
+            "at": wall, "clock": now,
+            "verdicts": dict(self._last_verdicts),
+            "annotations": dict(annotations),
+        })
+        file_utils.write_json(
+            bundle / "events.json", {"events": obs_events.recent(limit=1024)}
+        )
+        file_utils.write_json(
+            bundle / "config.json",
+            {k: conf.get(k) for k in sorted(_config.KNOWN_KEYS)},
+        )
+        file_utils.write_json(bundle / "jit.json", obs_runtime.jit_report())
+        routing: dict = {}
+        ledger = getattr(self.session, "routing_ledger", None)
+        if callable(ledger):
+            snap = getattr(ledger(), "snapshot", None)
+            if callable(snap):
+                routing = snap()
+        file_utils.write_json(bundle / "routing.json", routing)
+
+    def _close_incident(self, now: float, resolution: str) -> None:
+        """Finalize the open bundle: seal the local journal, copy the
+        last N sealed segments from every reachable member's journal
+        dir, refresh the event-ring dump (it now holds the whole
+        episode), and write the manifest — resolution plus the
+        actuation audit trail. No-op when nothing is open; advisory
+        like `_open_incident`."""
+        bundle = self._incident_dir
+        if bundle is None:
+            return
+        trigger = self._incident_trigger
+        opened_at = self._incident_opened_at
+        notes = list(self._incident_notes)
+        self._incident_dir = None
+        self._incident_trigger = None
+        self._incident_opened_at = None
+        self._incident_notes = []
+        conf = self.session.conf
+        try:
+            from hyperspace_tpu.utils import file_utils
+
+            copied = self._copy_journal_segments(
+                bundle, int(conf.controller_incident_segments)
+            )
+            file_utils.write_json(
+                bundle / "events.json", {"events": obs_events.recent(limit=1024)}
+            )
+            wall = time.time()  # noqa: HSL007 — manifest timestamps are
+            # operator-facing artifacts, not control flow.
+            file_utils.write_json(bundle / "manifest.json", {
+                "trigger": trigger, "resolution": resolution,
+                "member": self.member_id,
+                "opened_clock": opened_at, "closed_clock": now,
+                "closed_at": wall,
+                "verdicts": dict(self._last_verdicts),
+                "annotations": notes,
+                "actions": list(self._recent_actions),
+                "journal_segments": copied,
+            })
+            _EVT_INCIDENT.emit(
+                phase="closed", trigger=trigger, resolution=resolution,
+                bundle=bundle.name, member=self.member_id,
+            )
+        except (OSError, ValueError):
+            # Advisory: a bundle without a manifest reads as still-open
+            # in /debug/incidents, which is the truthful rendering of a
+            # close that could not complete.
+            stats.increment("controller.incident_errors")
+
+    def _copy_journal_segments(self, bundle: Path, keep: int) -> int:
+        """Copy the last `keep` SEALED journal segments from every
+        member's `<_obs>/<pid>/` dir into `bundle/journal/<pid>/`;
+        returns the copy count. Sealing the local journal first makes
+        this member's in-flight tail durable before the snapshot."""
+        from hyperspace_tpu.obs import journal as obs_journal
+
+        obs_journal.seal()
+        jroot = obs_journal.root()
+        if jroot is None:
+            return 0
+        jroot = Path(jroot)
+        if not jroot.is_dir():
+            return 0
+        copied = 0
+        for proc_dir in sorted(jroot.iterdir()):
+            if not (proc_dir.is_dir() and proc_dir.name.isdigit()):
+                continue
+            segs = obs_journal.segment_paths(proc_dir)[-max(1, keep):]
+            if not segs:
+                continue
+            dest = bundle / "journal" / proc_dir.name
+            dest.mkdir(parents=True, exist_ok=True)
+            for seg in segs:
+                try:
+                    shutil.copy2(seg, dest / Path(seg).name)
+                    copied += 1
+                except OSError:
+                    # A live member may evict the segment between the
+                    # listing and the copy — count it, keep copying.
+                    stats.increment("controller.incident_errors")
+        return copied
+
+    def _incident_resolution(self) -> str:
+        t = self._incident_trigger or ""
+        if t.startswith("slo"):
+            return "slo.recovered"
+        if t.startswith("quarantine"):
+            return "healed"
+        return "recovered"
+
+    @staticmethod
+    def _prune_incidents(root: Path, keep: int) -> None:
+        """Drop the oldest bundle dirs beyond `keep` (names are
+        timestamp-prefixed, so lexical order is chronological)."""
+        keep = max(1, keep)
+        dirs = sorted(d for d in root.iterdir() if d.is_dir())
+        for d in dirs[:-keep]:
+            shutil.rmtree(d, ignore_errors=True)
+
+    def list_incidents(self) -> list[dict]:
+        """Read-only bundle index (the /debug/incidents list): name,
+        trigger, open/closed, resolution — newest last."""
+        root = self._incident_root(self.session.conf)
+        if root is None or not root.is_dir():
+            return []
+        out = []
+        for d in sorted(root.iterdir()):
+            if not d.is_dir():
+                continue
+            doc: dict = {"name": d.name}
+            opened = self._read_marker(d / "open.json")
+            if opened:
+                doc["trigger"] = opened.get("trigger")
+                doc["member"] = opened.get("member")
+                doc["at"] = opened.get("at")
+            manifest = self._read_marker(d / "manifest.json")
+            doc["open"] = manifest is None
+            if manifest:
+                doc["resolution"] = manifest.get("resolution")
+            out.append(doc)
+        return out
+
+    def read_incident(self, name: str) -> dict | None:
+        """One bundle's manifest + open record + file inventory, or
+        None for unknown names (the /debug/incidents?name= detail)."""
+        if not name or "/" in name or "\\" in name or ".." in name:
+            return None  # bundle names never contain path separators
+        root = self._incident_root(self.session.conf)
+        if root is None:
+            return None
+        d = root / name
+        if not d.is_dir():
+            return None
+        files = sorted(
+            str(p.relative_to(d)) for p in d.rglob("*") if p.is_file()
+        )
+        doc: dict = {"name": name, "files": files}
+        opened = self._read_marker(d / "open.json")
+        if opened:
+            doc["open"] = opened
+        manifest = self._read_marker(d / "manifest.json")
+        if manifest:
+            doc["manifest"] = manifest
+        return doc
 
     # -- views ------------------------------------------------------------
     def snapshot(self) -> dict:
@@ -751,5 +1066,8 @@ class OpsController:
                 "sat_ticks": self._sat_ticks,
                 "scale_baseline": self._scale_baseline,
                 "pending_demotions": sum(c for _, c in self._demotions),
+                "open_incident": (
+                    self._incident_dir.name if self._incident_dir else None
+                ),
                 "recent_actions": list(self._recent_actions),
             }

@@ -62,16 +62,33 @@ class FileLease:
         """The current lease token, or None when unheld/unreadable."""
         return _read_text(self.path)
 
-    def try_acquire(self) -> tuple[str, bool] | None:
+    def holder_note(self) -> str | None:
+        """The holder's optional annotation (fourth token field) — the
+        single-flight leader stamps its root trace id here so followers
+        can link their wait span to the leader's trace. None on legacy
+        three-field tokens or when unheld."""
+        text = _read_text(self.path)
+        if not text:
+            return None
+        parts = text.split(":", 3)
+        return parts[3] or None if len(parts) == 4 else None
+
+    def try_acquire(self, note: str | None = None) -> tuple[str, bool] | None:
         """Claim the lease. Returns ``(token, reaped)`` on success —
         `reaped` is True when the claim displaced a stale (crashed)
-        holder — or None while a live contender holds it."""
+        holder — or None while a live contender holds it. `note` is an
+        optional annotation carried as a fourth token field (readable
+        via `holder_note`); epoch parsing ignores it, so three- and
+        four-field tokens coexist on one lease path."""
         fault_point("fleet.lease.acquire", self.path)
         self.path.parent.mkdir(parents=True, exist_ok=True)
         # Wall clock on purpose: the epoch must be comparable across
         # processes and survive the writer (monotonic clocks are
         # per-boot, not per-file).
         token = f"{time.time():.6f}:{os.getpid()}:{uuid.uuid4().hex}"  # noqa: HSL007
+        if note:
+            # One line, colon-delimited: strip both from the annotation.
+            token += ":" + "".join(c for c in str(note) if c not in ":\n\r")[:128]
         reaped = False
         for attempt in range(3):
             try:

@@ -37,6 +37,7 @@ from typing import Callable
 
 from hyperspace_tpu import stats
 from hyperspace_tpu.obs import events as obs_events
+from hyperspace_tpu.obs import trace as obs_trace
 from hyperspace_tpu.serve.fleet.lease import FileLease
 
 _EVT_TAKEOVER = obs_events.declare("fleet.singleflight.takeover")
@@ -79,42 +80,69 @@ class SingleFlight:
         idempotent, so the `finally` re-release is harmless."""
         lease = FileLease(self.root / f"{key_name(name)}.lease", self.lease_ttl_s)
         deadline = time.monotonic() + self.wait_s
-        while True:
-            # Check BEFORE claiming: once the leader releases, every
-            # waiter's next acquire would succeed — without this order a
-            # waiter that raced past its last check would win the freed
-            # lease and redo the build it was waiting for.
-            if check is not None:
-                value = check()
-                if value is not None:
-                    stats.increment("fleet.singleflight.follower_hits")
-                    return value
-            claim = lease.try_acquire()
-            if claim is not None:
-                token, reaped = claim
-                if on_lease is not None:
-                    on_lease(lease, token)
-                try:
-                    if check is not None:
-                        # Double-check after winning: the previous
-                        # leader may have published between our check
-                        # and the claim.
-                        value = check()
-                        if value is not None:
-                            stats.increment("fleet.singleflight.follower_hits")
-                            return value
-                    if reaped:
-                        stats.increment("fleet.singleflight.takeovers")
-                        _EVT_TAKEOVER.emit(key=str(name))
-                    stats.increment("fleet.singleflight.leader")
-                    return build()
-                finally:
-                    lease.release(token)
+        # Follower wait span: opened lazily on the first lap that
+        # actually waits, linked to the leader's root trace id read from
+        # the lease token's note field — the cross-process edge a merged
+        # fleet trace needs (docs/observability.md "cross-process query
+        # traces"). NOOP outside a trace; closed on every exit path.
+        wait_span = None
+        leader_id = None
+        try:
+            while True:
+                # Check BEFORE claiming: once the leader releases, every
+                # waiter's next acquire would succeed — without this order a
+                # waiter that raced past its last check would win the freed
+                # lease and redo the build it was waiting for.
+                if check is not None:
+                    value = check()
+                    if value is not None:
+                        stats.increment("fleet.singleflight.follower_hits")
+                        if wait_span is not None:
+                            wait_span.set(outcome="follower_hit")
+                        return value
+                claim = lease.try_acquire(note=obs_trace.current_trace_id())
+                if claim is not None:
+                    token, reaped = claim
                     if on_lease is not None:
-                        on_lease(None, None)
-            if time.monotonic() >= deadline:
-                # The leader is slow (or its artifact is uncacheable):
-                # build locally. Same cost as a world without dedup.
-                stats.increment("fleet.singleflight.local_fallbacks")
-                return build()
-            time.sleep(_POLL_S)
+                        on_lease(lease, token)
+                    try:
+                        if check is not None:
+                            # Double-check after winning: the previous
+                            # leader may have published between our check
+                            # and the claim.
+                            value = check()
+                            if value is not None:
+                                stats.increment("fleet.singleflight.follower_hits")
+                                if wait_span is not None:
+                                    wait_span.set(outcome="follower_hit")
+                                return value
+                        if reaped:
+                            stats.increment("fleet.singleflight.takeovers")
+                            _EVT_TAKEOVER.emit(key=str(name))
+                        stats.increment("fleet.singleflight.leader")
+                        if wait_span is not None:
+                            wait_span.set(outcome="became_leader")
+                        return build()
+                    finally:
+                        lease.release(token)
+                        if on_lease is not None:
+                            on_lease(None, None)
+                if time.monotonic() >= deadline:
+                    # The leader is slow (or its artifact is uncacheable):
+                    # build locally. Same cost as a world without dedup.
+                    stats.increment("fleet.singleflight.local_fallbacks")
+                    if wait_span is not None:
+                        wait_span.set(outcome="local_fallback")
+                    return build()
+                if wait_span is None:
+                    wait_span = obs_trace.span(
+                        "fleet.singleflight.wait", key=str(name)
+                    ).__enter__()
+                if leader_id is None:
+                    leader_id = lease.holder_note()
+                    if leader_id:
+                        wait_span.set(leader_trace_id=leader_id)
+                time.sleep(_POLL_S)
+        finally:
+            if wait_span is not None:
+                wait_span.__exit__(None, None, None)

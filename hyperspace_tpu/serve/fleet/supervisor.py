@@ -100,7 +100,12 @@ def register_worker(
     (atomic write_json) — how ephemeral `port=0` bindings become
     discoverable (obs/http.py)."""
     path = Path(fleet_dir) / WORKERS_DIRNAME / f"{int(worker_id)}.json"
-    file_utils.write_json(path, {"pid": os.getpid(), "port": port, "host": host})
+    # Wall clock on purpose: the heartbeat must be comparable from OTHER
+    # processes (the supervisor's last-heartbeat ages in /healthz).
+    file_utils.write_json(
+        path,
+        {"pid": os.getpid(), "port": port, "host": host, "ts": time.time()},  # noqa: HSL007
+    )
 
 
 def read_workers(fleet_dir: str | os.PathLike) -> dict[int, dict]:
@@ -137,6 +142,11 @@ def _worker_entry(target, worker_id: int, fleet_dir: str, stop_event, args: tupl
     if fstate is not None:
         faults.install_state(fstate)
     obs_trace.set_enabled(bool(env.get("obs_enabled", True)))
+    jstate = env.get("journal")
+    if jstate is not None:
+        from hyperspace_tpu.obs import journal as obs_journal
+
+        obs_journal.install_state(dict(jstate, worker_id=worker_id))
     target(WorkerContext(worker_id, fleet_dir, stop_event), *args)
 
 
@@ -209,6 +219,11 @@ class FleetSupervisor:
         self._restart_at: dict[int, float] = {}
         self._monitor_thread: threading.Thread | None = None
         self._stopping = False
+        # Last wall-clock instant each member proved life: a successful
+        # /healthz scrape, or its registration heartbeat — whichever is
+        # newer. Read (not scraped) by `fleet_summary` so /healthz can
+        # show a silently dead member's age between poll ticks.
+        self._last_seen: dict[int, float] = {}
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "FleetSupervisor":
@@ -265,9 +280,12 @@ class FleetSupervisor:
         return n
 
     def _spawn(self, worker_id: int):
+        from hyperspace_tpu.obs import journal as obs_journal
+
         env = {
             "faults": faults.export_state(),
             "obs_enabled": obs_trace.enabled(),
+            "journal": obs_journal.export_state(),
         }
         return self._host.spawn(
             worker_id,
@@ -361,13 +379,25 @@ class FleetSupervisor:
         worst = "ok"
         procs = list(self._host.processes().values())
         alive_pids = {p.pid for p in procs if p.is_alive()}
+        now = time.time()  # noqa: HSL007 — cross-process heartbeat ages
         for wid, reg in read_workers(self.fleet_dir).items():
             port = reg.get("port")
             doc = None
             if port and reg.get("pid") in alive_pids:
                 doc = _scrape_json(reg.get("host", "127.0.0.1"), port, "/healthz")
             status = doc["status"] if doc else "unreachable"
+            with self._lock:
+                seen = self._last_seen.get(wid)
+                reg_ts = reg.get("ts")
+                if isinstance(reg_ts, (int, float)):
+                    seen = max(seen or 0.0, float(reg_ts))
+                if doc is not None:
+                    seen = max(seen or 0.0, now)
+                if seen is not None:
+                    self._last_seen[wid] = seen
             members[wid] = {"pid": reg.get("pid"), "port": port, "status": status,
+                            "last_heartbeat_age_s":
+                                round(now - seen, 3) if seen else None,
                             "healthz": doc}
             if rank.get(status, 2) > rank.get(worst, 0):
                 worst = status
@@ -378,6 +408,32 @@ class FleetSupervisor:
             spawned = self.n
         return {"status": worst, "saturation": agg, "members": members,
                 "alive": self.alive_count(), "spawned": spawned}
+
+    def fleet_summary(self) -> dict:
+        """Cheap fleet view for /healthz: member pids/ports and per-member
+        last-heartbeat age WITHOUT scraping anyone (reads the fleet dir's
+        registrations and the liveness the supervisor already tracks) —
+        a silently dead member shows a growing age here between
+        `fleet_health` poll ticks instead of disappearing."""
+        now = time.time()  # noqa: HSL007 — cross-process heartbeat ages
+        procs = dict(self._host.processes())
+        members: dict[int, dict] = {}
+        for wid, reg in read_workers(self.fleet_dir).items():
+            with self._lock:
+                seen = self._last_seen.get(wid)
+            reg_ts = reg.get("ts")
+            if isinstance(reg_ts, (int, float)):
+                seen = max(seen or 0.0, float(reg_ts))
+            p = procs.get(wid)
+            members[wid] = {
+                "pid": reg.get("pid"),
+                "port": reg.get("port"),
+                "alive": bool(p.is_alive()) if p is not None else None,
+                "last_heartbeat_age_s": round(now - seen, 3) if seen else None,
+            }
+        with self._lock:
+            spawned = self.n
+        return {"members": members, "alive": self.alive_count(), "spawned": spawned}
 
     def aggregate_metrics(self) -> dict[int, str]:
         """Raw Prometheus text per registered live member (a scrape

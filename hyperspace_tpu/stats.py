@@ -104,6 +104,21 @@ Counter names in use:
 - ``faults.delays_injected``  brownout delays the injection harness
   applied (a `delay_s` fault rule firing — the slow-path counterpart
   of ``faults.injected``)
+- ``obs.journal.records``  telemetry records appended to this process's
+  durable journal (obs/journal.py — events, root spans, metrics
+  snapshots, SLO transitions, process markers)
+- ``obs.journal.errors``  advisory journal IO failures swallowed by the
+  never-raise contract (full disk, unwritable root — the query or
+  actuation being observed proceeds untouched)
+- ``obs.journal.segments_sealed``  active journal segments atomically
+  published as ``segment-<n>.jsonl`` (mkstemp + os.replace)
+- ``obs.journal.evictions``  sealed journal segments dropped oldest-first
+  by the per-process byte budget (``hyperspace.obs.journal.maxBytes``)
+- ``controller.incidents``  incident bundles the controller opened on an
+  SLO page, quarantine, or observe-only entry
+  (docs/fault_tolerance.md "incident bundles")
+- ``controller.incident_errors``  advisory incident-bundle capture
+  failures (forensics must never compound the incident)
 """
 
 from __future__ import annotations
@@ -156,6 +171,12 @@ KNOWN_COUNTERS = (
     "controller.health_probe_errors",
     "fleet.worker.scaled",
     "faults.delays_injected",
+    "obs.journal.records",
+    "obs.journal.errors",
+    "obs.journal.segments_sealed",
+    "obs.journal.evictions",
+    "controller.incidents",
+    "controller.incident_errors",
 )
 
 _counters = {name: _metrics.counter(name) for name in KNOWN_COUNTERS}

@@ -68,7 +68,7 @@ def _obs_reset():
     test, so cross-test counter drift can't leak into assertions and a
     test that configures a sink can't make a later test write to it."""
     from hyperspace_tpu import stats
-    from hyperspace_tpu.obs import events, metrics, runtime, slo, trace
+    from hyperspace_tpu.obs import events, journal, metrics, runtime, slo, trace
 
     stats.reset()
     metrics.REGISTRY.reset()
@@ -77,7 +77,9 @@ def _obs_reset():
     events.reset()
     slo.reset()
     runtime.reset()
+    journal.reset()
     yield
+    journal.reset()
 
 
 @pytest.fixture

@@ -601,7 +601,10 @@ def test_heal_coordination_gate_off_keeps_heals_local(tmp_path):
         hs.session.index_health["/idx/shared"] = {"reason": "torn"}
     ctrl.step(now=0.0)
     assert hs.calls == [("recover", "shared"), ("refresh", "shared", "full")]
-    assert not (tmp_path / "_fleet").exists()  # no marker, no lease
+    # No coordination artifacts: no heal marker, no lease. (The incident
+    # flight recorder may still create `_fleet/incidents` — it is not
+    # gated by heal.coordinate.)
+    assert not (tmp_path / "_fleet" / "heal").exists()
 
 
 # -- fleet scaling: supervisor actuation -------------------------------------
@@ -743,3 +746,162 @@ def test_storm_response_gate_off_never_pins():
     ctrl.step(now=0.0)
     assert ledger.pins == []
     assert _actuation_events("storm.response.sig-x") == []
+
+
+# -- incident flight recorder ------------------------------------------------
+
+
+def _incident_controller(tmp_path, server=None, **conf_overrides):
+    conf_overrides.setdefault(
+        "hyperspace.controller.incident.dir", str(tmp_path / "incidents")
+    )
+    return _controller(server=server, **conf_overrides)
+
+
+def test_page_episode_yields_one_finalized_bundle(tmp_path, shed_server):
+    from hyperspace_tpu.obs import journal
+
+    journal.configure(enabled=True, root=str(tmp_path / "_obs"))
+    completed, failed, *_ = _serve_counters()
+    hs, ctrl = _incident_controller(tmp_path, server=shed_server)
+    t = _drive_page(completed, failed, ctrl)
+    # The overload response engaging opened the bundle, still unresolved.
+    (inc,) = ctrl.list_incidents()
+    assert inc["open"] is True and inc["trigger"] == "slo.page"
+    assert ctrl.snapshot()["open_incident"] == inc["name"]
+    # Recovery closes + finalizes it.
+    completed.inc(80_000)
+    ctrl.step(now=t + 70.0)
+    ctrl.step(now=t + 71.0)
+    (inc,) = ctrl.list_incidents()
+    assert inc["open"] is False and inc["resolution"] == "slo.recovered"
+    doc = ctrl.read_incident(inc["name"])
+    # Content-complete: state snapshots at open, manifest at close,
+    # this member's sealed journal segments copied in.
+    for f in ("open.json", "events.json", "config.json", "jit.json",
+              "routing.json", "manifest.json"):
+        assert f in doc["files"]
+    assert any(f.startswith("journal/") for f in doc["files"])
+    assert doc["open"]["verdicts"]["serve.availability"] == "page"
+    actions = [a["action"] for a in doc["manifest"]["actions"]]
+    assert "shed.engage" in actions and "shed.release" in actions
+    assert stats.get("controller.incidents") == 1
+    assert ctrl.snapshot()["open_incident"] is None
+
+
+def test_fresh_quarantine_opens_bundle_closed_as_healed(tmp_path):
+    _serve_counters()
+    hs, ctrl = _incident_controller(tmp_path)
+    with hs.session._state_lock:
+        hs.session.index_health["/idx/a"] = {"reason": "torn"}
+    # One reconciliation pass: the fresh quarantine opens the bundle,
+    # the heal executes, and the now-empty quarantine closes it — the
+    # whole episode is recorded within the tick it resolved in.
+    ctrl.step(now=0.0)
+    (inc,) = ctrl.list_incidents()
+    assert inc["trigger"] == "quarantine.a"
+    assert inc["open"] is False and inc["resolution"] == "healed"
+    manifest = ctrl.read_incident(inc["name"])["manifest"]
+    assert "heal.a" in [a["action"] for a in manifest["actions"]]
+
+
+def test_budget_exhaustion_snapshots_an_observe_only_bundle(tmp_path):
+    _serve_counters()
+    hs, ctrl = _incident_controller(
+        tmp_path, **{"hyperspace.controller.actuationBudget": "0"}
+    )
+    demoted = events.declare("advisor.routing.demoted")
+    for i in range(3):
+        demoted.emit(signature=f"s{i}")
+    ctrl.step(now=0.0)
+    # Degrading to observe-only is itself an incident: opened and
+    # finalized in one motion — there is no recovery to wait for.
+    (inc,) = ctrl.list_incidents()
+    assert inc["trigger"] == "observe_only"
+    assert inc["open"] is False and inc["resolution"] == "observe_only"
+
+
+def test_incident_cooldown_and_retention(tmp_path):
+    _serve_counters()
+    hs, ctrl = _incident_controller(
+        tmp_path, **{"hyperspace.controller.cooldownSeconds": "10"}
+    )
+    # Three serial episodes on distinct indexes: three bundles...
+    for i, (t_open, t_close) in enumerate([(0.0, 1.0), (20.0, 21.0), (40.0, 41.0)]):
+        with hs.session._state_lock:
+            hs.session.index_health[f"/idx/i{i}"] = {"reason": "torn"}
+        ctrl.step(now=t_open)
+        ctrl.step(now=t_close)
+    # ...pruned to controller.incident.maxBundles (default 16 keeps all).
+    assert len(ctrl.list_incidents()) == 3
+    assert stats.get("controller.incidents") == 3
+    # Re-quarantine INSIDE the cooldown window: no fourth bundle.
+    with hs.session._state_lock:
+        hs.session.index_health["/idx/i2"] = {"reason": "torn again"}
+    ctrl.step(now=41.5)
+    assert len(ctrl.list_incidents()) == 3
+
+
+def test_incident_retention_prunes_oldest(tmp_path):
+    _serve_counters()
+    hs, ctrl = _incident_controller(
+        tmp_path,
+        **{
+            "hyperspace.controller.incident.maxBundles": "2",
+            "hyperspace.controller.cooldownSeconds": "1",
+        },
+    )
+    for i in range(3):
+        with hs.session._state_lock:
+            hs.session.index_health[f"/idx/i{i}"] = {"reason": "torn"}
+        ctrl.step(now=i * 10.0)
+        ctrl.step(now=i * 10.0 + 1.0)
+    incs = ctrl.list_incidents()
+    assert len(incs) == 2
+    assert {i["trigger"] for i in incs} == {"quarantine.i1", "quarantine.i2"}
+
+
+def test_incident_recorder_disabled_writes_nothing(tmp_path):
+    _serve_counters()
+    hs, ctrl = _incident_controller(
+        tmp_path, **{"hyperspace.controller.incident.enabled": "false"}
+    )
+    with hs.session._state_lock:
+        hs.session.index_health["/idx/a"] = {"reason": "torn"}
+    ctrl.step(now=0.0)
+    ctrl.step(now=1.0)
+    assert ctrl.list_incidents() == []
+    assert not (tmp_path / "incidents").exists()
+    assert stats.get("controller.incidents") == 0
+
+
+def test_debug_incidents_endpoint_serves_bundles(tmp_path):
+    import urllib.error
+
+    _serve_counters()
+    hs, ctrl = _incident_controller(tmp_path)
+    with hs.session._state_lock:
+        hs.session.index_health["/idx/a"] = {"reason": "torn"}
+    ctrl.step(now=0.0)
+    ctrl.step(now=1.0)
+    endpoint = obs_http.HealthServer().start()
+    try:
+        endpoint.attach_controller(ctrl)
+        with urllib.request.urlopen(
+            endpoint.url("/debug/incidents"), timeout=10
+        ) as r:
+            (inc,) = json.loads(r.read())["incidents"]
+        assert inc["resolution"] == "healed"
+        with urllib.request.urlopen(
+            endpoint.url(f"/debug/incidents?name={inc['name']}"), timeout=10
+        ) as r:
+            detail = json.loads(r.read())
+        assert detail["manifest"]["trigger"] == "quarantine.a"
+        assert "open.json" in detail["files"]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                endpoint.url("/debug/incidents?name=nope"), timeout=10
+            )
+        assert ei.value.code == 404
+    finally:
+        endpoint.stop()

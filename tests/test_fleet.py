@@ -80,6 +80,12 @@ class TestFileLease:
 
 # -- cross-process single-flight (driven in-process for determinism) ----------
 
+def _walk(span):
+    yield span
+    for c in span.get("children", ()):
+        yield from _walk(c)
+
+
 class TestSingleFlight:
     def test_leader_builds_follower_observes(self, tmp_path):
         sf = SingleFlight(tmp_path, lease_ttl_s=30, wait_s=10)
@@ -116,6 +122,53 @@ class TestSingleFlight:
         assert built == ["leader"]  # exactly one build across "processes"
         assert stats.get("fleet.singleflight.leader") == 1
         assert stats.get("fleet.singleflight.follower_hits") == 1
+
+    def test_follower_wait_span_links_leader_trace_id(self, tmp_path):
+        """Cross-process trace propagation (docs/observability.md): the
+        leader stamps its root trace id into the lease token note; a
+        follower that waited records a `fleet.singleflight.wait` span
+        carrying that leader id — the fleet chrome trace can join the
+        follower's stall to the trace that actually did the work."""
+        from hyperspace_tpu.obs import trace as obs_trace
+
+        sf = SingleFlight(tmp_path, lease_ttl_s=30, wait_s=10)
+        artifact = tmp_path / "artifact.json"
+        release = threading.Event()
+        leader_trace = []
+
+        def leader():
+            with obs_trace.trace("leader.query"):
+                leader_trace.append(obs_trace.current_trace_id())
+                sf.run("k", build=lambda: (
+                    release.wait(30),
+                    artifact.write_text(json.dumps({"v": 1})),
+                )[0], check=check)
+
+        def check():
+            return 1 if artifact.exists() else None
+
+        follower_roots = []
+
+        def follower():
+            with obs_trace.trace("follower.query"):
+                sf.run("k", build=lambda: -1, check=check)
+            follower_roots.append(obs_trace.last_trace().to_json())
+
+        t1 = threading.Thread(target=leader)
+        t1.start()
+        time.sleep(0.3)  # leader holds the lease, note = its trace id
+        t2 = threading.Thread(target=follower)
+        t2.start()
+        time.sleep(0.3)  # follower is in the wait loop
+        release.set()
+        t1.join(30)
+        t2.join(30)
+        (root,) = follower_roots
+        waits = [s for s in _walk(root) if s["name"] == "fleet.singleflight.wait"]
+        assert waits, "follower never recorded its wait"
+        (wait,) = waits
+        assert wait["attrs"]["outcome"] == "follower_hit"
+        assert wait["attrs"]["leader_trace_id"] == leader_trace[0]
 
     def test_wait_expiry_falls_back_to_local_build(self, tmp_path):
         sf = SingleFlight(tmp_path, lease_ttl_s=30, wait_s=0.1)
@@ -718,3 +771,85 @@ class TestEphemeralHealthPort:
         finally:
             a.stop()
             b.stop()
+
+
+def _journaling_member(ctx):
+    """Child: journal root spans forever (the supervisor shipped the
+    parent's journal config in via env, so this member writes its own
+    `<_obs>/<pid>/` segments) until stopped or killed."""
+    from hyperspace_tpu.obs import trace as _trace
+
+    i = 0
+    while not ctx.stop_event.is_set():
+        with _trace.trace("member.query") as _:
+            i += 1
+        time.sleep(0.005)
+
+
+class TestFleetJournal:
+    def test_sigkilled_member_journal_merges_into_fleet_chrome_trace(
+        self, tmp_path
+    ):
+        """The flight-recorder promise end to end: a fleet member dies by
+        a REAL SIGKILL mid-write, and its durable journal segments still
+        merge into the fleet chrome trace on a pid-qualified lane —
+        post-mortem observability does not require the process."""
+        from hyperspace_tpu.obs import export as obs_export
+        from hyperspace_tpu.obs import journal
+
+        jroot = tmp_path / "_obs"
+        # Small segments so the member seals quickly; the supervisor
+        # ships this exact config into the spawned member.
+        journal.configure(
+            enabled=True, root=str(jroot), segment_bytes=4096
+        )
+        sup = fleet.FleetSupervisor(
+            _journaling_member, fleet_dir=str(tmp_path / "fleet"), n=1,
+            max_restarts=0,
+        )
+        sup.start()
+        pid = None
+        try:
+            deadline = time.monotonic() + 180
+            while time.monotonic() < deadline:
+                p = sup._host.get(0)
+                if p is not None and p.pid is not None:
+                    pid = p.pid
+                    if journal.segment_paths(jroot / str(pid)):
+                        break  # at least one sealed segment on disk
+                time.sleep(0.05)
+            assert pid is not None and journal.segment_paths(jroot / str(pid))
+            os.kill(pid, signal.SIGKILL)  # no cleanup handlers run
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline and sup.alive_count() > 0:
+                time.sleep(0.05)
+            assert sup.alive_count() == 0
+        finally:
+            sup.stop(timeout=30)
+        # The dead member's sealed history survives and merges: a
+        # `process` start marker (install_state) and its root spans.
+        merged = journal.merge_dir(jroot)
+        member_recs = [r for r in merged if r["pid"] == pid]
+        assert any(r["kind"] == "process" for r in member_recs)
+        spans = [r for r in member_recs if r["kind"] == "span"]
+        assert spans and all(
+            r["trace"]["name"] == "member.query" for r in spans
+        )
+        # Fleet chrome export lanes the dead member by pid.
+        doc = obs_export.chrome_trace(obs_export.roots_from_fleet(str(jroot)))
+        slices = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert {e["pid"] for e in slices} >= {pid}
+        names = [
+            e["args"]["name"] for e in doc["traceEvents"]
+            if e["ph"] == "M" and e["name"] == "process_name"
+        ]
+        assert f"member pid {pid}" in names
+        # The kill tore at most the active tmp tail; sweep reaps it
+        # without touching sealed history.
+        before = journal.merge_dir(jroot)
+        journal.sweep(jroot)
+        assert journal.merge_dir(jroot) == before
+        assert not [
+            p for p in (jroot / str(pid)).iterdir()
+            if p.name.startswith(".tmp-seg-")
+        ]
