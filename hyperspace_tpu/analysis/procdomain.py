@@ -134,6 +134,13 @@ SPAWN_ENTRY_POINTS = {
         "service_body", "bench single-flight holder killed mid-build by the takeover regime"),
     "benchmarks.bench_soak._soak_fleet_worker": (
         "service_body", "soak fleet member: jax-free slot holder SIGKILLed by the respawn episode"),
+    # The continuous-ingestion daemon's optional process mode
+    # (hyperspace.ingest.processWorker): the whole poll loop runs in a
+    # spawn-context worker whose pause/stop controls ride atomic files
+    # under <system_path>/_ingest, so a SIGKILL leaves at most a
+    # transient log the next recover() converges.
+    "hyperspace_tpu.ingest.daemon._service_entry": (
+        "service", "ingest worker shim: fault/journal state in; commits via the two-phase Action"),
 }
 
 # Module-level imports that may never be reachable at worker start:

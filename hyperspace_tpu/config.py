@@ -260,6 +260,26 @@ ADVISOR_MIN_BENEFIT_SECONDS = "hyperspace.advisor.minBenefitSeconds"
 EXPLAIN_DISPLAY_MODE = "hyperspace.explain.displayMode"
 EXPLAIN_HIGHLIGHT_BEGIN = "hyperspace.explain.displayMode.highlight.beginTag"
 EXPLAIN_HIGHLIGHT_END = "hyperspace.explain.displayMode.highlight.endTag"
+# Continuous-ingestion daemon (hyperspace_tpu/ingest/, docs/ingestion.md):
+# a background service that turns refresh from an operator action into a
+# poll loop — source watchers (new-file arrival + appended-row CDC
+# batches) feed micro-batch incremental refreshes through the unchanged
+# two-phase Action protocol, with advisor-gated compaction once delta
+# fragmentation passes `hyperspace.advisor.lifecycle.maxDeltas`.
+# enabled defaults OFF (nothing polls, nothing mutates without opt-in);
+# pollSeconds is the tailer cadence; cdcBatchRows bounds the rows one
+# materialized CDC batch file carries; autoCompact gates the compaction
+# step (the advisor lifecycle gates still apply on top); processWorker
+# moves the loop into a spawn-context worker process
+# (parallel/procpool.py) instead of the default in-process thread;
+# maxLagSeconds is the advisory freshness objective past which the
+# daemon emits `ingest.lagging`.
+INGEST_ENABLED = "hyperspace.ingest.enabled"
+INGEST_POLL_SECONDS = "hyperspace.ingest.pollSeconds"
+INGEST_CDC_BATCH_ROWS = "hyperspace.ingest.cdcBatchRows"
+INGEST_AUTO_COMPACT = "hyperspace.ingest.autoCompact"
+INGEST_PROCESS_WORKER = "hyperspace.ingest.processWorker"
+INGEST_MAX_LAG_SECONDS = "hyperspace.ingest.maxLagSeconds"
 
 # Directory-layout constants (reference index/IndexConstants.scala:38-39).
 HYPERSPACE_LOG_DIR = "_hyperspace_log"
@@ -316,6 +336,9 @@ DEFAULT_OBS_JOURNAL_MAX_BYTES = 4 << 20
 DEFAULT_OBS_JOURNAL_SNAPSHOT_SECONDS = 5.0
 DEFAULT_CONTROLLER_INCIDENT_MAX_BUNDLES = 16
 DEFAULT_CONTROLLER_INCIDENT_SEGMENTS = 4
+DEFAULT_INGEST_POLL_SECONDS = 1.0
+DEFAULT_INGEST_CDC_BATCH_ROWS = 65536
+DEFAULT_INGEST_MAX_LAG_SECONDS = 30.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -817,6 +840,39 @@ KNOWN_KEYS: dict[str, ConfKey] = {
         "0",
         "Policy floor: recommendations whose estimated benefit is below this "
         "many seconds are reported but never auto-applied."),
+    INGEST_ENABLED: ConfKey(
+        "false",
+        "Continuous-ingestion daemon ([ingestion.md](ingestion.md)): source "
+        "watchers feed micro-batch incremental refreshes through the "
+        "two-phase Action protocol as a background service. Off by default — "
+        "nothing polls or mutates without opt-in; `Hyperspace.ingest()` "
+        "constructs the daemon either way."),
+    INGEST_POLL_SECONDS: ConfKey(
+        "1.0",
+        "Tailer cadence: how often the daemon polls its sources for new "
+        "files / appended CDC rows (and re-reads its pause control file)."),
+    INGEST_CDC_BATCH_ROWS: ConfKey(
+        "65536",
+        "Row bound of one materialized CDC batch file: a changelog tail "
+        "longer than this is split into multiple deterministic batch files "
+        "(each commits through its own micro-batch)."),
+    INGEST_AUTO_COMPACT: ConfKey(
+        "true",
+        "Gate the daemon's background compaction: once an index spans more "
+        "delta version dirs than `hyperspace.advisor.lifecycle.maxDeltas`, "
+        "trigger the optimize action (deferred while serve SLOs burn; the "
+        "advisor lifecycle gates still bound WHAT may compact)."),
+    INGEST_PROCESS_WORKER: ConfKey(
+        "false",
+        "Run the ingest loop in a spawn-context worker PROCESS "
+        "(parallel/procpool.py) instead of the default in-process daemon "
+        "thread — the crash-isolation deployment shape (a SIGKILLed worker "
+        "leaves only a transient log the next recover() converges)."),
+    INGEST_MAX_LAG_SECONDS: ConfKey(
+        "30.0",
+        "Advisory freshness objective: when data observed by the tailer has "
+        "waited longer than this without reaching a committed index version, "
+        "the daemon emits a WARN `ingest.lagging` event (never blocks)."),
 }
 
 
@@ -927,6 +983,12 @@ class HyperspaceConf:
     obs_http_enabled: bool = False  # opt-in: binds a socket
     obs_http_host: str = "127.0.0.1"
     obs_http_port: int = 0  # 0 = ephemeral
+    ingest_enabled: bool = False  # opt-in: the daemon mutates index state
+    ingest_poll_seconds: float = DEFAULT_INGEST_POLL_SECONDS
+    ingest_cdc_batch_rows: int = DEFAULT_INGEST_CDC_BATCH_ROWS
+    ingest_auto_compact: bool = True
+    ingest_process_worker: bool = False  # opt-in: spawns a worker process
+    ingest_max_lag_seconds: float = DEFAULT_INGEST_MAX_LAG_SECONDS
     overrides: dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def __post_init__(self):
@@ -1168,6 +1230,18 @@ class HyperspaceConf:
             from hyperspace_tpu.utils import retry
 
             retry.configure(cas_attempts=int(value))
+        elif key == INGEST_ENABLED:
+            self.ingest_enabled = _as_bool(value)
+        elif key == INGEST_POLL_SECONDS:
+            self.ingest_poll_seconds = float(value)
+        elif key == INGEST_CDC_BATCH_ROWS:
+            self.ingest_cdc_batch_rows = int(value)
+        elif key == INGEST_AUTO_COMPACT:
+            self.ingest_auto_compact = _as_bool(value)
+        elif key == INGEST_PROCESS_WORKER:
+            self.ingest_process_worker = _as_bool(value)
+        elif key == INGEST_MAX_LAG_SECONDS:
+            self.ingest_max_lag_seconds = float(value)
 
     def get(self, key: str, default: Any = None) -> Any:
         check_known_key(key)
@@ -1373,4 +1447,16 @@ class HyperspaceConf:
             from hyperspace_tpu.obs import journal as _obs_journal
 
             return _obs_journal.snapshot_seconds()
+        if key == INGEST_ENABLED:
+            return self.ingest_enabled
+        if key == INGEST_POLL_SECONDS:
+            return self.ingest_poll_seconds
+        if key == INGEST_CDC_BATCH_ROWS:
+            return self.ingest_cdc_batch_rows
+        if key == INGEST_AUTO_COMPACT:
+            return self.ingest_auto_compact
+        if key == INGEST_PROCESS_WORKER:
+            return self.ingest_process_worker
+        if key == INGEST_MAX_LAG_SECONDS:
+            return self.ingest_max_lag_seconds
         return default

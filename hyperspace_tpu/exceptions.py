@@ -242,6 +242,31 @@ ERROR_CONTRACTS: dict[str, tuple[str, ...]] = {
     # (the coordinator's liveness check converts that into WorkerCrashed).
     "hyperspace_tpu.execution.build_exchange.p1_shard": _QUERY_SURFACE,
     "hyperspace_tpu.execution.build_exchange.p2_owner": _QUERY_SURFACE,
+    # Continuous-ingestion daemon (hyperspace_tpu/ingest/,
+    # docs/ingestion.md). The writer commits through the SAME facade
+    # methods an operator would call (refresh/optimize), so it shares
+    # the full query surface. One daemon tick absorbs per-index
+    # Exceptions (recorded as ingest.commit_failures /
+    # ingest.compact_failures, the loop keeps polling the other
+    # watches) — what escapes tick() is injected IO faults at the
+    # ingest.* fault points, CrashPoint (a dying daemon does not keep
+    # committing), and the programming-error surface. The CDC tailer's
+    # poll is a contract of its own: the crash window between a batch
+    # file landing and the cursor persisting (the ingest.tail fault
+    # point) unwinds through it, and the deterministic batch naming is
+    # what makes the retry idempotent. `_service_entry` is the
+    # processWorker-mode spawn target (procdomain SPAWN_ENTRY_POINTS):
+    # its setup (session rebuild, config replay, watch registration)
+    # runs before the absorbing loop, so the full surface applies.
+    "hyperspace_tpu.ingest.daemon.IngestDaemon.tick": (
+        "OSError", "CrashPoint", "ValueError", "KeyError", "NotImplementedError",
+    ),
+    "hyperspace_tpu.ingest.tailer.CdcTailer.poll": (
+        "OSError", "CrashPoint", "ValueError", "KeyError",
+    ),
+    "hyperspace_tpu.ingest.daemon._service_entry": _QUERY_SURFACE,
+    "hyperspace_tpu.ingest.writer.commit_micro_batch": _QUERY_SURFACE,
+    "hyperspace_tpu.ingest.writer.maybe_compact": _QUERY_SURFACE,
 }
 
 

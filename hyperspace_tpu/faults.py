@@ -79,6 +79,15 @@ Fault point names in use (see each call site):
                       controller mutation (shed engage/release, heal,
                       sweep): a crash there proves the reconciliation
                       step leaves no partial actuation behind
+``ingest.tail``       ingest/tailer.py, after a CDC batch file lands but
+                      BEFORE the cursor persists: a crash there leaves an
+                      orphan batch the deterministic naming makes the
+                      retry idempotent over
+``ingest.commit``     ingest/writer.py, before a micro-batch's incremental
+                      refresh action runs (a crash mid-commit leaves at
+                      most the Action protocol's transient log)
+``ingest.compact``    ingest/writer.py, before the gated optimize action
+                      compacts delta buckets
 ====================  =====================================================
 
 Cross-process injection: the pooled build's workers are SPAWNED
@@ -130,6 +139,9 @@ KNOWN_POINTS = (
     "build.manifest.merge",
     "device.stage",
     "controller.actuate",
+    "ingest.tail",
+    "ingest.commit",
+    "ingest.compact",
 )
 
 

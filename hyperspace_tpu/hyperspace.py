@@ -213,7 +213,7 @@ class HyperspaceSession:
         """Register a line-delimited JSON dataset."""
         return Dataset.json(root).scan()
 
-    def optimized_plan(self, plan: LogicalPlan) -> LogicalPlan:
+    def optimized_plan(self, plan: LogicalPlan, snapshot=None) -> LogicalPlan:
         if not self._enabled:
             return plan
         from hyperspace_tpu.plan.prune import prune_columns
@@ -224,7 +224,13 @@ class HyperspaceSession:
         # extraOptimizations batch): side-local filters reach the join
         # sides (where the index rules cover them) and scans narrow to
         # what the query needs.
-        indexes = self.manager.get_indexes()
+        if snapshot is not None:
+            # MVCC pinned read (ingest/snapshot.py): the candidate set is
+            # the entries captured at admission, NOT the live listing —
+            # versions a concurrent micro-batch commits are invisible.
+            indexes = snapshot.entries()
+        else:
+            indexes = self.manager.get_indexes()
         with self._state_lock:
             unhealthy = set(self.index_health)
         if unhealthy:
@@ -237,12 +243,14 @@ class HyperspaceSession:
             ]
         return apply_rules(prune_columns(push_down_filters(plan)), indexes, conf=self.conf)
 
-    def run(self, plan: LogicalPlan, profile_dir: str | Path | None = None):
+    def run(self, plan: LogicalPlan, profile_dir: str | Path | None = None, snapshot=None):
         """Execute a plan (rewriting through indexes when enabled);
         returns a ColumnTable. With `profile_dir`, the execution runs
         under jax.profiler.trace and writes an xplane artifact there
         (SURVEY.md §5: the TPU profiling story) — open with TensorBoard
-        or xprof.
+        or xprof. With `snapshot` (a PinnedSnapshot from
+        :meth:`pin_snapshot`), the read repeats against the pinned
+        version stamp no matter what commits concurrently.
 
         Corruption fallback (`hyperspace.fallback.enabled`): when an
         index scan hits unreadable index data mid-query, the failing
@@ -250,15 +258,26 @@ class HyperspaceSession:
         re-plans — first through the remaining healthy indexes, then
         (if corruption persists) straight against the source data. The
         query answers either way; `hyperspace_tpu.stats` counts it."""
-        outcome = self.run_query(plan, profile_dir=profile_dir)
+        outcome = self.run_query(plan, profile_dir=profile_dir, snapshot=snapshot)
         self._publish(outcome)
         return outcome.result
+
+    def pin_snapshot(self):
+        """Pin an MVCC repeatable-read view of the collection at the
+        current per-index version stamp (ingest/snapshot.py,
+        docs/ingestion.md "snapshot semantics"). Pass the handle to
+        `run(..., snapshot=snap)`; release it (or use it as a context
+        manager) when done."""
+        from hyperspace_tpu.ingest.snapshot import PinnedSnapshot
+
+        return PinnedSnapshot(self)
 
     def run_query(
         self,
         plan: LogicalPlan,
         profile_dir: str | Path | None = None,
         plan_cache=None,
+        snapshot=None,
     ) -> QueryOutcome:
         """Execute a plan into a per-query :class:`QueryOutcome` without
         touching the session view — the concurrency-safe entry point the
@@ -280,6 +299,13 @@ class HyperspaceSession:
         from hyperspace_tpu.signature import plan_signature
 
         cache_before = self._cache_counts(hio, device_cache)
+        if snapshot is not None:
+            # Pin every raw source leaf to the snapshot's file lists
+            # BEFORE planning: the rewrite rules then exact-match the
+            # pinned entries and any raw fallback scans the pinned
+            # files — a repeatable read across concurrent commits.
+            plan = snapshot.pin_plan(plan)
+            stats.increment("ingest.pinned_reads")
         replans = 0
         use_indexes = True
         # Advisor plane (docs/advisor.md): the plan's structural
@@ -309,9 +335,9 @@ class HyperspaceSession:
                     if not use_indexes:
                         optimized = plan
                     elif plan_cache is not None and self._enabled:
-                        optimized = plan_cache.get_or_optimize(self, plan)
+                        optimized = plan_cache.get_or_optimize(self, plan, snapshot=snapshot)
                     else:
-                        optimized = self.optimized_plan(plan)
+                        optimized = self.optimized_plan(plan, snapshot=snapshot)
                     if use_indexes and self._enabled and self.conf.scan_prefetch_enabled:
                         # Query-tail prefetch: footers + first chunk of
                         # the index files the pruner keeps start loading
@@ -568,6 +594,18 @@ class Hyperspace:
         from hyperspace_tpu.serve.controller import OpsController
 
         return OpsController(self, server=server, **kwargs)
+
+    def ingest(self, **kwargs):
+        """The continuous-ingestion daemon over this API
+        (hyperspace_tpu/ingest/, docs/ingestion.md): CDC tailing,
+        micro-batch commits through the two-phase refresh action, and
+        advisor-gated compaction. Register indexes with `.watch(name,
+        changelog=...)`, then `.start()` / `.drain()` / `.stop()` — or
+        drive `.tick()` yourself. Gated by `hyperspace.ingest.enabled`
+        (default off): every tick is a no-op until you opt in."""
+        from hyperspace_tpu.ingest.daemon import IngestDaemon
+
+        return IngestDaemon(self, **kwargs)
 
     def explain(
         self,

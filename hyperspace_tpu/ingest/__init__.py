@@ -1,0 +1,25 @@
+"""Continuous ingestion: CDC tailing, streaming delta commits, MVCC
+snapshot-isolated reads (docs/ingestion.md).
+
+The package turns the batch-era refresh workflow into a service:
+
+- `tailer`    — poll-based source watchers: new-file arrival detection
+  plus a CDC changelog tailer that materializes appended rows into
+  deterministic batch files, with an atomically-persisted cursor.
+- `writer`    — micro-batch commits through the UNCHANGED two-phase
+  Action protocol (one incremental refresh per batch = one crash-safe
+  delta bucket), and advisor-gated background compaction.
+- `snapshot`  — `PinnedSnapshot`: a query pins the per-index version
+  stamp it was admitted under and re-reads repeatably against it while
+  micro-batches keep committing underneath.
+- `daemon`    — the `IngestDaemon` service loop tying them together:
+  thread-hosted by default, optionally a spawned worker process
+  (`hyperspace.ingest.processWorker`), controller-pausable through an
+  atomically-written control file, registered on `/healthz`.
+"""
+
+from hyperspace_tpu.ingest.daemon import IngestDaemon
+from hyperspace_tpu.ingest.snapshot import PinnedSnapshot
+from hyperspace_tpu.ingest.tailer import CdcTailer, FileArrivalWatcher
+
+__all__ = ["IngestDaemon", "PinnedSnapshot", "CdcTailer", "FileArrivalWatcher"]

@@ -1,0 +1,154 @@
+"""Poll-based source watchers with atomically-persisted cursors.
+
+Two change-data-capture shapes, both cheap enough to run every poll
+tick (docs/ingestion.md "tailing"):
+
+- :class:`FileArrivalWatcher` — new-file arrival: list the source root
+  (the same ``dataset.list_data_files`` walk the signature provider
+  uses) and diff against the cursor's known set. Arrived bytes are
+  metered into ``ingest.bytes`` — the ingest-throughput ledger.
+- :class:`CdcTailer` — appended-row CDC: tail a JSONL changelog from a
+  persisted byte offset and materialize complete new lines into
+  ``cdc-<seq>.parquet`` batch files inside the indexed source root,
+  where the next incremental refresh picks them up as appended data.
+
+Crash discipline mirrors the advisor ledger (advisor/routing.py): the
+cursor is one JSON document written via ``file_utils.write_json``
+(mkstemp + fsync + rename), loaded leniently (unreadable -> start
+empty). The ``ingest.tail`` fault point fires after a batch file lands
+but BEFORE the cursor persists, so a crash there leaves an orphan
+batch; batch names derive deterministically from the cursor sequence
+and the retry re-materializes the SAME bytes to the SAME name from the
+SAME offset — idempotent, and safe because a batch is only ever
+rewritten before the commit that would freeze its mtime into an index
+signature. Batch files are published atomically (temp + ``os.replace``)
+so a concurrent query never lists a torn parquet file.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from pathlib import Path
+
+from hyperspace_tpu import faults, stats
+from hyperspace_tpu.utils import file_utils
+
+
+class Cursor:
+    """One index's poll position, persisted atomically as a single JSON
+    document (``<system_path>/_ingest/cursors/<name>.json``)."""
+
+    def __init__(self, path: str | Path):
+        self.path = Path(path)
+        self._doc: dict | None = None
+
+    def load(self) -> dict:
+        if self._doc is None:
+            try:
+                self._doc = file_utils.read_json(self.path)
+            except (OSError, ValueError):
+                self._doc = {}
+            if not isinstance(self._doc, dict):
+                self._doc = {}
+        return self._doc
+
+    def save(self) -> None:
+        file_utils.write_json(self.path, self.load())
+
+
+class FileArrivalWatcher:
+    """Detect files arriving (or growing) under one source root."""
+
+    def __init__(self, root: str | Path, fmt: str, cursor: Cursor):
+        self.root = str(root)
+        self.format = fmt
+        self.cursor = cursor
+
+    def poll(self) -> int:
+        """Number of new-or-grown files observed this tick; arrived
+        bytes are metered into ``ingest.bytes``."""
+        from hyperspace_tpu.dataset import format_suffix, list_data_files
+
+        files = list_data_files(self.root, suffix=format_suffix(self.format))
+        doc = self.cursor.load()
+        known = doc.setdefault("known", {})
+        fresh = 0
+        new_bytes = 0
+        for fi in files:
+            seen = known.get(fi.path)
+            if seen == fi.size:
+                continue
+            fresh += 1
+            new_bytes += int(fi.size) - int(seen or 0)
+            known[fi.path] = fi.size
+        if fresh:
+            stats.increment("ingest.bytes", max(new_bytes, 0))
+            self.cursor.save()
+        return fresh
+
+
+class CdcTailer:
+    """Tail a JSONL changelog into deterministic parquet batch files."""
+
+    def __init__(self, changelog: str | Path, dest_root: str | Path, cursor: Cursor):
+        self.changelog = str(changelog)
+        self.dest_root = Path(dest_root)
+        self.cursor = cursor
+
+    def poll(self, batch_rows: int) -> int:
+        """Materialize complete appended changelog lines into at most
+        ``batch_rows``-row parquet batches; returns rows materialized
+        (also metered into ``ingest.rows``)."""
+        doc = self.cursor.load()
+        st = doc.setdefault("cdc", {"offset": 0, "seq": 0})
+        offset = int(st.get("offset", 0))
+        try:
+            size = os.path.getsize(self.changelog)
+        except OSError:
+            return 0  # changelog not created yet
+        if size <= offset:
+            return 0
+        with open(self.changelog, "rb") as f:
+            f.seek(offset)
+            data = f.read()
+        end = data.rfind(b"\n")
+        if end < 0:
+            return 0  # a partial trailing line: wait for the writer
+        chunk = data[: end + 1]
+        rows = [json.loads(line) for line in chunk.splitlines() if line.strip()]
+        seq = int(st.get("seq", 0))
+        total = 0
+        for i in range(0, len(rows), max(int(batch_rows), 1)):
+            batch = rows[i : i + max(int(batch_rows), 1)]
+            path = self.dest_root / f"cdc-{seq:06d}.parquet"
+            self._write_batch(path, batch)
+            # Crash here -> cursor below never advances; the retry
+            # rewrites the SAME file from the SAME offset (idempotent).
+            faults.fault_point("ingest.tail", path)
+            seq += 1
+            total += len(batch)
+        if total:
+            stats.increment("ingest.rows", total)
+        st["offset"] = offset + len(chunk)
+        st["seq"] = seq
+        self.cursor.save()
+        return total
+
+    @staticmethod
+    def _write_batch(path: Path, rows: list[dict]) -> None:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        cols = sorted({k for r in rows for k in r})
+        table = pa.table({c: [r.get(c) for r in rows] for c in cols})
+        path.parent.mkdir(parents=True, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=str(path.parent), prefix=".cdc-", suffix=".tmp")
+        os.close(fd)
+        try:
+            pq.write_table(table, tmp)
+            os.replace(tmp, path)  # atomic publish: no torn file is ever listed
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)

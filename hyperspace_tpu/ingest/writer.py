@@ -1,0 +1,86 @@
+"""Streaming delta writer: micro-batch commits and gated compaction.
+
+Each micro-batch commits through the UNCHANGED two-phase Action
+protocol — one ``refresh(name, "incremental")`` per poll tick that saw
+appended data, which appends exactly one delta bucket and swaps
+``latestStable`` atomically (actions/refresh.py). The daemon adds no
+new commit machinery: a SIGKILL mid-commit leaves at most the
+protocol's transient log entry, and ``recover()`` converges it exactly
+as it would an operator-run refresh. An empty poll is a no-op, not an
+error (the refresh action's "no appended source data files" abort is
+absorbed here).
+
+Compaction is advisor-gated (docs/ingestion.md "compaction"): it fires
+only when BOTH ``hyperspace.ingest.autoCompact`` and the advisor's
+lifecycle gate ``hyperspace.advisor.lifecycle.autoOptimize`` are on,
+only past ``hyperspace.advisor.lifecycle.maxDeltas`` delta buckets, and
+is deferred (``ingest.deferred``) while serve SLOs burn — rebuild-class
+background IO must not compound a latency incident.
+"""
+
+from __future__ import annotations
+
+from hyperspace_tpu import faults, stats
+from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu.obs import events as obs_events
+from hyperspace_tpu.obs import trace as obs_trace
+
+_EVT_COMMITTED = obs_events.declare("ingest.committed")
+_EVT_COMPACTED = obs_events.declare("ingest.compacted")
+
+# The refresh action's typed empty-poll abort (actions/refresh.py
+# validate()); matching it by message keeps the action's contract
+# unchanged while the daemon treats it as "nothing to do".
+_EMPTY_POLL = "no appended source data files found"
+
+
+def _latest_id(session, name: str):
+    mgr = session.manager
+    return mgr.log_manager_factory(mgr.path_resolver.get_index_path(name)).get_latest_id()
+
+
+def delta_count(session, name: str) -> int:
+    """Delta buckets in the latest stable entry (compaction pressure)."""
+    mgr = session.manager
+    entry = mgr.log_manager_factory(mgr.path_resolver.get_index_path(name)).get_latest_stable_log()
+    if entry is None or entry.content is None:
+        return 0
+    return len(entry.content.directories)
+
+
+def commit_micro_batch(hyperspace, name: str) -> int | None:
+    """Commit appended source data as one delta bucket; returns the new
+    latest log id, or None when the poll saw nothing new."""
+    faults.fault_point("ingest.commit")
+    try:
+        with obs_trace.span("ingest.commit", index=name):
+            hyperspace.refresh_index(name, "incremental")
+    except HyperspaceError as e:
+        if _EMPTY_POLL in str(e):
+            return None
+        raise
+    stats.increment("ingest.commits")
+    new_id = _latest_id(hyperspace.session, name)
+    _EVT_COMMITTED.emit(index=name, log_id=new_id)
+    return new_id
+
+
+def maybe_compact(hyperspace, name: str, burning: bool = False) -> bool:
+    """Compact delta buckets through the gated optimize action; returns
+    True only when a compaction actually ran."""
+    conf = hyperspace.session.conf
+    if not (conf.ingest_auto_compact and conf.advisor_auto_optimize):
+        return False
+    if delta_count(hyperspace.session, name) <= int(conf.advisor_lifecycle_max_deltas):
+        return False
+    if burning:
+        # Same discipline as the controller's _defer_background: hold
+        # rebuild-class IO while serve SLOs burn.
+        stats.increment("ingest.deferred")
+        return False
+    faults.fault_point("ingest.compact")
+    with obs_trace.span("ingest.compact", index=name):
+        hyperspace.optimize_index(name)
+    stats.increment("ingest.compactions")
+    _EVT_COMPACTED.emit(index=name, log_id=_latest_id(hyperspace.session, name))
+    return True

@@ -101,6 +101,22 @@ KNOWN_EVENTS: dict[str, str] = {
     # SLO plane (obs/slo.py): an objective's multi-window burn rate
     # crossed its page threshold.
     "slo.burn": "error",
+    # Continuous-ingestion daemon (hyperspace_tpu/ingest/,
+    # docs/ingestion.md): lifecycle transitions (started/stopped),
+    # every micro-batch landed (`ingest.committed` carries index/rows/
+    # bytes/log id), commits that raised (`ingest.commit_failed` — the
+    # Action already rolled back), compactions triggered through the
+    # gated optimize action, controller-driven pause/resume of the
+    # daemon, and the advisory freshness objective being missed
+    # (`ingest.lagging`, hyperspace.ingest.maxLagSeconds).
+    "ingest.started": "info",
+    "ingest.stopped": "info",
+    "ingest.committed": "info",
+    "ingest.commit_failed": "error",
+    "ingest.compacted": "info",
+    "ingest.paused": "warn",
+    "ingest.resumed": "info",
+    "ingest.lagging": "warn",
 }
 
 DEFAULT_MAX_EVENTS = 256

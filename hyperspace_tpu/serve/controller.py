@@ -172,7 +172,7 @@ class OpsController:
     """
 
     def __init__(self, hyperspace, server=None, clock=time.monotonic,
-                 member_id: str | None = None, supervisor=None):
+                 member_id: str | None = None, supervisor=None, ingest=None):
         # `hyperspace` is the user-facing API facade: like the advisor's
         # LifecyclePolicy, the controller has exactly the powers an
         # operator has — recover/refresh/lifecycle — no private side
@@ -185,6 +185,12 @@ class OpsController:
         # optional supervisor handle the scale actuator drives.
         self.member_id = str(member_id) if member_id else f"pid-{os.getpid()}"
         self.supervisor = supervisor
+        # Continuous-ingestion daemon handle (ingest/daemon.py): the
+        # controller throttles it while serve SLOs burn and resumes it
+        # on recovery — background commit/compact IO is exactly the
+        # load class the backoff discipline exists for.
+        self.ingest = ingest
+        self._ingest_paused = False
         self._clock = clock
         self._lock = threading.RLock()
         self._budget = int(self.session.conf.controller_actuation_budget)
@@ -306,6 +312,8 @@ class OpsController:
                 # stand down without observing or deciding anything.
                 if self._engaged:
                     self._release_overload(now, trigger="kill_switch")
+                if self._ingest_paused:
+                    self._resume_ingest(now, trigger="kill_switch")
                 self._close_incident(now, resolution="kill_switch")
                 return self.snapshot()
             stats.increment("controller.ticks")
@@ -354,6 +362,32 @@ class OpsController:
             # (budget-free, like every release).
             if self.supervisor is not None:
                 self._reconcile_scale(conf, now)
+
+            # 1c. Ingest backoff: the continuous-ingestion daemon is
+            # rebuild-class background IO on the serve plane — pause it
+            # (durably: an atomically-written control file its every
+            # tick polls, so it works across process boundaries) while
+            # pages persist, resume once the burn clears. Pausing is a
+            # budgeted, cooldown-disciplined actuation; resuming is
+            # budget-free like every release.
+            if self.ingest is not None:
+                if (
+                    burning
+                    and not self._ingest_paused
+                    and self._page_ticks >= int(conf.controller_hysteresis_ticks)
+                ):
+                    if self._actuate(
+                        "ingest.pause", trigger="slo.page", now=now,
+                        fn=lambda: self.ingest.pause(reason="controller.slo_burn"),
+                        verdicts=dict(self._last_verdicts),
+                    ):
+                        self._ingest_paused = True
+                elif (
+                    not burning
+                    and self._ingest_paused
+                    and self._ok_ticks >= int(conf.controller_recovery_ticks)
+                ):
+                    self._resume_ingest(now, trigger="slo.recovered")
 
             # 2. Heal quarantined indexes — rebuild-class work, deferred
             # while serve SLOs burn (backing off background work is
@@ -555,6 +589,32 @@ class OpsController:
         )
         self._recent_actions.append(
             {"action": "shed.release", "trigger": trigger, "at": now,
+             "seq": record["seq"]}
+        )
+
+    def _resume_ingest(self, now: float, trigger: str) -> None:
+        """Un-pause the ingest daemon we paused. Budget-free by design,
+        exactly like `_release_overload`: the controller must always be
+        able to hand back what it took (kill switch, budget
+        exhaustion), and a resume that fails stays paused-by-us so the
+        next tick retries."""
+        faults.fault_point("controller.actuate")
+        try:
+            self.ingest.resume()
+        except Exception as e:
+            stats.increment("controller.actuation_failures")
+            _EVT_FAILED.emit(
+                action="ingest.resume", trigger=trigger,
+                error=f"{type(e).__name__}: {e}",
+            )
+            return
+        self._ingest_paused = False
+        record = _EVT_ACTUATION.emit(
+            action="ingest.resume", trigger=trigger, outcome="executed",
+            member=self.member_id, budget_remaining=self._budget,
+        )
+        self._recent_actions.append(
+            {"action": "ingest.resume", "trigger": trigger, "at": now,
              "seq": record["seq"]}
         )
 
@@ -1059,6 +1119,7 @@ class OpsController:
                 "mode": mode,
                 "member": self.member_id,
                 "engaged": self._engaged,
+                "ingest_paused": self._ingest_paused,
                 "budget_remaining": self._budget,
                 "verdicts": dict(self._last_verdicts),
                 "page_ticks": self._page_ticks,

@@ -43,19 +43,22 @@ def collection_log_versions(session) -> tuple:
     return tuple(out)
 
 
-def versioned_plan_key(session, plan) -> tuple:
+def versioned_plan_key(session, plan, snapshot=None) -> tuple:
     """The full serve-cache key for `plan` under `session`'s current
     world state (module docstring). Stat-ing the source files costs one
     os.stat per file — orders of magnitude cheaper than re-optimizing,
     and it is exactly what makes a post-append/post-refresh hit
-    impossible."""
+    impossible. A pinned `snapshot` (ingest/snapshot.py) substitutes its
+    admission-time stamp for the live version vector: the pinned world
+    never moves, so pinned reads keep hitting while micro-batches bump
+    the live ids underneath."""
     fp = FileBasedSignatureProvider().signature(plan)
     with session._state_lock:
         quarantined = tuple(sorted(session.index_health))
     return (
         plan_signature(plan),
         fp.value if fp is not None else None,
-        collection_log_versions(session),
+        snapshot.stamp if snapshot is not None else collection_log_versions(session),
         quarantined,
         session.is_hyperspace_enabled(),
     )
@@ -75,11 +78,11 @@ class PlanCache:
         self._misses = obs_metrics.counter("serve.plan_cache.misses", "optimized-plan cache misses")
         self._evictions = obs_metrics.counter("serve.plan_cache.evictions", "LRU evictions")
 
-    def get_or_optimize(self, session, plan):
+    def get_or_optimize(self, session, plan, snapshot=None):
         """The optimized plan for `plan`, from cache when the versioned
         key matches, else freshly via `session.optimized_plan` (outside
         the lock — optimization reads the index log and stats files)."""
-        key = versioned_plan_key(session, plan)
+        key = versioned_plan_key(session, plan, snapshot=snapshot)
         with self._lock:
             hit = self._entries.get(key)
             if hit is not None:
@@ -87,7 +90,7 @@ class PlanCache:
                 self._hits.inc()
                 return hit
         self._misses.inc()
-        optimized = session.optimized_plan(plan)
+        optimized = session.optimized_plan(plan, snapshot=snapshot)
         with self._lock:
             if key not in self._entries:
                 self._entries[key] = optimized

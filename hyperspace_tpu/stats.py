@@ -119,6 +119,25 @@ Counter names in use:
   (docs/fault_tolerance.md "incident bundles")
 - ``controller.incident_errors``  advisory incident-bundle capture
   failures (forensics must never compound the incident)
+- ``ingest.ticks``  poll passes the continuous-ingestion daemon ran
+  (hyperspace_tpu/ingest/, docs/ingestion.md)
+- ``ingest.commits``  micro-batches committed through the incremental
+  refresh action (each one is a new crash-safe index version)
+- ``ingest.commit_failures``  micro-batch commits that raised an ordinary
+  Exception — the Action's own rollback ran; the daemon keeps polling
+- ``ingest.rows``  source rows the tailer materialized from CDC
+  changelogs into batch files
+- ``ingest.bytes``  source bytes the daemon observed arriving (new files
+  + materialized CDC batches) — the ingest-throughput ledger
+- ``ingest.compactions``  delta-bucket compactions the daemon triggered
+  through the gated optimize action
+- ``ingest.compact_failures``  compactions that raised an ordinary
+  Exception (rolled back by the optimize action itself)
+- ``ingest.deferred``  daemon work held back — paused by the controller,
+  or compaction deferred behind its gates
+- ``ingest.snapshots``  MVCC pinned snapshots taken (ingest/snapshot.py)
+- ``ingest.pinned_reads``  queries executed against a pinned snapshot's
+  stamp instead of the live latest-stable versions
 """
 
 from __future__ import annotations
@@ -177,6 +196,16 @@ KNOWN_COUNTERS = (
     "obs.journal.evictions",
     "controller.incidents",
     "controller.incident_errors",
+    "ingest.ticks",
+    "ingest.commits",
+    "ingest.commit_failures",
+    "ingest.rows",
+    "ingest.bytes",
+    "ingest.compactions",
+    "ingest.compact_failures",
+    "ingest.deferred",
+    "ingest.snapshots",
+    "ingest.pinned_reads",
 )
 
 _counters = {name: _metrics.counter(name) for name in KNOWN_COUNTERS}

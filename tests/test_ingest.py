@@ -1,0 +1,649 @@
+"""Continuous-ingestion service (hyperspace_tpu/ingest/, docs/ingestion.md).
+
+The contract under test, end to end:
+
+- **Snapshot isolation**: a reader pinned BEFORE a micro-batch commit
+  repeatably sees the old stamp across the live commit; a new reader
+  sees the new rows immediately; releasing the stamp un-pins; a
+  released handle fails loudly instead of silently reading live.
+- **CDC tailing**: appended-row batches materialize idempotently (a
+  crash between batch publish and cursor save re-writes the SAME
+  file — no duplicate rows ever reach the index), and file arrivals
+  are observed exactly once.
+- **Crash sweeps**: a hard crash at EVERY fault point a daemon tick
+  passes through (kill-mid-append) and at the compaction points
+  (kill-mid-compact) leaves the index crash-consistent — recover()
+  converges, queries answer correctly, and a disarmed re-tick drains
+  to exactly-once delivery.
+- **SIGKILL**: a processWorker-mode daemon killed with a real SIGKILL
+  mid-stream leaves no torn snapshot; a fresh daemon drains the rest.
+- **Controller backoff**: OpsController pauses the daemon while serve
+  SLOs burn (audited, budgeted, hysteresis-gated) and resumes it on
+  recovery; the kill switch releases a held pause.
+"""
+
+import json
+import os
+import signal
+import time
+from pathlib import Path
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import (
+    Hyperspace,
+    HyperspaceSession,
+    IndexConfig,
+    col,
+    faults,
+    stats,
+)
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu.faults import CrashPoint
+from hyperspace_tpu.ingest import writer as ingest_writer
+from hyperspace_tpu.ingest.tailer import Cursor, FileArrivalWatcher
+from hyperspace_tpu.obs import events, metrics
+from hyperspace_tpu.utils import retry
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """Every test starts and ends disarmed, with a no-sleep retry
+    schedule (the test_fault_injection discipline)."""
+    faults.reset()
+    retry.configure(max_attempts=3, backoff_base=0.0, sleeper=lambda s: None)
+    yield
+    faults.reset()
+    retry.configure(max_attempts=3, backoff_base=0.005, sleeper=time.sleep)
+
+
+def _write_source(root: Path, n: int = 40) -> str:
+    rng = np.random.default_rng(11)
+    table = pa.table(
+        {
+            "id": pa.array(np.arange(n, dtype=np.int64)),
+            "key": pa.array(np.arange(n, dtype=np.int64) % 4),
+            "value": pa.array(rng.standard_normal(n)),
+        }
+    )
+    root.mkdir(parents=True, exist_ok=True)
+    pq.write_table(table, root / "part-0.parquet")
+    return str(root)
+
+
+def _append_changelog(path: Path, start: int, n: int) -> None:
+    with open(path, "a", encoding="utf-8") as f:
+        for i in range(start, start + n):
+            f.write(json.dumps({"id": i, "key": i % 4, "value": float(i)}) + "\n")
+
+
+def _setup(tmp_path, n: int = 40, cdc: int = 24, **conf):
+    """Source + ACTIVE index + changelog + watching daemon, harness
+    disarmed during the build."""
+    source = _write_source(tmp_path / "src", n=n)
+    session = HyperspaceSession(system_path=str(tmp_path / "sys"), num_buckets=2)
+    session.conf.set("hyperspace.ingest.enabled", "true")
+    for k, v in conf.items():
+        session.conf.set(k, v)
+    hs = Hyperspace(session)
+    hs.create_index(
+        session.parquet(source), IndexConfig("idx1", ["key"], ["id", "value"])
+    )
+    session.enable_hyperspace()
+    changelog = tmp_path / "changes.jsonl"
+    _append_changelog(changelog, n, cdc)
+    daemon = hs.ingest().watch("idx1", changelog=changelog)
+    return source, session, hs, daemon, changelog
+
+
+def _plan(session, source):
+    return session.parquet(source).filter(col("key") == 1).select("id", "value")
+
+
+def _ids(session, source, snapshot=None):
+    out = session.run(_plan(session, source), snapshot=snapshot).decode()
+    return sorted(int(i) for i in out["id"])
+
+
+def _query_matches(session, source: str) -> None:
+    """Canonical probe: the indexed filter answers row-identically to
+    pandas over the raw source (whatever files exist right now)."""
+    import pyarrow.dataset as pads
+
+    got = session.to_pandas(_plan(session, source))
+    raw = pads.dataset(source, format="parquet").to_table().to_pandas()
+    exp = raw[raw["key"] == 1][["id", "value"]]
+    cols = ["id", "value"]
+    pd.testing.assert_frame_equal(
+        got[cols].sort_values(cols).reset_index(drop=True),
+        exp[cols].sort_values(cols).reset_index(drop=True),
+        check_dtype=False,
+    )
+
+
+# ---------------------------------------------------------------------------
+# MVCC snapshot isolation
+# ---------------------------------------------------------------------------
+
+
+class TestSnapshotIsolation:
+    def test_pinned_reader_repeatable_across_live_commit(self, tmp_path):
+        """THE tentpole property: pin before the commit, commit a live
+        micro-batch, and the pinned reader repeatably sees the old
+        world while a fresh reader sees the new rows."""
+        source, session, hs, daemon, _ = _setup(tmp_path)
+        snap = session.pin_snapshot()
+        before = _ids(session, source, snapshot=snap)
+        assert before  # key==1 exists in the seed data
+
+        out = daemon.tick()
+        assert out["commits"] == 1  # the CDC batch committed underneath us
+
+        live = _ids(session, source)
+        assert set(live) > set(before)  # new reader sees the new rows
+        # Repeatable: the pinned view is byte-stable across the commit,
+        # read after read.
+        assert _ids(session, source, snapshot=snap) == before
+        assert _ids(session, source, snapshot=snap) == before
+        assert stats.get("ingest.pinned_reads") >= 3
+
+        snap.release()
+        # Release un-pins: the same session reads the live world again.
+        assert _ids(session, source) == live
+
+    def test_released_snapshot_fails_loudly(self, tmp_path):
+        source, session, hs, daemon, _ = _setup(tmp_path)
+        with session.pin_snapshot() as snap:
+            _ids(session, source, snapshot=snap)
+        with pytest.raises(HyperspaceError, match="snapshot released"):
+            session.run(_plan(session, source), snapshot=snap)
+
+    def test_stamp_versions_the_plan_cache_key(self, tmp_path):
+        """A pinned query and a live query after a commit must never
+        share a cache entry: the snapshot stamp replaces the live
+        version vector in the plan-cache key."""
+        from hyperspace_tpu.serve.plan_cache import versioned_plan_key
+
+        source, session, hs, daemon, _ = _setup(tmp_path)
+        snap = session.pin_snapshot()
+        plan = _plan(session, source)
+        # run_query pins the plan before keying — mirror that order.
+        pinned = snap.pin_plan(plan)
+        k_pinned = versioned_plan_key(session, pinned, snapshot=snap)
+        assert k_pinned == versioned_plan_key(session, snap.pin_plan(plan), snapshot=snap)
+        daemon.tick()
+        # Live key moved with the commit; pinned key did not.
+        assert versioned_plan_key(session, plan) != k_pinned
+        assert versioned_plan_key(session, snap.pin_plan(plan), snapshot=snap) == k_pinned
+        snap.release()
+
+    def test_snapshot_pins_unindexed_sources_on_first_touch(self, tmp_path):
+        """A source no index covers is pinned at first read: files that
+        arrive later are invisible to the snapshot."""
+        extra = tmp_path / "plain"
+        _write_source(extra, n=20)
+        source, session, hs, daemon, _ = _setup(tmp_path)
+        snap = session.pin_snapshot()
+        q = session.parquet(str(extra)).select("id")
+        n0 = len(session.run(q, snapshot=snap).decode()["id"])
+        pq.write_table(
+            pa.table({"id": [900], "key": [0], "value": [0.0]}),
+            extra / "late.parquet",
+        )
+        assert len(session.run(q, snapshot=snap).decode()["id"]) == n0
+        assert len(session.run(q).decode()["id"]) == n0 + 1
+        snap.release()
+
+
+# ---------------------------------------------------------------------------
+# CDC tailer + arrival watcher
+# ---------------------------------------------------------------------------
+
+
+class TestTailer:
+    def test_arrival_watcher_sees_each_file_once(self, tmp_path):
+        root = _write_source(tmp_path / "src", n=10)
+        w = FileArrivalWatcher(root, "parquet", Cursor(tmp_path / "cur.json"))
+        assert w.poll() == 1  # the seed file, observed once
+        assert w.poll() == 0
+        pq.write_table(
+            pa.table({"id": [99], "key": [0], "value": [0.0]}),
+            Path(root) / "part-9.parquet",
+        )
+        assert w.poll() == 1
+        assert w.poll() == 0
+
+    def test_tailer_waits_for_complete_lines(self, tmp_path):
+        dest = tmp_path / "dest"
+        dest.mkdir()
+        log = tmp_path / "c.jsonl"
+        log.write_text(json.dumps({"id": 1, "v": 1}) + "\n" + '{"id": 2, "v"')
+        t = __import__(
+            "hyperspace_tpu.ingest.tailer", fromlist=["CdcTailer"]
+        ).CdcTailer(log, dest, Cursor(tmp_path / "cur.json"))
+        assert t.poll(100) == 1  # only the complete line
+        with open(log, "a", encoding="utf-8") as f:
+            f.write(': 2}\n')
+        assert t.poll(100) == 1  # the completed tail line, exactly once
+        assert t.poll(100) == 0
+
+    def test_crash_between_batch_and_cursor_is_idempotent(self, tmp_path):
+        """ingest.tail fires after the batch file publishes, before the
+        cursor saves — the canonical torn window. The re-poll must
+        rewrite the SAME batch (same offset, same seq), not append a
+        duplicate."""
+        from hyperspace_tpu.ingest.tailer import CdcTailer
+
+        dest = tmp_path / "dest"
+        dest.mkdir()
+        log = tmp_path / "c.jsonl"
+        _append_changelog(log, 0, 6)
+        t = CdcTailer(log, dest, Cursor(tmp_path / "cur.json"))
+        faults.inject("ingest.tail", crash=True, at_call=1)
+        with pytest.raises(CrashPoint):
+            t.poll(100)
+        faults.reset()
+        batches = sorted(dest.glob("cdc-*.parquet"))
+        assert len(batches) == 1  # published before the crash
+        assert t.poll(100) == 6  # replay from the unadvanced cursor
+        batches = sorted(dest.glob("cdc-*.parquet"))
+        assert len(batches) == 1  # rewritten, not duplicated
+        table = pq.read_table(batches[0])
+        assert sorted(table.column("id").to_pylist()) == list(range(6))
+        assert t.poll(100) == 0
+
+
+# ---------------------------------------------------------------------------
+# Crash sweeps: kill-mid-append, kill-mid-compact
+# ---------------------------------------------------------------------------
+
+
+def _assert_converges(tmp_path, source, session, hs, daemon, point, total_ids):
+    """Post-crash invariants: stable log resolves, recover() converges,
+    queries answer correctly, and a disarmed drain reaches exactly-once
+    delivery of every CDC row."""
+    ctx = f"point={point}"
+    mgr = session.manager
+    lm = mgr.log_manager_factory(mgr.path_resolver.get_index_path("idx1"))
+    lm.get_latest_stable_log()  # 1. still resolves, crash or not
+    hs.recover("idx1")  # 2. converges
+    again = hs.recover("idx1")  # 3. idempotent
+    assert not again["rolled"] and again["orphans_removed"] == 0, ctx
+    _query_matches(session, source)  # 4. correct on whatever landed
+    # 5. disarmed re-ticks drain to exactly-once delivery.
+    assert daemon.drain(timeout=60), ctx
+    got = _ids(session, source)
+    assert got == sorted(i for i in total_ids if i % 4 == 1), ctx
+
+
+class TestCrashSweep:
+    def test_kill_mid_append_every_tick_fault_point(self, tmp_path_factory):
+        """Discover every fault point a committing tick passes through
+        (ingest.tail, ingest.commit, then the refresh action's own
+        log/bucket points), then replay with a hard crash at each."""
+        base = tmp_path_factory.mktemp("disc")
+        source, session, hs, daemon, _ = _setup(base)
+        with faults.recording() as seen:
+            daemon.tick()
+        points = sorted(seen)
+        assert "ingest.tail" in points and "ingest.commit" in points
+
+        crashed_at = []
+        for point in points:
+            tmp = tmp_path_factory.mktemp("sweep")
+            source, session, hs, daemon, _ = _setup(tmp)
+            faults.inject(point, crash=True, at_call=1)
+            try:
+                daemon.tick()
+            except CrashPoint:
+                crashed_at.append(point)
+            finally:
+                faults.reset()
+            _assert_converges(
+                tmp, source, session, hs, daemon, point, range(40 + 24)
+            )
+        assert crashed_at, f"no crash fired across {points}"
+
+    def test_kill_mid_compact(self, tmp_path_factory):
+        """Deltas past lifecycle.maxDeltas trigger advisor-gated
+        compaction; a hard crash inside it (at ingest.compact and at
+        the optimize action's stable-log swap) must leave the merged
+        state recoverable and the data exactly-once."""
+        for point in ("ingest.compact", "log.stable.write"):
+            tmp = tmp_path_factory.mktemp("compact")
+            source, session, hs, daemon, changelog = _setup(
+                tmp,
+                **{
+                    "hyperspace.advisor.lifecycle.autoOptimize": "true",
+                    "hyperspace.advisor.lifecycle.maxDeltas": "1",
+                },
+            )
+            daemon.tick()  # delta 1 (the seeded CDC batch)
+            _append_changelog(changelog, 64, 8)
+            # This tick appends delta 2 then crosses maxDeltas=1 and
+            # compacts — crash inside the compaction.
+            faults.inject(point, crash=True, at_call=2 if point == "log.stable.write" else 1)
+            with pytest.raises(CrashPoint):
+                daemon.tick()
+            faults.reset()
+            _assert_converges(
+                tmp, source, session, hs, daemon, point, range(40 + 24 + 8)
+            )
+
+    def test_compaction_runs_and_is_deferred_while_burning(self, tmp_path):
+        # Advisor gate OFF during setup so the ticks below only commit.
+        source, session, hs, daemon, changelog = _setup(
+            tmp_path, **{"hyperspace.advisor.lifecycle.maxDeltas": "1"}
+        )
+        daemon.tick()  # delta 1 (the seeded CDC batch)
+        _append_changelog(changelog, 64, 8)
+        daemon.tick()  # delta 2: past maxDeltas, but the gate is off
+        assert ingest_writer.delta_count(session, "idx1") > 1
+        session.conf.set("hyperspace.advisor.lifecycle.autoOptimize", "true")
+        # While SLOs burn, the compaction is deferred — not skipped
+        # silently: the deferral is counted.
+        base = stats.get("ingest.deferred")
+        assert ingest_writer.maybe_compact(hs, "idx1", burning=True) is False
+        assert stats.get("ingest.deferred") == base + 1
+        assert ingest_writer.delta_count(session, "idx1") > 1
+        # Calm again: the compaction fires and collapses the deltas.
+        assert ingest_writer.maybe_compact(hs, "idx1", burning=False) is True
+        assert stats.get("ingest.compactions") >= 1
+        assert ingest_writer.delta_count(session, "idx1") <= 1
+        _query_matches(session, source)
+
+
+# ---------------------------------------------------------------------------
+# Daemon lifecycle, drain, healthz
+# ---------------------------------------------------------------------------
+
+
+class TestDaemonLifecycle:
+    def test_disabled_kill_switch_makes_ticks_noops(self, tmp_path):
+        source, session, hs, daemon, _ = _setup(tmp_path)
+        session.conf.set("hyperspace.ingest.enabled", "false")
+        base = stats.get("ingest.ticks")
+        out = daemon.tick()
+        assert stats.get("ingest.ticks") == base and out["commits"] == 0
+
+    def test_pause_defers_resume_commits(self, tmp_path):
+        source, session, hs, daemon, _ = _setup(tmp_path)
+        daemon.pause(reason="test")
+        assert daemon.paused()
+        out = daemon.tick()
+        assert out["commits"] == 0 and stats.get("ingest.deferred") >= 1
+        daemon.resume()
+        out = daemon.tick()
+        assert out["commits"] == 1
+        names = [e["name"] for e in events.recent()]
+        assert "ingest.paused" in names and "ingest.resumed" in names
+
+    def test_watch_requires_existing_index(self, tmp_path):
+        session = HyperspaceSession(system_path=str(tmp_path / "sys"))
+        daemon = Hyperspace(session).ingest()
+        with pytest.raises(HyperspaceError, match="create the index first"):
+            daemon.watch("nope")
+
+    def test_thread_mode_start_commits_then_drains(self, tmp_path):
+        source, session, hs, daemon, changelog = _setup(
+            tmp_path, **{"hyperspace.ingest.pollSeconds": "0.05"}
+        )
+        daemon.start()
+        try:
+            assert daemon.drain(timeout=60)
+            assert set(_ids(session, source)) >= {i for i in range(64) if i % 4 == 1}
+            _append_changelog(changelog, 64, 8)
+            assert daemon.drain(timeout=60)
+            got = _ids(session, source)
+            assert got == [i for i in range(72) if i % 4 == 1]
+        finally:
+            daemon.stop()
+        snap = daemon.snapshot()
+        assert not snap["running"] and snap["commits"] >= 2
+
+    def test_snapshot_shape_for_healthz(self, tmp_path):
+        source, session, hs, daemon, _ = _setup(tmp_path)
+        daemon.tick()
+        snap = daemon.snapshot()
+        assert snap["watched"] == ["idx1"]
+        assert snap["enabled"] and not snap["running"]
+        assert snap["last_commit_ids"]["idx1"] >= 1
+        assert snap["last_commit_lag_seconds"] is not None
+
+    def test_lagging_event_when_commit_cannot_keep_up(self, tmp_path):
+        source, session, hs, daemon, _ = _setup(
+            tmp_path, **{"hyperspace.ingest.maxLagSeconds": "0.5"}
+        )
+        # Observe the pending data but fail the commit (transient faults
+        # exhaust the retry budget), then tick past the lag bound.
+        t = [0.0]
+        daemon._clock = lambda: t[0]
+        with faults.injected("log.write", times=100):
+            daemon.tick(now=0.0)
+            t[0] = 10.0
+            daemon.tick(now=10.0)
+        assert any(e["name"] == "ingest.lagging" for e in events.recent())
+        assert stats.get("ingest.commit_failures") >= 1
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL: processWorker mode leaves no torn snapshot
+# ---------------------------------------------------------------------------
+
+
+class TestSigkill:
+    def test_sigkilled_daemon_leaves_no_torn_snapshot(self, tmp_path):
+        """A REAL SIGKILL (no cleanup handlers) against the worker
+        process mid-stream: the last stable log still resolves,
+        recover() converges, queries answer correctly, and a fresh
+        daemon drains the remaining CDC rows exactly once."""
+        source, session, hs, daemon, changelog = _setup(
+            tmp_path,
+            cdc=48,
+            **{
+                "hyperspace.ingest.processWorker": "true",
+                "hyperspace.ingest.pollSeconds": "0.05",
+                "hyperspace.ingest.cdcBatchRows": "8",
+            },
+        )
+        mgr = session.manager
+        lm = mgr.log_manager_factory(mgr.path_resolver.get_index_path("idx1"))
+        base_id = lm.get_latest_id()
+        daemon.start()
+        try:
+            pid = daemon.worker_pid()
+            assert pid is not None
+            # Wait until the worker has committed at least once, so the
+            # kill lands mid-stream rather than pre-flight.
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                if (lm.get_latest_id() or 0) > (base_id or 0):
+                    break
+                time.sleep(0.05)
+            assert (lm.get_latest_id() or 0) > (base_id or 0), "worker never committed"
+            os.kill(pid, signal.SIGKILL)  # no cleanup handlers run
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline and daemon._host.alive_count() > 0:
+                time.sleep(0.05)
+            assert daemon._host.alive_count() == 0
+        finally:
+            daemon.stop()
+        # No torn snapshot: stable state resolves and recovery converges.
+        assert lm.get_latest_stable_log() is not None
+        hs.recover("idx1")
+        _query_matches(session, source)
+        # A fresh (thread-mode) daemon finishes the job exactly-once.
+        session.conf.set("hyperspace.ingest.processWorker", "false")
+        d2 = hs.ingest().watch("idx1", changelog=changelog)
+        assert d2.drain(timeout=120)
+        got = _ids(session, source)
+        assert got == [i for i in range(40 + 48) if i % 4 == 1]
+
+
+# ---------------------------------------------------------------------------
+# Controller backoff: pause while burning, resume on recovery
+# ---------------------------------------------------------------------------
+
+
+class _CtrlSession:
+    """The session surface OpsController + IngestDaemon read: conf and
+    the lock-guarded index_health map (test_controller.FakeSession)."""
+
+    def __init__(self, tmp_path, **conf_overrides):
+        import threading
+
+        self.conf = HyperspaceConf()
+        self.conf.set("hyperspace.system.path", str(tmp_path / "sys"))
+        self.conf.set("hyperspace.controller.enabled", "true")
+        self.conf.set("hyperspace.ingest.enabled", "true")
+        for k, v in conf_overrides.items():
+            self.conf.set(k, v)
+        self._state_lock = threading.RLock()
+        self.index_health = {}
+
+
+class _CtrlHyperspace:
+    def __init__(self, session):
+        self.session = session
+
+    def recover(self, name=None):
+        return {}
+
+    def lifecycle(self):
+        class _L:
+            def sweep(self):
+                return {"applied": [], "skipped": [], "failed": []}
+
+        return _L()
+
+
+def _drive_page(completed, failed, ctrl, t0=0.0):
+    """Baseline traffic then a failure burst — two consecutive page
+    ticks (hysteresis 2) so the controller actuates."""
+    completed.inc(10_000)
+    ctrl.step(now=t0)
+    ctrl.step(now=t0 + 4000.0)
+    failed.inc(3_000)
+    ctrl.step(now=t0 + 4030.0)  # page tick 1: hysteresis holds
+    ctrl.step(now=t0 + 4031.0)  # page tick 2: actuate
+    return t0 + 4031.0
+
+
+def _actuations(action):
+    return [
+        e
+        for e in events.recent()
+        if e["name"] == "controller.actuation"
+        and e["fields"]["action"] == action
+    ]
+
+
+class TestControllerBackoff:
+    def _wire(self, tmp_path, **conf):
+        from hyperspace_tpu.ingest.daemon import IngestDaemon
+        from hyperspace_tpu.serve.controller import OpsController
+
+        session = _CtrlSession(tmp_path, **conf)
+        hs = _CtrlHyperspace(session)
+        daemon = IngestDaemon(hs)
+        ctrl = OpsController(hs, clock=lambda: 0.0, ingest=daemon)
+        completed = metrics.counter("serve.completed")
+        failed = metrics.counter("serve.failed")
+        return session, daemon, ctrl, completed, failed
+
+    def test_burn_pauses_ingest_recovery_resumes(self, tmp_path):
+        session, daemon, ctrl, completed, failed = self._wire(tmp_path)
+        t = _drive_page(completed, failed, ctrl)
+        assert daemon.paused()
+        assert ctrl.snapshot()["ingest_paused"]
+        evts = _actuations("ingest.pause")
+        assert evts and evts[-1]["fields"]["trigger"] == "slo.page"
+        assert evts[-1]["fields"]["outcome"] == "executed"
+        # Daemon honors it: ticks defer instead of committing.
+        base = stats.get("ingest.deferred")
+        daemon.tick()
+        assert stats.get("ingest.deferred") == base + 1
+        # Clean traffic pushes the burst out of the page windows; two
+        # consecutive ok ticks (recovery hysteresis) release the pause.
+        completed.inc(1_000_000)
+        ctrl.step(now=t + 70.0)  # ok tick 1: still paused
+        assert daemon.paused()
+        ctrl.step(now=t + 71.0)  # ok tick 2: resume
+        assert not daemon.paused()
+        assert not ctrl.snapshot()["ingest_paused"]
+        resumes = _actuations("ingest.resume")
+        assert resumes and resumes[-1]["fields"]["trigger"] == "slo.recovered"
+
+    def test_pause_respects_hysteresis(self, tmp_path):
+        session, daemon, ctrl, completed, failed = self._wire(tmp_path)
+        completed.inc(10_000)
+        ctrl.step(now=0.0)
+        ctrl.step(now=4000.0)
+        failed.inc(3_000)
+        ctrl.step(now=4030.0)  # page tick 1 of hysteresis 2
+        assert not daemon.paused()  # no actuation on a single page tick
+
+    def test_kill_switch_releases_held_pause(self, tmp_path):
+        session, daemon, ctrl, completed, failed = self._wire(tmp_path)
+        _drive_page(completed, failed, ctrl)
+        assert daemon.paused()
+        session.conf.set("hyperspace.controller.enabled", "false")
+        ctrl.step(now=9000.0)
+        assert not daemon.paused()
+        assert not ctrl.snapshot()["ingest_paused"]
+
+    def test_pause_spends_actuation_budget(self, tmp_path):
+        """The pause goes through the budgeted _actuate path — with the
+        hourly budget already spent, the controller degrades to
+        observe-only and the daemon keeps committing."""
+        session, daemon, ctrl, completed, failed = self._wire(
+            tmp_path, **{"hyperspace.controller.actuationBudget": "0"}
+        )
+        _drive_page(completed, failed, ctrl)
+        assert not daemon.paused()
+        # Audited as observe-only, never executed: budget discipline.
+        evts = _actuations("ingest.pause")
+        assert evts and all(e["fields"]["outcome"] == "observe_only" for e in evts)
+
+
+# ---------------------------------------------------------------------------
+# Registry honesty (the ingest.* names this subsystem declares)
+# ---------------------------------------------------------------------------
+
+
+class TestRegistries:
+    def test_fault_points_known(self):
+        for point in ("ingest.tail", "ingest.commit", "ingest.compact"):
+            assert point in faults.KNOWN_POINTS
+
+    def test_counters_declared(self):
+        for c in (
+            "ingest.ticks",
+            "ingest.commits",
+            "ingest.commit_failures",
+            "ingest.rows",
+            "ingest.bytes",
+            "ingest.compactions",
+            "ingest.compact_failures",
+            "ingest.deferred",
+            "ingest.snapshots",
+            "ingest.pinned_reads",
+        ):
+            assert c in stats.KNOWN_COUNTERS, c
+
+    def test_error_contracts_cover_daemon_entry_points(self):
+        from hyperspace_tpu.exceptions import ERROR_CONTRACTS
+
+        for qname in (
+            "hyperspace_tpu.ingest.daemon.IngestDaemon.tick",
+            "hyperspace_tpu.ingest.daemon._service_entry",
+            "hyperspace_tpu.ingest.tailer.CdcTailer.poll",
+            "hyperspace_tpu.ingest.writer.commit_micro_batch",
+            "hyperspace_tpu.ingest.writer.maybe_compact",
+        ):
+            assert qname in ERROR_CONTRACTS, qname
