@@ -63,6 +63,17 @@ def collection_stamp(session) -> str:
     return hashlib.md5(payload).hexdigest()
 
 
+def snapshot_stamp(snapshot) -> str:
+    """Version stamp of a pinned snapshot's admitted world — the same
+    MD5 fold over the snapshot's own (index dir, log id) pin tuple, so
+    a pinned query keys the ledger on ITS read point instead of the
+    live version vector: a concurrent commit must not wipe routing
+    evidence a pinned reader cannot even see (snapshot-stamp
+    discipline, HSL030)."""
+    payload = repr(snapshot.stamp).encode()
+    return hashlib.md5(payload).hexdigest()
+
+
 class RoutingLedger:
     """Per-plan-signature outcome ledger with versioned invalidation.
 

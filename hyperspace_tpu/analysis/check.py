@@ -63,6 +63,23 @@ engine (analysis/program.py → callgraph.py → locks.py):
   ``device.kernel.*`` counters). The inferred trace graph, donation
   proof and per-kernel ladder proofs land in the report's
   ``trace_domains``.
+- **HSL027-030 durability domains** (analysis/duradomain.py) — the
+  crash-consistency invariants over the inferred durability domain (the
+  call-graph closure writing under a declared ``DURABLE_ROOTS`` plane):
+  atomic-publish completeness (every durable write reaches the
+  mkstemp + fsync + ``os.replace`` idiom, generalizing HSL021 beyond
+  lease/fleet paths — sites HSL027 claims are deduplicated out of
+  HSL021), torn-window ordering (every ``TORN_WINDOWS`` exactly-once
+  protocol statically orders its two writes AND arms a
+  ``faults.KNOWN_POINTS`` entry inside the window, so the crash sweeps
+  provably exercise each torn state), replay idempotence (durable file
+  names reachable from ``REPLAY_ROOTS`` recovery/re-poll/takeover
+  paths derive from cursor/log-id/generation values, never wall clock,
+  pid or RNG), and snapshot-stamp discipline (pinned-snapshot contexts
+  never read the live version vector). The inferred durability graph —
+  roots, write sites, window proofs with their in-window fault-point
+  witnesses, replay closures — lands in the report's
+  ``durable_domains``.
 - **Validator corpus** — a small set of known-good / known-bad logical
   plans is pushed through the plan validator (analysis/validator.py) as
   a self-test; skipped (with a note) when numpy isn't installed, so the
@@ -104,6 +121,7 @@ from hyperspace_tpu.analysis.lint import (
     Finding,
     RULES,
 )
+from hyperspace_tpu.analysis.duradomain import DurabilityDomains
 from hyperspace_tpu.analysis.locks import LockGraph, resource_findings
 from hyperspace_tpu.analysis.procdomain import ProcessDomains
 from hyperspace_tpu.analysis.tracedomain import TraceDomains
@@ -700,9 +718,17 @@ def run_check(
     unwind, unwind_proof = unwind_findings(program, callgraph, raises_obj, contracts)
     findings.extend(unwind)
     domains = ProcessDomains(program, callgraph, raises_obj)
-    findings.extend(domains.findings())
     tdomains = TraceDomains(program, callgraph, raises_obj)
+    ddomains = DurabilityDomains(program, callgraph, raises_obj)
+    # HSL021 vs HSL027 dedupe: a lease/fleet write site HSL027 now
+    # checks reports ONCE, under the newer rule — otherwise every
+    # --changed run would double-report the shared sites.
+    findings.extend(
+        f for f in domains.findings()
+        if not (f.rule == "HSL021" and (f.path, f.line) in ddomains.claimed_sites)
+    )
     findings.extend(tdomains.findings())
+    findings.extend(ddomains.findings())
     allowed = []
     kept = []
     for f in findings:
@@ -769,6 +795,20 @@ def run_check(
             # design), so this ratio runs high — the bound pins it from
             # drifting higher, like calls_unresolved_ratio above.
             "trace_domain_unresolved_ratio": tdomains.unresolved_ratio(),
+            # Durability-domain accounting (HSL027-030): same CI
+            # contract — zero roots/sites/windows on the real repo
+            # would mean the registry extraction or write-site
+            # detection silently broke.
+            "durable_roots": len(ddomains.roots or {}),
+            "durable_write_sites": len(ddomains.sites),
+            "durable_domain_functions": len(ddomains.domain_fns),
+            "torn_windows": len(ddomains.windows or {}),
+            "torn_windows_proven": sum(
+                1 for p in ddomains._window_proofs.values() if p["proven"]
+            ),
+            "replay_roots": len(ddomains.replay_roots or {}),
+            "replay_closure_functions": len(ddomains.replay_fns),
+            "durable_domain_unresolved_ratio": ddomains.unresolved_ratio(),
         },
         "validator_corpus": corpus,
         "lock_graph": lockgraph.to_json(),
@@ -783,6 +823,12 @@ def run_check(
         # (entries, traced closure, donation proof, per-kernel fallback
         # ladders) — jitdemo pins its exact shape in a golden.
         "trace_domains": tdomains.to_json(),
+        # The HSL027-030 substrate: the inferred durability-domain
+        # graph (durable roots + write sites, torn-window proofs with
+        # their in-window fault-point witnesses, replay closures,
+        # snapshot carriers) — durademo pins its exact shape in a
+        # golden.
+        "durable_domains": ddomains.to_json(),
         # Informational (never gated): private functions no public entry
         # point reaches through the resolved call graph.
         "dead_symbols": dead,
@@ -796,7 +842,7 @@ def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m hyperspace_tpu.analysis.check",
         description="Unified static analysis: per-file lint (HSL001-HSL008), "
-                    "whole-program rules (HSL009-HSL026), validator corpus, "
+                    "whole-program rules (HSL009-HSL030), validator corpus, "
                     "findings baseline.",
     )
     ap.add_argument("paths", nargs="*", help="files/directories (default: the "

@@ -193,6 +193,18 @@ RULES: dict[str, RuleInfo] = {
         RuleInfo("HSL026", "kernel-fallback-ladder",
                  "Pallas engagement undeclared in ops.KNOWN_KERNELS or missing its exactness gate, permanent fallback, or device.kernel.* counters",
                  scope="program"),
+        RuleInfo("HSL027", "durable-atomic-publish",
+                 "durable write under a DURABLE_ROOTS plane does not reach the mkstemp + fsync + os.replace idiom — crash can surface a torn or zero-length file",
+                 scope="program"),
+        RuleInfo("HSL028", "torn-window-ordering",
+                 "declared TORN_WINDOWS exactly-once protocol: two writes not statically ordered, or no KNOWN_POINTS fault point armed inside the window",
+                 scope="program"),
+        RuleInfo("HSL029", "replay-idempotence",
+                 "durable file name on a REPLAY_ROOTS recovery/re-poll/takeover path derives from wall clock, pid, or RNG instead of cursor/log-id/generation values",
+                 scope="program"),
+        RuleInfo("HSL030", "snapshot-stamp-discipline",
+                 "pinned-snapshot context reads the live version vector (get_latest_id/collection_log_versions/latest_log_id) instead of keying on the snapshot stamp",
+                 scope="program"),
     )
 }
 

@@ -823,6 +823,7 @@ class ProcessDomains:
                         f"+ os.replace, or file_utils.write_json) or claim "
                         f"with O_CREAT|O_EXCL (shared-file protocol, "
                         f"docs/static_analysis.md)",
+                        witness_paths=(mod.path,),
                     ))
         return findings
 
@@ -845,6 +846,14 @@ class ProcessDomains:
             "reap_via": list(reap_via) if reap_via else None,
         })
         if reap_via is None and not _suppressed(mod, node.lineno, SHARED_FILE):
+            # The witness is the acquire's own reachable closure: the
+            # reap this finding says is MISSING would live in one of
+            # those files, so --changed keeps the finding when any
+            # candidate module is edited.
+            witness = tuple(dict.fromkeys(
+                prog.modules[prog.functions[q].module].path
+                for q in sorted(candidates) if q in prog.functions
+            ))
             findings.append(Finding(
                 mod.path, node.lineno, 0, SHARED_FILE,
                 f"O_EXCL lease acquire in {fn.qname} has no reachable "
@@ -852,6 +861,7 @@ class ProcessDomains:
                 f"every later claimant forever; add a reap that judges the "
                 f"creator-written epoch against a TTL and atomically clears "
                 f"the lease (serve/fleet/lease.py is the pattern)",
+                witness_paths=witness,
             ))
 
     @staticmethod

@@ -224,7 +224,13 @@ class ModuleInfo:
 
     @property
     def lines(self) -> list[str]:
-        return self.source.splitlines()
+        # Memoized: the durability sweep reads node segments against
+        # this table for every call expression in the program, and
+        # re-splitting the source each access made that quadratic.
+        got = self.__dict__.get("_lines")
+        if got is None:
+            got = self.__dict__["_lines"] = self.source.splitlines()
+        return got
 
 
 class _FunctionPass(ast.NodeVisitor):

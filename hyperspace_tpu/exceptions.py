@@ -174,7 +174,13 @@ _QUERY_SURFACE = (
 ERROR_CONTRACTS: dict[str, tuple[str, ...]] = {
     "hyperspace_tpu.hyperspace.HyperspaceSession.run": _QUERY_SURFACE,
     "hyperspace_tpu.hyperspace.HyperspaceSession.run_query": _QUERY_SURFACE,
-    "hyperspace_tpu.serve.scheduler.QueryServer.submit": ("AdmissionRejected",),
+    # submit emits admission telemetry; the journal's seal path arms the
+    # journal.seal fault point (HSL028 torn window), so a simulated hard
+    # death there escapes untouched — and stats.increment's KeyError is
+    # the declared-counter-registry programming-error surface.
+    "hyperspace_tpu.serve.scheduler.QueryServer.submit": (
+        "AdmissionRejected", "CrashPoint", "KeyError",
+    ),
     "hyperspace_tpu.serve.scheduler.QueryHandle.result": (
         "QueryTimeout", "HyperspaceError", "OSError", "CrashPoint",
     ),
@@ -222,7 +228,11 @@ ERROR_CONTRACTS: dict[str, tuple[str, ...]] = {
     # passes through it (the scheduler's contracts cover those).
     # (KeyError is the declared-registry surface: stats.increment raises
     # it for an undeclared counter name — a programming error.)
-    "hyperspace_tpu.serve.fleet.quota.TenantQuotas.admit": ("QuotaExceeded",),
+    # Rejections emit telemetry, so the journal.seal crash surface (and
+    # the counter-registry KeyError) rides along with the typed verdict.
+    "hyperspace_tpu.serve.fleet.quota.TenantQuotas.admit": (
+        "QuotaExceeded", "CrashPoint", "KeyError",
+    ),
     "hyperspace_tpu.serve.fleet.singleflight.SingleFlight.run": (
         "OSError", "CrashPoint", "KeyError",
     ),

@@ -88,6 +88,16 @@ Fault point names in use (see each call site):
                       most the Action protocol's transient log)
 ``ingest.compact``    ingest/writer.py, before the gated optimize action
                       compacts delta buckets
+``ingest.stamp``      ingest/daemon.py, after a micro-batch commits but
+                      BEFORE the daemon stamps its lag/commit bookkeeping
+                      (the commit-before-stamp torn window HSL028 proves)
+``journal.seal``      obs/journal.py, after a sealed segment publishes but
+                      BEFORE the eviction index runs (the
+                      seal-before-index torn window HSL028 proves)
+``controller.heal.marker`` serve/controller.py, after the leader heals the
+                      shared bytes but BEFORE the generation marker
+                      publishes (the marker-after-heal torn window
+                      HSL028 proves)
 ====================  =====================================================
 
 Cross-process injection: the pooled build's workers are SPAWNED
@@ -142,6 +152,9 @@ KNOWN_POINTS = (
     "ingest.tail",
     "ingest.commit",
     "ingest.compact",
+    "ingest.stamp",
+    "journal.seal",
+    "controller.heal.marker",
 )
 
 

@@ -320,7 +320,14 @@ class HyperspaceSession:
             from hyperspace_tpu.advisor import routing as adv_routing
 
             ledger = self.routing_ledger()
-            routing_stamp = adv_routing.collection_stamp(self)
+            # A pinned query keys the ledger on its OWN read point —
+            # the live stamp moves under concurrent commits the pinned
+            # view cannot see, and a moved stamp WIPES the ledger.
+            routing_stamp = (
+                adv_routing.snapshot_stamp(snapshot)
+                if snapshot is not None
+                else adv_routing.collection_stamp(self)
+            )
             if self._enabled:
                 routed = ledger.decide(sig, stamp=routing_stamp)
                 if routed == "raw":

@@ -290,6 +290,11 @@ class IngestDaemon:
             self._check_lag(conf, name, now)
             new_id = ingest_writer.commit_micro_batch(self.hyperspace, name)
             if new_id is not None:
+                # Torn window: the micro-batch committed (log entry is
+                # durable) but the daemon's lag/commit bookkeeping is
+                # not yet stamped. A crash here is converged by
+                # recover(); the next tick restamps from the log.
+                faults.fault_point("ingest.stamp", name)
                 self._commits += 1
                 self._last_commit_id[name] = new_id
                 since = self._pending_since.pop(name, now)

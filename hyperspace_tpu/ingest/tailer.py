@@ -148,7 +148,16 @@ class CdcTailer:
         os.close(fd)
         try:
             pq.write_table(table, tmp)
+            # fsync BEFORE the rename: os.replace makes the NAME durable
+            # independently of the data, so without the barrier a crash
+            # can surface a zero-length cdc- file the planner then lists.
+            rfd = os.open(tmp, os.O_RDONLY)
+            try:
+                os.fsync(rfd)
+            finally:
+                os.close(rfd)
             os.replace(tmp, path)  # atomic publish: no torn file is ever listed
+            file_utils.fsync_dir(path.parent)
         finally:
             if os.path.exists(tmp):
                 os.unlink(tmp)

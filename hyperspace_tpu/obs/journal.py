@@ -63,6 +63,7 @@ import threading
 import time
 from pathlib import Path
 
+from hyperspace_tpu import faults
 from hyperspace_tpu.obs import metrics as _metrics
 
 # Import-time counter handles (the scheduler idiom): `.inc()` never
@@ -356,6 +357,11 @@ def _seal_locked() -> None:
         final = d / f"{SEGMENT_PREFIX}{_next_seg:08d}{SEGMENT_SUFFIX}"
         os.replace(_fh_path, final)
         _fsync_dir(d)
+        # Torn window: segment sealed, eviction index not yet run. A
+        # crash here leaves an extra sealed segment on disk; the next
+        # seal's sweep re-lists and evicts it (CrashPoint is a
+        # BaseException, so the except OSError below never eats it).
+        faults.fault_point("journal.seal", final)
         _next_seg += 1
         _SEALED.inc()
         _evict_locked(d)

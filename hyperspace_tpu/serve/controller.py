@@ -132,11 +132,13 @@ import collections
 import json
 import os
 import shutil
+import tempfile
 import threading
 import time
 from pathlib import Path
 
 from hyperspace_tpu import faults, stats
+from hyperspace_tpu.utils import file_utils
 from hyperspace_tpu.obs import events as obs_events
 from hyperspace_tpu.obs import metrics as obs_metrics
 from hyperspace_tpu.obs import slo as obs_slo
@@ -658,6 +660,10 @@ class OpsController:
 
         def build():
             self._heal_local(conf, name)
+            # Torn window: shared bytes healed, marker not yet
+            # published. A crash here leaves followers quarantined for
+            # one tick; the next leader re-heals idempotently.
+            faults.fault_point("controller.heal.marker", marker)
             prior = self._read_marker(marker) or {}
             gen = int(prior.get("generation", 0)) + 1
             self._write_marker(marker, {
@@ -715,11 +721,22 @@ class OpsController:
 
     @staticmethod
     def _write_marker(path: Path, doc: dict) -> None:
-        # Tmp + rename so a follower's read never sees a torn document;
+        # mkstemp + fsync + rename so a follower's read never sees a
+        # torn document AND a crash never publishes an empty marker (the
+        # rename is durable before the data without the fsync barrier);
         # writer races are excluded by the single-flight lease.
-        tmp = path.with_suffix(".tmp")
-        tmp.write_text(json.dumps(doc))
-        os.replace(tmp, path)
+        fd, tmp = tempfile.mkstemp(dir=str(path.parent), prefix=".heal-",
+                                   suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(json.dumps(doc))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            file_utils.fsync_dir(path.parent)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
 
     def _reconcile_scale(self, conf, now: float) -> None:
         """Fleet-scale hysteresis: count saturated vs calm ticks from

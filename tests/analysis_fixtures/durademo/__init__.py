@@ -1,0 +1,27 @@
+"""durademo: durability-domain fixture package (duradomain.py, HSL027-030).
+
+A miniature durable plane exercising every shape the durability-domain
+inference handles — registry extraction (the package declares its own
+``DURABLE_ROOTS``/``TORN_WINDOWS``/``REPLAY_ROOTS``/``KNOWN_POINTS``
+literals), direct and delegated write sites with witness chains,
+``self.<attr>`` path widening, torn-window proofs with in-window fault
+points, the replay closure, and the pinned-snapshot carrier walk —
+with exactly four planted violations, one per rule:
+
+- HSL027: ``store.publish_fast`` renames the ledger into place with no
+  fsync before the publish; ``publish_atomic``/``save_ledger`` are the
+  proven direct and delegated counterparts.
+- HSL028: ``tailer.Tailer.commit`` orders its two writes but arms the
+  ``durademo.stamp`` point only AFTER the window, so the crash sweep
+  can never kill inside the torn state; ``Tailer.poll`` is the proven
+  window (point strictly between batch publish and cursor save).
+- HSL029: ``tailer.Tailer._write_batch`` names its batch file from
+  ``time.time()`` on the declared ``poll`` replay path; ``_save_cursor``
+  writes a replay-stable name.
+- HSL030: ``control._live_floor`` reads the live version vector one
+  hop below the pinned carrier ``Planner.resolve``; ``plan_key`` (the
+  snapshot-dispatch split) and ``decide`` (default-fill) are clean.
+
+Like every analysis fixture, this package is parsed by the engine and
+never imported — ``faultsim.py`` stands in for the fault harness.
+"""

@@ -1,0 +1,28 @@
+"""Pinned-snapshot carriers (HSL030): the planted live read hides one
+hop below the carrier, and both sanctioned shapes — the
+snapshot-dispatch conditional and the default-fill idiom — stay
+clean."""
+
+
+def _live_floor(session):
+    # Planted HSL030 target: reached unguarded from Planner.resolve.
+    return session.get_latest_id()
+
+
+class Planner:
+    def resolve(self, session, snapshot):
+        return _live_floor(session)
+
+    def plan_key(self, session, snapshot):
+        # Clean: dispatching on the snapshot parameter IS the
+        # sanctioned pinned-vs-live split.
+        if snapshot is not None:
+            return snapshot.stamp
+        else:
+            return session.latest_log_id
+
+    def decide(self, session, snapshot, stamp=None):
+        # Clean: default-fill — a pinned caller passes the
+        # snapshot-derived stamp; the live read only fills an absence.
+        stamp = _live_floor(session) if stamp is None else stamp
+        return stamp

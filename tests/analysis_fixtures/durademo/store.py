@@ -1,0 +1,38 @@
+"""The durable planes and the sanctioned publish idiom.
+
+``DURABLE_ROOTS`` is the fixture's registry — the engine AST-extracts
+the literal from any scanned module, the same way procdemo declares its
+own ``SPAWN_ENTRY_POINTS``."""
+
+import os
+import tempfile
+
+DURABLE_ROOTS = {
+    "ledger": "the demo ledger (atomic JSON, the 2-phase anchor)",
+    "batches": "seq-named delta batches the tailer republishes",
+    "cursor": "the tail cursor the batches commit ahead of",
+}
+
+
+def publish_json(path, doc):
+    """The proven idiom: payload fsync strictly before the rename."""
+    fd, tmp = tempfile.mkstemp()
+    with os.fdopen(fd, "w") as f:
+        f.write(doc)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def save_ledger(state_dir, doc):
+    # Delegated clean site: the chain down to publish_json proves it.
+    publish_json(state_dir + "/ledger.json", doc)
+
+
+def publish_fast(state_dir, doc):
+    # Planted HSL027: rename with no fsync — the new name can be
+    # durable before its bytes are.
+    tmp = state_dir + "/.partial"
+    with open(tmp, "w") as f:
+        f.write(doc)
+    os.replace(tmp, state_dir + "/ledger.json")

@@ -1,0 +1,61 @@
+"""The replayed tail loop: the two declared torn windows and the
+replay root. ``poll`` is the proven window (ordered writes, in-window
+point); ``commit`` is the planted HSL028 (point armed after the
+window); ``_write_batch`` is the planted HSL029 (wall-clock batch name
+on the replay path)."""
+
+import time
+
+from durademo import faultsim
+from durademo.store import publish_json
+
+TORN_WINDOWS = {
+    "durademo.batch_before_cursor": (
+        "durademo.tailer.Tailer.poll",
+        "_write_batch", "_save_cursor", "durademo.tail",
+        "the batch must land before the cursor advances; the re-poll "
+        "rewrites the same seq-named file"),
+    "durademo.commit_before_stamp": (
+        "durademo.tailer.Tailer.commit",
+        "_append_log", "_stamp", "durademo.stamp",
+        "the commit must land before the bookkeeping stamp"),
+}
+
+REPLAY_ROOTS = {
+    "durademo.tailer.Tailer.poll":
+        "re-poll after a crash must rewrite the same batch paths",
+}
+
+
+class Tailer:
+    def __init__(self, state_dir):
+        self.state_dir = state_dir
+        self.seq = 0
+
+    def poll(self, rows):
+        self._write_batch(rows)
+        faultsim.fault_point("durademo.tail")
+        self._save_cursor()
+
+    def _write_batch(self, rows):
+        # Planted HSL029: the batch name derives from the wall clock —
+        # a re-poll writes a DIFFERENT path and orphans this one.
+        name = self.state_dir + "/batches/" + str(time.time())
+        publish_json(name, repr(rows))
+
+    def _save_cursor(self):
+        # Clean counterpart: a fixed, replay-stable name.
+        publish_json(self.state_dir + "/cursor.json", str(self.seq))
+
+    def commit(self, rows):
+        # Planted HSL028: the point arms only AFTER the stamp — the
+        # sweep can never kill inside the window.
+        self._append_log(rows)
+        self._stamp()
+        faultsim.fault_point("durademo.stamp")
+
+    def _append_log(self, rows):
+        return len(rows)
+
+    def _stamp(self):
+        self.seq = self.seq + 1

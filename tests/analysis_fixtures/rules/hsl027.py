@@ -1,0 +1,40 @@
+"""HSL027 atomic-publish completeness corpus.
+
+The file declares its own ``DURABLE_ROOTS`` plane (the engine
+AST-extracts the literal, so the rule arms without the real registry):
+every write whose call text names the ``ledger`` root owes the
+fsync-before-replace idiom — directly, or through a delegation chain
+that proves it. One fsync-less publish is planted next to the proven
+counterpart and a delegated-clean site.
+"""
+
+import os
+import tempfile
+
+DURABLE_ROOTS = {
+    "ledger": "the corpus ledger plane (atomic JSON)",
+}
+
+
+def publish_fast(state_dir, doc):
+    tmp = state_dir + "/.partial"
+    with open(tmp, "w") as f:
+        f.write(doc)
+    os.replace(tmp, state_dir + "/ledger.json")  # expect: HSL027
+
+
+def publish_atomic(state_dir, doc):
+    # Clean counterpart: payload fsync strictly before the rename — a
+    # crash can surface the old ledger or the new one, never a torn one.
+    fd, tmp = tempfile.mkstemp(dir=state_dir)
+    with os.fdopen(fd, "w") as f:
+        f.write(doc)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, state_dir + "/ledger.json")
+
+
+def save(state_dir, doc):
+    # Delegated clean site: the chain down to publish_atomic proves the
+    # idiom, so the caller owes nothing at this line.
+    publish_atomic(state_dir + "/ledger", doc)

@@ -1,0 +1,50 @@
+"""HSL028 torn-window ordering corpus.
+
+``TORN_WINDOWS`` declares two exactly-once protocols over this file's
+own functions (the engine AST-extracts the literal, and the file's
+``KNOWN_POINTS`` tuple stands in for the real fault registry).
+``commit`` arms the in-window fault point strictly between the two
+writes — proven. ``commit_unarmed`` orders the writes but arms its
+point only AFTER the second write, so the crash sweep can never kill
+inside the torn state — the window is unproven.
+"""
+
+from hyperspace_tpu import faults
+
+KNOWN_POINTS = ("ingest.tail", "ingest.stamp")
+
+TORN_WINDOWS = {
+    "corpus.batch_before_cursor": (
+        "hsl028.commit", "write_batch", "save_cursor", "ingest.tail",
+        "the batch must land before the cursor advances"),
+    "corpus.commit_before_stamp": (
+        "hsl028.commit_unarmed", "write_batch", "save_cursor", "ingest.stamp",
+        "the commit must land before the bookkeeping stamp"),
+}
+
+
+def write_batch(rows):
+    return list(rows)
+
+
+def save_cursor(seq):
+    return seq
+
+
+def commit(rows, seq):
+    write_batch(rows)
+    faults.fault_point("ingest.tail")
+    return save_cursor(seq)
+
+
+def commit_unarmed(rows, seq):  # expect: HSL028
+    write_batch(rows)
+    save_cursor(seq)
+    faults.fault_point("ingest.stamp")
+
+
+def recover(rows, seq):
+    # The unwind root (HSL018): both committers are reachable from a
+    # recovery construct, so the corpus stays single-rule.
+    commit(rows, seq)
+    commit_unarmed(rows, seq)

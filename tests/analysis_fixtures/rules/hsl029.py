@@ -1,0 +1,48 @@
+"""HSL029 replay-idempotence corpus.
+
+``repoll`` is a declared replay root: every durable file name written
+in its call-graph closure must derive from cursor/seq/generation
+values, so a re-poll after a crash rewrites the SAME path.
+``_write_wallclock`` names its batch from ``time.time()`` — a replay
+would write a different path and orphan the first file.
+"""
+
+import os
+import tempfile
+import time
+
+DURABLE_ROOTS = {
+    "batches": "seq-named batch files the tailer republishes on re-poll",
+}
+
+REPLAY_ROOTS = {
+    "hsl029.repoll": "re-poll after a crash must rewrite the same batch",
+}
+
+
+def _publish(path, doc):
+    # The atomic idiom — both writers below delegate here, so HSL027
+    # stays quiet and only the naming discipline is under test.
+    fd, tmp = tempfile.mkstemp()
+    with os.fdopen(fd, "w") as f:
+        f.write(doc)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _write_wallclock(state_dir, rows):
+    name = state_dir + "/batches/" + str(time.time())
+    _publish(name, repr(rows))  # expect: HSL029
+
+
+def _write_seq(state_dir, rows, seq):
+    # Clean counterpart: the name derives from the cursor seq — the
+    # replay rewrites the same file.
+    name = state_dir + "/batches/" + str(seq)
+    _publish(name, repr(rows))
+
+
+def repoll(state_dir, rows, seq):
+    _write_wallclock(state_dir, rows)
+    _write_seq(state_dir, rows, seq)

@@ -1,0 +1,34 @@
+"""HSL030 snapshot-stamp discipline corpus.
+
+A ``snapshot`` parameter marks the pinned context: the carrier and its
+unguarded call closure must never read the live version vector. The
+planted read hides one hop below the carrier — only the closure walk
+sees it. The clean counterparts show both sanctioned shapes: a
+conditional dispatching on the snapshot parameter (both branches
+deliberate) and the default-fill idiom (the live read only fills an
+ABSENT argument).
+"""
+
+
+def _live_floor(session):
+    return session.get_latest_id()  # expect: HSL030
+
+
+def plan_key(session, snapshot):
+    return _live_floor(session)
+
+
+def plan_key_pinned(session, snapshot):
+    # Clean: dispatching on the snapshot parameter IS the sanctioned
+    # pinned-vs-live split.
+    if snapshot is not None:
+        return snapshot.stamp
+    else:
+        return session.latest_log_id
+
+
+def decide(session, snapshot, stamp=None):
+    # Clean: default-fill — a pinned caller passes the snapshot-derived
+    # stamp; the live read only runs when the argument is absent.
+    stamp = _live_floor(session) if stamp is None else stamp
+    return stamp

@@ -281,6 +281,46 @@ class TestRouting:
         led.record(sig, "indexed", 10.0)
         assert "Adaptive routing: raw" in hs.explain(q)
 
+    def test_pinned_reader_keys_ledger_on_snapshot_stamp(self, session, hs,
+                                                         tmp_path):
+        """Snapshot-stamp discipline (HSL030 regression): a pinned query
+        keys the routing ledger on the snapshot's OWN read point. A
+        concurrent commit moves the LIVE collection stamp — which wipes
+        the ledger for live readers — but must not wipe (or be wiped
+        by) evidence recorded under a pinned view that cannot even see
+        the commit."""
+        from hyperspace_tpu.advisor.routing import (
+            collection_stamp,
+            snapshot_stamp,
+        )
+
+        q = self._setup(session, hs, tmp_path)
+        led = session.routing_ledger()
+        session.enable_hyperspace()
+        with session.pin_snapshot() as snap:
+            pinned = snapshot_stamp(snap)
+            assert pinned == collection_stamp(session)  # same world at pin
+            # A pinned run keys the ledger on the PINNED plan's
+            # signature (run_query pins the plan before signing it).
+            sig = plan_signature(snap.pin_plan(q))
+            # both paths measured under the pinned key: demoted
+            led.record(sig, "raw", 0.01, stamp=pinned)
+            led.record(sig, "indexed", 10.0, stamp=pinned)
+            assert led.decide(sig, stamp=pinned) == "raw"
+            # a concurrent commit moves the live stamp under the reader …
+            hs.refresh_index("kidx")
+            assert collection_stamp(session) != pinned
+            assert snapshot_stamp(snap) == pinned  # the pin does not move
+            # … but the pinned run still routes on its own evidence
+            session.run(q, snapshot=snap)
+            st = dict(session.last_query_stats)
+            assert st["advisor_routing"] == {"decision": "raw", "demoted": True}
+            assert led.decide(sig, stamp=pinned) == "raw"  # and kept it
+        # a LIVE reader sees the moved stamp: structural re-promotion
+        session.run(q)
+        st = dict(session.last_query_stats)
+        assert st["advisor_routing"]["decision"] == "indexed"
+
     def test_underscore_dirs_invisible_to_catalog(self, session, hs, tmp_path):
         """The ledger dir lives under the system path but must never be
         listed as an index (or lazy recovery would poke at it forever)."""

@@ -109,7 +109,7 @@ class TestLintBehaviors:
     def test_rules_registry_covers_all_ids(self):
         from hyperspace_tpu.analysis.lint import RULES
 
-        assert sorted(RULES) == [f"HSL{i:03d}" for i in range(27)]
+        assert sorted(RULES) == [f"HSL{i:03d}" for i in range(31)]
         assert RULES["HSL009"].scope == "program"
         assert RULES["HSL013"].scope == "program"
         assert RULES["HSL016"].scope == "program"

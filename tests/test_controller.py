@@ -14,6 +14,7 @@ import urllib.request
 import pytest
 
 from hyperspace_tpu import faults, stats
+from hyperspace_tpu.analysis.duradomain import TORN_WINDOWS
 from hyperspace_tpu.config import HyperspaceConf
 from hyperspace_tpu.faults import CrashPoint
 from hyperspace_tpu.obs import events, metrics, slo
@@ -530,6 +531,77 @@ def test_sigkilled_healer_lease_is_reaped_and_taken_over(tmp_path):
     takeover = [e for e in events.recent()
                 if e["name"] == "fleet.singleflight.takeover"]
     assert takeover and takeover[0]["fields"]["key"] == "heal.shared"
+
+
+def test_write_marker_publishes_atomically_or_not_at_all(tmp_path):
+    """Atomic-publish completeness (HSL027 regression): a marker write
+    that dies before the rename leaves NO marker and no tmp litter — a
+    follower can never read a torn or empty heal document."""
+    import os as _os
+
+    heal_dir = tmp_path / "heal"
+    heal_dir.mkdir()
+    marker = heal_dir / "shared.json"
+
+    def boom(fd):
+        raise OSError("disk on fire")
+
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setattr(_os, "fsync", boom)
+        with pytest.raises(OSError):
+            OpsController._write_marker(marker, {"index": "shared",
+                                                 "generation": 1})
+    assert not marker.exists()
+    assert list(heal_dir.iterdir()) == []  # the torn tmp was reclaimed
+    OpsController._write_marker(marker, {"index": "shared", "generation": 1})
+    assert json.loads(marker.read_text())["generation"] == 1
+    assert [p.name for p in heal_dir.iterdir()] == ["shared.json"]
+
+
+def _drive_marker_after_heal(tmp_path, point):
+    """Kill between the shared-bytes heal and the generation-marker
+    publish: the bytes are healed, no marker exists, and the next
+    member to see the quarantine leads a full idempotent re-heal."""
+    _serve_counters()
+    hs_a, ctrl_a = _fleet_controller(tmp_path, "member-a")
+    with hs_a.session._state_lock:
+        hs_a.session.index_health["/idx/shared"] = {"reason": "torn"}
+    faults.inject(point, crash=True, at_call=1)
+    try:
+        with pytest.raises(CrashPoint):
+            ctrl_a.step(now=0.0)
+    finally:
+        faults.reset()
+    # First half of the window held: the leader healed the shared bytes
+    # (recover + gated rebuild ran, its local quarantine lifted) …
+    assert hs_a.calls == [("recover", "shared"), ("refresh", "shared", "full")]
+    # … and the second half never ran: no marker was published.
+    marker = tmp_path / "_fleet" / "heal" / "shared.json"
+    assert not marker.exists()
+    # Convergence: a surviving member still quarantined sees NO fresh
+    # marker, so it leads its own heal — recover() is idempotent over
+    # the already-healed bytes — and publishes generation 1.
+    hs_b, ctrl_b = _fleet_controller(tmp_path, "member-b")
+    with hs_b.session._state_lock:
+        hs_b.session.index_health["/idx/shared"] = {"reason": "torn"}
+    ctrl_b.step(now=0.0)
+    assert hs_b.calls == [("recover", "shared"), ("refresh", "shared", "full")]
+    assert hs_b.session.index_health == {}
+    doc = json.loads(marker.read_text())
+    assert doc["member"] == "member-b" and doc["generation"] == 1
+
+
+@pytest.mark.parametrize(
+    "window", sorted(k for k in TORN_WINDOWS if k.startswith("controller."))
+)
+def test_kill_inside_torn_window_converges(window, tmp_path):
+    """Driven BY NAME from `analysis.duradomain.TORN_WINDOWS`: a
+    controller window added to the registry without a driver here fails
+    with a KeyError, so the crash sweep tracks the proven protocols."""
+    drivers = {"controller.marker_after_heal": _drive_marker_after_heal}
+    _fn, _first, _second, point, why = TORN_WINDOWS[window]
+    assert point in faults.KNOWN_POINTS, why
+    drivers[window](tmp_path, point)
 
 
 def test_restarted_member_observes_stale_marker_once_then_heals(tmp_path):

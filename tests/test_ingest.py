@@ -42,6 +42,7 @@ from hyperspace_tpu import (
     faults,
     stats,
 )
+from hyperspace_tpu.analysis.duradomain import TORN_WINDOWS
 from hyperspace_tpu.config import HyperspaceConf
 from hyperspace_tpu.exceptions import HyperspaceError
 from hyperspace_tpu.faults import CrashPoint
@@ -232,6 +233,38 @@ class TestTailer:
         assert t.poll(100) == 1  # the completed tail line, exactly once
         assert t.poll(100) == 0
 
+    def test_batch_publish_fsyncs_data_before_the_rename(self, tmp_path,
+                                                         monkeypatch):
+        """Atomic-publish completeness (HSL027 regression): the batch
+        bytes are fsynced before os.replace, so a crash can never make
+        a zero-length cdc- file's NAME durable ahead of its data."""
+        from hyperspace_tpu.ingest.tailer import CdcTailer
+
+        calls = []
+        real_fsync, real_replace = os.fsync, os.replace
+
+        def spy_fsync(fd):
+            calls.append("fsync")
+            return real_fsync(fd)
+
+        def spy_replace(src, dst):
+            calls.append(("replace", os.path.basename(str(dst))))
+            return real_replace(src, dst)
+
+        monkeypatch.setattr(os, "fsync", spy_fsync)
+        monkeypatch.setattr(os, "replace", spy_replace)
+        dest = tmp_path / "dest"
+        dest.mkdir()
+        log = tmp_path / "c.jsonl"
+        _append_changelog(log, 0, 6)
+        t = CdcTailer(log, dest, Cursor(tmp_path / "cur.json"))
+        assert t.poll(100) == 6
+        publish = next(
+            i for i, c in enumerate(calls)
+            if isinstance(c, tuple) and c[1].startswith("cdc-")
+        )
+        assert "fsync" in calls[:publish], calls
+
     def test_crash_between_batch_and_cursor_is_idempotent(self, tmp_path):
         """ingest.tail fires after the batch file publishes, before the
         cursor saves — the canonical torn window. The re-poll must
@@ -356,6 +389,80 @@ class TestCrashSweep:
         assert stats.get("ingest.compactions") >= 1
         assert ingest_writer.delta_count(session, "idx1") <= 1
         _query_matches(session, source)
+
+
+# ---------------------------------------------------------------------------
+# Torn-window sweeps, driven BY NAME from the static registry
+# ---------------------------------------------------------------------------
+
+
+def _drive_batch_before_cursor(tmp_path_factory, point):
+    """Kill between the CDC batch publish and the cursor save: the
+    batch must be whole on disk, the cursor must not have advanced, and
+    the re-poll must rewrite the SAME seq-named file."""
+    from hyperspace_tpu.ingest.tailer import CdcTailer
+
+    tmp = tmp_path_factory.mktemp("torn_tail")
+    dest = tmp / "dest"
+    dest.mkdir()
+    log = tmp / "c.jsonl"
+    _append_changelog(log, 0, 6)
+    t = CdcTailer(log, dest, Cursor(tmp / "cur.json"))
+    faults.inject(point, crash=True, at_call=1)
+    with pytest.raises(CrashPoint):
+        t.poll(100)
+    faults.reset()
+    # First half of the window held: the batch published whole …
+    (batch,) = sorted(dest.glob("cdc-*.parquet"))
+    # … and the second half never ran: no cursor was published.
+    assert not (tmp / "cur.json").exists()
+    assert t.poll(100) == 6  # replay from the unadvanced cursor
+    assert sorted(dest.glob("cdc-*.parquet")) == [batch]  # rewritten
+    table = pq.read_table(batch)
+    assert sorted(table.column("id").to_pylist()) == list(range(6))
+    assert t.poll(100) == 0
+
+
+def _drive_commit_before_lag_stamp(tmp_path_factory, point):
+    """Kill between the micro-batch commit and the daemon's lag/commit
+    stamp: the commit is durable, the bookkeeping is torn, recover()
+    converges, and the disarmed drain restamps."""
+    tmp = tmp_path_factory.mktemp("torn_stamp")
+    source, session, hs, daemon, changelog = _setup(tmp)
+    faults.inject(point, crash=True, at_call=1)
+    with pytest.raises(CrashPoint):
+        daemon.tick()
+    faults.reset()
+    # The commit landed but the stamp never did — the torn state the
+    # window declares.
+    assert daemon.snapshot()["last_commit_ids"] == {}
+    _assert_converges(tmp, source, session, hs, daemon, point, range(40 + 24))
+    # The stamp is advisory bookkeeping: the next COMMITTING tick
+    # restamps it from the log.
+    _append_changelog(changelog, 64, 4)
+    daemon.tick()
+    assert daemon.snapshot()["last_commit_ids"].get("idx1", 0) >= 1
+
+
+_TORN_WINDOW_DRIVERS = {
+    "ingest.cdc.batch_before_cursor": _drive_batch_before_cursor,
+    "ingest.commit_before_lag_stamp": _drive_commit_before_lag_stamp,
+}
+
+
+class TestTornWindowSweep:
+    """Parametrized over the NAMES in `analysis.duradomain.TORN_WINDOWS`:
+    an ingest window added to the registry without a driver here fails
+    with a KeyError, so the crash sweep can never silently drift from
+    the statically proven protocol set."""
+
+    @pytest.mark.parametrize(
+        "window", sorted(k for k in TORN_WINDOWS if k.startswith("ingest."))
+    )
+    def test_kill_inside_window_converges(self, window, tmp_path_factory):
+        _fn, _first, _second, point, why = TORN_WINDOWS[window]
+        assert point in faults.KNOWN_POINTS, why
+        _TORN_WINDOW_DRIVERS[window](tmp_path_factory, point)
 
 
 # ---------------------------------------------------------------------------

@@ -13,7 +13,11 @@ import subprocess
 import sys
 import time
 
-from hyperspace_tpu import stats
+import pytest
+
+from hyperspace_tpu import faults, stats
+from hyperspace_tpu.analysis.duradomain import TORN_WINDOWS
+from hyperspace_tpu.faults import CrashPoint
 from hyperspace_tpu.obs import events, journal, metrics, slo, trace
 from hyperspace_tpu.obs import export as obs_export
 
@@ -290,3 +294,58 @@ def test_fleet_chrome_lanes_are_pid_qualified(tmp_path):
     assert {m["args"]["name"] for m in names} == {
         "member pid 101", "member pid 202"
     }
+
+
+# -- torn-window sweep, driven BY NAME from the static registry --------------
+
+
+def _drive_seal_before_index(tmp_path, point):
+    """Kill between the segment publish (replace + dir fsync) and the
+    eviction/bookkeeping index: the sealed segment must be whole, the
+    bookkeeping must be untouched, and a restarted journaler re-scans
+    the directory and indexes PAST the orphan instead of over it."""
+    root = _enable(tmp_path)
+    journal.record("event", event={"name": "torn", "seq": 0})
+    sealed_before = stats.get("obs.journal.segments_sealed")
+    faults.inject(point, crash=True, at_call=1)
+    try:
+        with pytest.raises(CrashPoint):
+            journal.seal()
+    finally:
+        faults.reset()
+    # First half of the window held: the segment published whole …
+    (seg,) = journal.segment_paths(_my_dir(root))
+    seqs = [r["event"]["seq"] for r in journal.read_segment(seg)
+            if r["kind"] == "event" and r["event"].get("name") == "torn"]
+    assert seqs == [0]
+    # … and the second half never ran: no seal counted, no eviction.
+    assert stats.get("obs.journal.segments_sealed") == sealed_before
+    # A real kill takes the process; model the restart with the
+    # journal's own reset (fresh segment cursor -> directory re-scan).
+    journal.reset()
+    _enable(tmp_path)
+    journal.record("event", event={"name": "torn", "seq": 1})
+    journal.seal()
+    segs = journal.segment_paths(_my_dir(root))
+    assert len(segs) == 2  # the orphan was indexed past, not overwritten
+    merged = [r["event"]["seq"] for r in journal.merge_dir(root)
+              if r["kind"] == "event" and r["event"].get("name") == "torn"]
+    assert merged == [0, 1]
+    assert journal.sweep(root) == []  # sealed segments are never swept
+
+
+_TORN_WINDOW_DRIVERS = {
+    "journal.seal_before_index": _drive_seal_before_index,
+}
+
+
+@pytest.mark.parametrize(
+    "window", sorted(k for k in TORN_WINDOWS if k.startswith("journal."))
+)
+def test_kill_inside_window_converges(window, tmp_path):
+    """A journal window added to `analysis.duradomain.TORN_WINDOWS`
+    without a driver here fails with a KeyError — the crash sweep can
+    never silently drift from the statically proven protocol set."""
+    _fn, _first, _second, point, why = TORN_WINDOWS[window]
+    assert point in faults.KNOWN_POINTS, why
+    _TORN_WINDOW_DRIVERS[window](tmp_path, point)
